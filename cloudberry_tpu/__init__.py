@@ -1,0 +1,31 @@
+"""cloudberry_tpu — a TPU-native MPP analytical SQL framework.
+
+A ground-up re-design of the capabilities of Apache Cloudberry (the
+Greenplum-lineage MPP PostgreSQL fork; see SURVEY.md) for TPU hardware:
+
+- the per-segment executor (reference: ``src/backend/executor``) is a set of
+  JAX/XLA kernels over Arrow-style columnar device buffers with static shapes;
+- the Motion/interconnect shuffle (reference: ``src/backend/cdb/motion``,
+  ``contrib/interconnect``) is expressed as ``jax.lax`` collectives
+  (``all_to_all`` / ``all_gather`` / ``ppermute``) over an ICI device mesh;
+- the locus model (reference: ``src/include/cdb/cdbpathlocus.h:41-68``) is a
+  first-class ``Sharding`` annotation on every plan node, driving motion
+  insertion exactly like ``cdbpath_motion_for_join``;
+- storage is immutable columnar micro-partitions with footer stats
+  (modeled on ``contrib/pax_storage``), not heap/WAL pages.
+
+Everything under ``jit`` is traced once: no data-dependent Python control
+flow, static shapes with selection masks, ``lax`` control flow only.
+"""
+
+import jax
+
+# 64-bit support: analytical SQL needs int64 keys and f64 aggregates.
+# On TPU, f64 is emulated — hot kernels downcast per Config.exec.compute_dtype.
+jax.config.update("jax_enable_x64", True)
+
+from cloudberry_tpu.config import Config, get_config, set_config  # noqa: E402
+from cloudberry_tpu.session import Session  # noqa: E402
+
+__version__ = "0.1.0"
+__all__ = ["Config", "get_config", "set_config", "Session", "__version__"]

@@ -1,0 +1,3 @@
+from cloudberry_tpu.catalog.catalog import Catalog, Table, DistributionPolicy
+
+__all__ = ["Catalog", "Table", "DistributionPolicy"]

@@ -1,0 +1,116 @@
+"""Catalog — tables, schemas, distribution policies.
+
+The MPP catalog analog: the reference records how every table is spread over
+segments in ``gp_distribution_policy`` (hash keys / randomly / replicated)
+and the cluster layout in ``gp_segment_configuration`` (SURVEY.md §2.1
+"Catalog extensions"). Here a ``DistributionPolicy`` hangs off each table and
+drives the planner's locus assignment; placement uses the same
+jump-consistent-hash discipline as cdbhash.c:55 so elastic resize moves
+minimal data.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Literal, Optional
+
+import numpy as np
+
+from cloudberry_tpu.columnar.dictionary import StringDictionary
+from cloudberry_tpu.types import Schema
+from cloudberry_tpu.utils import hashing
+
+
+@dataclass(frozen=True)
+class DistributionPolicy:
+    kind: Literal["hashed", "random", "replicated"]
+    keys: tuple[str, ...] = ()
+
+    @staticmethod
+    def hashed(*keys: str) -> "DistributionPolicy":
+        return DistributionPolicy("hashed", tuple(keys))
+
+    @staticmethod
+    def replicated() -> "DistributionPolicy":
+        return DistributionPolicy("replicated")
+
+    @staticmethod
+    def random() -> "DistributionPolicy":
+        return DistributionPolicy("random")
+
+
+@dataclass
+class TableStats:
+    row_count: int = 0
+    # per-column (min, max) over numeric/date columns — scan pruning + costing
+    min_max: dict[str, tuple[float, float]] = field(default_factory=dict)
+
+
+@dataclass
+class Table:
+    name: str
+    schema: Schema
+    policy: DistributionPolicy
+    data: dict[str, np.ndarray] = field(default_factory=dict)   # host columns
+    dicts: dict[str, StringDictionary] = field(default_factory=dict)
+    stats: TableStats = field(default_factory=TableStats)
+
+    @property
+    def num_rows(self) -> int:
+        return self.stats.row_count
+
+    def set_data(self, data: dict[str, np.ndarray],
+                 dicts: dict[str, StringDictionary] | None = None) -> None:
+        self.data = data
+        self.dicts = dicts or {}
+        n = len(next(iter(data.values()))) if data else 0
+        self.stats.row_count = n
+        for f in self.schema.fields:
+            arr = data.get(f.name)
+            if arr is not None and arr.dtype.kind in "if" and n:
+                self.stats.min_max[f.name] = (float(arr.min()), float(arr.max()))
+
+    def shard_assignment(self, n_segments: int) -> Optional[np.ndarray]:
+        """Segment id per row (None for replicated tables).
+
+        Hash-distributed: jump_consistent_hash over the distribution keys —
+        minimal movement on resize (gpexpand analog). Random ('Strewn' locus):
+        round-robin.
+        """
+        if self.policy.kind == "replicated":
+            return None
+        n = self.stats.row_count
+        if self.policy.kind == "random":
+            return (np.arange(n) % n_segments).astype(np.int32)
+        cols = [self.data[k] for k in self.policy.keys]
+        h = hashing.hash_columns_np([np.asarray(c) for c in cols])
+        return hashing.jump_consistent_hash_np(h, n_segments)
+
+
+class Catalog:
+    def __init__(self):
+        self.tables: dict[str, Table] = {}
+
+    def create_table(self, name: str, schema: Schema,
+                     policy: DistributionPolicy | None = None,
+                     if_not_exists: bool = False) -> Table:
+        name = name.lower()
+        if name in self.tables:
+            if if_not_exists:
+                return self.tables[name]
+            raise ValueError(f"table {name!r} already exists")
+        t = Table(name, schema, policy or DistributionPolicy.random())
+        self.tables[name] = t
+        return t
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        name = name.lower()
+        if name not in self.tables and if_exists:
+            return
+        del self.tables[name]
+
+    def table(self, name: str) -> Table:
+        t = self.tables.get(name.lower())
+        if t is None:
+            raise KeyError(f"unknown table {name!r}")
+        return t

@@ -1,0 +1,4 @@
+from cloudberry_tpu.columnar.dictionary import StringDictionary
+from cloudberry_tpu.columnar.batch import ColumnBatch
+
+__all__ = ["StringDictionary", "ColumnBatch"]

@@ -1,0 +1,115 @@
+"""Typed configuration tree — the GUC system analog.
+
+The reference keeps ~6k lines of GUCs (``src/backend/utils/misc/guc_gp.c``,
+e.g. ``gp_interconnect_type`` at :5124, ``enable_parallel`` at :3209) plus a
+QD-vs-dispatched classification. Here configuration is a typed, immutable
+dataclass tree; a session carries one, and ``with_overrides`` produces a
+modified copy (the dispatch analog: the whole tree is part of the compiled
+plan's static context, so every "segment" — mesh slot — sees the same values
+by construction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class InterconnectConfig:
+    """Motion transport knobs (reference: gp_interconnect_* GUCs,
+    contrib/interconnect/ic_modules.c:26-160 vtable selection)."""
+
+    # 'ici'      — XLA collectives inside shard_map (the default transport)
+    # 'loopback' — single-device host loopback used by tests (MotionIPCLayer seam)
+    backend: str = "ici"
+    # Per-destination bucket capacity for hash redistribute, as a multiple of
+    # fair share (local_rows / n_segments). The moral equivalent of the UDP
+    # interconnect's capacity-based flow control (ic_udpifc.c:3018-3040):
+    # rows over capacity are detected and reported, not silently dropped.
+    capacity_factor: float = 2.0
+    # Use ragged_all_to_all when available instead of padded all_to_all.
+    ragged: bool = False
+
+
+@dataclass(frozen=True)
+class ExecConfig:
+    """Executor shape/dtype discipline (XLA: static shapes only)."""
+
+    # Default tile capacity for intermediate results when not inferable.
+    batch_capacity: int = 1 << 20
+    # Group-by output capacity when the planner cannot bound cardinality.
+    # (None → same as input capacity: always correct, more memory.)
+    agg_capacity: int | None = None
+    # Float compute dtype on device. f64 is emulated on TPU; money columns
+    # keep exactness via int64-cent accumulation regardless of this setting.
+    compute_dtype: str = "float64"
+    # Sum aggregates over decimal columns accumulate in int64 fixed-point.
+    exact_decimal_agg: bool = True
+    # Runtime bloom-style filters pushed from join build to probe scan
+    # (reference: nodeRuntimeFilter.c).
+    enable_runtime_filters: bool = True
+
+
+@dataclass(frozen=True)
+class PlannerConfig:
+    """Cost-model analog of cdbpath.c's motion choices."""
+
+    # Broadcast the smaller join side instead of redistributing both when its
+    # (estimated) row count is below this (reference: cdbpath_motion_for_join
+    # cdbpath.c:1346 chooses broadcast vs redistribute by cost).
+    broadcast_threshold: int = 100_000
+    # Prune dispatch to a single segment for point predicates on the
+    # distribution key (reference: cdbtargeteddispatch.c).
+    enable_direct_dispatch: bool = True
+    # Two/three-stage aggregation (reference: cdbgroupingpaths.c).
+    enable_multistage_agg: bool = True
+
+
+@dataclass(frozen=True)
+class ResourceConfig:
+    """Memory governance analog (vmem_tracker.c:94, workfile_mgr.c)."""
+
+    # Per-segment device-memory budget for one query's intermediates (bytes).
+    query_mem_bytes: int = 4 << 30
+    # Admission: max concurrent statements (resgroup slot pool analog,
+    # resgroup.c:135-171).
+    max_concurrency: int = 8
+
+
+@dataclass(frozen=True)
+class Config:
+    n_segments: int = 1
+    interconnect: InterconnectConfig = field(default_factory=InterconnectConfig)
+    exec: ExecConfig = field(default_factory=ExecConfig)
+    planner: PlannerConfig = field(default_factory=PlannerConfig)
+    resource: ResourceConfig = field(default_factory=ResourceConfig)
+
+    def with_overrides(self, **kv: Any) -> "Config":
+        """Return a copy with dotted-path overrides, e.g.
+        ``cfg.with_overrides(**{"exec.compute_dtype": "float32"})``."""
+        out = self
+        for path, value in kv.items():
+            parts = path.split(".")
+            out = _replace_path(out, parts, value)
+        return out
+
+
+def _replace_path(node: Any, parts: list[str], value: Any) -> Any:
+    if len(parts) == 1:
+        return dataclasses.replace(node, **{parts[0]: value})
+    child = getattr(node, parts[0])
+    return dataclasses.replace(node, **{parts[0]: _replace_path(child, parts[1:], value)})
+
+
+_global_config = Config()
+
+
+def get_config() -> Config:
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,313 @@
+"""Relational kernels over fixed-capacity column batches — all jittable.
+
+Design discipline (SURVEY.md §7.3): XLA requires static shapes, so
+- filters AND into the selection mask (no compaction);
+- group-by is sort-based: lexsort → boundary flags → segment reductions.
+  Exact (no hash collisions), and sort/scan map well onto the VPU;
+- joins are "sorted-build lookup": sort the unique (PK) side, binary-search
+  probes with ``searchsorted``, gather payloads. This covers every PK–FK join
+  shape in TPC-H; a many-to-many expansion kernel is planned separately.
+
+These replace the reference's per-tuple executor nodes: nodeAgg.c,
+nodeHash.c/nodeHashjoin.c, nodeSort.c, nodeLimit.c — pointer-chasing hash
+tables have no TPU analog, sort+segment ops are the native formulation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Columns = dict[str, jnp.ndarray]
+
+# --------------------------------------------------------------------------
+# key normalization: every key column becomes a sortable uint64 whose order
+# matches SQL order (ints/dates: offset; floats: IEEE total-order trick;
+# strings: rank table gathered by caller).
+# --------------------------------------------------------------------------
+
+_SIGN64 = jnp.uint64(1) << jnp.uint64(63)
+
+
+def sort_key_u64(col: jnp.ndarray) -> jnp.ndarray:
+    """Map a column to uint64 preserving SQL ascending order."""
+    if col.dtype == jnp.bool_:
+        return col.astype(jnp.uint64)
+    if col.dtype == jnp.float32:
+        # IEEE total-order trick in 32 bits, then widen — avoids the f64
+        # bitcast that the TPU backend cannot compile.
+        bits = col.view(jnp.uint32)
+        mask = jnp.where(bits >> jnp.uint32(31) != 0,
+                         jnp.uint32(0xFFFFFFFF), jnp.uint32(1) << jnp.uint32(31))
+        return (bits ^ mask).astype(jnp.uint64)
+    if col.dtype == jnp.float64:
+        # f64→u64 bitcast does NOT compile on the TPU backend (see
+        # .claude/skills/verify/SKILL.md); genuine DOUBLE sort keys are
+        # CPU-only until reworked — DECIMAL (int64) is the hot-path type.
+        bits = col.view(jnp.uint64)
+        mask = jnp.where(bits >> jnp.uint64(63) != 0,
+                         jnp.uint64(0xFFFFFFFFFFFFFFFF), _SIGN64)
+        return bits ^ mask
+    return col.astype(jnp.int64).view(jnp.uint64) ^ _SIGN64
+
+
+_U64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def key_ranges(
+    keys: Sequence[jnp.ndarray], sel: jnp.ndarray
+) -> list[tuple[jnp.ndarray, jnp.ndarray]]:
+    """Per-column (lo, span) over the SELECTED rows, in u64 key space."""
+    out = []
+    for k in keys:
+        u = sort_key_u64(k)
+        lo = jnp.min(jnp.where(sel, u, _U64_MAX))
+        hi = jnp.max(jnp.where(sel, u, jnp.uint64(0)))
+        span = jnp.maximum(hi - lo, jnp.uint64(0)) + jnp.uint64(1)
+        out.append((lo, span))
+    return out
+
+
+def pack_with_ranges(
+    keys: Sequence[jnp.ndarray],
+    ranges: Sequence[tuple[jnp.ndarray, jnp.ndarray]],
+) -> jnp.ndarray:
+    """Pack key columns into ONE order-preserving uint64 using given ranges.
+
+    Exact when the product of spans fits 64 bits (always true for TPC-H key
+    columns). Values outside a range pack to the all-ones sentinel, which
+    never equals an in-range pack — so cross-side packing (join probe against
+    build-side ranges) stays exact rather than aliasing.
+    """
+    packed = jnp.zeros(keys[0].shape, dtype=jnp.uint64)
+    oob = jnp.zeros(keys[0].shape, dtype=jnp.bool_)
+    for k, (lo, span) in zip(keys, ranges):
+        u = sort_key_u64(k)
+        oob = oob | (u < lo) | (u - lo >= span)
+        packed = packed * span + jnp.clip(u - lo, jnp.uint64(0), span - jnp.uint64(1))
+    return jnp.where(oob, _U64_MAX, packed)
+
+
+def pack_keys(keys: Sequence[jnp.ndarray], sel: jnp.ndarray) -> jnp.ndarray:
+    """Pack multiple key columns of one batch into order-preserving uint64
+    (selected rows are in-range by construction; others → sentinel)."""
+    return pack_with_ranges(keys, key_ranges(keys, sel))
+
+
+def sort_indices(
+    keys: Sequence[jnp.ndarray],
+    sel: jnp.ndarray,
+    descending: Sequence[bool] | None = None,
+) -> jnp.ndarray:
+    """Permutation putting selected rows first, ordered by keys (lexsort).
+
+    keys[0] is the PRIMARY key (SQL ORDER BY first column)."""
+    n = sel.shape[0]
+    desc = list(descending) if descending is not None else [False] * len(keys)
+    cols = []
+    for k, d in zip(keys, desc):
+        u = sort_key_u64(k)
+        cols.append(~u if d else u)
+    # lexsort: LAST key is primary ⇒ reverse; unselected rows go last.
+    order = jnp.lexsort(tuple(reversed(cols)) + (~sel,))
+    return order
+
+
+# --------------------------------------------------------------------------
+# group-by
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: func ∈ {sum,count,min,max,avg}; count with arg=None is
+    COUNT(*). ``values`` are pre-evaluated argument arrays (None for *)."""
+    func: str
+    out_name: str
+
+
+def group_aggregate(
+    key_cols: Columns,
+    agg_values: dict[str, Optional[jnp.ndarray]],
+    aggs: Sequence[AggSpec],
+    sel: jnp.ndarray,
+    out_capacity: int,
+) -> tuple[Columns, Columns, jnp.ndarray, jnp.ndarray]:
+    """Sort-based grouped aggregation (nodeAgg.c analog).
+
+    Returns (out_key_cols, out_agg_cols, out_sel, n_groups); groups are
+    emitted in ascending key order (a free ORDER BY for the common agg→sort
+    pattern). ``n_groups`` is the TRUE group count — the executor must check
+    it against out_capacity after the run: groups beyond capacity are clipped
+    into the last slot, so n_groups > out_capacity means wrong results and is
+    an error, never silent (the capacity-flow-control discipline of
+    ic_udpifc.c:3018 applied to shapes).
+    """
+    names = list(key_cols)
+    perm = sort_indices([key_cols[n] for n in names], sel)
+    s_sel = sel[perm]
+    s_keys = {n: key_cols[n][perm] for n in names}
+
+    new_grp = jnp.zeros_like(s_sel)
+    for n in names:
+        k = s_keys[n]
+        new_grp = new_grp | (k != jnp.roll(k, 1))
+    new_grp = new_grp.at[0].set(True)
+    new_grp = new_grp & s_sel
+
+    gid = jnp.cumsum(new_grp.astype(jnp.int32)) - 1
+    n_groups = jnp.sum(new_grp.astype(jnp.int32))
+    # invalid rows → dumped into segment `out_capacity` and dropped
+    gid = jnp.where(s_sel, jnp.clip(gid, 0, out_capacity - 1), out_capacity)
+
+    out_keys: Columns = {}
+    scatter_idx = jnp.where(new_grp, gid, out_capacity)
+    for n in names:
+        buf = jnp.zeros((out_capacity,), dtype=s_keys[n].dtype)
+        out_keys[n] = buf.at[scatter_idx].set(s_keys[n], mode="drop")
+
+    nseg = out_capacity
+    out_aggs: Columns = {}
+    for spec in aggs:
+        v = agg_values.get(spec.out_name)
+        if v is not None:
+            v = v[perm]
+        if spec.func == "count":
+            # COUNT(*) and COUNT(col) agree while columns are non-nullable;
+            # null-aware COUNT(col) will weigh v's validity here.
+            ones = s_sel.astype(jnp.int64)
+            out = jax.ops.segment_sum(ones, gid, num_segments=nseg + 1)[:nseg]
+        elif spec.func == "sum":
+            vv = jnp.where(s_sel, v, 0)
+            out = jax.ops.segment_sum(vv, gid, num_segments=nseg + 1)[:nseg]
+        elif spec.func == "min":
+            out = jax.ops.segment_min(jnp.where(s_sel, v, _dtype_max(v.dtype)),
+                                      gid, num_segments=nseg + 1)[:nseg]
+        elif spec.func == "max":
+            out = jax.ops.segment_max(jnp.where(s_sel, v, _dtype_min(v.dtype)),
+                                      gid, num_segments=nseg + 1)[:nseg]
+        elif spec.func == "avg":
+            vv = jnp.where(s_sel, v, 0)
+            ssum = jax.ops.segment_sum(vv.astype(jnp.float64), gid,
+                                       num_segments=nseg + 1)[:nseg]
+            cnt = jax.ops.segment_sum(s_sel.astype(jnp.int64), gid,
+                                      num_segments=nseg + 1)[:nseg]
+            out = ssum / jnp.maximum(cnt, 1)
+        else:
+            raise NotImplementedError(spec.func)
+        out_aggs[spec.out_name] = out
+
+    out_sel = jnp.arange(out_capacity) < n_groups
+    return out_keys, out_aggs, out_sel, n_groups
+
+
+def global_aggregate(
+    agg_values: dict[str, Optional[jnp.ndarray]],
+    aggs: Sequence[AggSpec],
+    sel: jnp.ndarray,
+) -> Columns:
+    """Ungrouped aggregation → one-row columns (shape (1,))."""
+    out: Columns = {}
+    for spec in aggs:
+        v = agg_values.get(spec.out_name)
+        if spec.func == "count":
+            out[spec.out_name] = jnp.sum(sel.astype(jnp.int64))[None]
+        elif spec.func == "sum":
+            out[spec.out_name] = jnp.sum(jnp.where(sel, v, 0))[None]
+        elif spec.func == "min":
+            out[spec.out_name] = jnp.min(
+                jnp.where(sel, v, _dtype_max(v.dtype)))[None]
+        elif spec.func == "max":
+            out[spec.out_name] = jnp.max(
+                jnp.where(sel, v, _dtype_min(v.dtype)))[None]
+        elif spec.func == "avg":
+            s = jnp.sum(jnp.where(sel, v, 0).astype(jnp.float64))
+            c = jnp.sum(sel.astype(jnp.int64))
+            out[spec.out_name] = (s / jnp.maximum(c, 1))[None]
+        else:
+            raise NotImplementedError(spec.func)
+    return out
+
+
+def _dtype_max(dt):
+    return jnp.asarray(jnp.finfo(dt).max if jnp.issubdtype(dt, jnp.floating)
+                       else jnp.iinfo(dt).max, dtype=dt)
+
+
+def _dtype_min(dt):
+    return jnp.asarray(jnp.finfo(dt).min if jnp.issubdtype(dt, jnp.floating)
+                       else jnp.iinfo(dt).min, dtype=dt)
+
+
+# --------------------------------------------------------------------------
+# join: sorted-build lookup (PK–FK)
+# --------------------------------------------------------------------------
+
+
+def join_lookup(
+    build_key: Sequence[jnp.ndarray],
+    build_sel: jnp.ndarray,
+    probe_key: Sequence[jnp.ndarray],
+    probe_sel: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """For each probe row: index of the matching build row, and a match mask.
+
+    Requires the build side unique on the key (the planner puts the PK side
+    here — same choice nodeHash.c makes for the hash side). Exact: compares
+    packed keys, and packing is order-preserving/injective for in-range ints.
+    Returns (build_row_idx int32[cap_p], matched bool[cap_p]).
+    """
+    ranges = key_ranges(list(build_key), build_sel)
+    kb = pack_with_ranges(list(build_key), ranges)
+    kp = pack_with_ranges(list(probe_key), ranges)
+    big = _U64_MAX
+    kb_masked = jnp.where(build_sel, kb, big)
+    order = jnp.argsort(kb_masked)
+    kb_sorted = kb_masked[order]
+    pos = jnp.searchsorted(kb_sorted, kp)
+    pos_c = jnp.clip(pos, 0, kb_sorted.shape[0] - 1)
+    # kp == sentinel marks out-of-range probes; excluding it also makes the
+    # empty-build case (kb_sorted all sentinel) correctly match nothing.
+    matched = (kb_sorted[pos_c] == kp) & probe_sel & (kp != big)
+    build_row = order[pos_c].astype(jnp.int32)
+    return build_row, matched
+
+
+def gather_payload(cols: Columns, idx: jnp.ndarray, matched: jnp.ndarray) -> Columns:
+    """Gather build-side payload columns to probe rows (0 where unmatched)."""
+    out = {}
+    for name, c in cols.items():
+        g = jnp.take(c, idx, axis=0)
+        out[name] = jnp.where(matched, g, jnp.zeros((), dtype=c.dtype))
+    return out
+
+
+# --------------------------------------------------------------------------
+# misc
+# --------------------------------------------------------------------------
+
+
+def limit_mask(sel: jnp.ndarray, k: int, offset: int = 0) -> jnp.ndarray:
+    """Keep rows offset..offset+k of the SELECTED sequence (post-sort)."""
+    rank = jnp.cumsum(sel.astype(jnp.int64)) - 1
+    return sel & (rank >= offset) & (rank < offset + k)
+
+
+def compact(
+    cols: Columns, sel: jnp.ndarray, capacity: int
+) -> tuple[Columns, jnp.ndarray, jnp.ndarray]:
+    """Stable-compact selected rows to the front at a (possibly smaller)
+    capacity — used before motions to shrink shuffle width (the TupleSplit /
+    multi-stage-agg motivation, SURVEY.md §2.2).
+
+    Also returns the TRUE selected-row count; the executor must check it
+    against ``capacity`` post-run — rows beyond capacity are truncated, which
+    is an error to surface, never silence."""
+    n_selected = jnp.sum(sel.astype(jnp.int64))
+    idx = sort_indices([jnp.zeros_like(sel, dtype=jnp.int32)], sel)
+    idx = idx[:capacity]
+    out = {n: c[idx] for n, c in cols.items()}
+    return out, sel[idx], n_selected

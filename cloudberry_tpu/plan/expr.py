@@ -1,0 +1,161 @@
+"""Bound scalar-expression IR.
+
+The binder turns parsed SQL expressions into this typed IR; the executor
+compiles it to jax.numpy ops (exec/expr_compile.py). This is the analog of
+PG's ExprState evaluation (src/backend/executor/execExpr.c) — except the
+"interpreter" is XLA, so an expression evaluates over a whole column batch in
+one fused kernel rather than per tuple.
+
+String predicates never touch device strings: the binder pre-computes a
+boolean lookup table over the column's host dictionary and emits
+``DictLookup`` (gather by code). Ordering comparisons on strings gather a
+host-computed rank table (see columnar/dictionary.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from cloudberry_tpu.types import BOOL, DType, SqlType
+
+
+class Expr:
+    dtype: SqlType
+
+    def children(self) -> tuple["Expr", ...]:
+        return ()
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    name: str
+    dtype: SqlType
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: Any
+    dtype: SqlType
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    """op ∈ {+,-,*,/,=,<>,<,<=,>,>=,and,or}"""
+    op: str
+    left: Expr
+    right: Expr
+    dtype: SqlType
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    """op ∈ {not,-}"""
+    op: str
+    operand: Expr
+    dtype: SqlType
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    operand: Expr
+    dtype: SqlType
+
+    def children(self):
+        return (self.operand,)
+
+
+@dataclass(frozen=True)
+class Func(Expr):
+    """Scalar functions: extract_year/extract_month, abs, substring-class
+    functions are rewritten to DictLookup by the binder."""
+    name: str
+    args: tuple[Expr, ...]
+    dtype: SqlType
+
+    def children(self):
+        return self.args
+
+
+@dataclass(frozen=True)
+class CaseWhen(Expr):
+    whens: tuple[tuple[Expr, Expr], ...]
+    otherwise: Optional[Expr]
+    dtype: SqlType
+
+    def children(self):
+        out = []
+        for c, v in self.whens:
+            out += [c, v]
+        if self.otherwise is not None:
+            out.append(self.otherwise)
+        return tuple(out)
+
+
+@dataclass(frozen=True, eq=False)
+class DictLookup(Expr):
+    """Gather host-computed per-code table by a string column's codes.
+
+    table dtype bool → predicate (LIKE/IN/=); int32 → rank/ordering.
+    """
+    column: Expr
+    table: np.ndarray = field(hash=False, compare=False)
+    dtype: SqlType = BOOL
+
+    def children(self):
+        return (self.column,)
+
+
+@dataclass(frozen=True)
+class IsValid(Expr):
+    """True where an outer-join matched (IS NOT NULL on nullable side)."""
+    mask_name: str
+    negate: bool = False
+    dtype: SqlType = BOOL
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """Aggregate call — lives in Agg plan nodes, not inside scalar exprs.
+
+    func ∈ {sum, count, count_star, min, max, avg, count_distinct}.
+    """
+    func: str
+    arg: Optional[Expr]
+    distinct: bool = False
+    filter: Optional[Expr] = None
+
+    @property
+    def dtype(self) -> SqlType:
+        from cloudberry_tpu.types import FLOAT64, INT64
+
+        if self.func in ("count", "count_star", "count_distinct"):
+            return INT64
+        if self.func == "avg":
+            return FLOAT64
+        assert self.arg is not None
+        return self.arg.dtype
+
+
+def walk(e: Expr):
+    yield e
+    for c in e.children():
+        yield from walk(c)
+
+
+def columns_used(e: Expr) -> set[str]:
+    out = set()
+    for node in walk(e):
+        if isinstance(node, ColumnRef):
+            out.add(node.name)
+        if isinstance(node, IsValid):
+            out.add(node.mask_name)
+    return out

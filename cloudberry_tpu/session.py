@@ -1,0 +1,41 @@
+"""Session — the QD (query dispatcher) analog.
+
+A Session owns a catalog, a config, and a device mesh; ``sql()`` runs the full
+pipeline: parse → bind/plan (motion insertion) → compile → execute. The
+reference's equivalent surface is a libpq connection to the coordinator
+backend (exec_simple_query, src/backend/tcop/postgres.c:1655); here it is an
+in-process Python API (the serving layer comes later).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from cloudberry_tpu.config import Config, get_config
+
+
+class Session:
+    def __init__(self, config: Config | None = None):
+        from cloudberry_tpu.catalog.catalog import Catalog
+
+        self.config = config or get_config()
+        self.catalog = Catalog()
+
+    def sql(self, query: str, **params: Any):
+        from cloudberry_tpu.sql.parser import parse_sql
+        from cloudberry_tpu.plan.planner import plan_statement
+        from cloudberry_tpu.exec.executor import execute
+
+        stmt = parse_sql(query)
+        result = plan_statement(stmt, self, params)
+        if result.is_ddl:
+            return result.ddl_result
+        return execute(result.plan, self)
+
+    def explain(self, query: str) -> str:
+        from cloudberry_tpu.sql.parser import parse_sql
+        from cloudberry_tpu.plan.planner import plan_statement
+
+        stmt = parse_sql(query)
+        result = plan_statement(stmt, self, {})
+        return result.plan.explain()

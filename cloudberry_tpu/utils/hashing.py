@@ -1,0 +1,110 @@
+"""Distribution hashing — the cdbhash analog.
+
+The reference routes tuples to segments by hashing distribution-key columns
+(``makeCdbHash`` src/backend/cdb/cdbhash.c:78) and maps hash → segment with
+``jump_consistent_hash`` (cdbhash.c:55) so that elastic resize (gpexpand /
+gpshrink) moves a minimal fraction of rows; a legacy modulo mapping exists in
+cdblegacyhash.c. Both are provided here as vectorized jittable JAX functions
+(device-side routing for HASH motion) and as numpy functions (host-side
+placement at load time).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# splitmix64 finalizer constants — a well-mixed 64-bit avalanche.
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+_JUMP = np.uint64(2862933555777941757)
+
+
+def splitmix64_jnp(x: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized 64-bit avalanche hash (device)."""
+    z = x.astype(jnp.uint64)
+    z = (z ^ (z >> 30)) * jnp.uint64(_C1)
+    z = (z ^ (z >> 27)) * jnp.uint64(_C2)
+    return z ^ (z >> 31)
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        z = x.astype(np.uint64)
+        z = (z ^ (z >> np.uint64(30))) * _C1
+        z = (z ^ (z >> np.uint64(27))) * _C2
+        return z ^ (z >> np.uint64(31))
+
+
+def combine_hashes_jnp(hs: list[jnp.ndarray]) -> jnp.ndarray:
+    """Order-sensitive multi-column hash combine (cdbhash accumulates columns
+    into one 32-bit hash; we keep 64 bits)."""
+    acc = jnp.zeros_like(hs[0], dtype=jnp.uint64)
+    for h in hs:
+        acc = splitmix64_jnp(acc ^ h.astype(jnp.uint64))
+    return acc
+
+
+def combine_hashes_np(hs: list[np.ndarray]) -> np.ndarray:
+    acc = np.zeros_like(hs[0], dtype=np.uint64)
+    for h in hs:
+        acc = splitmix64_np(acc ^ h.astype(np.uint64))
+    return acc
+
+
+def hash_columns_jnp(cols: list[jnp.ndarray]) -> jnp.ndarray:
+    """Hash one or more integer-valued columns (codes/ints/dates) to uint64."""
+    return combine_hashes_jnp([splitmix64_jnp(_col_bits_jnp(c)) for c in cols])
+
+
+def hash_columns_np(cols: list[np.ndarray]) -> np.ndarray:
+    return combine_hashes_np([splitmix64_np(_col_bits_np(c)) for c in cols])
+
+
+def _col_bits_jnp(c: jnp.ndarray) -> jnp.ndarray:
+    if c.dtype == jnp.float64:
+        return c.view(jnp.uint64)  # bit-pattern hash; exact-equality semantics
+    if c.dtype == jnp.float32:
+        return c.view(jnp.uint32).astype(jnp.uint64)
+    if c.dtype == jnp.bool_:
+        return c.astype(jnp.uint64)
+    return c.astype(jnp.int64).view(jnp.uint64)
+
+
+def _col_bits_np(c: np.ndarray) -> np.ndarray:
+    if c.dtype == np.float64:
+        return c.view(np.uint64)
+    if c.dtype == np.float32:
+        return c.view(np.uint32).astype(np.uint64)
+    if c.dtype == np.bool_:
+        return c.astype(np.uint64)
+    return c.astype(np.int64).view(np.uint64)
+
+
+def modulo_segment(h: jnp.ndarray, n_segments: int) -> jnp.ndarray:
+    """Legacy modulo mapping (cdblegacyhash.c) — the device routing default."""
+    return (h % jnp.uint64(n_segments)).astype(jnp.int32)
+
+
+def jump_consistent_hash_np(keys: np.ndarray, n_buckets: int) -> np.ndarray:
+    """Lamping-Veach jump consistent hash, vectorized over keys (host side).
+
+    Used for data placement so a resize from N to N+1 buckets relocates only
+    ~1/(N+1) of rows (reference: cdbhash.c:55, gpexpand minimal movement).
+    """
+    keys = keys.astype(np.uint64)
+    b = np.full(keys.shape, -1, dtype=np.int64)
+    j = np.zeros(keys.shape, dtype=np.int64)
+    active = j < n_buckets
+    with np.errstate(over="ignore"):
+        while active.any():
+            b = np.where(active, j, b)
+            keys = np.where(active, keys * _JUMP + np.uint64(1), keys)
+            denom = ((keys >> np.uint64(33)) + np.uint64(1)).astype(np.float64)
+            j = np.where(
+                active,
+                ((b + 1) * (float(1 << 31) / denom)).astype(np.int64),
+                j,
+            )
+            active = j < n_buckets
+    return b.astype(np.int32)

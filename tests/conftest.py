@@ -1,0 +1,30 @@
+"""Test harness: 8 virtual CPU devices — the demo-cluster analog.
+
+The reference tests multi-node behavior with N postmasters on localhost
+(gpMgmt/demo, SURVEY.md §4.2); we test multi-chip behavior with N virtual XLA
+CPU devices. Must run before jax initializes.
+"""
+
+import os
+
+# sitecustomize imports jax at interpreter start, so env-var-only control is
+# too late; jax.config still works because no backend is initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"  # the terminal presets axon (real TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def session():
+    import cloudberry_tpu as cb
+
+    return cb.Session()

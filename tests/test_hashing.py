@@ -1,0 +1,38 @@
+import numpy as np
+
+from cloudberry_tpu.utils import hashing
+
+
+def test_splitmix_consistency_np_jnp():
+    import jax.numpy as jnp
+
+    x = np.arange(100, dtype=np.int64)
+    a = hashing.splitmix64_np(x.view(np.uint64))
+    b = np.asarray(hashing.splitmix64_jnp(jnp.asarray(x).view(jnp.uint64)))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_hash_columns_matches_device_host():
+    import jax.numpy as jnp
+
+    k1 = np.array([1, 2, 3, 4], dtype=np.int64)
+    k2 = np.array([10.5, 0.0, -3.25, 10.5])
+    a = hashing.hash_columns_np([k1, k2])
+    b = np.asarray(hashing.hash_columns_jnp([jnp.asarray(k1), jnp.asarray(k2)]))
+    np.testing.assert_array_equal(a, b)
+    assert len(set(a.tolist())) == 4
+
+
+def test_jump_consistent_hash_minimal_movement():
+    keys = hashing.splitmix64_np(np.arange(20000, dtype=np.uint64))
+    b8 = hashing.jump_consistent_hash_np(keys, 8)
+    b9 = hashing.jump_consistent_hash_np(keys, 9)
+    assert b8.min() >= 0 and b8.max() == 7
+    moved = (b8 != b9).mean()
+    # jump hash moves ~1/9 of keys on 8→9 resize (vs ~8/9 for modulo)
+    assert moved < 0.15
+    # everything that moved went to the new bucket
+    assert set(b9[b8 != b9].tolist()) == {8}
+    # rough balance
+    counts = np.bincount(b8, minlength=8)
+    assert counts.min() > 0.8 * counts.mean()

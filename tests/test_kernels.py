@@ -1,0 +1,184 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from cloudberry_tpu.exec import kernels as K
+
+
+def _sel(n, cap):
+    s = np.zeros(cap, dtype=bool)
+    s[:n] = True
+    return jnp.asarray(s)
+
+
+def test_sort_indices_orders_and_pushes_invalid_last():
+    cap = 8
+    k = jnp.asarray(np.array([5, 1, 3, 2, 9, 0, 0, 0], dtype=np.int64))
+    sel = _sel(5, cap)
+    perm = K.sort_indices([k], sel)
+    got = np.asarray(k[perm][:5])
+    np.testing.assert_array_equal(got, [1, 2, 3, 5, 9])
+    assert np.asarray(sel[perm])[5:].sum() == 0
+
+
+def test_sort_descending_and_secondary():
+    a = jnp.asarray(np.array([1, 2, 1, 2, 1], dtype=np.int64))
+    b = jnp.asarray(np.array([10.0, 20.0, 30.0, 5.0, 20.0]))
+    sel = jnp.ones(5, dtype=bool)
+    perm = K.sort_indices([a, b], sel, descending=[False, True])
+    rows = list(zip(np.asarray(a[perm]).tolist(), np.asarray(b[perm]).tolist()))
+    assert rows == [(1, 30.0), (1, 20.0), (1, 10.0), (2, 20.0), (2, 5.0)]
+
+
+def test_sort_negative_floats():
+    v = jnp.asarray(np.array([0.5, -1.5, -0.25, 2.0, -1.5]))
+    perm = K.sort_indices([v], jnp.ones(5, dtype=bool))
+    got = np.asarray(v[perm])
+    np.testing.assert_array_equal(got, np.sort(np.asarray(v)))
+
+
+@pytest.mark.parametrize("jit", [False, True])
+def test_group_aggregate_vs_pandas(jit):
+    rng = np.random.default_rng(0)
+    n, cap = 900, 1024
+    k1 = rng.integers(0, 7, n).astype(np.int64)
+    k2 = rng.integers(0, 3, n).astype(np.int32)
+    v = rng.normal(size=n)
+    df = pd.DataFrame({"k1": k1, "k2": k2, "v": v})
+    expect = (
+        df.groupby(["k1", "k2"])
+        .agg(s=("v", "sum"), c=("v", "size"), mn=("v", "min"), a=("v", "mean"))
+        .reset_index()
+        .sort_values(["k1", "k2"])
+    )
+
+    key_cols = {
+        "k1": jnp.asarray(np.pad(k1, (0, cap - n))),
+        "k2": jnp.asarray(np.pad(k2, (0, cap - n))),
+    }
+    vals = jnp.asarray(np.pad(v, (0, cap - n)))
+    sel = _sel(n, cap)
+    aggs = [K.AggSpec("sum", "s"), K.AggSpec("count", "c"),
+            K.AggSpec("min", "mn"), K.AggSpec("avg", "a")]
+    agg_values = {"s": vals, "c": None, "mn": vals, "a": vals}
+
+    fn = lambda kc, av, s: K.group_aggregate(kc, av, aggs, s, 64)
+    if jit:
+        fn = jax.jit(fn)
+    out_keys, out_aggs, out_sel, n_groups = fn(key_cols, agg_values, sel)
+    assert int(n_groups) == len(expect)
+
+    m = np.asarray(out_sel)
+    got = pd.DataFrame({
+        "k1": np.asarray(out_keys["k1"])[m],
+        "k2": np.asarray(out_keys["k2"])[m],
+        "s": np.asarray(out_aggs["s"])[m],
+        "c": np.asarray(out_aggs["c"])[m],
+        "mn": np.asarray(out_aggs["mn"])[m],
+        "a": np.asarray(out_aggs["a"])[m],
+    })
+    assert len(got) == len(expect)
+    np.testing.assert_array_equal(got["k1"], expect["k1"].to_numpy())
+    np.testing.assert_array_equal(got["k2"], expect["k2"].to_numpy())
+    np.testing.assert_allclose(got["s"], expect["s"].to_numpy(), rtol=1e-12)
+    np.testing.assert_array_equal(got["c"], expect["c"].to_numpy())
+    np.testing.assert_allclose(got["mn"], expect["mn"].to_numpy(), rtol=1e-12)
+    np.testing.assert_allclose(got["a"], expect["a"].to_numpy(), rtol=1e-12)
+
+
+def test_global_aggregate():
+    v = jnp.asarray(np.array([1.0, 2.0, 3.0, 100.0]))
+    sel = jnp.asarray(np.array([True, True, True, False]))
+    out = K.global_aggregate(
+        {"s": v, "c": None, "mx": v},
+        [K.AggSpec("sum", "s"), K.AggSpec("count", "c"), K.AggSpec("max", "mx")],
+        sel,
+    )
+    assert float(out["s"][0]) == 6.0
+    assert int(out["c"][0]) == 3
+    assert float(out["mx"][0]) == 3.0
+
+
+@pytest.mark.parametrize("jit", [False, True])
+def test_join_lookup_pk_fk(jit):
+    cap_b, cap_p = 8, 16
+    bkey = np.array([10, 20, 30, 40, 0, 0, 0, 0], dtype=np.int64)
+    bsel = _sel(4, cap_b)
+    pkey = np.array([20, 20, 99, 40, 10, 30, 30, 7] + [0] * 8, dtype=np.int64)
+    psel = _sel(8, cap_p)
+
+    fn = K.join_lookup
+    if jit:
+        fn = jax.jit(fn)
+    idx, matched = fn([jnp.asarray(bkey)], bsel, [jnp.asarray(pkey)], psel)
+    m = np.asarray(matched)
+    np.testing.assert_array_equal(
+        m[:8], [True, True, False, True, True, True, True, False])
+    picked = np.asarray(idx)[m]
+    np.testing.assert_array_equal(bkey[picked], np.asarray(pkey[:8])[m[:8]])
+
+
+def test_join_lookup_multikey():
+    bk1 = np.array([1, 1, 2, 2], dtype=np.int64)
+    bk2 = np.array([1, 2, 1, 2], dtype=np.int64)
+    bsel = jnp.ones(4, dtype=bool)
+    pk1 = np.array([1, 2, 2, 3], dtype=np.int64)
+    pk2 = np.array([2, 1, 9, 1], dtype=np.int64)
+    psel = jnp.ones(4, dtype=bool)
+    idx, matched = K.join_lookup(
+        [jnp.asarray(bk1), jnp.asarray(bk2)], bsel,
+        [jnp.asarray(pk1), jnp.asarray(pk2)], psel)
+    np.testing.assert_array_equal(np.asarray(matched), [True, True, False, False])
+    got = np.asarray(idx)[np.asarray(matched)]
+    np.testing.assert_array_equal(bk1[got], [1, 2])
+    np.testing.assert_array_equal(bk2[got], [2, 1])
+
+
+def test_join_empty_build():
+    bsel = jnp.zeros(4, dtype=bool)
+    psel = jnp.ones(4, dtype=bool)
+    k = jnp.asarray(np.array([1, 2, 3, 4], dtype=np.int64))
+    _, matched = K.join_lookup([k], bsel, [k], psel)
+    assert not bool(np.asarray(matched).any())
+
+
+def test_limit_mask():
+    sel = jnp.asarray(np.array([True, False, True, True, True, False, True]))
+    out = np.asarray(K.limit_mask(sel, 2, offset=1))
+    np.testing.assert_array_equal(
+        out, [False, False, True, True, False, False, False])
+
+
+def test_compact():
+    cols = {"x": jnp.asarray(np.array([9, 8, 7, 6], dtype=np.int64))}
+    sel = jnp.asarray(np.array([False, True, False, True]))
+    out, osel, n = K.compact(cols, sel, 2)
+    assert np.asarray(osel).all()
+    assert int(n) == 2
+    np.testing.assert_array_equal(np.asarray(out["x"]), [8, 6])
+
+
+def test_compact_overflow_reported():
+    cols = {"x": jnp.asarray(np.arange(4, dtype=np.int64))}
+    sel = jnp.ones(4, dtype=bool)
+    _, _, n = K.compact(cols, sel, 2)
+    assert int(n) == 4  # caller sees 4 > capacity 2 and errors
+
+
+def test_group_overflow_reported():
+    cols = {"k": jnp.asarray(np.arange(8, dtype=np.int64))}
+    sel = jnp.ones(8, dtype=bool)
+    *_, n_groups = K.group_aggregate(cols, {"c": None}, [K.AggSpec("count", "c")], sel, 4)
+    assert int(n_groups) == 8  # caller sees 8 > capacity 4 and errors
+
+
+def test_decimal_int_ingest():
+    import pandas as pd
+    from cloudberry_tpu.columnar import ColumnBatch
+    from cloudberry_tpu.types import Schema, DECIMAL
+    b = ColumnBatch.from_arrays({"p": np.array([100, 200], dtype=np.int64)},
+                                Schema.of(p=DECIMAL(2)))
+    np.testing.assert_array_equal(np.asarray(b.columns["p"]), [10000, 20000])
+    assert b.to_pandas()["p"].tolist() == [100.0, 200.0]
