@@ -44,6 +44,8 @@ class TableStats:
     row_count: int = 0
     # per-column (min, max) over numeric/date columns — scan pruning + costing
     min_max: dict[str, tuple[float, float]] = field(default_factory=dict)
+    # lazily-computed per-column uniqueness (PK detection for join planning)
+    unique: dict[str, bool] = field(default_factory=dict)
 
 
 @dataclass
@@ -65,10 +67,36 @@ class Table:
         self.dicts = dicts or {}
         n = len(next(iter(data.values()))) if data else 0
         self.stats.row_count = n
+        self.stats.unique = {}
         for f in self.schema.fields:
             arr = data.get(f.name)
             if arr is not None and arr.dtype.kind in "if" and n:
                 self.stats.min_max[f.name] = (float(arr.min()), float(arr.max()))
+
+    def is_unique(self, col: str) -> bool:
+        """Whether a column's values are distinct (PK detection; the planner
+        uses this the way nodeHash.c trusts unique-ified hash sides). Lazy +
+        cached; recomputed when data changes (set_data clears the cache)."""
+        cached = self.stats.unique.get(col)
+        if cached is None:
+            arr = self.data.get(col)
+            if arr is None or arr.dtype.kind not in "iuf":
+                cached = False
+            else:
+                cached = bool(len(np.unique(arr)) == len(arr))
+            self.stats.unique[col] = cached
+        return cached
+
+    def to_pandas(self):
+        """Decode the (already physically-encoded) table data to pandas."""
+        import pandas as pd
+
+        from cloudberry_tpu.columnar.batch import decode_column
+
+        return pd.DataFrame({
+            f.name: decode_column(np.asarray(self.data[f.name]), f, self.dicts)
+            for f in self.schema.fields
+        })
 
     def shard_assignment(self, n_segments: int) -> Optional[np.ndarray]:
         """Segment id per row (None for replicated tables).
@@ -100,6 +128,9 @@ class Catalog:
                 return self.tables[name]
             raise ValueError(f"table {name!r} already exists")
         t = Table(name, schema, policy or DistributionPolicy.random())
+        # empty columns from the start so scans of unpopulated tables work
+        t.data = {f.name: np.zeros(0, dtype=f.type.np_dtype)
+                  for f in schema.fields}
         self.tables[name] = t
         return t
 

@@ -1,0 +1,226 @@
+"""Single-program executor: plan tree → one jitted XLA computation.
+
+The reference pulls tuples through a process-per-slice Volcano tree
+(ExecProcNode, src/backend/executor/execProcnode.c); here the WHOLE plan
+compiles into one XLA program over fixed-capacity column arrays — scans are
+function inputs, operators are the kernels in exec/kernels.py, and (in
+distributed mode) motions are collectives. Runtime "can't happen" conditions
+(agg capacity overflow, duplicate build keys in a PK join) are returned as
+scalar check outputs and raised host-side after the run — the shape-world
+analog of ereport().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloudberry_tpu.columnar.batch import ColumnBatch
+from cloudberry_tpu.exec import kernels as K
+from cloudberry_tpu.exec.expr_compile import compile_expr
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.types import DType, Field, Schema
+
+
+class ExecError(RuntimeError):
+    pass
+
+
+@dataclass
+class Executable:
+    plan: N.PlanNode
+    fn: Callable  # (tables pytree) -> (cols dict, sel, checks dict)
+    table_names: list[str]
+
+
+def execute(plan: N.PlanNode, session) -> ColumnBatch:
+    exe = compile_plan(plan, session)
+    tables = prepare_tables(exe.table_names, session)
+    return run_executable(exe, tables)
+
+
+def compile_plan(plan: N.PlanNode, session) -> Executable:
+    table_names = sorted({s.table_name for s in _scans(plan)})
+
+    def run(tables):
+        checks: dict[str, jnp.ndarray] = {}
+        cols, sel = _compile_node(plan, tables, checks)
+        out = {f.name: cols[f.name] for f in plan.fields}
+        return out, sel, checks
+
+    return Executable(plan, jax.jit(run), table_names)
+
+
+def prepare_tables(table_names: list[str], session) -> dict:
+    tables = {}
+    for name in table_names:
+        t = session.catalog.table(name)
+        tables[name] = {c: jnp.asarray(v) for c, v in t.data.items()}
+    return tables
+
+
+def run_executable(exe: Executable, tables: dict) -> ColumnBatch:
+    cols, sel, checks = exe.fn(tables)
+    for msg, bad in checks.items():
+        if bool(np.asarray(bad)):
+            raise ExecError(msg)
+    fields = tuple(Field(f.name, f.type) for f in exe.plan.fields)
+    dicts = {f.name: f.sdict for f in exe.plan.fields if f.sdict is not None}
+    return ColumnBatch(Schema(fields),
+                       {k: np.asarray(v) for k, v in cols.items()},
+                       np.asarray(sel), dicts)
+
+
+def _scans(plan: N.PlanNode):
+    if isinstance(plan, N.PScan) and plan.table_name != "$dual":
+        yield plan
+    for c in plan.children():
+        yield from _scans(c)
+
+
+# ------------------------------------------------------------- node lowering
+
+
+def _compile_node(node: N.PlanNode, tables, checks) -> tuple[dict, jnp.ndarray]:
+    if isinstance(node, N.PScan):
+        if node.table_name == "$dual":
+            return {}, jnp.ones((1,), dtype=jnp.bool_)
+        data = tables[node.table_name]
+        cols = {}
+        for phys, out in node.column_map.items():
+            arr = data[phys]
+            if arr.shape[0] < node.capacity:  # empty table: 0 rows, cap 1
+                arr = jnp.zeros((node.capacity,), dtype=arr.dtype)
+            cols[out] = arr
+        n = node.num_rows if node.num_rows >= 0 else node.capacity
+        sel = jnp.arange(node.capacity) < n
+        return cols, sel
+
+    if isinstance(node, N.PFilter):
+        cols, sel = _compile_node(node.child, tables, checks)
+        mask = compile_expr(node.predicate)(cols)
+        return cols, sel & mask
+
+    if isinstance(node, N.PProject):
+        cols, sel = _compile_node(node.child, tables, checks)
+        out = {name: compile_expr(e)(cols) for name, e in node.exprs}
+        return out, sel
+
+    if isinstance(node, N.PJoin):
+        return _compile_join(node, tables, checks)
+
+    if isinstance(node, N.PAgg):
+        return _compile_agg(node, tables, checks)
+
+    if isinstance(node, N.PSort):
+        cols, sel = _compile_node(node.child, tables, checks)
+        keys, desc = [], []
+        for e, asc in node.keys:
+            keys.append(_sortable(e, node.child, cols))
+            desc.append(not asc)
+        perm = K.sort_indices(keys, sel, descending=desc)
+        return {n: c[perm] for n, c in cols.items()}, sel[perm]
+
+    if isinstance(node, N.PLimit):
+        cols, sel = _compile_node(node.child, tables, checks)
+        return cols, K.limit_mask(sel, node.limit, node.offset)
+
+    if isinstance(node, N.PMotion):
+        # single-program mode: loopback motion is the identity (the
+        # MotionIPCLayer seam's test backend); collectives live in
+        # exec/dist_executor.py
+        return _compile_node(node.child, tables, checks)
+
+    raise ExecError(f"cannot execute node {type(node).__name__}")
+
+
+def _compile_join(node: N.PJoin, tables, checks):
+    bcols, bsel = _compile_node(node.build, tables, checks)
+    pcols, psel = _compile_node(node.probe, tables, checks)
+    bkeys = [compile_expr(k)(bcols) for k in node.build_keys]
+    pkeys = [compile_expr(k)(pcols) for k in node.probe_keys]
+    idx, matched = K.join_lookup(bkeys, bsel, pkeys, psel)
+    checks[f"join build side has duplicate keys (node {id(node)}); "
+           "many-to-many joins need the expansion kernel"] = \
+        _dup_keys_flag(bkeys, bsel)
+    payload = K.gather_payload({n: bcols[n] for n in node.build_payload},
+                               idx, matched)
+    cols = {**pcols, **payload}
+    if node.match_name:
+        cols[node.match_name] = matched
+    if node.kind == "inner" or node.kind == "semi":
+        sel = matched
+    elif node.kind == "left":
+        sel = psel
+    elif node.kind == "anti":
+        sel = psel & ~matched
+    else:
+        raise ExecError(f"join kind {node.kind}")
+    return cols, sel
+
+
+def _dup_keys_flag(bkeys, bsel) -> jnp.ndarray:
+    kb = K.pack_keys(list(bkeys), bsel)
+    kb = jnp.where(bsel, kb, K._U64_MAX)
+    s = jnp.sort(kb)
+    eq = (s[1:] == s[:-1]) & (s[1:] != K._U64_MAX)
+    return eq.any()
+
+
+def _compile_agg(node: N.PAgg, tables, checks):
+    cols, sel = _compile_node(node.child, tables, checks)
+    agg_specs = []
+    agg_values: dict[str, Any] = {}
+    post_scale: dict[str, float] = {}
+    for name, call in node.aggs:
+        func = call.func
+        if func == "count" and call.arg is None:
+            agg_values[name] = None
+        elif func in ("sum", "min", "max", "avg", "count"):
+            agg_values[name] = compile_expr(call.arg)(cols) \
+                if call.arg is not None else None
+        else:
+            raise ExecError(f"aggregate {func} not implemented yet")
+        if func == "avg" and call.arg is not None \
+                and call.arg.dtype.base == DType.DECIMAL:
+            post_scale[name] = 10.0 ** call.arg.dtype.scale
+        agg_specs.append(K.AggSpec(func, name))
+
+    if not node.group_keys:
+        out = K.global_aggregate(agg_values, agg_specs, sel)
+        for name, div in post_scale.items():
+            out[name] = out[name] / div
+        return out, jnp.ones((1,), dtype=jnp.bool_)
+
+    key_cols = {name: compile_expr(e)(cols) for name, e in node.group_keys}
+    out_keys, out_aggs, out_sel, n_groups = K.group_aggregate(
+        key_cols, agg_values, agg_specs, sel, node.capacity)
+    checks[f"aggregation overflow: more groups than capacity "
+           f"{node.capacity} (node {id(node)})"] = n_groups > node.capacity
+    for name, div in post_scale.items():
+        out_aggs[name] = out_aggs[name] / div
+    return {**out_keys, **out_aggs}, out_sel
+
+
+def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:
+    """ORDER BY key array; string columns sort by host rank, not code."""
+    arr = compile_expr(e)(cols)
+    if e.dtype.base == DType.STRING:
+        sdict = None
+        if isinstance(e, ex.ColumnRef):
+            try:
+                sdict = child.field(e.name).sdict
+            except KeyError:
+                sdict = getattr(e, "_sdict", None)
+        else:
+            sdict = getattr(e, "_sdict", None) or getattr(e, "_out_dict", None)
+        if sdict is not None and len(sdict):
+            rank = jnp.asarray(sdict.rank_table())
+            safe = jnp.clip(arr, 0, rank.shape[0] - 1)
+            return jnp.where(arr >= 0, jnp.take(rank, safe), -1)
+    return arr

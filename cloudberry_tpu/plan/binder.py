@@ -1,0 +1,1133 @@
+"""Binder: unbound AST → typed plan tree.
+
+The reference's analog is parse analysis + planning
+(src/backend/parser/analyze.c + optimizer); this binder does both name/type
+resolution and logical planning:
+
+- names resolve to alias-qualified output columns (``alias.col``) so
+  self-joins (TPC-H Q21's three lineitem aliases) stay unambiguous;
+- decimal scale arithmetic (int64 fixed-point, see types.SqlType);
+- string predicates fold into host-side dictionary lookup tables
+  (columnar/dictionary.py) at bind time;
+- implicit FROM-list joins are assembled from WHERE equi-conjuncts into a
+  left-deep tree, dimension side as build — the spirit of
+  cdbpath_motion_for_join's colocation reasoning, with cost stats to come;
+- aggregates are extracted from select/having/order expressions into a PAgg
+  node, outer expressions rewritten over its outputs (the reference's
+  TargetEntry/Aggref split).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+import numpy as np
+
+from cloudberry_tpu import types as T
+from cloudberry_tpu.catalog.catalog import Catalog, Table
+from cloudberry_tpu.columnar.dictionary import StringDictionary
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.sql import ast
+from cloudberry_tpu.types import DType, SqlType
+
+AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+MAX_DECIMAL_SCALE = 6
+
+
+class BindError(ValueError):
+    pass
+
+
+@dataclass
+class RangeEntry:
+    """One FROM item in scope: alias → its plan's output fields."""
+    alias: str
+    plan: N.PlanNode
+
+
+@dataclass
+class Scope:
+    entries: list[RangeEntry] = dc_field(default_factory=list)
+
+    def resolve(self, parts: tuple[str, ...]) -> tuple[RangeEntry, N.PlanField]:
+        if len(parts) == 2:
+            for e in self.entries:
+                if e.alias == parts[0]:
+                    for f in e.plan.fields:
+                        if f.name == f"{parts[0]}.{parts[1]}":
+                            return e, f
+            raise BindError(f"unknown column {'.'.join(parts)!r}")
+        # exact physical-name match first (generated names like "$agg1" or
+        # rewritten qualified names), then unqualified suffix match
+        for e in self.entries:
+            for f in e.plan.fields:
+                if f.name == parts[0]:
+                    return e, f
+        hits = []
+        seen = set()
+        for e in self.entries:
+            for f in e.plan.fields:
+                if f.name.split(".")[-1] == parts[0]:
+                    # entries rebound to one merged join plan are one source
+                    key = (id(e.plan), f.name)
+                    if key not in seen:
+                        seen.add(key)
+                        hits.append((e, f))
+        if not hits:
+            raise BindError(f"unknown column {parts[0]!r}")
+        if len(hits) > 1:
+            raise BindError(f"ambiguous column {parts[0]!r}")
+        return hits[0]
+
+    def aliases_of(self, node: ast.ExprNode) -> set[str]:
+        """Aliases referenced by an unbound expression (for conjunct
+        classification)."""
+        out: set[str] = set()
+
+        def walk(n):
+            if isinstance(n, ast.Name):
+                e, _ = self.resolve(n.parts)
+                out.add(e.alias)
+            for v in vars(n).values() if isinstance(n, ast.Node) else ():
+                if isinstance(v, ast.Node):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, ast.Node):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if isinstance(y, ast.Node):
+                                    walk(y)
+
+        walk(node)
+        return out
+
+
+def _unique_sets(plan: N.PlanNode, catalog: Catalog) -> list[frozenset[str]]:
+    """Column sets guaranteed unique in a plan's output (PK propagation):
+    scans expose unique base columns, joins preserve the PROBE side's
+    uniqueness (each probe row matches ≤1 build row), aggs are unique on
+    their group keys."""
+    cached = getattr(plan, "_unique_sets", None)
+    if cached is not None:
+        return cached
+    out: list[frozenset[str]] = []
+    if isinstance(plan, N.PScan) and plan.table_name != "$dual":
+        t = catalog.table(plan.table_name)
+        for phys, name in plan.column_map.items():
+            if t.is_unique(phys):
+                out.append(frozenset([name]))
+    elif isinstance(plan, (N.PFilter, N.PSort, N.PLimit, N.PMotion)):
+        out = _unique_sets(plan.children()[0], catalog)
+    elif isinstance(plan, N.PJoin):
+        out = _unique_sets(plan.probe, catalog)
+    elif isinstance(plan, N.PAgg):
+        if plan.group_keys:
+            out = [frozenset(n for n, _ in plan.group_keys)]
+    elif isinstance(plan, N.PProject):
+        renames = {}
+        for name, e in plan.exprs:
+            if isinstance(e, ex.ColumnRef):
+                renames[e.name] = name
+        for s in _unique_sets(plan.child, catalog):
+            if all(c in renames for c in s):
+                out.append(frozenset(renames[c] for c in s))
+    plan._unique_sets = out
+    return out
+
+
+def _build_is_unique(plan: N.PlanNode, keys: list[ex.Expr],
+                     catalog: Catalog) -> bool:
+    names = {k.name for k in keys if isinstance(k, ex.ColumnRef)}
+    return any(s <= names for s in _unique_sets(plan, catalog))
+
+
+class Binder:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self._counter = 0
+
+    def gensym(self, prefix: str) -> str:
+        self._counter += 1
+        return f"${prefix}{self._counter}"
+
+    # ------------------------------------------------------------ statements
+
+    def bind_select(self, sel: ast.Select) -> N.PlanNode:
+        scope = Scope()
+        plans: dict[str, N.PlanNode] = {}
+        post_join_filters: list[ast.ExprNode] = []
+
+        for ref in sel.from_refs:
+            alias, plan = self.bind_table_ref(ref, scope, post_join_filters)
+            plans[alias] = plan
+
+        if not plans:
+            # FROM-less SELECT (select 1): one-row dummy
+            plan = _const_row()
+        else:
+            conjuncts = _split_conjuncts(sel.where) if sel.where else []
+            edges, per_alias, residual = self._classify(conjuncts, scope)
+            for alias, preds in per_alias.items():
+                if alias not in plans:
+                    # alias buried in an explicit JOIN tree: filter post-join
+                    residual.extend(preds)
+                    continue
+                p = plans[alias]
+                for pred in preds:
+                    p = self._filter(p, self.bind_scalar(pred, scope))
+                plans[alias] = p
+                _rebind_scope(scope, alias, p)
+            plan = self._join_tree(plans, edges, scope)
+            for pred in residual:
+                plan = self._filter(plan, self.bind_scalar(pred, scope))
+
+        # -------- aggregation
+        has_agg = (bool(sel.group_by) or sel.having is not None
+                   or any(_has_agg(i.expr) for i in sel.items)
+                   or any(_has_agg(o.expr) for o in sel.order_by))
+
+        if has_agg:
+            plan, out_scope = self._bind_agg(sel, plan, scope)
+        else:
+            out_scope = scope
+            plan = self._bind_projection(sel, plan, scope)
+
+        # -------- DISTINCT
+        if sel.distinct:
+            child = plan
+            plan = N.PAgg(child, [(f.name, _colref(f)) for f in child.fields],
+                          [], capacity=_plan_capacity(child))
+            plan.fields = [N.PlanField(f.name, f.type, f.sdict)
+                           for f in child.fields]
+
+        # -------- ORDER BY / LIMIT
+        visible = list(plan.fields)
+        if sel.order_by:
+            keys = []
+            for oi in sel.order_by:
+                bound = self._bind_output_expr(oi.expr, plan, out_scope)
+                missing = ex.columns_used(bound) - set(plan.names)
+                if missing:
+                    # ORDER BY references non-output columns: carry them as a
+                    # hidden sort column through the projection, drop after
+                    if isinstance(plan, N.PProject):
+                        name = self.gensym("sort")
+                        plan.exprs.append((name, bound))
+                        f = N.PlanField(name, bound.dtype, _expr_dict(bound))
+                        plan.fields.append(f)
+                        bound = _colref(f)
+                    else:
+                        raise BindError(
+                            "ORDER BY expression references columns outside "
+                            "the select list")
+                keys.append((bound, oi.ascending))
+            s = N.PSort(plan, keys)
+            s.fields = list(plan.fields)
+            plan = s
+        if sel.limit is not None or sel.offset:
+            limit = sel.limit if sel.limit is not None else (1 << 62)
+            l = N.PLimit(plan, limit, sel.offset)
+            l.fields = list(plan.fields)
+            plan = l
+        if len(visible) != len(plan.fields):
+            drop = N.PProject(plan, [(f.name, _colref(f)) for f in visible])
+            drop.fields = visible
+            plan = drop
+        return plan
+
+    # ------------------------------------------------------------ FROM refs
+
+    def bind_table_ref(self, ref: ast.TableRefNode, scope: Scope,
+                       post_filters: list[ast.ExprNode]) -> tuple[str, N.PlanNode]:
+        if isinstance(ref, ast.TableName):
+            table = self._lookup_table(ref.name)
+            alias = ref.alias or ref.name
+            plan = _scan_node(table, alias)
+            scope.entries.append(RangeEntry(alias, plan))
+            return alias, plan
+        if isinstance(ref, ast.DerivedTable):
+            sub = self.bind_select(ref.select)
+            alias = ref.alias
+            # re-qualify output names under the derived alias
+            proj = N.PProject(sub, [(f"{alias}.{f.name.split('.')[-1]}",
+                                     ex.ColumnRef(f.name, f.type))
+                                    for f in sub.fields])
+            proj.fields = [N.PlanField(f"{alias}.{f.name.split('.')[-1]}",
+                                       f.type, f.sdict) for f in sub.fields]
+            scope.entries.append(RangeEntry(alias, proj))
+            return alias, proj
+        if isinstance(ref, ast.JoinRef):
+            return self._bind_join_ref(ref, scope, post_filters)
+        raise BindError(f"unsupported FROM item {type(ref).__name__}")
+
+    def _bind_join_ref(self, ref: ast.JoinRef, scope: Scope,
+                       post_filters: list[ast.ExprNode]) -> tuple[str, N.PlanNode]:
+        lalias, lplan = self.bind_table_ref(ref.left, scope, post_filters)
+        ralias, rplan = self.bind_table_ref(ref.right, scope, post_filters)
+        if ref.kind == "cross":
+            raise BindError("CROSS JOIN not supported yet")
+        conjs = _split_conjuncts(ref.on)
+        lkeys, rkeys, residual = [], [], []
+        for c in conjs:
+            if isinstance(c, ast.BinOp) and c.op == "=":
+                sides = (scope.aliases_of(c.left), scope.aliases_of(c.right))
+                lset = {e.alias for e in scope.entries
+                        if _plan_contains(lplan, e.plan) or e.alias == lalias}
+                if sides[0] <= lset and not (sides[1] & lset):
+                    lkeys.append(self.bind_scalar(c.left, scope))
+                    rkeys.append(self.bind_scalar(c.right, scope))
+                    continue
+                if sides[1] <= lset and not (sides[0] & lset):
+                    lkeys.append(self.bind_scalar(c.right, scope))
+                    rkeys.append(self.bind_scalar(c.left, scope))
+                    continue
+            residual.append(c)
+        if not lkeys:
+            raise BindError("JOIN requires at least one equi-condition")
+        if ref.kind == "inner":
+            # build side must be unique on its keys; prefer the smaller side
+            l_uniq = _build_is_unique(lplan, lkeys, self.catalog)
+            r_uniq = _build_is_unique(rplan, rkeys, self.catalog)
+            l_small = _plan_capacity(lplan) <= _plan_capacity(rplan)
+            if l_uniq and (not r_uniq or l_small):
+                plan = self._make_join("inner", lplan, rplan, lkeys, rkeys)
+            else:
+                plan = self._make_join("inner", rplan, lplan, rkeys, lkeys)
+        elif ref.kind == "left":
+            plan = self._make_join("left", rplan, lplan, rkeys, lkeys)
+        elif ref.kind == "right":
+            plan = self._make_join("left", lplan, rplan, lkeys, rkeys)
+        else:
+            raise BindError(f"{ref.kind} join not supported yet")
+        for c in residual:
+            plan = self._filter(plan, self.bind_scalar(c, scope))
+        # merge the two range entries into one compound entry set; rebind all
+        for e in scope.entries:
+            if e.alias in (lalias, ralias) or _plan_contains(plan, e.plan):
+                e.plan = plan
+        return lalias, plan
+
+    def _lookup_table(self, name: str) -> Table:
+        return self.catalog.table(name)
+
+    # --------------------------------------------------------- join assembly
+
+    def _classify(self, conjuncts: list[ast.ExprNode], scope: Scope):
+        """Split WHERE conjuncts into join edges / single-rel filters /
+        residual (multi-rel non-equi) — the planner's qual distribution."""
+        edges = []        # (alias_a, expr_a, alias_b, expr_b)
+        per_alias: dict[str, list[ast.ExprNode]] = {}
+        residual = []
+        for c in conjuncts:
+            aliases = scope.aliases_of(c)
+            if len(aliases) == 1:
+                per_alias.setdefault(next(iter(aliases)), []).append(c)
+            elif (len(aliases) == 2 and isinstance(c, ast.BinOp)
+                  and c.op == "="):
+                la = scope.aliases_of(c.left)
+                ra = scope.aliases_of(c.right)
+                if len(la) == 1 and len(ra) == 1 and la != ra:
+                    edges.append((next(iter(la)), c.left,
+                                  next(iter(ra)), c.right))
+                else:
+                    residual.append(c)
+            elif len(aliases) >= 2 and isinstance(c, ast.BinOp) and c.op == "or":
+                # Q19 pattern: OR whose every branch repeats the same
+                # equi-join condition — hoist the common conjuncts as join
+                # edges, keep the full OR as a residual filter.
+                for cc in _common_branch_conjuncts(c):
+                    if isinstance(cc, ast.BinOp) and cc.op == "=":
+                        la = scope.aliases_of(cc.left)
+                        ra = scope.aliases_of(cc.right)
+                        if len(la) == 1 and len(ra) == 1 and la != ra:
+                            edges.append((next(iter(la)), cc.left,
+                                          next(iter(ra)), cc.right))
+                residual.append(c)
+            elif len(aliases) == 0:
+                residual.append(c)
+            else:
+                residual.append(c)
+        return edges, per_alias, residual
+
+    def _join_tree(self, plans: dict[str, N.PlanNode], edges, scope: Scope
+                   ) -> N.PlanNode:
+        if len(plans) == 1:
+            return next(iter(plans.values()))
+        # group aliases by current plan object (explicit joins may share)
+        groups: dict[int, set[str]] = {}
+        plan_of: dict[int, N.PlanNode] = {}
+        for a, p in plans.items():
+            groups.setdefault(id(p), set()).add(a)
+            plan_of[id(p)] = p
+        # start from the largest capacity group (the fact side)
+        order = sorted(plan_of, key=lambda i: _plan_capacity(plan_of[i]),
+                       reverse=True)
+        joined_aliases = set(groups[order[0]])
+        current = plan_of[order[0]]
+        remaining = {i for i in order[1:]}
+        edges = list(edges)
+        while remaining:
+            # connectable groups, with bound keys for both orientations
+            candidates = []
+            for gid in remaining:
+                galiases = groups[gid]
+                used = [e for e in edges
+                        if (e[0] in joined_aliases and e[2] in galiases)
+                        or (e[2] in joined_aliases and e[0] in galiases)]
+                if not used:
+                    continue
+                cur_keys, new_keys = [], []
+                for (a, lx, b, rx) in used:
+                    if a in joined_aliases:
+                        cur_keys.append(self.bind_scalar(lx, scope))
+                        new_keys.append(self.bind_scalar(rx, scope))
+                    else:
+                        cur_keys.append(self.bind_scalar(rx, scope))
+                        new_keys.append(self.bind_scalar(lx, scope))
+                candidates.append((gid, used, cur_keys, new_keys))
+            if not candidates:
+                raise BindError("cross join between FROM items not supported "
+                                "(no join condition found)")
+            # Prefer candidates whose build side is provably unique on the
+            # join keys (PK side — join_lookup's contract); among those, the
+            # smallest build. Non-unique edges (e.g. Q5's c_nationkey =
+            # s_nationkey) are deferred until more edges make them unique.
+            def rank(c):
+                gid, used, cur_keys, new_keys = c
+                other = plan_of[gid]
+                uniq = _build_is_unique(other, new_keys, self.catalog)
+                return (0 if uniq else 1, _plan_capacity(other))
+
+            candidates.sort(key=rank)
+            gid, used, cur_keys, new_keys = candidates[0]
+            other = plan_of[gid]
+            new_unique = _build_is_unique(other, new_keys, self.catalog)
+            cur_unique = _build_is_unique(current, cur_keys, self.catalog)
+            for e in used:
+                edges.remove(e)
+            # orientation: build must be unique; prefer the smaller side
+            if new_unique and (not cur_unique
+                               or _plan_capacity(other)
+                               <= _plan_capacity(current)):
+                current = self._make_join("inner", other, current,
+                                          new_keys, cur_keys)
+            else:
+                current = self._make_join("inner", current, other,
+                                          cur_keys, new_keys)
+            joined_aliases |= groups[gid]
+            remaining.discard(gid)
+            for e in scope.entries:
+                if e.alias in joined_aliases:
+                    e.plan = current
+        return current
+
+    def _make_join(self, kind: str, build: N.PlanNode, probe: N.PlanNode,
+                   build_keys: list[ex.Expr], probe_keys: list[ex.Expr]
+                   ) -> N.PJoin:
+        payload = [f.name for f in build.fields]
+        match_name = self.gensym("match")
+        j = N.PJoin(kind, build, probe, build_keys, probe_keys,
+                    payload, match_name)
+        j.fields = list(probe.fields) + [
+            N.PlanField(f.name, f.type, f.sdict) for f in build.fields]
+        return j
+
+    def _filter(self, child: N.PlanNode, pred: ex.Expr) -> N.PFilter:
+        f = N.PFilter(child, pred)
+        f.fields = list(child.fields)
+        return f
+
+    # ---------------------------------------------------------- aggregation
+
+    def _bind_agg(self, sel: ast.Select, plan: N.PlanNode, scope: Scope
+                  ) -> tuple[N.PlanNode, Scope]:
+        group_keys: list[tuple[str, ex.Expr]] = []
+        key_name_by_ast: dict[str, str] = {}
+        alias_map = {i.alias: i.expr for i in sel.items if i.alias}
+        for g in sel.group_by:
+            if isinstance(g, ast.Name) and len(g.parts) == 1 \
+                    and g.parts[0] in alias_map:
+                g = alias_map[g.parts[0]]
+            bound = self.bind_scalar(g, scope)
+            name = (bound.name if isinstance(bound, ex.ColumnRef)
+                    else self.gensym("k"))
+            group_keys.append((name, bound))
+            key_name_by_ast[_ast_key(g)] = name
+
+        aggs: list[tuple[str, ex.AggCall]] = []
+        agg_names: dict[str, str] = {}
+
+        def extract(node: ast.ExprNode) -> ast.ExprNode:
+            """Replace aggregate calls with references to agg outputs."""
+            if isinstance(node, ast.FuncCall) and node.name in AGG_FUNCS:
+                key = _ast_key(node)
+                if key not in agg_names:
+                    if node.star:
+                        call = ex.AggCall("count", None)
+                    else:
+                        arg = self.bind_scalar(node.args[0], scope)
+                        func = node.name
+                        if func == "count" and node.distinct:
+                            func = "count_distinct"
+                        call = ex.AggCall(func, arg, distinct=node.distinct)
+                    agg_names[key] = self.gensym("agg")
+                    aggs.append((agg_names[key], call))
+                return ast.Name((agg_names[key],))
+            if _ast_key(node) in key_name_by_ast:
+                return ast.Name((key_name_by_ast[_ast_key(node)],))
+            out = node.__class__(**vars(node))
+            for fname, v in vars(node).items():
+                if isinstance(v, ast.ExprNode):
+                    setattr(out, fname, extract(v))
+                elif isinstance(v, list):
+                    setattr(out, fname, [
+                        extract(x) if isinstance(x, ast.ExprNode) else
+                        tuple(extract(y) if isinstance(y, ast.ExprNode) else y
+                              for y in x) if isinstance(x, tuple) else x
+                        for x in v])
+            return out
+
+        rewritten_items = [(i, extract(i.expr)) for i in sel.items]
+        rewritten_having = extract(sel.having) if sel.having else None
+        rewritten_order = [(extract(o.expr), o.ascending)
+                           for o in sel.order_by]
+
+        agg = N.PAgg(plan, group_keys, aggs,
+                     capacity=_agg_capacity(plan, group_keys))
+        agg.fields = [
+            N.PlanField(n, e.dtype,
+                        _expr_dict(e)) for n, e in group_keys
+        ] + [N.PlanField(n, c.dtype, None) for n, c in aggs]
+        plan = agg
+
+        agg_scope = Scope([RangeEntry("$agg", agg)])
+
+        if rewritten_having is not None:
+            plan = self._filter(plan, self.bind_scalar(rewritten_having,
+                                                       agg_scope))
+
+        exprs: list[tuple[str, ex.Expr]] = []
+        fields: list[N.PlanField] = []
+        taken: set[str] = set()
+        for (item, rw) in rewritten_items:
+            bound = self.bind_scalar(rw, agg_scope)
+            name = item.alias or _default_name(item.expr) or self.gensym("col")
+            name = _uniquify(name, taken)
+            exprs.append((name, bound))
+            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound)))
+        proj = N.PProject(plan, exprs)
+        proj.fields = fields
+        # stash rewritten order-by for _bind_output_expr
+        self._rewritten_order = {id(o.expr): r
+                                 for o, (r, _) in zip(sel.order_by,
+                                                      rewritten_order)}
+        self._agg_scope = agg_scope
+        return proj, agg_scope
+
+    def _bind_projection(self, sel: ast.Select, plan: N.PlanNode,
+                         scope: Scope) -> N.PlanNode:
+        exprs: list[tuple[str, ex.Expr]] = []
+        fields: list[N.PlanField] = []
+        taken: set[str] = set()
+        seen_sources: set[str] = set()
+        for item in sel.items:
+            if isinstance(item.expr, ast.Star):
+                for e in scope.entries:
+                    if item.expr.table and e.alias != item.expr.table:
+                        continue
+                    for f in e.plan.fields:
+                        if f.name in seen_sources:
+                            continue  # entries rebound to one merged plan
+                        seen_sources.add(f.name)
+                        name = _uniquify(f.name.split(".")[-1], taken)
+                        exprs.append((name, _colref(f)))
+                        fields.append(N.PlanField(name, f.type, f.sdict))
+                continue
+            bound = self.bind_scalar(item.expr, scope)
+            name = item.alias or _default_name(item.expr) or self.gensym("col")
+            name = _uniquify(name, taken)
+            exprs.append((name, bound))
+            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound)))
+        proj = N.PProject(plan, exprs)
+        proj.fields = fields
+        self._rewritten_order = {}
+        self._agg_scope = None
+        return proj
+
+    def _bind_output_expr(self, e: ast.ExprNode, plan: N.PlanNode,
+                          scope: Scope) -> ex.Expr:
+        """Bind an ORDER BY expr: select aliases/outputs first, then scope."""
+        if isinstance(e, ast.Name) and len(e.parts) == 1:
+            for f in plan.fields:
+                if f.name == e.parts[0]:
+                    return ex.ColumnRef(f.name, f.type)
+        rw = getattr(self, "_rewritten_order", {}).get(id(e))
+        if rw is not None and self._agg_scope is not None:
+            try:
+                return self.bind_scalar(rw, self._agg_scope)
+            except BindError:
+                pass
+        out_scope = Scope([RangeEntry("$out",
+                                      _fields_only_plan(plan.fields))])
+        try:
+            return self.bind_scalar(e, out_scope)
+        except BindError:
+            return self.bind_scalar(e, scope)
+
+    # ----------------------------------------------------------- expressions
+
+    def bind_scalar(self, node: ast.ExprNode, scope: Scope) -> ex.Expr:
+        b = lambda n: self.bind_scalar(n, scope)
+
+        if isinstance(node, ast.Name):
+            _, f = scope.resolve(node.parts)
+            return _colref(f)
+
+        if isinstance(node, ast.NumberLit):
+            return _bind_number(node.text)
+
+        if isinstance(node, ast.StringLit):
+            # bare string literal: binds to a code only in comparison context;
+            # keep as python-string literal for the comparison rewriter
+            return ex.Literal(node.value, T.STRING)
+
+        if isinstance(node, ast.BoolLit):
+            return ex.Literal(node.value, T.BOOL)
+
+        if isinstance(node, ast.DateLit):
+            return ex.Literal(T.date_to_days(node.value), T.DATE)
+
+        if isinstance(node, ast.IntervalLit):
+            raise BindError("interval literal only valid in date arithmetic")
+
+        if isinstance(node, ast.UnaryOp):
+            if node.op == "not":
+                return ex.UnaryOp("not", b(node.operand), T.BOOL)
+            operand = b(node.operand)
+            if node.op == "+":
+                return operand
+            if isinstance(operand, ex.Literal):
+                return ex.Literal(-operand.value, operand.dtype)
+            return ex.UnaryOp("-", operand, operand.dtype)
+
+        if isinstance(node, ast.BinOp):
+            return self._bind_binop(node, scope)
+
+        if isinstance(node, ast.Between):
+            lo = ast.BinOp(">=", node.expr, node.low)
+            hi = ast.BinOp("<=", node.expr, node.high)
+            both = ast.BinOp("and", lo, hi)
+            out = self.bind_scalar(both, scope)
+            if node.negated:
+                return ex.UnaryOp("not", out, T.BOOL)
+            return out
+
+        if isinstance(node, ast.InList):
+            e = b(node.expr)
+            if e.dtype.base == DType.STRING and all(
+                    isinstance(it, ast.StringLit) for it in node.items):
+                sdict = _require_dict(e)
+                values = {it.value for it in node.items}
+                table = sdict.predicate_table(lambda v: v in values)
+                out: ex.Expr = ex.DictLookup(e, table)
+            else:
+                cmps = [self._bind_binop(ast.BinOp("=", node.expr, it), scope)
+                        for it in node.items]
+                out = cmps[0]
+                for c in cmps[1:]:
+                    out = ex.BinOp("or", out, c, T.BOOL)
+            if node.negated:
+                return ex.UnaryOp("not", out, T.BOOL)
+            return out
+
+        if isinstance(node, ast.Like):
+            e = b(node.expr)
+            sdict = _require_dict(e)
+            out = ex.DictLookup(e, sdict.like_table(node.pattern))
+            if node.negated:
+                return ex.UnaryOp("not", out, T.BOOL)
+            return out
+
+        if isinstance(node, ast.IsNull):
+            e = b(node.operand)
+            if isinstance(e, ex.IsValid):
+                # match-mask column: IS NULL ⇔ not matched
+                return ex.IsValid(e.mask_name, negate=not node.negated)
+            # non-nullable columns: IS NULL is constant false
+            return ex.Literal(bool(node.negated), T.BOOL)
+
+        if isinstance(node, ast.CaseExpr):
+            whens = [(b(c), b(v)) for c, v in node.whens]
+            otherwise = b(node.otherwise) if node.otherwise else None
+            result_exprs = [v for _, v in whens] + (
+                [otherwise] if otherwise is not None else [])
+            if any(e.dtype.base == DType.STRING for e in result_exprs):
+                return self._bind_string_case(whens, otherwise, result_exprs)
+            rtype = _common_type([e.dtype for e in result_exprs])
+            whens = tuple((c, self._coerce(v, rtype)) for c, v in whens)
+            otherwise = self._coerce(otherwise, rtype) if otherwise is not None else None
+            return ex.CaseWhen(whens, otherwise, rtype)
+
+        if isinstance(node, ast.ExtractExpr):
+            e = b(node.operand)
+            if e.dtype.base != DType.DATE:
+                raise BindError("EXTRACT requires a date operand")
+            return ex.Func(f"extract_{node.part}", (e,), T.INT32)
+
+        if isinstance(node, ast.CastExpr):
+            e = b(node.operand)
+            t = T.SQL_TYPE_MAP.get(node.type_name)
+            if t is None:
+                raise BindError(f"unknown type {node.type_name!r}")
+            if t.base == DType.DECIMAL and node.scale is not None:
+                t = T.DECIMAL(node.scale)
+            return ex.Cast(e, t)
+
+        if isinstance(node, ast.SubstringExpr):
+            return self._bind_substring(node, scope)
+
+        if isinstance(node, ast.FuncCall):
+            if node.name in AGG_FUNCS:
+                raise BindError(f"aggregate {node.name}() not allowed here")
+            raise BindError(f"unknown function {node.name!r}")
+
+        raise BindError(f"unsupported expression {type(node).__name__}")
+
+    def _bind_string_case(self, whens, otherwise, result_exprs) -> ex.Expr:
+        """CASE yielding strings: all results must be literals (or one shared
+        dictionary column); literals get a fresh output dictionary."""
+        if not all(isinstance(e, ex.Literal) for e in result_exprs):
+            raise BindError("string CASE requires literal results "
+                            "(dictionary merge not supported yet)")
+        out_dict = StringDictionary()
+        enc = lambda e: ex.Literal(out_dict.add(e.value), T.STRING)
+        whens = tuple((c, enc(v)) for c, v in whens)
+        otherwise = enc(otherwise) if otherwise is not None else \
+            ex.Literal(-1, T.STRING)
+        out = ex.CaseWhen(whens, otherwise, T.STRING)
+        object.__setattr__(out, "_out_dict", out_dict)
+        return out
+
+    def _bind_substring(self, node: ast.SubstringExpr, scope: Scope) -> ex.Expr:
+        e = self.bind_scalar(node.operand, scope)
+        sdict = _require_dict(e)
+        if not (isinstance(node.start, ast.NumberLit)
+                and (node.length is None
+                     or isinstance(node.length, ast.NumberLit))):
+            raise BindError("SUBSTRING bounds must be literals")
+        start = int(node.start.text)
+        length = int(node.length.text) if node.length else None
+        out_dict = StringDictionary()
+        table = np.empty(len(sdict), dtype=np.int32)
+        for code, v in enumerate(sdict.values):
+            sub = v[start - 1:] if length is None else v[start - 1:start - 1 + length]
+            table[code] = out_dict.add(sub)
+        col = ex.DictLookup(e, table, T.STRING)
+        object.__setattr__(col, "_out_dict", out_dict)
+        return col
+
+    def _bind_binop(self, node: ast.BinOp, scope: Scope) -> ex.Expr:
+        op = node.op
+        if op in ("and", "or"):
+            return ex.BinOp(op, self.bind_scalar(node.left, scope),
+                            self.bind_scalar(node.right, scope), T.BOOL)
+
+        # date ± interval folding (literal side only, TPC-H style)
+        if op in ("+", "-"):
+            folded = self._fold_date_interval(node, scope)
+            if folded is not None:
+                return folded
+
+        left = self.bind_scalar(node.left, scope)
+        right = self.bind_scalar(node.right, scope)
+
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            return self._bind_comparison(op, left, right)
+
+        # arithmetic
+        lt, rt = left.dtype, right.dtype
+        if lt.base == DType.DATE or rt.base == DType.DATE:
+            if op == "-" and lt.base == DType.DATE and rt.base == DType.DATE:
+                return ex.BinOp("-", left, right, T.INT32)
+            if lt.base == DType.DATE and rt.base in (DType.INT32, DType.INT64):
+                return ex.BinOp(op, left, self._coerce(right, T.INT32), T.DATE)
+            raise BindError("unsupported date arithmetic")
+        if op == "/":
+            lf = self._coerce(left, T.FLOAT64)
+            rf = self._coerce(right, T.FLOAT64)
+            return ex.BinOp("/", lf, rf, T.FLOAT64)
+        if DType.FLOAT64 in (lt.base, rt.base):
+            return ex.BinOp(op, self._coerce(left, T.FLOAT64),
+                            self._coerce(right, T.FLOAT64), T.FLOAT64)
+        if DType.DECIMAL in (lt.base, rt.base):
+            if op == "*":
+                l = self._as_decimal(left)
+                r = self._as_decimal(right)
+                scale = l.dtype.scale + r.dtype.scale
+                out = ex.BinOp("*", l, r, T.DECIMAL(scale))
+                if scale > MAX_DECIMAL_SCALE:
+                    out = ex.Func(
+                        "scale_down",
+                        (out, ex.Literal(scale - MAX_DECIMAL_SCALE, T.INT32)),
+                        T.DECIMAL(MAX_DECIMAL_SCALE))
+                return out
+            # + / -: align scales
+            l = self._as_decimal(left)
+            r = self._as_decimal(right)
+            scale = max(l.dtype.scale, r.dtype.scale)
+            return ex.BinOp(op, self._coerce(l, T.DECIMAL(scale)),
+                            self._coerce(r, T.DECIMAL(scale)),
+                            T.DECIMAL(scale))
+        # pure integer
+        rtype = T.INT64 if DType.INT64 in (lt.base, rt.base) else T.INT32
+        return ex.BinOp(op, self._coerce(left, rtype),
+                        self._coerce(right, rtype), rtype)
+
+    def _bind_comparison(self, op: str, left: ex.Expr, right: ex.Expr) -> ex.Expr:
+        lt, rt = left.dtype, right.dtype
+        # string comparisons fold through the dictionary
+        if lt.base == DType.STRING or rt.base == DType.STRING:
+            if lt.base != DType.STRING:
+                left, right = right, left
+                op = _flip_op(op)
+                lt, rt = left.dtype, right.dtype
+            if isinstance(right, ex.Literal) and rt.base == DType.STRING:
+                sdict = _require_dict(left)
+                lit = right.value
+                if op == "=":
+                    code = sdict.code_of(lit)
+                    return ex.BinOp("=", left,
+                                    ex.Literal(code, T.STRING), T.BOOL)
+                if op == "<>":
+                    code = sdict.code_of(lit)
+                    return ex.BinOp("<>", left,
+                                    ex.Literal(code, T.STRING), T.BOOL)
+                table = sdict.predicate_table(
+                    lambda v: _str_cmp(op, v, lit))
+                return ex.DictLookup(left, table)
+            if rt.base == DType.STRING:
+                ldict, rdict = _expr_dict(left), _expr_dict(right)
+                if ldict is None or rdict is None:
+                    raise BindError("string comparison requires "
+                                    "dictionary-encoded operands")
+                if ldict is rdict:
+                    if op in ("=", "<>"):
+                        return ex.BinOp(op, left, right, T.BOOL)
+                    r = ldict.rank_table()
+                    return ex.BinOp(op, ex.DictLookup(left, r, T.INT32),
+                                    ex.DictLookup(right, r, T.INT32), T.BOOL)
+                if op in ("=", "<>"):
+                    # translate right codes into left's dictionary; absent → -1
+                    # (never equals a valid left code, and -1==-1 cannot arise
+                    # because left codes are always ≥ 0 for selected rows)
+                    xlat = np.fromiter(
+                        (ldict.code_of(v) for v in rdict.values),
+                        dtype=np.int32, count=len(rdict))
+                    rx = ex.DictLookup(right, xlat, T.STRING)
+                    eq = ex.BinOp("=", left, rx, T.BOOL)
+                    if op == "=":
+                        return eq
+                    return ex.UnaryOp("not", eq, T.BOOL)
+                # ordering across dictionaries: rank both against the union
+                union = sorted(set(ldict.values) | set(rdict.values))
+                pos = {v: i for i, v in enumerate(union)}
+                lr = np.fromiter((pos[v] for v in ldict.values),
+                                 dtype=np.int32, count=len(ldict))
+                rr = np.fromiter((pos[v] for v in rdict.values),
+                                 dtype=np.int32, count=len(rdict))
+                return ex.BinOp(op, ex.DictLookup(left, lr, T.INT32),
+                                ex.DictLookup(right, rr, T.INT32), T.BOOL)
+            raise BindError("string comparison requires a literal or column")
+        if lt.base == DType.DECIMAL or rt.base == DType.DECIMAL:
+            l = self._as_decimal(left)
+            r = self._as_decimal(right)
+            scale = max(l.dtype.scale, r.dtype.scale)
+            return ex.BinOp(op, self._coerce(l, T.DECIMAL(scale)),
+                            self._coerce(r, T.DECIMAL(scale)), T.BOOL)
+        if lt.base == DType.FLOAT64 or rt.base == DType.FLOAT64:
+            return ex.BinOp(op, self._coerce(left, T.FLOAT64),
+                            self._coerce(right, T.FLOAT64), T.BOOL)
+        return ex.BinOp(op, left, right, T.BOOL)
+
+    def _fold_date_interval(self, node: ast.BinOp, scope: Scope
+                            ) -> Optional[ex.Expr]:
+        if not isinstance(node.right, ast.IntervalLit):
+            return None
+        base = self.bind_scalar(node.left, scope)
+        iv = node.right
+        sign = 1 if node.op == "+" else -1
+        if isinstance(base, ex.Literal) and base.dtype.base == DType.DATE:
+            d = T.days_to_date(base.value)
+            d2 = _shift_date(d, sign * iv.n, iv.unit)
+            return ex.Literal(T.date_to_days(d2), T.DATE)
+        if iv.unit == "day":
+            return ex.BinOp("+" if sign > 0 else "-", base,
+                            ex.Literal(iv.n, T.INT32), T.DATE)
+        raise BindError("year/month interval arithmetic requires a literal date")
+
+    def _as_decimal(self, e: ex.Expr) -> ex.Expr:
+        if e.dtype.base == DType.DECIMAL:
+            return e
+        if e.dtype.base in (DType.INT32, DType.INT64):
+            if isinstance(e, ex.Literal):
+                return _literal_cast(e, T.DECIMAL(0))
+            return ex.Cast(e, T.DECIMAL(0))
+        if isinstance(e, ex.Literal) and e.dtype.base == DType.FLOAT64:
+            # float literal in decimal context: give it a scale from its text
+            return ex.Cast(e, T.DECIMAL(2))
+        raise BindError(f"cannot treat {e.dtype} as decimal")
+
+    def _coerce(self, e: ex.Expr, t: SqlType) -> ex.Expr:
+        if e.dtype == t:
+            return e
+        if isinstance(e, ex.Literal):
+            return _literal_cast(e, t)
+        return ex.Cast(e, t)
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _colref(f: N.PlanField) -> ex.ColumnRef:
+    """ColumnRef carrying the field's dictionary (string ops need it)."""
+    c = ex.ColumnRef(f.name, f.type)
+    if f.sdict is not None:
+        object.__setattr__(c, "_sdict", f.sdict)
+    return c
+
+
+def _scan_node(table: Table, alias: str) -> N.PScan:
+    cmap = {f.name: f"{alias}.{f.name}" for f in table.schema.fields}
+    scan = N.PScan(table.name, cmap, capacity=max(table.num_rows, 1),
+                   num_rows=table.num_rows)
+    scan.fields = [N.PlanField(f"{alias}.{f.name}", f.type,
+                               table.dicts.get(f.name))
+                   for f in table.schema.fields]
+    return scan
+
+
+def _fields_only_plan(fields: list[N.PlanField]) -> N.PlanNode:
+    p = N.PlanNode()
+    p.fields = [N.PlanField(f.name, f.type, f.sdict) for f in fields]
+    return p
+
+
+def _const_row() -> N.PlanNode:
+    p = N.PScan("$dual", {}, capacity=1)
+    p.fields = []
+    return p
+
+
+def _rebind_scope(scope: Scope, alias: str, plan: N.PlanNode) -> None:
+    for e in scope.entries:
+        if e.alias == alias:
+            e.plan = plan
+
+
+def _plan_contains(root: N.PlanNode, target: N.PlanNode) -> bool:
+    if root is target:
+        return True
+    return any(_plan_contains(c, target) for c in root.children())
+
+
+def _plan_capacity(p: N.PlanNode) -> int:
+    if isinstance(p, N.PScan):
+        return p.capacity
+    if isinstance(p, (N.PAgg,)):
+        return p.capacity
+    kids = p.children()
+    if not kids:
+        return 1
+    if isinstance(p, N.PJoin):
+        return _plan_capacity(p.probe)
+    return max(_plan_capacity(c) for c in kids)
+
+
+def _agg_capacity(child: N.PlanNode, group_keys) -> int:
+    if not group_keys:
+        return 1
+    # product of dictionary sizes when ALL keys are low-cardinality strings
+    prod = 1
+    for _, e in group_keys:
+        d = _expr_dict(e)
+        if d is None or len(d) > 10_000:
+            prod = None
+            break
+        prod *= max(len(d), 1)
+    cap = _plan_capacity(child)
+    if prod is not None:
+        return min(max(prod, 8), cap)
+    return cap
+
+
+def _or_branches(e: ast.ExprNode) -> list[ast.ExprNode]:
+    if isinstance(e, ast.BinOp) and e.op == "or":
+        return _or_branches(e.left) + _or_branches(e.right)
+    return [e]
+
+
+def _common_branch_conjuncts(or_expr: ast.ExprNode) -> list[ast.ExprNode]:
+    """Conjuncts present (structurally) in EVERY branch of an OR."""
+    branches = _or_branches(or_expr)
+    sets = []
+    for b in branches:
+        sets.append({_ast_key(c): c for c in _split_conjuncts(b)})
+    common_keys = set(sets[0])
+    for s in sets[1:]:
+        common_keys &= set(s)
+    return [sets[0][k] for k in common_keys]
+
+
+def _split_conjuncts(e: Optional[ast.ExprNode]) -> list[ast.ExprNode]:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinOp) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _has_agg(node: ast.ExprNode) -> bool:
+    if isinstance(node, ast.FuncCall) and node.name in AGG_FUNCS:
+        return True
+    for v in vars(node).values():
+        if isinstance(v, ast.ExprNode) and _has_agg(v):
+            return True
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ast.ExprNode) and _has_agg(x):
+                    return True
+                if isinstance(x, tuple) and any(
+                        isinstance(y, ast.ExprNode) and _has_agg(y) for y in x):
+                    return True
+    return False
+
+
+def _ast_key(node: ast.Node) -> str:
+    parts = [type(node).__name__]
+    for k, v in sorted(vars(node).items()):
+        if isinstance(v, ast.Node):
+            parts.append(f"{k}={_ast_key(v)}")
+        elif isinstance(v, list):
+            parts.append(f"{k}=[" + ",".join(
+                _ast_key(x) if isinstance(x, ast.Node) else repr(x)
+                for x in v) + "]")
+        else:
+            parts.append(f"{k}={v!r}")
+    return "(" + " ".join(parts) + ")"
+
+
+def _uniquify(name: str, taken: set[str]) -> str:
+    out = name
+    i = 1
+    while out in taken:
+        out = f"{name}_{i}"
+        i += 1
+    taken.add(out)
+    return out
+
+
+def _default_name(node: ast.ExprNode) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.parts[-1]
+    if isinstance(node, ast.FuncCall):
+        return node.name
+    return None
+
+
+def _bind_number(text: str) -> ex.Literal:
+    if "e" in text.lower():
+        return ex.Literal(float(text), T.FLOAT64)
+    if "." in text:
+        frac = text.split(".")[1]
+        scale = len(frac)
+        return ex.Literal(int(text.replace(".", "")), T.DECIMAL(scale))
+    return ex.Literal(int(text), T.INT64)
+
+
+def _literal_cast(e: ex.Literal, t: SqlType) -> ex.Literal:
+    v = e.value
+    if t.base == DType.DECIMAL:
+        if e.dtype.base == DType.DECIMAL:
+            diff = t.scale - e.dtype.scale
+            return ex.Literal(int(v) * 10 ** diff if diff >= 0
+                              else int(round(v / 10 ** (-diff))), t)
+        if e.dtype.base in (DType.INT32, DType.INT64):
+            return ex.Literal(int(v) * 10 ** t.scale, t)
+        if e.dtype.base == DType.FLOAT64:
+            return ex.Literal(int(round(v * 10 ** t.scale)), t)
+    if t.base == DType.FLOAT64:
+        if e.dtype.base == DType.DECIMAL:
+            return ex.Literal(v / 10 ** e.dtype.scale, t)
+        return ex.Literal(float(v), t)
+    if t.base in (DType.INT32, DType.INT64):
+        return ex.Literal(int(v), t)
+    return ex.Literal(v, t)
+
+
+def _common_type(ts: list[SqlType]) -> SqlType:
+    if any(t.base == DType.FLOAT64 for t in ts):
+        return T.FLOAT64
+    if any(t.base == DType.DECIMAL for t in ts):
+        scale = max(t.scale for t in ts if t.base == DType.DECIMAL)
+        return T.DECIMAL(scale)
+    if any(t.base == DType.INT64 for t in ts):
+        return T.INT64
+    return ts[0]
+
+
+def _flip_op(op: str) -> str:
+    return {"=": "=", "<>": "<>", "<": ">", "<=": ">=",
+            ">": "<", ">=": "<="}[op]
+
+
+def _str_cmp(op: str, a: str, b: str) -> bool:
+    return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b,
+            "=": a == b, "<>": a != b}[op]
+
+
+def _require_dict(e: ex.Expr) -> StringDictionary:
+    d = _expr_dict(e)
+    if d is None:
+        raise BindError("string operation requires a dictionary-encoded column")
+    return d
+
+
+def _expr_dict(e: ex.Expr) -> Optional[StringDictionary]:
+    """The dictionary governing a STRING-typed expression's codes."""
+    if e.dtype.base != DType.STRING:
+        return None
+    if hasattr(e, "_out_dict"):
+        return e._out_dict  # substring-produced dictionary
+    if isinstance(e, ex.ColumnRef):
+        return getattr(e, "_sdict", None)
+    if isinstance(e, ex.CaseWhen):
+        for _, v in e.whens:
+            d = _expr_dict(v)
+            if d is not None:
+                return d
+    return None
+
+
+def _shift_date(d: datetime.date, n: int, unit: str) -> datetime.date:
+    if unit == "day":
+        return d + datetime.timedelta(days=n)
+    if unit == "month":
+        m = d.month - 1 + n
+        y = d.year + m // 12
+        m = m % 12 + 1
+        day = min(d.day, _days_in_month(y, m))
+        return datetime.date(y, m, day)
+    if unit == "year":
+        return _shift_date(d, 12 * n, "month")
+    raise BindError(f"unsupported interval unit {unit}")
+
+
+def _days_in_month(y: int, m: int) -> int:
+    if m == 12:
+        return 31
+    return (datetime.date(y, m + 1, 1) - datetime.date(y, m, 1)).days

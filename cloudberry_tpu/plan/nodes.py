@@ -1,0 +1,170 @@
+"""Plan IR — one node family used logically and physically.
+
+The reference has separate Path→Plan layers (src/backend/optimizer,
+src/backend/nodes/plannodes.h); here a single tree serves both: the binder
+produces it, the distribution pass (plan/distribute.py) rewrites it by
+inserting Motion nodes and annotating Sharding (the CdbPathLocus analog,
+cdbpathlocus.h:41-68), and the executor lowers it to one jitted function.
+
+Every node carries an output schema: a list of PlanField (unique name, type,
+host-side dictionary for strings). Row capacity is static per node — the
+XLA shape discipline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+from cloudberry_tpu.columnar.dictionary import StringDictionary
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan.sharding import Sharding
+from cloudberry_tpu.types import SqlType
+
+
+@dataclass(frozen=True)
+class PlanField:
+    name: str
+    type: SqlType
+    sdict: Optional[StringDictionary] = None  # for STRING columns
+
+
+@dataclass
+class PlanNode:
+    fields: list[PlanField] = dc_field(default_factory=list, init=False)
+    sharding: Sharding = dc_field(default=None, init=False)  # set by distribute
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> PlanField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def children(self) -> list["PlanNode"]:
+        return []
+
+    def title(self) -> str:
+        return type(self).__name__.removeprefix("P")
+
+    def explain(self, indent: int = 0) -> str:
+        lines = [" " * indent + "-> " + self.title()
+                 + (f"  [{self.sharding}]" if self.sharding else "")]
+        for c in self.children():
+            lines.append(c.explain(indent + 3))
+        return "\n".join(lines)
+
+
+@dataclass
+class PScan(PlanNode):
+    table_name: str
+    # physical column name in storage → output (aliased) field name
+    column_map: dict[str, str]
+    capacity: int          # static array capacity (≥1 even when empty)
+    num_rows: int = -1     # actual rows; -1 means == capacity
+
+    def title(self):
+        return f"Scan {self.table_name} [{self.capacity}]"
+
+
+@dataclass
+class PFilter(PlanNode):
+    child: PlanNode
+    predicate: ex.Expr
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class PProject(PlanNode):
+    child: PlanNode
+    exprs: list[tuple[str, ex.Expr]]  # output name -> expr
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class PJoin(PlanNode):
+    """Sorted-build lookup join. ``build`` must be unique on build_keys —
+    verified at runtime (dup detection), the nodeHashjoin analog."""
+
+    kind: str  # 'inner' | 'left' | 'semi' | 'anti'
+    build: PlanNode
+    probe: PlanNode
+    build_keys: list[ex.Expr]
+    probe_keys: list[ex.Expr]
+    # columns of build to carry into output (gathered); probe cols pass through
+    build_payload: list[str] = dc_field(default_factory=list)
+    # name of the bool match-mask output column (left join null tests)
+    match_name: Optional[str] = None
+
+    def children(self):
+        return [self.build, self.probe]
+
+    def title(self):
+        return f"Join {self.kind}"
+
+
+@dataclass
+class PAgg(PlanNode):
+    """mode: 'single' | 'partial' | 'final' (multi-stage agg,
+    cdbgroupingpaths.c analog)."""
+
+    child: PlanNode
+    group_keys: list[tuple[str, ex.Expr]]   # output key name -> expr
+    aggs: list[tuple[str, ex.AggCall]]      # output agg name -> call
+    capacity: int                            # max groups (static)
+    mode: str = "single"
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        kind = "GroupAgg" if self.group_keys else "Agg"
+        return f"{kind} {self.mode} [{self.capacity}]"
+
+
+@dataclass
+class PSort(PlanNode):
+    child: PlanNode
+    keys: list[tuple[ex.Expr, bool]]  # (expr, ascending)
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class PLimit(PlanNode):
+    child: PlanNode
+    limit: int
+    offset: int = 0
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        return f"Limit {self.limit}" + (f" offset {self.offset}" if self.offset else "")
+
+
+@dataclass
+class PMotion(PlanNode):
+    """The Motion node (nodeMotion.c analog). kind:
+    'gather'       — all segments → singleton (GATHER_MOTION)
+    'redistribute' — hash on keys (HASH_MOTION → all_to_all)
+    'broadcast'    — every row to every segment (BROADCAST → all_gather)
+    """
+
+    child: PlanNode
+    kind: str
+    hash_keys: list[ex.Expr] = dc_field(default_factory=list)
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        return f"Motion {self.kind}"

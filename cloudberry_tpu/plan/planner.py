@@ -1,0 +1,119 @@
+"""Statement planning + DDL/DML execution.
+
+The dispatch analog of exec_simple_query (src/backend/tcop/postgres.c:1655):
+DDL executes directly against the catalog; SELECT goes binder → distribution
+pass → executable plan. The distribution pass (plan/distribute.py) is the
+cdbllize analog — it inserts Motion nodes per the Sharding algebra.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from cloudberry_tpu import types as T
+from cloudberry_tpu.catalog.catalog import DistributionPolicy
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.plan.binder import BindError, Binder
+from cloudberry_tpu.sql import ast
+from cloudberry_tpu.types import Field, Schema, SqlType
+
+
+@dataclass
+class PlanResult:
+    is_ddl: bool = False
+    ddl_result: Any = None
+    plan: Optional[N.PlanNode] = None
+
+
+def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
+    catalog = session.catalog
+
+    if isinstance(stmt, ast.CreateTable):
+        fields = []
+        for c in stmt.columns:
+            t = T.SQL_TYPE_MAP.get(c.type_name)
+            if t is None:
+                raise BindError(f"unknown type {c.type_name!r}")
+            if t.base == T.DType.DECIMAL and c.scale is not None:
+                t = T.DECIMAL(c.scale)
+            fields.append(Field(c.name, t, nullable=not c.not_null))
+        policy = {
+            "hash": DistributionPolicy.hashed(*stmt.dist_keys),
+            "replicated": DistributionPolicy.replicated(),
+            "random": DistributionPolicy.random(),
+        }[stmt.distribution]
+        catalog.create_table(stmt.name, Schema(tuple(fields)), policy,
+                             if_not_exists=stmt.if_not_exists)
+        return PlanResult(is_ddl=True, ddl_result=f"CREATE TABLE {stmt.name}")
+
+    if isinstance(stmt, ast.DropTable):
+        catalog.drop_table(stmt.name, if_exists=stmt.if_exists)
+        return PlanResult(is_ddl=True, ddl_result=f"DROP TABLE {stmt.name}")
+
+    if isinstance(stmt, ast.InsertValues):
+        return PlanResult(is_ddl=True,
+                          ddl_result=_insert_values(catalog, stmt))
+
+    if isinstance(stmt, ast.Explain):
+        binder = Binder(catalog)
+        plan = binder.bind_select(stmt.stmt)
+        plan = _distribute(plan, session)
+        return PlanResult(is_ddl=True, ddl_result=plan.explain())
+
+    if isinstance(stmt, ast.Select):
+        binder = Binder(catalog)
+        plan = binder.bind_select(stmt)
+        plan = _distribute(plan, session)
+        return PlanResult(plan=plan)
+
+    raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+
+def _distribute(plan: N.PlanNode, session) -> N.PlanNode:
+    if session.config.n_segments > 1:
+        from cloudberry_tpu.plan.distribute import distribute_plan
+
+        return distribute_plan(plan, session)
+    return plan
+
+
+def _insert_values(catalog, stmt: ast.InsertValues) -> str:
+    from cloudberry_tpu.columnar.batch import encode_column
+
+    table = catalog.table(stmt.table)
+    cols = stmt.columns or table.schema.names
+    if set(cols) != set(table.schema.names):
+        raise BindError("INSERT must target all columns (no defaults yet)")
+    by_col: dict[str, list] = {c: [] for c in cols}
+    for row in stmt.rows:
+        if len(row) != len(cols):
+            raise BindError("INSERT row arity mismatch")
+        for c, v in zip(cols, row):
+            by_col[c].append(_literal_value(v))
+    new_data = {}
+    for f in table.schema.fields:
+        vals = np.asarray(by_col[f.name])
+        arr = encode_column(vals, f, table.dicts)
+        old = table.data.get(f.name)
+        new_data[f.name] = arr if old is None or len(old) == 0 \
+            else np.concatenate([old, arr])
+    table.set_data(new_data, table.dicts)
+    return f"INSERT {len(stmt.rows)}"
+
+
+def _literal_value(e: ast.ExprNode):
+    if isinstance(e, ast.NumberLit):
+        return float(e.text) if "." in e.text or "e" in e.text.lower() \
+            else int(e.text)
+    if isinstance(e, ast.StringLit):
+        return e.value
+    if isinstance(e, ast.DateLit):
+        return e.value
+    if isinstance(e, ast.BoolLit):
+        return e.value
+    if isinstance(e, ast.UnaryOp) and e.op == "-":
+        return -_literal_value(e.operand)
+    raise BindError("INSERT VALUES must be literals")

@@ -1,0 +1,252 @@
+"""Unbound SQL AST — what the parser produces.
+
+The reference's analog is PG's raw parse tree (src/backend/parser/gram.y,
+with Cloudberry additions like DISTRIBUTED BY at gram.y's CREATE TABLE
+productions). This AST covers the analytical SQL surface TPC-H/TPC-DS-class
+workloads need; the binder (plan/binder.py) resolves names and types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class Node:
+    pass
+
+
+# ---------------------------------------------------------------- expressions
+
+
+class ExprNode(Node):
+    pass
+
+
+@dataclass
+class Name(ExprNode):
+    parts: tuple[str, ...]  # ("t", "col") or ("col",)
+
+    @property
+    def text(self) -> str:
+        return ".".join(self.parts)
+
+
+@dataclass
+class Star(ExprNode):
+    table: Optional[str] = None  # t.* if set
+
+
+@dataclass
+class NumberLit(ExprNode):
+    text: str  # keep literal text; binder decides int vs decimal + scale
+
+
+@dataclass
+class StringLit(ExprNode):
+    value: str
+
+
+@dataclass
+class DateLit(ExprNode):
+    value: str  # ISO yyyy-mm-dd
+
+
+@dataclass
+class IntervalLit(ExprNode):
+    n: int
+    unit: str  # 'year' | 'month' | 'day'
+
+
+@dataclass
+class BoolLit(ExprNode):
+    value: bool
+
+
+@dataclass
+class NullLit(ExprNode):
+    pass
+
+
+@dataclass
+class BinOp(ExprNode):
+    op: str
+    left: ExprNode
+    right: ExprNode
+
+
+@dataclass
+class UnaryOp(ExprNode):
+    op: str  # 'not' | '-' | '+'
+    operand: ExprNode
+
+
+@dataclass
+class IsNull(ExprNode):
+    operand: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class Between(ExprNode):
+    expr: ExprNode
+    low: ExprNode
+    high: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class InList(ExprNode):
+    expr: ExprNode
+    items: list[ExprNode]
+    negated: bool = False
+
+
+@dataclass
+class Like(ExprNode):
+    expr: ExprNode
+    pattern: str
+    negated: bool = False
+
+
+@dataclass
+class FuncCall(ExprNode):
+    name: str
+    args: list[ExprNode]
+    distinct: bool = False
+    star: bool = False  # count(*)
+
+
+@dataclass
+class ExtractExpr(ExprNode):
+    part: str  # 'year' | 'month' | 'day'
+    operand: ExprNode
+
+
+@dataclass
+class SubstringExpr(ExprNode):
+    operand: ExprNode
+    start: ExprNode
+    length: Optional[ExprNode]
+
+
+@dataclass
+class CaseExpr(ExprNode):
+    whens: list[tuple[ExprNode, ExprNode]]
+    otherwise: Optional[ExprNode]
+
+
+@dataclass
+class CastExpr(ExprNode):
+    operand: ExprNode
+    type_name: str
+    scale: Optional[int] = None
+
+
+@dataclass
+class ScalarSubquery(ExprNode):
+    select: "Select"
+
+
+@dataclass
+class InSubquery(ExprNode):
+    expr: ExprNode
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass
+class Exists(ExprNode):
+    select: "Select"
+    negated: bool = False
+
+
+# ---------------------------------------------------------------- table refs
+
+
+class TableRefNode(Node):
+    pass
+
+
+@dataclass
+class TableName(TableRefNode):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class DerivedTable(TableRefNode):
+    select: "Select"
+    alias: str
+
+
+@dataclass
+class JoinRef(TableRefNode):
+    kind: str  # 'inner' | 'left' | 'right' | 'full' | 'cross'
+    left: TableRefNode
+    right: TableRefNode
+    on: Optional[ExprNode]
+
+
+# ---------------------------------------------------------------- statements
+
+
+@dataclass
+class SelectItem(Node):
+    expr: ExprNode
+    alias: Optional[str] = None
+
+
+@dataclass
+class OrderItem(Node):
+    expr: ExprNode
+    ascending: bool = True
+
+
+@dataclass
+class Select(Node):
+    items: list[SelectItem]
+    from_refs: list[TableRefNode] = field(default_factory=list)
+    where: Optional[ExprNode] = None
+    group_by: list[ExprNode] = field(default_factory=list)
+    having: Optional[ExprNode] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    type_name: str
+    scale: Optional[int] = None
+    not_null: bool = False
+
+
+@dataclass
+class CreateTable(Node):
+    name: str
+    columns: list[ColumnDef]
+    distribution: str = "random"  # 'hash' | 'random' | 'replicated'
+    dist_keys: tuple[str, ...] = ()
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class InsertValues(Node):
+    table: str
+    columns: list[str]
+    rows: list[list[ExprNode]]
+
+
+@dataclass
+class Explain(Node):
+    stmt: Select
+    analyze: bool = False

@@ -1,0 +1,499 @@
+"""Recursive-descent SQL parser for the TPC-H/TPC-DS-class surface.
+
+The reference's grammar is bison (src/backend/parser/gram.y) with MPP
+additions — DISTRIBUTED BY / REPLICATED / RANDOMLY on CREATE TABLE is the one
+reproduced here (gram.y OptDistributedBy). Statements supported: SELECT
+(joins, subqueries, CASE, EXTRACT, SUBSTRING, BETWEEN/IN/LIKE/EXISTS,
+GROUP BY/HAVING/ORDER BY/LIMIT), CREATE/DROP TABLE, INSERT … VALUES, EXPLAIN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cloudberry_tpu.sql import ast
+from cloudberry_tpu.sql.lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+def parse_sql(sql: str) -> ast.Node:
+    p = Parser(tokenize(sql))
+    stmt = p.parse_statement()
+    p.accept_op(";")
+    p.expect_eof()
+    return stmt
+
+
+class Parser:
+    def __init__(self, tokens: list[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def advance(self) -> Token:
+        t = self.cur
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        return self.cur.kind == "ident" and self.cur.text in kws
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        if self.at_kw(*kws):
+            return self.advance().text
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise ParseError(f"expected {kw.upper()} at {self.cur.text!r} "
+                             f"(pos {self.cur.pos})")
+
+    def at_op(self, *ops: str) -> bool:
+        return self.cur.kind == "op" and self.cur.text in ops
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.at_op(*ops):
+            return self.advance().text
+        return None
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r} at {self.cur.text!r} "
+                             f"(pos {self.cur.pos})")
+
+    def expect_ident(self) -> str:
+        if self.cur.kind != "ident":
+            raise ParseError(f"expected identifier at {self.cur.text!r} "
+                             f"(pos {self.cur.pos})")
+        return self.advance().text
+
+    def expect_eof(self) -> None:
+        if self.cur.kind != "eof":
+            raise ParseError(f"unexpected trailing input at {self.cur.text!r} "
+                             f"(pos {self.cur.pos})")
+
+    # ----------------------------------------------------------- statements
+
+    def parse_statement(self) -> ast.Node:
+        if self.at_kw("select"):
+            return self.parse_select()
+        if self.at_kw("explain"):
+            self.advance()
+            analyze = bool(self.accept_kw("analyze"))
+            return ast.Explain(self.parse_select(), analyze)
+        if self.at_kw("create"):
+            return self.parse_create_table()
+        if self.at_kw("drop"):
+            self.advance()
+            self.expect_kw("table")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropTable(self.expect_ident(), if_exists)
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        raise ParseError(f"unsupported statement start {self.cur.text!r}")
+
+    def parse_create_table(self) -> ast.CreateTable:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.expect_ident()
+        self.expect_op("(")
+        cols = []
+        while True:
+            cname = self.expect_ident()
+            tname = self.expect_ident()
+            scale = None
+            if self.accept_op("("):
+                self.advance()  # precision (ignored)
+                if self.accept_op(","):
+                    scale = int(self.advance().text)
+                self.expect_op(")")
+            not_null = False
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                not_null = True
+            self.accept_kw("primary") and self.expect_kw("key")
+            cols.append(ast.ColumnDef(cname, tname, scale, not_null))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        distribution, keys = "random", ()
+        if self.accept_kw("distributed"):
+            if self.accept_kw("by"):
+                self.expect_op("(")
+                ks = [self.expect_ident()]
+                while self.accept_op(","):
+                    ks.append(self.expect_ident())
+                self.expect_op(")")
+                distribution, keys = "hash", tuple(ks)
+            elif self.accept_kw("replicated"):
+                distribution = "replicated"
+            elif self.accept_kw("randomly"):
+                distribution = "random"
+            else:
+                raise ParseError("expected BY/REPLICATED/RANDOMLY after DISTRIBUTED")
+        return ast.CreateTable(name, cols, distribution, keys, if_not_exists)
+
+    def parse_insert(self) -> ast.InsertValues:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.expect_ident()
+        columns: list[str] = []
+        if self.accept_op("("):
+            columns.append(self.expect_ident())
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_expr()]
+            while self.accept_op(","):
+                row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return ast.InsertValues(table, columns, rows)
+
+    # --------------------------------------------------------------- SELECT
+
+    def parse_select(self) -> ast.Select:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        sel = ast.Select(items=items, distinct=distinct)
+        if self.accept_kw("from"):
+            sel.from_refs = [self.parse_table_ref()]
+            while self.accept_op(","):
+                sel.from_refs.append(self.parse_table_ref())
+        if self.accept_kw("where"):
+            sel.where = self.parse_expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            sel.group_by = [self.parse_expr()]
+            while self.accept_op(","):
+                sel.group_by.append(self.parse_expr())
+        if self.accept_kw("having"):
+            sel.having = self.parse_expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            sel.order_by = [self.parse_order_item()]
+            while self.accept_op(","):
+                sel.order_by.append(self.parse_order_item())
+        if self.accept_kw("limit"):
+            sel.limit = int(self.advance().text)
+        if self.accept_kw("offset"):
+            sel.offset = int(self.advance().text)
+        return sel
+
+    def parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.advance()
+            return ast.SelectItem(ast.Star())
+        # t.* pattern
+        if (self.cur.kind == "ident"
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].text == "."
+                and self.toks[self.i + 2].kind == "op"
+                and self.toks[self.i + 2].text == "*"):
+            t = self.advance().text
+            self.advance()
+            self.advance()
+            return ast.SelectItem(ast.Star(table=t))
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.cur.kind == "ident" and not self.at_kw(*_CLAUSE_KWS):
+            alias = self.advance().text
+        return ast.SelectItem(e, alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        asc = True
+        if self.accept_kw("desc"):
+            asc = False
+        else:
+            self.accept_kw("asc")
+        return ast.OrderItem(e, asc)
+
+    # ----------------------------------------------------------- table refs
+
+    def parse_table_ref(self) -> ast.TableRefNode:
+        left = self.parse_table_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.parse_table_primary()
+                left = ast.JoinRef("cross", left, right, None)
+                continue
+            kind = None
+            if self.at_kw("inner", "join"):
+                self.accept_kw("inner")
+                kind = "inner"
+            elif self.at_kw("left", "right", "full"):
+                kind = self.advance().text
+                self.accept_kw("outer")
+            else:
+                return left
+            self.expect_kw("join")
+            right = self.parse_table_primary()
+            self.expect_kw("on")
+            on = self.parse_expr()
+            left = ast.JoinRef(kind, left, right, on)
+
+    def parse_table_primary(self) -> ast.TableRefNode:
+        if self.accept_op("("):
+            if self.at_kw("select"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.expect_ident()
+                return ast.DerivedTable(sub, alias)
+            ref = self.parse_table_ref()
+            self.expect_op(")")
+            return ref
+        name = self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif (self.cur.kind == "ident"
+              and not self.at_kw(*_CLAUSE_KWS, "inner", "left", "right",
+                                 "full", "cross", "join", "on")):
+            alias = self.advance().text
+        return ast.TableName(name, alias)
+
+    # ---------------------------------------------------------- expressions
+
+    def parse_expr(self) -> ast.ExprNode:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.ExprNode:
+        e = self.parse_and()
+        while self.accept_kw("or"):
+            e = ast.BinOp("or", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> ast.ExprNode:
+        e = self.parse_not()
+        while self.accept_kw("and"):
+            e = ast.BinOp("and", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> ast.ExprNode:
+        if self.accept_kw("not"):
+            return ast.UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> ast.ExprNode:
+        if self.at_kw("exists"):
+            self.advance()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.Exists(sub)
+        e = self.parse_additive()
+        negated = bool(self.accept_kw("not"))
+        if self.accept_kw("between"):
+            low = self.parse_additive()
+            self.expect_kw("and")
+            high = self.parse_additive()
+            return ast.Between(e, low, high, negated)
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            if self.at_kw("select"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.InSubquery(e, sub, negated)
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.InList(e, items, negated)
+        if self.accept_kw("like"):
+            pat = self.advance()
+            if pat.kind != "string":
+                raise ParseError("LIKE pattern must be a string literal")
+            return ast.Like(e, pat.text, negated)
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return ast.IsNull(e, neg)
+        if negated:
+            raise ParseError("expected BETWEEN/IN/LIKE after NOT")
+        op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
+        if op:
+            if op == "!=":
+                op = "<>"
+            rhs = self.parse_additive()
+            return ast.BinOp(op, e, rhs)
+        return e
+
+    def parse_additive(self) -> ast.ExprNode:
+        e = self.parse_multiplicative()
+        while True:
+            op = self.accept_op("+", "-", "||")
+            if not op:
+                return e
+            e = ast.BinOp(op, e, self.parse_multiplicative())
+
+    def parse_multiplicative(self) -> ast.ExprNode:
+        e = self.parse_unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return e
+            e = ast.BinOp(op, e, self.parse_unary())
+
+    def parse_unary(self) -> ast.ExprNode:
+        op = self.accept_op("-", "+")
+        if op:
+            return ast.UnaryOp(op, self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self) -> ast.ExprNode:
+        t = self.cur
+        if t.kind == "number":
+            self.advance()
+            return ast.NumberLit(t.text)
+        if t.kind == "string":
+            self.advance()
+            return ast.StringLit(t.text)
+        if self.at_op("("):
+            self.advance()
+            if self.at_kw("select"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.ScalarSubquery(sub)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident":
+            return self.parse_ident_expr()
+        raise ParseError(f"unexpected token {t.text!r} (pos {t.pos})")
+
+    def parse_ident_expr(self) -> ast.ExprNode:
+        word = self.cur.text
+        if word == "date" and self.toks[self.i + 1].kind == "string":
+            self.advance()
+            return ast.DateLit(self.advance().text)
+        if word == "interval" and self.toks[self.i + 1].kind == "string":
+            self.advance()
+            n = int(self.advance().text)
+            unit = self.expect_ident()
+            unit = unit.rstrip("s")
+            if unit not in ("year", "month", "day"):
+                raise ParseError(f"unsupported interval unit {unit!r}")
+            return ast.IntervalLit(n, unit)
+        if word == "case":
+            return self.parse_case()
+        if word == "cast":
+            self.advance()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("as")
+            tname = self.expect_ident()
+            scale = None
+            if self.accept_op("("):
+                self.advance()
+                if self.accept_op(","):
+                    scale = int(self.advance().text)
+                self.expect_op(")")
+            self.expect_op(")")
+            return ast.CastExpr(e, tname, scale)
+        if word == "extract":
+            self.advance()
+            self.expect_op("(")
+            part = self.expect_ident()
+            self.expect_kw("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.ExtractExpr(part, e)
+        if word == "substring":
+            self.advance()
+            self.expect_op("(")
+            e = self.parse_expr()
+            if self.accept_kw("from"):
+                start = self.parse_expr()
+                length = self.parse_expr() if self.accept_kw("for") else None
+            else:
+                self.expect_op(",")
+                start = self.parse_expr()
+                length = self.parse_expr() if self.accept_op(",") else None
+            self.expect_op(")")
+            return ast.SubstringExpr(e, start, length)
+        if word in ("true", "false"):
+            self.advance()
+            return ast.BoolLit(word == "true")
+        if word == "null":
+            self.advance()
+            return ast.NullLit()
+        if word in _RESERVED:
+            raise ParseError(f"unexpected keyword {word.upper()!r} "
+                             f"(pos {self.cur.pos})")
+        # function call or (qualified) column name
+        if (self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].text == "("):
+            fname = self.advance().text
+            self.advance()  # (
+            if self.accept_op("*"):
+                self.expect_op(")")
+                return ast.FuncCall(fname, [], star=True)
+            distinct = bool(self.accept_kw("distinct"))
+            args: list[ast.ExprNode] = []
+            if not self.at_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall(fname, args, distinct=distinct)
+        parts = [self.advance().text]
+        while self.at_op(".") and self.toks[self.i + 1].kind == "ident":
+            self.advance()
+            parts.append(self.advance().text)
+        return ast.Name(tuple(parts))
+
+    def parse_case(self) -> ast.CaseExpr:
+        self.expect_kw("case")
+        whens: list[tuple[ast.ExprNode, ast.ExprNode]] = []
+        while self.accept_kw("when"):
+            c = self.parse_expr()
+            self.expect_kw("then")
+            v = self.parse_expr()
+            whens.append((c, v))
+        otherwise = self.parse_expr() if self.accept_kw("else") else None
+        self.expect_kw("end")
+        return ast.CaseExpr(whens, otherwise)
+
+
+_CLAUSE_KWS = ("from", "where", "group", "having", "order", "limit", "offset",
+               "union", "intersect", "except", "as", "and", "or", "not",
+               "when", "then", "else", "end", "desc", "asc", "between", "in",
+               "like", "is")
+
+# words that can never start a primary expression (bare column name)
+_RESERVED = frozenset(_CLAUSE_KWS) | {
+    "select", "by", "on", "join", "inner", "left", "right", "full", "cross",
+    "distinct", "exists", "create", "drop", "insert", "into", "values",
+    "table", "distributed",
+}

@@ -1,0 +1,118 @@
+import pytest
+
+from cloudberry_tpu.sql import ast
+from cloudberry_tpu.sql.parser import ParseError, parse_sql
+
+
+def test_simple_select():
+    s = parse_sql("select a, b + 1 as c from t where a > 10 order by a desc limit 5")
+    assert isinstance(s, ast.Select)
+    assert len(s.items) == 2
+    assert s.items[1].alias == "c"
+    assert isinstance(s.where, ast.BinOp) and s.where.op == ">"
+    assert not s.order_by[0].ascending
+    assert s.limit == 5
+
+
+def test_join_syntax():
+    s = parse_sql("""select t1.a from t1 inner join t2 on t1.id = t2.id
+                     left join t3 on t2.x = t3.x""")
+    j = s.from_refs[0]
+    assert isinstance(j, ast.JoinRef) and j.kind == "left"
+    assert isinstance(j.left, ast.JoinRef) and j.left.kind == "inner"
+
+
+def test_case_between_in_like():
+    s = parse_sql("""select case when a between 1 and 2 then 'x' else 'y' end
+                     from t where b in ('p','q') and c like 'ab%' and d not in (1,2)""")
+    c = s.items[0].expr
+    assert isinstance(c, ast.CaseExpr)
+    assert isinstance(c.whens[0][0], ast.Between)
+    w = s.where
+    assert isinstance(w, ast.BinOp) and w.op == "and"
+
+
+def test_date_interval_extract():
+    s = parse_sql("""select extract(year from o_orderdate)
+                     from orders where o_orderdate < date '1995-03-15' + interval '1' year""")
+    assert isinstance(s.items[0].expr, ast.ExtractExpr)
+    add = s.where.right
+    assert isinstance(add, ast.BinOp) and isinstance(add.right, ast.IntervalLit)
+    assert add.right.unit == "year"
+
+
+def test_subqueries():
+    s = parse_sql("""select a from t where exists (select 1 from u where u.x = t.a)
+                     and b > (select avg(b) from t) and c in (select c from v)""")
+    w = s.where
+    # and(and(exists, >), in)
+    assert isinstance(w.right, ast.InSubquery)
+    assert isinstance(w.left.left, ast.Exists)
+    assert isinstance(w.left.right.right, ast.ScalarSubquery)
+
+
+def test_derived_table():
+    s = parse_sql("select x from (select a as x from t) as sub where x > 0")
+    d = s.from_refs[0]
+    assert isinstance(d, ast.DerivedTable) and d.alias == "sub"
+
+
+def test_create_table_distributed():
+    s = parse_sql("""create table lineitem (
+        l_orderkey bigint not null, l_price decimal(12,2), l_comment varchar(44)
+    ) distributed by (l_orderkey)""")
+    assert isinstance(s, ast.CreateTable)
+    assert s.distribution == "hash" and s.dist_keys == ("l_orderkey",)
+    assert s.columns[1].scale == 2
+    r = parse_sql("create table n (x int) distributed replicated")
+    assert r.distribution == "replicated"
+
+
+def test_insert_values():
+    s = parse_sql("insert into t (a, b) values (1, 'x'), (2, 'y')")
+    assert isinstance(s, ast.InsertValues)
+    assert len(s.rows) == 2 and s.columns == ["a", "b"]
+
+
+def test_explain():
+    s = parse_sql("explain select 1")
+    assert isinstance(s, ast.Explain)
+
+
+def test_count_distinct_and_star():
+    s = parse_sql("select count(*), count(distinct a), sum(b) from t")
+    f0, f1, f2 = (i.expr for i in s.items)
+    assert f0.star and not f1.star and f1.distinct
+    assert f2.name == "sum"
+
+
+def test_operator_precedence():
+    s = parse_sql("select a + b * c - d from t")
+    e = s.items[0].expr
+    # ((a + (b*c)) - d)
+    assert e.op == "-" and e.left.op == "+" and e.left.right.op == "*"
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse_sql("select from t")
+    with pytest.raises(ParseError):
+        parse_sql("select a from t where")
+    with pytest.raises(ParseError):
+        parse_sql("selec a from t")
+    with pytest.raises(ParseError):
+        parse_sql("select a from t; extra garbage")
+
+
+def test_string_escapes_and_comments():
+    s = parse_sql("""select 'it''s' -- trailing comment
+                     /* block */ from t""")
+    assert s.items[0].expr.value == "it's"
+
+
+def test_all_tpch_queries_parse():
+    from tools.tpch_queries import QUERIES
+
+    for name, sql in QUERIES.items():
+        stmt = parse_sql(sql)
+        assert isinstance(stmt, ast.Select), name

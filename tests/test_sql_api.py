@@ -1,0 +1,76 @@
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.plan.binder import BindError
+
+
+@pytest.fixture
+def sess():
+    return cb.Session()
+
+
+def test_create_insert_select(sess):
+    sess.sql("""create table items (id bigint not null, price decimal(10,2),
+                name text, sold date) distributed by (id)""")
+    sess.sql("""insert into items values
+                (1, 9.99, 'apple', '2024-01-05'),
+                (2, 12.50, 'pear', '2024-02-01'),
+                (3, 0.99, 'fig', '2024-01-20')""")
+    out = sess.sql("select name, price from items where price > 5 order by price desc")
+    df = out.to_pandas()
+    assert df["name"].tolist() == ["pear", "apple"]
+    assert df["price"].tolist() == [12.50, 9.99]
+
+
+def test_group_and_having(sess):
+    sess.sql("create table s (k text, v int) distributed randomly")
+    sess.sql("insert into s values ('a',1),('a',2),('b',5),('b',7),('c',1)")
+    df = sess.sql("""select k, sum(v) as total, count(*) as n from s
+                     group by k having sum(v) > 2 order by total desc""").to_pandas()
+    assert df["k"].tolist() == ["b", "a"]
+    assert df["total"].tolist() == [12, 3]
+    assert df["n"].tolist() == [2, 2]
+
+
+def test_string_order_by_uses_collation(sess):
+    sess.sql("create table t (s text) distributed randomly")
+    sess.sql("insert into t values ('pear'),('apple'),('zebra'),('fig')")
+    df = sess.sql("select s from t order by s").to_pandas()
+    assert df["s"].tolist() == ["apple", "fig", "pear", "zebra"]
+
+
+def test_distinct(sess):
+    sess.sql("create table d (x int) distributed randomly")
+    sess.sql("insert into d values (3),(1),(3),(2),(1)")
+    df = sess.sql("select distinct x from d order by x").to_pandas()
+    assert df["x"].tolist() == [1, 2, 3]
+
+
+def test_case_expression(sess):
+    sess.sql("create table c (v int) distributed randomly")
+    sess.sql("insert into c values (1),(5),(10)")
+    df = sess.sql("""select case when v < 3 then 'small'
+                                when v < 8 then 'mid'
+                                else 'big' end as bucket
+                     from c order by v""").to_pandas()
+    assert df["bucket"].tolist() == ["small", "mid", "big"]
+
+
+def test_drop_and_errors(sess):
+    sess.sql("create table gone (x int)")
+    sess.sql("drop table gone")
+    with pytest.raises(KeyError):
+        sess.sql("select * from gone")
+    sess.sql("create table there (x int)")
+    with pytest.raises(BindError):
+        sess.sql("select nosuchcol from there")
+
+
+def test_decimal_exactness(sess):
+    # classic float-sum trap: 0.1 + 0.2 — int64 fixed point stays exact
+    sess.sql("create table m (v decimal(10,2))")
+    rows = ",".join(["(0.10)"] * 100)
+    sess.sql(f"insert into m values {rows}")
+    df = sess.sql("select sum(v) as s from m").to_pandas()
+    assert df["s"][0] == 10.0  # exactly, no 9.999999...

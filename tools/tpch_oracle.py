@@ -1,0 +1,134 @@
+"""pandas reference implementations of TPC-H queries — the expected-output
+oracle for correctness tests (the pg_regress expected-file analog, computed
+rather than stored so it tracks the generator)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def d(s: str) -> np.datetime64:
+    return np.datetime64(s)
+
+
+def q1(t):
+    li = t["lineitem"]
+    m = li[li.l_shipdate <= d("1998-09-02")].copy()
+    m["disc_price"] = m.l_extendedprice * (1 - m.l_discount)
+    m["charge"] = m.disc_price * (1 + m.l_tax)
+    g = m.groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "size"),
+    )
+    return g.sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+
+
+def q3(t):
+    li, od, cu = t["lineitem"], t["orders"], t["customer"]
+    j = od.merge(cu[cu.c_mktsegment == "BUILDING"],
+                 left_on="o_custkey", right_on="c_custkey")
+    j = li.merge(j, left_on="l_orderkey", right_on="o_orderkey")
+    j = j[(j.o_orderdate < d("1995-03-15")) & (j.l_shipdate > d("1995-03-15"))]
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                  as_index=False)["revenue"].sum()
+    g = g.sort_values(["revenue", "o_orderdate"], ascending=[False, True],
+                      kind="stable").head(10)
+    return g[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]] \
+        .reset_index(drop=True)
+
+
+def q5(t):
+    li, od, cu = t["lineitem"], t["orders"], t["customer"]
+    su, na, re = t["supplier"], t["nation"], t["region"]
+    j = li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+    j = j.merge(cu, left_on="o_custkey", right_on="c_custkey")
+    j = j.merge(su, left_on="l_suppkey", right_on="s_suppkey")
+    j = j[j.c_nationkey == j.s_nationkey]
+    j = j.merge(na, left_on="s_nationkey", right_on="n_nationkey")
+    j = j.merge(re, left_on="n_regionkey", right_on="r_regionkey")
+    j = j[(j.r_name == "ASIA") & (j.o_orderdate >= d("1994-01-01"))
+          & (j.o_orderdate < d("1995-01-01"))]
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    g = j.groupby("n_name", as_index=False)["revenue"].sum()
+    return g.sort_values("revenue", ascending=False).reset_index(drop=True)
+
+
+def q6(t):
+    li = t["lineitem"]
+    m = (li.l_shipdate >= d("1994-01-01")) & (li.l_shipdate < d("1995-01-01")) \
+        & (li.l_discount >= 0.05) & (li.l_discount <= 0.07) & (li.l_quantity < 24)
+    return pd.DataFrame({
+        "revenue": [(li[m].l_extendedprice * li[m].l_discount).sum()]})
+
+
+def q10(t):
+    li, od, cu, na = t["lineitem"], t["orders"], t["customer"], t["nation"]
+    j = li[li.l_returnflag == "R"].merge(
+        od[(od.o_orderdate >= d("1993-10-01"))
+           & (od.o_orderdate < d("1994-01-01"))],
+        left_on="l_orderkey", right_on="o_orderkey")
+    j = j.merge(cu, left_on="o_custkey", right_on="c_custkey")
+    j = j.merge(na, left_on="c_nationkey", right_on="n_nationkey")
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    g = j.groupby(["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                   "c_address", "c_comment"], as_index=False)["revenue"].sum()
+    g = g.sort_values("revenue", ascending=False, kind="stable").head(20)
+    return g[["c_custkey", "c_name", "revenue", "c_acctbal", "n_name",
+              "c_address", "c_phone", "c_comment"]].reset_index(drop=True)
+
+
+def q12(t):
+    li, od = t["lineitem"], t["orders"]
+    m = li[li.l_shipmode.isin(["MAIL", "SHIP"])
+           & (li.l_commitdate < li.l_receiptdate)
+           & (li.l_shipdate < li.l_commitdate)
+           & (li.l_receiptdate >= d("1994-01-01"))
+           & (li.l_receiptdate < d("1995-01-01"))]
+    j = m.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+    hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    g = j.assign(high=hi.astype(int), low=(~hi).astype(int)).groupby(
+        "l_shipmode", as_index=False).agg(
+        high_line_count=("high", "sum"), low_line_count=("low", "sum"))
+    return g.sort_values("l_shipmode").reset_index(drop=True)
+
+
+def q14(t):
+    li, pa = t["lineitem"], t["part"]
+    j = li[(li.l_shipdate >= d("1995-09-01"))
+           & (li.l_shipdate < d("1995-10-01"))].merge(
+        pa, left_on="l_partkey", right_on="p_partkey")
+    rev = j.l_extendedprice * (1 - j.l_discount)
+    promo = rev.where(j.p_type.str.startswith("PROMO"), 0.0)
+    return pd.DataFrame({
+        "promo_revenue": [100.0 * promo.sum() / rev.sum()]})
+
+
+def q19(t):
+    li, pa = t["lineitem"], t["part"]
+    j = li.merge(pa, left_on="l_partkey", right_on="p_partkey")
+    base = j.l_shipmode.isin(["AIR", "AIR REG"]) \
+        & (j.l_shipinstruct == "DELIVER IN PERSON")
+
+    def branch(brand, containers, qlo, qhi, slo, shi):
+        return ((j.p_brand == brand) & j.p_container.isin(containers)
+                & (j.l_quantity >= qlo) & (j.l_quantity <= qhi)
+                & (j.p_size >= slo) & (j.p_size <= shi))
+
+    m = base & (
+        branch("Brand#12", ["SM CASE", "SM BOX", "SM PACK", "SM PKG"], 1, 11, 1, 5)
+        | branch("Brand#23", ["MED BAG", "MED BOX", "MED PKG", "MED PACK"], 10, 20, 1, 10)
+        | branch("Brand#34", ["LG CASE", "LG BOX", "LG PACK", "LG PKG"], 20, 30, 1, 15))
+    return pd.DataFrame({
+        "revenue": [(j[m].l_extendedprice * (1 - j[m].l_discount)).sum()]})
+
+
+ORACLES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6, "q10": q10, "q12": q12,
+           "q14": q14, "q19": q19}
