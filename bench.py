@@ -1,0 +1,102 @@
+"""Benchmark: TPC-H Q1 on the TPU chip vs the same engine pinned to host CPU.
+
+BASELINE.md staged config #1: "TPC-H SF1 Q1 — single-segment lineitem scan +
+HashAgg (CPU baseline)". Both sides run the identical compiled plan (this
+engine); only the executing device differs — so the number isolates the
+hardware + XLA-backend difference the way the reference's north star
+("≥5× the CPU executor") intends.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+where value = TPU speedup over CPU executor and vs_baseline = value / 5.0
+(fraction of the ≥5× target).
+
+Env knobs: BENCH_SF (default 1.0), BENCH_REPS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    try:
+        # allow both the TPU (default) and host CPU backends in one process
+        jax.config.update("jax_platforms", None)
+    except Exception:
+        pass
+
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.exec.executor import compile_plan, prepare_tables
+    from cloudberry_tpu.plan.binder import Binder
+    from cloudberry_tpu.sql.parser import parse_sql
+    from tools.tpch_queries import QUERIES
+    from tools.tpchgen import load_tpch
+
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+
+    t0 = time.time()
+    session = cb.Session()
+    load_tpch(session, sf=sf, seed=1, tables=["lineitem"])
+    n_rows = session.catalog.table("lineitem").num_rows
+    log(f"generated lineitem sf={sf}: {n_rows} rows in {time.time()-t0:.1f}s")
+
+    plan = Binder(session.catalog).bind_select(parse_sql(QUERIES["q1"]))
+
+    def bench_on(device) -> float:
+        # compile per executing platform so each backend gets its best
+        # kernel formulation (honest baseline: best-CPU vs best-TPU)
+        exe = compile_plan(plan, session, platform=device.platform)
+        with jax.default_device(device):
+            tables = {
+                name: {c: jax.device_put(v, device)
+                       for c, v in session.catalog.table(name).data.items()}
+                for name in exe.table_names
+            }
+            # warmup/compile
+            out = exe.fn(tables)
+            jax.block_until_ready(out)
+            best = float("inf")
+            for _ in range(reps):
+                t = time.time()
+                out = exe.fn(tables)
+                jax.block_until_ready(out)
+                best = min(best, time.time() - t)
+        return best
+
+    tpu_devices = [d for d in jax.devices() if d.platform != "cpu"]
+    cpu = jax.devices("cpu")[0]
+
+    cpu_t = bench_on(cpu)
+    log(f"cpu executor: {cpu_t*1000:.1f} ms "
+        f"({n_rows/cpu_t/1e6:.2f}M rows/s)")
+
+    if tpu_devices:
+        tpu_t = bench_on(tpu_devices[0])
+        log(f"tpu executor: {tpu_t*1000:.1f} ms "
+            f"({n_rows/tpu_t/1e6:.2f}M rows/s)")
+    else:
+        log("no TPU visible; reporting cpu-vs-cpu (=1.0)")
+        tpu_t = cpu_t
+
+    speedup = cpu_t / tpu_t
+    print(json.dumps({
+        "metric": f"tpch_sf{sf:g}_q1_speedup_vs_cpu_executor",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup / 5.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,8 @@ class Table:
         n = len(next(iter(data.values()))) if data else 0
         self.stats.row_count = n
         self.stats.unique = {}
+        # bump version so session-level sharded layouts are invalidated
+        self._version = getattr(self, "_version", 0) + 1
         for f in self.schema.fields:
             arr = data.get(f.name)
             if arr is not None and arr.dtype.kind in "if" and n:

@@ -4,10 +4,10 @@ The reference pulls tuples through a process-per-slice Volcano tree
 (ExecProcNode, src/backend/executor/execProcnode.c); here the WHOLE plan
 compiles into one XLA program over fixed-capacity column arrays — scans are
 function inputs, operators are the kernels in exec/kernels.py, and (in
-distributed mode) motions are collectives. Runtime "can't happen" conditions
-(agg capacity overflow, duplicate build keys in a PK join) are returned as
-scalar check outputs and raised host-side after the run — the shape-world
-analog of ereport().
+distributed mode, exec/dist_executor.py) motions are collectives. Runtime
+"can't happen" conditions (agg capacity overflow, duplicate build keys in a
+PK join) are returned as scalar check outputs and raised host-side after the
+run — the shape-world analog of ereport().
 """
 
 from __future__ import annotations
@@ -39,19 +39,25 @@ class Executable:
 
 
 def execute(plan: N.PlanNode, session) -> ColumnBatch:
+    if session.config.n_segments > 1:
+        from cloudberry_tpu.exec.dist_executor import execute_distributed
+
+        return execute_distributed(plan, session)
     exe = compile_plan(plan, session)
     tables = prepare_tables(exe.table_names, session)
     return run_executable(exe, tables)
 
 
-def compile_plan(plan: N.PlanNode, session) -> Executable:
-    table_names = sorted({s.table_name for s in _scans(plan)})
+def compile_plan(plan: N.PlanNode, session,
+                 platform: str | None = None) -> Executable:
+    table_names = sorted({s.table_name for s in scans_of(plan)})
+    platform = platform or jax.default_backend()
 
     def run(tables):
-        checks: dict[str, jnp.ndarray] = {}
-        cols, sel = _compile_node(plan, tables, checks)
+        low = Lowerer(tables, platform=platform)
+        cols, sel = low.lower(plan)
         out = {f.name: cols[f.name] for f in plan.fields}
-        return out, sel, checks
+        return out, sel, low.checks
 
     return Executable(plan, jax.jit(run), table_names)
 
@@ -66,31 +72,82 @@ def prepare_tables(table_names: list[str], session) -> dict:
 
 def run_executable(exe: Executable, tables: dict) -> ColumnBatch:
     cols, sel, checks = exe.fn(tables)
+    raise_checks(checks)
+    return make_batch(exe.plan, cols, sel)
+
+
+def raise_checks(checks: dict) -> None:
     for msg, bad in checks.items():
-        if bool(np.asarray(bad)):
+        if bool(np.asarray(bad).any()):
             raise ExecError(msg)
-    fields = tuple(Field(f.name, f.type) for f in exe.plan.fields)
-    dicts = {f.name: f.sdict for f in exe.plan.fields if f.sdict is not None}
+
+
+def make_batch(plan: N.PlanNode, cols, sel) -> ColumnBatch:
+    fields = tuple(Field(f.name, f.type) for f in plan.fields)
+    dicts = {f.name: f.sdict for f in plan.fields if f.sdict is not None}
     return ColumnBatch(Schema(fields),
                        {k: np.asarray(v) for k, v in cols.items()},
                        np.asarray(sel), dicts)
 
 
-def _scans(plan: N.PlanNode):
+def scans_of(plan: N.PlanNode):
     if isinstance(plan, N.PScan) and plan.table_name != "$dual":
         yield plan
     for c in plan.children():
-        yield from _scans(c)
+        yield from scans_of(c)
 
 
-# ------------------------------------------------------------- node lowering
+# ------------------------------------------------------------- plan lowering
 
 
-def _compile_node(node: N.PlanNode, tables, checks) -> tuple[dict, jnp.ndarray]:
-    if isinstance(node, N.PScan):
+class Lowerer:
+    """Traces a plan into jax ops. Subclassed by the distributed executor,
+    which overrides scan (per-segment inputs) and motion (collectives)."""
+
+    def __init__(self, tables, platform: str | None = None):
+        self.tables = tables
+        self.checks: dict[str, jnp.ndarray] = {}
+        # scatter (segment ops) lower well on CPU; TPU serializes large
+        # scatters, so it gets unrolled masked reductions instead
+        platform = platform or jax.default_backend()
+        self.dense_strategy = "segment" if platform == "cpu" else "reduce"
+
+    def lower(self, node: N.PlanNode) -> tuple[dict, jnp.ndarray]:
+        if isinstance(node, N.PScan):
+            return self.scan(node)
+        if isinstance(node, N.PFilter):
+            cols, sel = self.lower(node.child)
+            mask = compile_expr(node.predicate)(cols)
+            return cols, sel & mask
+        if isinstance(node, N.PProject):
+            cols, sel = self.lower(node.child)
+            out = {name: compile_expr(e)(cols) for name, e in node.exprs}
+            return out, sel
+        if isinstance(node, N.PJoin):
+            return self.join(node)
+        if isinstance(node, N.PAgg):
+            return self.agg(node)
+        if isinstance(node, N.PSort):
+            cols, sel = self.lower(node.child)
+            keys, desc = [], []
+            for e, asc in node.keys:
+                keys.append(_sortable(e, node.child, cols))
+                desc.append(not asc)
+            perm = K.sort_indices(keys, sel, descending=desc)
+            return {n: c[perm] for n, c in cols.items()}, sel[perm]
+        if isinstance(node, N.PLimit):
+            cols, sel = self.lower(node.child)
+            return cols, K.limit_mask(sel, node.limit, node.offset)
+        if isinstance(node, N.PMotion):
+            return self.motion(node)
+        raise ExecError(f"cannot execute node {type(node).__name__}")
+
+    # ------------------------------------------------------------ hookable
+
+    def scan(self, node: N.PScan):
         if node.table_name == "$dual":
             return {}, jnp.ones((1,), dtype=jnp.bool_)
-        data = tables[node.table_name]
+        data = self.tables[node.table_name]
         cols = {}
         for phys, out in node.column_map.items():
             arr = data[phys]
@@ -101,67 +158,131 @@ def _compile_node(node: N.PlanNode, tables, checks) -> tuple[dict, jnp.ndarray]:
         sel = jnp.arange(node.capacity) < n
         return cols, sel
 
-    if isinstance(node, N.PFilter):
-        cols, sel = _compile_node(node.child, tables, checks)
-        mask = compile_expr(node.predicate)(cols)
-        return cols, sel & mask
-
-    if isinstance(node, N.PProject):
-        cols, sel = _compile_node(node.child, tables, checks)
-        out = {name: compile_expr(e)(cols) for name, e in node.exprs}
-        return out, sel
-
-    if isinstance(node, N.PJoin):
-        return _compile_join(node, tables, checks)
-
-    if isinstance(node, N.PAgg):
-        return _compile_agg(node, tables, checks)
-
-    if isinstance(node, N.PSort):
-        cols, sel = _compile_node(node.child, tables, checks)
-        keys, desc = [], []
-        for e, asc in node.keys:
-            keys.append(_sortable(e, node.child, cols))
-            desc.append(not asc)
-        perm = K.sort_indices(keys, sel, descending=desc)
-        return {n: c[perm] for n, c in cols.items()}, sel[perm]
-
-    if isinstance(node, N.PLimit):
-        cols, sel = _compile_node(node.child, tables, checks)
-        return cols, K.limit_mask(sel, node.limit, node.offset)
-
-    if isinstance(node, N.PMotion):
+    def motion(self, node: N.PMotion):
         # single-program mode: loopback motion is the identity (the
-        # MotionIPCLayer seam's test backend); collectives live in
-        # exec/dist_executor.py
-        return _compile_node(node.child, tables, checks)
+        # MotionIPCLayer seam's test backend)
+        return self.lower(node.child)
 
-    raise ExecError(f"cannot execute node {type(node).__name__}")
+    # ------------------------------------------------------------ operators
+
+    def join(self, node: N.PJoin):
+        bcols, bsel = self.lower(node.build)
+        pcols, psel = self.lower(node.probe)
+        bkeys = [compile_expr(k)(bcols) for k in node.build_keys]
+        pkeys = [compile_expr(k)(pcols) for k in node.probe_keys]
+        idx, matched = K.join_lookup(bkeys, bsel, pkeys, psel)
+        self.checks[
+            f"join build side has duplicate keys (node {id(node)}); "
+            "many-to-many joins need the expansion kernel"] = \
+            _dup_keys_flag(bkeys, bsel)
+        payload = K.gather_payload({n: bcols[n] for n in node.build_payload},
+                                   idx, matched)
+        cols = {**pcols, **payload}
+        if node.match_name:
+            cols[node.match_name] = matched
+        if node.kind in ("inner", "semi"):
+            sel = matched
+        elif node.kind == "left":
+            sel = psel
+        elif node.kind == "anti":
+            sel = psel & ~matched
+        else:
+            raise ExecError(f"join kind {node.kind}")
+        return cols, sel
+
+    def agg(self, node: N.PAgg):
+        cols, sel = self.lower(node.child)
+        agg_specs = []
+        agg_values: dict[str, Any] = {}
+        post_scale: dict[str, float] = {}
+        for name, call in node.aggs:
+            func = call.func
+            if func == "count" and call.arg is None:
+                agg_values[name] = None
+            elif func in ("sum", "min", "max", "avg", "count"):
+                agg_values[name] = compile_expr(call.arg)(cols) \
+                    if call.arg is not None else None
+            else:
+                raise ExecError(f"aggregate {func} not implemented yet")
+            if func == "avg" and call.arg is not None \
+                    and call.arg.dtype.base == DType.DECIMAL:
+                post_scale[name] = 10.0 ** call.arg.dtype.scale
+            agg_specs.append(K.AggSpec(func, name))
+
+        if not node.group_keys:
+            out = K.global_aggregate(agg_values, agg_specs, sel)
+            for name, div in post_scale.items():
+                out[name] = out[name] / div
+            return out, jnp.ones((1,), dtype=jnp.bool_)
+
+        dense = self._dense_agg(node, cols, sel, agg_specs, agg_values,
+                                post_scale)
+        if dense is not None:
+            return dense
+
+        key_cols = {name: compile_expr(e)(cols)
+                    for name, e in node.group_keys}
+        out_keys, out_aggs, out_sel, n_groups = K.group_aggregate(
+            key_cols, agg_values, agg_specs, sel, node.capacity)
+        self.checks[
+            f"aggregation overflow: more groups than capacity "
+            f"{node.capacity} (node {id(node)})"] = n_groups > node.capacity
+        for name, div in post_scale.items():
+            out_aggs[name] = out_aggs[name] / div
+        return {**out_keys, **out_aggs}, out_sel
 
 
-def _compile_join(node: N.PJoin, tables, checks):
-    bcols, bsel = _compile_node(node.build, tables, checks)
-    pcols, psel = _compile_node(node.probe, tables, checks)
-    bkeys = [compile_expr(k)(bcols) for k in node.build_keys]
-    pkeys = [compile_expr(k)(pcols) for k in node.probe_keys]
-    idx, matched = K.join_lookup(bkeys, bsel, pkeys, psel)
-    checks[f"join build side has duplicate keys (node {id(node)}); "
-           "many-to-many joins need the expansion kernel"] = \
-        _dup_keys_flag(bkeys, bsel)
-    payload = K.gather_payload({n: bcols[n] for n in node.build_payload},
-                               idx, matched)
-    cols = {**pcols, **payload}
-    if node.match_name:
-        cols[node.match_name] = matched
-    if node.kind == "inner" or node.kind == "semi":
-        sel = matched
-    elif node.kind == "left":
-        sel = psel
-    elif node.kind == "anti":
-        sel = psel & ~matched
-    else:
-        raise ExecError(f"join kind {node.kind}")
-    return cols, sel
+    def _dense_agg(self, node: N.PAgg, cols, sel, agg_specs, agg_values,
+                   post_scale):
+        """Perfect-hash aggregation when ALL group keys are dictionary-coded
+        strings with a small static domain (nodeAgg's hashed strategy with a
+        compile-time-perfect hash) — skips the sort entirely."""
+        sizes = []
+        for name, e in node.group_keys:
+            f = node.field(name)
+            if f.type.base != DType.STRING or f.sdict is None \
+                    or len(f.sdict) == 0:
+                return None
+            sizes.append(len(f.sdict))
+        prod = 1
+        for s in sizes:
+            prod *= s
+        # 'reduce' unrolls one masked reduction per cell — cap the unroll
+        # hard or XLA program size / compile time explodes; 'segment' (CPU
+        # scatter) scales to larger domains
+        max_cells = 4096 if self.dense_strategy == "segment" else 64
+        if prod > min(node.capacity, max_cells):
+            return None
+
+        strides = []
+        acc = 1
+        for s in reversed(sizes):
+            strides.append(acc)
+            acc *= s
+        strides.reverse()
+
+        gid = jnp.zeros(sel.shape, dtype=jnp.int32)
+        for (name, e), stride in zip(node.group_keys, strides):
+            gid = gid + compile_expr(e)(cols).astype(jnp.int32) \
+                * np.int32(stride)
+        out_aggs, occupied = K.group_aggregate_dense(
+            gid, prod, agg_values, agg_specs, sel,
+            strategy=self.dense_strategy)
+        for name, div in post_scale.items():
+            out_aggs[name] = out_aggs[name] / div
+
+        cell = jnp.arange(prod, dtype=jnp.int32)
+        out_keys = {}
+        for (name, _), stride, size in zip(node.group_keys, strides, sizes):
+            out_keys[name] = (cell // np.int32(stride)) % np.int32(size)
+
+        cap = node.capacity
+        if cap > prod:
+            pad = cap - prod
+            out_keys = {n: jnp.pad(c, (0, pad)) for n, c in out_keys.items()}
+            out_aggs = {n: jnp.pad(c, (0, pad)) for n, c in out_aggs.items()}
+            occupied = jnp.pad(occupied, (0, pad))
+        return {**out_keys, **out_aggs}, occupied
 
 
 def _dup_keys_flag(bkeys, bsel) -> jnp.ndarray:
@@ -170,41 +291,6 @@ def _dup_keys_flag(bkeys, bsel) -> jnp.ndarray:
     s = jnp.sort(kb)
     eq = (s[1:] == s[:-1]) & (s[1:] != K._U64_MAX)
     return eq.any()
-
-
-def _compile_agg(node: N.PAgg, tables, checks):
-    cols, sel = _compile_node(node.child, tables, checks)
-    agg_specs = []
-    agg_values: dict[str, Any] = {}
-    post_scale: dict[str, float] = {}
-    for name, call in node.aggs:
-        func = call.func
-        if func == "count" and call.arg is None:
-            agg_values[name] = None
-        elif func in ("sum", "min", "max", "avg", "count"):
-            agg_values[name] = compile_expr(call.arg)(cols) \
-                if call.arg is not None else None
-        else:
-            raise ExecError(f"aggregate {func} not implemented yet")
-        if func == "avg" and call.arg is not None \
-                and call.arg.dtype.base == DType.DECIMAL:
-            post_scale[name] = 10.0 ** call.arg.dtype.scale
-        agg_specs.append(K.AggSpec(func, name))
-
-    if not node.group_keys:
-        out = K.global_aggregate(agg_values, agg_specs, sel)
-        for name, div in post_scale.items():
-            out[name] = out[name] / div
-        return out, jnp.ones((1,), dtype=jnp.bool_)
-
-    key_cols = {name: compile_expr(e)(cols) for name, e in node.group_keys}
-    out_keys, out_aggs, out_sel, n_groups = K.group_aggregate(
-        key_cols, agg_values, agg_specs, sel, node.capacity)
-    checks[f"aggregation overflow: more groups than capacity "
-           f"{node.capacity} (node {id(node)})"] = n_groups > node.capacity
-    for name, div in post_scale.items():
-        out_aggs[name] = out_aggs[name] / div
-    return {**out_keys, **out_aggs}, out_sel
 
 
 def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:

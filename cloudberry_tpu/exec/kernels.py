@@ -204,6 +204,81 @@ def group_aggregate(
     return out_keys, out_aggs, out_sel, n_groups
 
 
+def group_aggregate_dense(
+    gid: jnp.ndarray,
+    n_cells: int,
+    agg_values: dict[str, Optional[jnp.ndarray]],
+    aggs: Sequence[AggSpec],
+    sel: jnp.ndarray,
+    strategy: str = "reduce",
+) -> tuple[Columns, jnp.ndarray]:
+    """Perfect-hash grouped aggregation for small, statically-known key
+    domains (e.g. dictionary-coded strings: Q1's returnflag × linestatus).
+
+    strategy='reduce' (TPU): unrolled per-cell masked tree-reductions.
+    strategy='segment' (CPU): scatter-based segment ops.
+
+    No sort and — crucially — no scatter: XLA lowers large scatters to a
+    serialized update loop on TPU (measured ~150ms per 1.8M-row segment_sum),
+    while an unrolled per-cell masked tree-reduction is a fused VPU sweep.
+    Exact for int64 (tree reduction of exact adds). Returns (agg columns
+    indexed by cell id, occupancy mask); key reconstruction from cell id is
+    the caller's job.
+    """
+    gid = jnp.where(sel, jnp.clip(gid, 0, n_cells - 1), n_cells)
+    out: Columns = {}
+    if strategy == "segment":
+        # scatter-based: best on CPU, where XLA emits a tight update loop
+        counts = jax.ops.segment_sum(sel.astype(jnp.int64), gid,
+                                     num_segments=n_cells + 1)[:n_cells]
+        seg = lambda vv: jax.ops.segment_sum(
+            vv, gid, num_segments=n_cells + 1)[:n_cells]
+        smin = lambda vv: jax.ops.segment_min(
+            vv, gid, num_segments=n_cells + 1)[:n_cells]
+        smax = lambda vv: jax.ops.segment_max(
+            vv, gid, num_segments=n_cells + 1)[:n_cells]
+        for spec in aggs:
+            v = agg_values.get(spec.out_name)
+            if spec.func == "count":
+                out[spec.out_name] = counts
+            elif spec.func == "sum":
+                out[spec.out_name] = seg(jnp.where(sel, v, 0))
+            elif spec.func == "min":
+                out[spec.out_name] = smin(jnp.where(sel, v, _dtype_max(v.dtype)))
+            elif spec.func == "max":
+                out[spec.out_name] = smax(jnp.where(sel, v, _dtype_min(v.dtype)))
+            elif spec.func == "avg":
+                s = seg(jnp.where(sel, v, 0).astype(jnp.float64))
+                out[spec.out_name] = s / jnp.maximum(counts, 1)
+            else:
+                raise NotImplementedError(spec.func)
+        return out, counts > 0
+    cell_masks = [gid == c for c in range(n_cells)]
+    counts = jnp.stack([m.sum(dtype=jnp.int64) for m in cell_masks])
+    for spec in aggs:
+        v = agg_values.get(spec.out_name)
+        if spec.func == "count":
+            out[spec.out_name] = counts
+        elif spec.func == "sum":
+            out[spec.out_name] = jnp.stack(
+                [jnp.where(m, v, 0).sum() for m in cell_masks])
+        elif spec.func == "min":
+            big = _dtype_max(v.dtype)
+            out[spec.out_name] = jnp.stack(
+                [jnp.where(m, v, big).min() for m in cell_masks])
+        elif spec.func == "max":
+            small = _dtype_min(v.dtype)
+            out[spec.out_name] = jnp.stack(
+                [jnp.where(m, v, small).max() for m in cell_masks])
+        elif spec.func == "avg":
+            s = jnp.stack([jnp.where(m, v, 0).sum(dtype=jnp.float64)
+                           for m in cell_masks])
+            out[spec.out_name] = s / jnp.maximum(counts, 1)
+        else:
+            raise NotImplementedError(spec.func)
+    return out, counts > 0
+
+
 def global_aggregate(
     agg_values: dict[str, Optional[jnp.ndarray]],
     aggs: Sequence[AggSpec],

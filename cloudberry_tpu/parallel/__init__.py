@@ -1,0 +1,3 @@
+from cloudberry_tpu.parallel.mesh import segment_mesh
+
+__all__ = ["segment_mesh"]

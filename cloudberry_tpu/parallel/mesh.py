@@ -1,0 +1,28 @@
+"""Device mesh — the gp_segment_configuration analog.
+
+The reference's cluster topology is a catalog of N segment postmasters
+(cdbutil.c getCdbComponentInfo); here it is a jax.sharding.Mesh with one
+``seg`` axis: mesh slot ↔ segment. Multi-host later extends this to a
+(host, seg) mesh with DCN between hosts; the executor only ever names the
+``seg`` axis, so that change is local to this module.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+SEG_AXIS = "seg"
+
+
+def segment_mesh(n_segments: int) -> Mesh:
+    devices = jax.devices()
+    if len(devices) < n_segments:
+        raise RuntimeError(
+            f"config asks for {n_segments} segments but only "
+            f"{len(devices)} devices are visible; for tests set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_segments}")
+    import numpy as np
+
+    return Mesh(np.asarray(devices[:n_segments]), (SEG_AXIS,))

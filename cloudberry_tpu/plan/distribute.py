@@ -1,0 +1,389 @@
+"""Distribution pass — the cdbllize/cdbpath analog.
+
+Walks the bound plan bottom-up, assigns a Sharding to every node (the
+CdbPathLocus discipline, cdbpathlocus.h:41-68) and inserts PMotion nodes
+exactly where the reference's planner inserts Motions:
+
+- joins: colocated if both sides hash-partitioned on corresponding join keys
+  (cdbpath_motion_for_join, cdbpath.c:1346); else broadcast the small side
+  (BROADCAST motion) or redistribute (HASH motion) — here lowered to
+  all_gather / all_to_all over the mesh;
+- grouped aggregation: one-stage when child is partitioned on a subset of
+  the group keys, else two-stage partial→redistribute→final
+  (cdbgroupingpaths.c multi-stage agg), with avg split into sum+count;
+- global aggregation: partial per segment → gather → final merge;
+- sort/limit and the query result: gathered to a singleton (GATHER motion,
+  the QD top slice).
+
+Segment placement (load time, host) and Motion routing (device) both use
+jump_consistent_hash over the same column hash — colocation depends on it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.plan.sharding import Sharding
+from cloudberry_tpu.types import DType, FLOAT64, INT64
+
+
+def distribute_plan(plan: N.PlanNode, session) -> N.PlanNode:
+    d = Distributor(session)
+    plan, cap = d.walk(plan)
+    if plan.sharding.is_partitioned:
+        plan, cap = d.gather(plan, cap)
+    return plan
+
+
+class Distributor:
+    def __init__(self, session):
+        self.session = session
+        self.nseg = session.config.n_segments
+        self.cfg = session.config
+
+    # -------------------------------------------------------------- walking
+
+    def walk(self, node: N.PlanNode) -> tuple[N.PlanNode, int]:
+        if isinstance(node, N.PScan):
+            return self._scan(node)
+        if isinstance(node, N.PFilter):
+            child, cap = self.walk(node.child)
+            node.child = child
+            node.sharding = child.sharding
+            return node, cap
+        if isinstance(node, N.PProject):
+            child, cap = self.walk(node.child)
+            node.child = child
+            node.sharding = _project_sharding(child.sharding, node.exprs)
+            return node, cap
+        if isinstance(node, N.PJoin):
+            return self._join(node)
+        if isinstance(node, N.PAgg):
+            return self._agg(node)
+        if isinstance(node, N.PSort):
+            child, cap = self.walk(node.child)
+            if child.sharding.is_partitioned:
+                child, cap = self.gather(child, cap)
+            node.child = child
+            node.sharding = child.sharding
+            return node, cap
+        if isinstance(node, N.PLimit):
+            child, cap = self.walk(node.child)
+            if child.sharding.is_partitioned:
+                child, cap = self.gather(child, cap)
+            node.child = child
+            node.sharding = child.sharding
+            return node, cap
+        raise ValueError(f"distribute: unhandled node {type(node).__name__}")
+
+    def _scan(self, node: N.PScan) -> tuple[N.PlanNode, int]:
+        if node.table_name == "$dual":
+            node.sharding = Sharding.general()
+            return node, 1
+        table = self.session.catalog.table(node.table_name)
+        policy = table.policy
+        if policy.kind == "replicated":
+            node.sharding = Sharding.replicated()
+            return node, node.capacity
+        shard_cap = self.session.shard_capacity(node.table_name)
+        node.capacity = shard_cap
+        node.num_rows = -2  # per-segment count provided at runtime
+        if policy.kind == "hashed":
+            keys = tuple(node.column_map[k] for k in policy.keys)
+            node.sharding = Sharding.hashed(*keys)
+        else:
+            node.sharding = Sharding.strewn()
+        return node, shard_cap
+
+    # --------------------------------------------------------------- motion
+
+    def gather(self, child: N.PlanNode, cap: int) -> tuple[N.PlanNode, int]:
+        m = N.PMotion(child, "gather")
+        m.fields = list(child.fields)
+        m.sharding = Sharding.singleton()
+        m.out_capacity = cap * self.nseg
+        return m, m.out_capacity
+
+    def broadcast(self, child: N.PlanNode, cap: int) -> tuple[N.PlanNode, int]:
+        m = N.PMotion(child, "broadcast")
+        m.fields = list(child.fields)
+        m.sharding = Sharding.replicated()
+        m.out_capacity = cap * self.nseg
+        return m, m.out_capacity
+
+    def redistribute(self, child: N.PlanNode, cap: int,
+                     keys: list[ex.Expr]) -> tuple[N.PlanNode, int]:
+        m = N.PMotion(child, "redistribute", hash_keys=list(keys))
+        m.fields = list(child.fields)
+        key_names = tuple(k.name for k in keys
+                          if isinstance(k, ex.ColumnRef))
+        m.sharding = (Sharding.hashed(*key_names)
+                      if len(key_names) == len(keys) else Sharding.strewn())
+        # capacity-based flow control (the ic_udpifc.c:3018 analog): each
+        # destination bucket holds factor × fair share; overflow is a
+        # detected runtime error, never a silent drop
+        factor = self.cfg.interconnect.capacity_factor
+        m.bucket_cap = max(int(math.ceil(cap / self.nseg * factor)), 8)
+        m.out_capacity = m.bucket_cap * self.nseg
+        return m, m.out_capacity
+
+    # ----------------------------------------------------------------- join
+
+    def _join(self, node: N.PJoin) -> tuple[N.PlanNode, int]:
+        build, bcap = self.walk(node.build)
+        probe, pcap = self.walk(node.probe)
+        bsh, psh = build.sharding, probe.sharding
+
+        b_part = bsh.is_partitioned
+        p_part = psh.is_partitioned
+
+        if b_part and p_part and not _join_colocated(node, bsh, psh):
+            est_build_total = bcap * self.nseg
+            if est_build_total <= self.cfg.planner.broadcast_threshold:
+                build, bcap = self.broadcast(build, bcap)
+            else:
+                bsub = _hashed_key_positions(bsh, node.build_keys)
+                psub = _hashed_key_positions(psh, node.probe_keys)
+                if bsub is not None:
+                    probe, pcap = self.redistribute(
+                        probe, pcap, [node.probe_keys[i] for i in bsub])
+                elif psub is not None:
+                    build, bcap = self.redistribute(
+                        build, bcap, [node.build_keys[i] for i in psub])
+                else:
+                    build, bcap = self.redistribute(build, bcap,
+                                                    list(node.build_keys))
+                    probe, pcap = self.redistribute(probe, pcap,
+                                                    list(node.probe_keys))
+        elif b_part and not p_part:
+            if node.kind in ("inner", "semi"):
+                # probe replicated/singleton, build partitioned: each segment
+                # joins its build shard against the full probe; a probe row
+                # is selected only on the segment owning its build partner,
+                # so results are partitioned — by the BUILD side's actual
+                # distribution, translated onto the equal-valued probe keys.
+                node.build = build
+                node.probe = probe
+                bsub = _hashed_key_positions(bsh, node.build_keys)
+                if bsub is not None:
+                    names = [node.probe_keys[i].name for i in bsub
+                             if isinstance(node.probe_keys[i], ex.ColumnRef)]
+                    node.sharding = (Sharding.hashed(*names)
+                                     if len(names) == len(bsub)
+                                     else Sharding.strewn())
+                else:
+                    node.sharding = Sharding.strewn()
+                return node, pcap
+            # left/anti joins select probe rows that match NOWHERE — every
+            # segment must see the whole build side to decide that
+            build, bcap = self.broadcast(build, bcap)
+
+        node.build = build
+        node.probe = probe
+        node.sharding = probe.sharding if p_part else (
+            Sharding.strewn() if build.sharding.is_partitioned
+            else probe.sharding)
+        return node, pcap
+
+    # ------------------------------------------------------------------ agg
+
+    def _agg(self, node: N.PAgg) -> tuple[N.PlanNode, int]:
+        child, cap = self.walk(node.child)
+        node.child = child
+        csh = child.sharding
+
+        if not csh.is_partitioned:
+            node.sharding = csh
+            node.capacity = min(node.capacity, max(cap, 1))
+            return node, node.capacity
+
+        if node.group_keys:
+            key_src = {e.name for _, e in node.group_keys
+                       if isinstance(e, ex.ColumnRef)}
+            if csh.kind == "hashed" and set(csh.keys) <= key_src and csh.keys:
+                # colocated grouping: one stage, stays partitioned
+                node.sharding = _rename_sharding(csh, node.group_keys)
+                node.capacity = min(node.capacity, cap)
+                return node, node.capacity
+            return self._two_stage_group_agg(node, child, cap)
+        return self._two_stage_global_agg(node, child, cap)
+
+    def _two_stage_group_agg(self, node: N.PAgg, child: N.PlanNode,
+                             cap: int) -> tuple[N.PlanNode, int]:
+        partial_aggs, final_aggs, finalize = _split_aggs(node.aggs)
+        partial = N.PAgg(child, node.group_keys, partial_aggs,
+                         capacity=min(node.capacity, cap), mode="partial")
+        partial.fields = [N.PlanField(n, e.dtype, _f_dict(child, e))
+                          for n, e in node.group_keys] + \
+                         [N.PlanField(n, c.dtype, None)
+                          for n, c in partial_aggs]
+        partial.sharding = child.sharding
+
+        key_refs = [_field_ref(partial, n) for n, _ in node.group_keys]
+        motion, mcap = self.redistribute(partial, partial.capacity, key_refs)
+
+        final_keys = [(n, _field_ref(motion, n)) for n, _ in node.group_keys]
+        final = N.PAgg(motion, final_keys, final_aggs,
+                       capacity=min(node.capacity, mcap), mode="final")
+        final.fields = [N.PlanField(n, e.dtype, _f_dict(motion, e))
+                        for n, e in final_keys] + \
+                       [N.PlanField(n, c.dtype, None) for n, c in final_aggs]
+        final.sharding = _rename_sharding(
+            Sharding.hashed(*(k.name for k in key_refs
+                              if isinstance(k, ex.ColumnRef))),
+            final_keys)
+
+        out = _finalize_project(final, node, finalize)
+        out.sharding = final.sharding
+        return out, final.capacity
+
+    def _two_stage_global_agg(self, node: N.PAgg, child: N.PlanNode,
+                              cap: int) -> tuple[N.PlanNode, int]:
+        partial_aggs, final_aggs, finalize = _split_aggs(node.aggs)
+        partial = N.PAgg(child, [], partial_aggs, capacity=1, mode="partial")
+        partial.fields = [N.PlanField(n, c.dtype, None)
+                          for n, c in partial_aggs]
+        partial.sharding = child.sharding
+
+        motion, mcap = self.gather(partial, 1)
+
+        final = N.PAgg(motion, [], final_aggs, capacity=1, mode="final")
+        final.fields = [N.PlanField(n, c.dtype, None) for n, c in final_aggs]
+        final.sharding = Sharding.singleton()
+
+        out = _finalize_project(final, node, finalize)
+        out.sharding = final.sharding
+        return out, 1
+
+
+# ---------------------------------------------------------------- agg split
+
+
+def _split_aggs(aggs):
+    """(partial_aggs, final_merge_aggs, finalize_exprs) — how each aggregate
+    decomposes across the motion boundary (the reference's combine
+    functions / multi-stage Aggref splitting)."""
+    partial: list[tuple[str, ex.AggCall]] = []
+    final: list[tuple[str, ex.AggCall]] = []
+    finalize: dict[str, tuple[str, str]] = {}  # out name -> ('avg', s, c)
+    for name, call in aggs:
+        if call.func in ("sum", "min", "max"):
+            partial.append((name, call))
+            merge = "sum" if call.func == "sum" else call.func
+            final.append((name, ex.AggCall(
+                merge, ex.ColumnRef(name, call.dtype))))
+        elif call.func == "count":
+            partial.append((name, call))
+            final.append((name, ex.AggCall(
+                "sum", ex.ColumnRef(name, INT64))))
+        elif call.func == "avg":
+            s, c = f"{name}$s", f"{name}$c"
+            assert call.arg is not None
+            partial.append((s, ex.AggCall("sum", call.arg)))
+            partial.append((c, ex.AggCall("count", call.arg)))
+            final.append((s, ex.AggCall(
+                "sum", ex.ColumnRef(s, call.arg.dtype))))
+            final.append((c, ex.AggCall("sum", ex.ColumnRef(c, INT64))))
+            finalize[name] = (s, c)
+        else:
+            raise ValueError(f"cannot distribute aggregate {call.func}")
+    return partial, final, finalize
+
+
+def _finalize_project(final: N.PAgg, node: N.PAgg, finalize) -> N.PlanNode:
+    """Restore the original agg output schema (avg = sum/count)."""
+    if not finalize:
+        final_names = {f.name for f in final.fields}
+        assert {f.name for f in node.fields} <= final_names
+        proj_exprs = [(f.name, _field_ref(final, f.name))
+                      for f in node.fields]
+    else:
+        proj_exprs = []
+        for f in node.fields:
+            if f.name in finalize:
+                s, c = finalize[f.name]
+                sf = _field_ref(final, s)
+                cf = _field_ref(final, c)
+                proj_exprs.append((f.name, ex.BinOp(
+                    "/", ex.Cast(sf, FLOAT64), ex.Cast(cf, FLOAT64),
+                    FLOAT64)))
+            else:
+                proj_exprs.append((f.name, _field_ref(final, f.name)))
+    proj = N.PProject(final, proj_exprs)
+    proj.fields = list(node.fields)
+    return proj
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _field_ref(plan: N.PlanNode, name: str) -> ex.ColumnRef:
+    f = plan.field(name)
+    c = ex.ColumnRef(f.name, f.type)
+    if f.sdict is not None:
+        object.__setattr__(c, "_sdict", f.sdict)
+    return c
+
+
+def _f_dict(plan: N.PlanNode, e: ex.Expr):
+    if isinstance(e, ex.ColumnRef):
+        try:
+            return plan.field(e.name).sdict
+        except KeyError:
+            return None
+    return None
+
+
+def _project_sharding(child_sh: Sharding, exprs) -> Sharding:
+    if child_sh.kind != "hashed":
+        return child_sh
+    renames = {}
+    for out_name, e in exprs:
+        if isinstance(e, ex.ColumnRef) and e.name not in renames:
+            renames[e.name] = out_name
+    if all(k in renames for k in child_sh.keys):
+        return Sharding.hashed(*(renames[k] for k in child_sh.keys))
+    return Sharding.strewn()
+
+
+def _rename_sharding(csh: Sharding, group_keys) -> Sharding:
+    """Child sharding keys (source col names) → agg output key names."""
+    if csh.kind != "hashed":
+        return csh
+    src_to_out = {}
+    for out_name, e in group_keys:
+        if isinstance(e, ex.ColumnRef) and e.name not in src_to_out:
+            src_to_out[e.name] = out_name
+    if all(k in src_to_out for k in csh.keys):
+        return Sharding.hashed(*(src_to_out[k] for k in csh.keys))
+    return Sharding.strewn()
+
+
+def _hashed_key_positions(sh: Sharding, keys: list[ex.Expr]
+                          ) -> Optional[list[int]]:
+    """If ``sh`` is hashed exactly on an ordered subset of ``keys`` (by
+    column name), return those key positions; else None."""
+    if sh.kind != "hashed" or not sh.keys:
+        return None
+    names = [k.name if isinstance(k, ex.ColumnRef) else None for k in keys]
+    pos = []
+    for k in sh.keys:
+        if k not in names:
+            return None
+        pos.append(names.index(k))
+    return pos
+
+
+def _join_colocated(node: N.PJoin, bsh: Sharding, psh: Sharding) -> bool:
+    """Both sides hash-partitioned on CORRESPONDING join key positions, in
+    the same order — equal key tuples then land on the same segment."""
+    bpos = _hashed_key_positions(bsh, node.build_keys)
+    if bpos is None:
+        return False
+    ppos = _hashed_key_positions(psh, node.probe_keys)
+    if ppos is None:
+        return False
+    return bpos == ppos

@@ -162,6 +162,9 @@ class PMotion(PlanNode):
     child: PlanNode
     kind: str
     hash_keys: list[ex.Expr] = dc_field(default_factory=list)
+    # set by the distribution pass:
+    out_capacity: int = 0   # receive-side array capacity
+    bucket_cap: int = 0     # per-destination bucket capacity (redistribute)
 
     def children(self):
         return [self.child]

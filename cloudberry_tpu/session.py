@@ -5,13 +5,31 @@ pipeline: parse → bind/plan (motion insertion) → compile → execute. The
 reference's equivalent surface is a libpq connection to the coordinator
 backend (exec_simple_query, src/backend/tcop/postgres.c:1655); here it is an
 in-process Python API (the serving layer comes later).
+
+The session also owns segment data placement: the analog of the reference's
+load-time row routing (cdbhash + jump_consistent_hash, cdbhash.c:55-78),
+cached per (table, n_segments) the way segment data lives on segment disks.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any
 
+import numpy as np
+
 from cloudberry_tpu.config import Config, get_config
+
+
+@dataclass
+class ShardedTable:
+    """Host-side sharded layout: per-column (n_segments, capacity) arrays
+    padded to the largest shard, plus true per-segment row counts."""
+    columns: dict[str, np.ndarray]
+    counts: np.ndarray          # (n_segments,) int64
+    capacity: int
+    replicated: bool
+    version: int
 
 
 class Session:
@@ -20,6 +38,7 @@ class Session:
 
         self.config = config or get_config()
         self.catalog = Catalog()
+        self._shard_cache: dict[str, ShardedTable] = {}
 
     def sql(self, query: str, **params: Any):
         from cloudberry_tpu.sql.parser import parse_sql
@@ -38,4 +57,43 @@ class Session:
 
         stmt = parse_sql(query)
         result = plan_statement(stmt, self, {})
+        if result.is_ddl:
+            return str(result.ddl_result)
         return result.plan.explain()
+
+    # ------------------------------------------------------- data placement
+
+    def sharded_table(self, name: str) -> ShardedTable:
+        t = self.catalog.table(name)
+        nseg = self.config.n_segments
+        key = f"{name}@{nseg}"
+        cached = self._shard_cache.get(key)
+        version = getattr(t, "_version", t.stats.row_count)
+        if cached is not None and cached.version == version:
+            return cached
+
+        if t.policy.kind == "replicated":
+            st = ShardedTable(dict(t.data),
+                              np.full(nseg, t.num_rows, dtype=np.int64),
+                              max(t.num_rows, 1), True, version)
+        else:
+            assign = t.shard_assignment(nseg)
+            counts = np.bincount(assign, minlength=nseg).astype(np.int64) \
+                if len(assign) else np.zeros(nseg, dtype=np.int64)
+            cap = max(int(counts.max()) if len(counts) else 0, 1)
+            cols = {}
+            order = np.argsort(assign, kind="stable") if len(assign) else assign
+            starts = np.concatenate([[0], np.cumsum(counts)])
+            for cname, arr in t.data.items():
+                buf = np.zeros((nseg, cap), dtype=arr.dtype)
+                sorted_arr = arr[order]
+                for s in range(nseg):
+                    n = counts[s]
+                    buf[s, :n] = sorted_arr[starts[s]:starts[s] + n]
+                cols[cname] = buf
+            st = ShardedTable(cols, counts, cap, False, version)
+        self._shard_cache[key] = st
+        return st
+
+    def shard_capacity(self, name: str) -> int:
+        return self.sharded_table(name).capacity

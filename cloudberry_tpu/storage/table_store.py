@@ -1,0 +1,223 @@
+"""TableStore — persistent tables over immutable micro-partitions with
+snapshot manifests.
+
+The transactional design follows SURVEY.md §7.1's stance: instead of
+re-building per-node WAL + 2PC (cdbtm.c), the coordinator owns ONE logical
+commit log per store: every write produces new immutable partition files plus
+a new manifest version; readers pin a manifest version and see a consistent
+snapshot (the distributed-snapshot analog, cdbdistributedsnapshot.c — here
+trivially consistent because data files never mutate). Deletes are
+delete-vectors recorded in the manifest (the AO visimap analog,
+appendonly_visimap.c). Commit = atomic rename of the CURRENT pointer; crash
+before rename leaves the previous snapshot intact (crash recovery = nothing
+to do).
+
+Layout:
+    root/<table>/part-<uuid>.cbmp           immutable column data
+    root/<table>/_manifests/v<k>.json       snapshot manifests
+    root/<table>/_manifests/CURRENT         text: latest committed version
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from cloudberry_tpu.columnar.dictionary import StringDictionary
+from cloudberry_tpu.storage import micropartition as mp
+from cloudberry_tpu.types import DType, Schema
+
+
+@dataclass
+class PartitionEntry:
+    file: str
+    num_rows: int
+    # stats: {col: [min, max]}
+    stats: dict
+    # sorted row ids deleted from this partition (visimap analog)
+    deleted: list[int]
+
+
+class TableStore:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # ----------------------------------------------------------- manifests
+
+    def _mdir(self, table: str) -> str:
+        return os.path.join(self.root, table, "_manifests")
+
+    def current_version(self, table: str) -> int:
+        try:
+            with open(os.path.join(self._mdir(table), "CURRENT")) as f:
+                return int(f.read().strip())
+        except FileNotFoundError:
+            return 0
+
+    def read_manifest(self, table: str,
+                      version: Optional[int] = None) -> dict:
+        v = self.current_version(table) if version is None else version
+        if v == 0:
+            return {"version": 0, "schema": None, "partitions": [],
+                    "dicts": {}}
+        with open(os.path.join(self._mdir(table), f"v{v}.json")) as f:
+            return json.load(f)
+
+    def _commit(self, table: str, manifest: dict) -> int:
+        """Atomically publish a new snapshot (single-coordinator commit)."""
+        mdir = self._mdir(table)
+        os.makedirs(mdir, exist_ok=True)
+        v = self.current_version(table) + 1
+        manifest["version"] = v
+        path = os.path.join(mdir, f"v{v}.json")
+        with open(path, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # atomic CURRENT swap — the commit point
+        fd, tmp = tempfile.mkstemp(dir=mdir)
+        with os.fdopen(fd, "w") as f:
+            f.write(str(v))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(mdir, "CURRENT"))
+        return v
+
+    # -------------------------------------------------------------- writes
+
+    def append(self, table: str, data: dict[str, np.ndarray], schema: Schema,
+               dicts: dict[str, StringDictionary] | None = None,
+               rows_per_partition: int = 1 << 20,
+               replace: bool = False) -> int:
+        """Append rows as new micro-partitions (``replace=True``: the new
+        snapshot contains ONLY these rows — still one atomic commit, so a
+        crash mid-write never publishes an empty intermediate).
+        Returns the new snapshot version."""
+        tdir = os.path.join(self.root, table)
+        os.makedirs(tdir, exist_ok=True)
+        man = self.read_manifest(table)
+        if replace:
+            man["partitions"] = []
+        n = len(next(iter(data.values()))) if data else 0
+        new_parts = []
+        for lo in range(0, max(n, 1), rows_per_partition):
+            hi = min(lo + rows_per_partition, n)
+            if hi <= lo:
+                break
+            chunk = {k: v[lo:hi] for k, v in data.items()}
+            fname = f"part-{uuid.uuid4().hex}.cbmp"
+            footer = mp.write_micropartition(
+                os.path.join(tdir, fname), chunk, schema, dicts)
+            stats = {c["name"]: [c["min"], c["max"]]
+                     for c in footer["columns"] if "min" in c}
+            new_parts.append({"file": fname, "num_rows": hi - lo,
+                              "stats": stats, "deleted": []})
+        # dictionaries are table-level, append-only state: a new dict must
+        # EXTEND the stored one (codes in already-written partitions keep
+        # decoding correctly); anything else is a caller error, not silent
+        # corruption.
+        man["schema"] = [mp._field_json(f) for f in schema.fields]
+        old_dicts = man.get("dicts", {}) if not replace else {}
+        new_dicts = {k: list(d.values) for k, d in (dicts or {}).items()}
+        for k, old in old_dicts.items():
+            new = new_dicts.get(k)
+            if new is None:
+                new_dicts[k] = old
+            elif new[:len(old)] != old:
+                raise ValueError(
+                    f"dictionary for column {k!r} is not an append-only "
+                    f"extension of the stored dictionary")
+        man["dicts"] = new_dicts
+        man["partitions"] = man["partitions"] + new_parts
+        return self._commit(table, man)
+
+    def delete_rows(self, table: str, pred) -> int:
+        """Mark rows deleted (visimap-style) where pred(columns)->bool mask;
+        pred receives decoded per-partition columns. Returns new version."""
+        man = self.read_manifest(table)
+        schema = Schema(tuple(mp._field_from_json(j) for j in man["schema"]))
+        tdir = os.path.join(self.root, table)
+        for part in man["partitions"]:
+            cols = mp.read_columns(os.path.join(tdir, part["file"]))
+            mask = np.asarray(pred(cols))
+            if mask.any():
+                dead = set(part["deleted"]) | set(np.nonzero(mask)[0].tolist())
+                part["deleted"] = sorted(dead)
+        del schema
+        return self._commit(table, man)
+
+    # --------------------------------------------------------------- reads
+
+    def scan(self, table: str, columns: list[str] | None = None,
+             version: Optional[int] = None,
+             prune: dict | None = None) -> tuple[dict, Schema, dict]:
+        """Snapshot read. ``prune``: {col: (lo, hi)} ranges — partitions
+        provably outside are skipped via footer stats.
+
+        Returns (columns dict, schema, dicts)."""
+        man = self.read_manifest(table, version)
+        if man["schema"] is None:
+            raise KeyError(f"table {table!r} has no data in store")
+        schema = Schema(tuple(mp._field_from_json(j) for j in man["schema"]))
+        tdir = os.path.join(self.root, table)
+        chunks: list[dict[str, np.ndarray]] = []
+        for part in man["partitions"]:
+            if prune and not all(
+                    _part_may_match(part, c, lo, hi)
+                    for c, (lo, hi) in prune.items()):
+                continue
+            cols = mp.read_columns(os.path.join(tdir, part["file"]), columns)
+            if part["deleted"]:
+                keep = np.ones(part["num_rows"], dtype=bool)
+                keep[np.asarray(part["deleted"], dtype=np.int64)] = False
+                cols = {k: v[keep] for k, v in cols.items()}
+            chunks.append(cols)
+        names = columns or schema.names
+        out = {}
+        for name in names:
+            arrs = [c[name] for c in chunks]
+            f = schema.field(name)
+            out[name] = (np.concatenate(arrs) if arrs
+                         else np.zeros(0, dtype=f.type.np_dtype))
+        dicts = {k: StringDictionary(v) for k, v in man["dicts"].items()}
+        return out, schema, dicts
+
+    # ------------------------------------------------------ session bridge
+
+    def save_table(self, t) -> int:
+        """Persist a catalog Table's current data as a fresh snapshot
+        (one atomic commit)."""
+        return self.append(t.name, t.data, t.schema, t.dicts, replace=True)
+
+    def load_table(self, catalog, name: str,
+                   version: Optional[int] = None):
+        """Materialize a stored table into a catalog (replaces data)."""
+        from cloudberry_tpu.catalog.catalog import DistributionPolicy
+
+        data, schema, dicts = self.scan(name, version=version)
+        if name in catalog.tables:
+            t = catalog.table(name)
+        else:
+            t = catalog.create_table(name, schema,
+                                     DistributionPolicy.random())
+        t.dicts = dicts
+        t.set_data(data, dicts)
+        return t
+
+
+def _part_may_match(part: dict, col: str, lo, hi) -> bool:
+    st = part.get("stats", {}).get(col)
+    if st is None:
+        return True
+    if lo is not None and st[1] < lo:
+        return False
+    if hi is not None and st[0] > hi:
+        return False
+    return True

@@ -86,6 +86,35 @@ def modulo_segment(h: jnp.ndarray, n_segments: int) -> jnp.ndarray:
     return (h % jnp.uint64(n_segments)).astype(jnp.int32)
 
 
+def jump_consistent_hash_jnp(keys: jnp.ndarray, n_buckets: int) -> jnp.ndarray:
+    """Device-side jump consistent hash — MUST match the numpy version so
+    Motion routing lands rows on the same segment where load-time placement
+    (catalog.shard_assignment) put their join partners. Vectorized masked
+    while_loop; expected O(ln n) iterations."""
+    import jax
+
+    keys = keys.astype(jnp.uint64)
+    b0 = jnp.full(keys.shape, -1, dtype=jnp.int64)
+    j0 = jnp.zeros(keys.shape, dtype=jnp.int64)
+
+    def cond(state):
+        _, j, _ = state
+        return (j < n_buckets).any()
+
+    def body(state):
+        b, j, k = state
+        active = j < n_buckets
+        b = jnp.where(active, j, b)
+        k = jnp.where(active, k * jnp.uint64(_JUMP) + jnp.uint64(1), k)
+        denom = ((k >> jnp.uint64(33)) + jnp.uint64(1)).astype(jnp.float64)
+        jn = ((b + 1) * (float(1 << 31) / denom)).astype(jnp.int64)
+        j = jnp.where(active, jn, j)
+        return b, j, k
+
+    b, _, _ = jax.lax.while_loop(cond, body, (b0, j0, keys))
+    return b.astype(jnp.int32)
+
+
 def jump_consistent_hash_np(keys: np.ndarray, n_buckets: int) -> np.ndarray:
     """Lamping-Veach jump consistent hash, vectorized over keys (host side).
 
