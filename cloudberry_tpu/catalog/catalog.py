@@ -89,6 +89,27 @@ class Table:
             self.stats.unique[col] = cached
         return cached
 
+    def is_unique_cols(self, cols: tuple[str, ...]) -> bool:
+        """Exact multi-column uniqueness (composite PK detection, e.g.
+        partsupp's (ps_partkey, ps_suppkey)) — lexsort + adjacent compare."""
+        key = "|".join(sorted(cols))
+        cached = self.stats.unique.get(key)
+        if cached is None:
+            arrs = [self.data.get(c) for c in cols]
+            if any(a is None or a.dtype.kind not in "iuf" for a in arrs):
+                cached = False
+            elif self.stats.row_count == 0:
+                cached = True
+            else:
+                order = np.lexsort(tuple(arrs))
+                eq = np.ones(len(order) - 1, dtype=bool)
+                for a in arrs:
+                    s_ = a[order]
+                    eq &= s_[1:] == s_[:-1]
+                cached = not bool(eq.any())
+            self.stats.unique[key] = cached
+        return cached
+
     def to_pandas(self):
         """Decode the (already physically-encoded) table data to pandas."""
         import pandas as pd

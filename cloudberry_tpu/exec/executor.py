@@ -107,6 +107,7 @@ class Lowerer:
     def __init__(self, tables, platform: str | None = None):
         self.tables = tables
         self.checks: dict[str, jnp.ndarray] = {}
+        self._subcache: dict[int, jnp.ndarray] = {}
         # scatter (segment ops) lower well on CPU; TPU serializes large
         # scatters, so it gets unrolled masked reductions instead
         platform = platform or jax.default_backend()
@@ -117,11 +118,11 @@ class Lowerer:
             return self.scan(node)
         if isinstance(node, N.PFilter):
             cols, sel = self.lower(node.child)
-            mask = compile_expr(node.predicate)(cols)
+            mask = self.expr(node.predicate, cols)
             return cols, sel & mask
         if isinstance(node, N.PProject):
             cols, sel = self.lower(node.child)
-            out = {name: compile_expr(e)(cols) for name, e in node.exprs}
+            out = {name: self.expr(e, cols) for name, e in node.exprs}
             return out, sel
         if isinstance(node, N.PJoin):
             return self.join(node)
@@ -163,18 +164,56 @@ class Lowerer:
         # MotionIPCLayer seam's test backend)
         return self.lower(node.child)
 
+    # ----------------------------------------------------------- expressions
+
+    def expr(self, e: ex.Expr, cols) -> jnp.ndarray:
+        """Evaluate an expression; uncorrelated scalar subqueries (InitPlan
+        analog) are lowered once inside the same program and broadcast."""
+        subs = [n for n in ex.walk(e) if isinstance(n, ex.SubqueryScalar)]
+        if not subs:
+            return compile_expr(e)(cols)
+        aug = dict(cols)
+        mapping = {}
+        for sq in subs:
+            key = id(sq)
+            if key not in self._subcache:
+                scols, ssel = self.lower(sq.plan)
+                arr = scols[sq.plan.fields[0].name]
+                n = jnp.sum(ssel.astype(jnp.int64))
+                self.checks[
+                    f"scalar subquery returned a row count != 1 (node "
+                    f"{key}); NULL/multi-row scalar subqueries are not "
+                    "supported yet"] = n != 1
+                idx = jnp.argmax(ssel)  # the single selected row
+                self._subcache[key] = arr[idx]
+            name = f"$sqv{key}"
+            mapping[key] = name
+            aug[name] = self._subcache[key]
+        return compile_expr(_substitute_subqueries(e, mapping))(aug)
+
     # ------------------------------------------------------------ operators
 
     def join(self, node: N.PJoin):
         bcols, bsel = self.lower(node.build)
         pcols, psel = self.lower(node.probe)
-        bkeys = [compile_expr(k)(bcols) for k in node.build_keys]
-        pkeys = [compile_expr(k)(pcols) for k in node.probe_keys]
+        bkeys = [self.expr(k, bcols) for k in node.build_keys]
+        pkeys = [self.expr(k, pcols) for k in node.probe_keys]
+
+        if node.kind in ("semi", "anti") and node.residual is not None:
+            return self._join_semi_residual(node, bcols, bsel, bkeys,
+                                            pcols, psel, pkeys)
+        if not node.unique_build:
+            return self._join_expand(node, bcols, bsel, bkeys,
+                                     pcols, psel, pkeys)
+
         idx, matched = K.join_lookup(bkeys, bsel, pkeys, psel)
-        self.checks[
-            f"join build side has duplicate keys (node {id(node)}); "
-            "many-to-many joins need the expansion kernel"] = \
-            _dup_keys_flag(bkeys, bsel)
+        if node.kind in ("inner", "left"):
+            # semi/anti only test membership; inner/left rely on the
+            # planner's uniqueness proof — verify it at runtime
+            self.checks[
+                f"join build side has duplicate keys (node {id(node)}) but "
+                "the planner assumed a unique (PK) build side"] = \
+                _dup_keys_flag(bkeys, bsel)
         payload = K.gather_payload({n: bcols[n] for n in node.build_payload},
                                    idx, matched)
         cols = {**pcols, **payload}
@@ -190,6 +229,63 @@ class Lowerer:
             raise ExecError(f"join kind {node.kind}")
         return cols, sel
 
+    def _join_semi_residual(self, node: N.PJoin, bcols, bsel, bkeys,
+                            pcols, psel, pkeys):
+        """Correlated EXISTS with extra non-equi conditions (Q21 shape):
+        expand equi-match pairs, evaluate the residual per pair, then
+        OR-reduce back onto probe rows."""
+        cap = node.out_capacity
+        pi, bi, osel, _matched, total = K.join_expand(
+            bkeys, bsel, pkeys, psel, cap)
+        self.checks[
+            f"semi-join expansion overflow: match pairs exceed capacity "
+            f"{cap} (node {id(node)})"] = total > cap
+        paircols = {name: jnp.take(c, pi, axis=0) for name, c in pcols.items()}
+        for name in node.build_payload:
+            paircols[name] = jnp.take(bcols[name], bi, axis=0)
+        rmask = self.expr(node.residual, paircols) & osel
+        hit = jnp.zeros(psel.shape, dtype=jnp.bool_)
+        hit = hit.at[pi].max(rmask, mode="drop")
+        sel = psel & hit if node.kind == "semi" else psel & ~hit
+        return dict(pcols), sel
+
+    def _join_expand(self, node: N.PJoin, bcols, bsel, bkeys,
+                     pcols, psel, pkeys):
+        """Many-to-many expansion: one output row per match pair; LEFT joins
+        append unmatched (preserved) probe rows after the pairs."""
+        cap = node.out_capacity
+        pi, bi, osel, matched, total = K.join_expand(
+            bkeys, bsel, pkeys, psel, cap)
+        need = total
+        is_pair = osel
+        if node.kind == "left":
+            um = psel & ~matched
+            um_rank = jnp.cumsum(um.astype(total.dtype)) - 1
+            n_um = jnp.sum(um.astype(total.dtype))
+            slot = jnp.where(um, total + um_rank, cap)
+            pi = pi.at[slot].set(jnp.arange(um.shape[0], dtype=pi.dtype),
+                                 mode="drop")
+            j = jnp.arange(cap, dtype=total.dtype)
+            osel = j < (total + n_um)
+            is_pair = j < total
+            need = total + n_um
+        elif node.kind != "inner":
+            raise ExecError(f"expansion join does not support {node.kind}")
+        self.checks[
+            f"join expansion overflow: match pairs exceed capacity {cap} "
+            f"(node {id(node)})"] = need > cap
+
+        cols = {}
+        for name, c in pcols.items():
+            cols[name] = jnp.take(c, pi, axis=0)
+        for name in node.build_payload:
+            g = jnp.take(bcols[name], bi, axis=0)
+            cols[name] = jnp.where(is_pair, g,
+                                   jnp.zeros((), dtype=g.dtype))
+        if node.match_name:
+            cols[node.match_name] = is_pair
+        return cols, osel
+
     def agg(self, node: N.PAgg):
         cols, sel = self.lower(node.child)
         agg_specs = []
@@ -197,10 +293,33 @@ class Lowerer:
         post_scale: dict[str, float] = {}
         for name, call in node.aggs:
             func = call.func
+            nmask = getattr(call.arg, "_null_mask", None) \
+                if call.arg is not None else None
+            if nmask == "$lost":
+                raise ExecError(
+                    f"aggregate {func}() over a nullable column exported "
+                    "through a derived table is not supported yet")
             if func == "count" and call.arg is None:
                 agg_values[name] = None
+            elif func == "count" and nmask is not None:
+                # COUNT(col) over an outer join's nullable side counts only
+                # matched rows
+                func = "count_nn"
+                agg_values[name] = cols[nmask]
+            elif func in ("sum", "min", "max") and nmask is not None:
+                # null rows contribute the aggregate's identity; a group of
+                # ONLY null rows yields the identity rather than SQL NULL
+                # (documented limitation until null-valued outputs exist)
+                v = self.expr(call.arg, cols)
+                ident = {"sum": jnp.zeros((), dtype=v.dtype),
+                         "min": K._dtype_max(v.dtype),
+                         "max": K._dtype_min(v.dtype)}[func]
+                agg_values[name] = jnp.where(cols[nmask], v, ident)
+            elif func == "avg" and nmask is not None:
+                raise ExecError("avg() over an outer join's nullable side "
+                                "is not supported yet")
             elif func in ("sum", "min", "max", "avg", "count"):
-                agg_values[name] = compile_expr(call.arg)(cols) \
+                agg_values[name] = self.expr(call.arg, cols) \
                     if call.arg is not None else None
             else:
                 raise ExecError(f"aggregate {func} not implemented yet")
@@ -220,7 +339,7 @@ class Lowerer:
         if dense is not None:
             return dense
 
-        key_cols = {name: compile_expr(e)(cols)
+        key_cols = {name: self.expr(e, cols)
                     for name, e in node.group_keys}
         out_keys, out_aggs, out_sel, n_groups = K.group_aggregate(
             key_cols, agg_values, agg_specs, sel, node.capacity)
@@ -263,7 +382,7 @@ class Lowerer:
 
         gid = jnp.zeros(sel.shape, dtype=jnp.int32)
         for (name, e), stride in zip(node.group_keys, strides):
-            gid = gid + compile_expr(e)(cols).astype(jnp.int32) \
+            gid = gid + self.expr(e, cols).astype(jnp.int32) \
                 * np.int32(stride)
         out_aggs, occupied = K.group_aggregate_dense(
             gid, prod, agg_values, agg_specs, sel,
@@ -310,3 +429,31 @@ def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:
             safe = jnp.clip(arr, 0, rank.shape[0] - 1)
             return jnp.where(arr >= 0, jnp.take(rank, safe), -1)
     return arr
+
+
+def _substitute_subqueries(e: ex.Expr, mapping: dict[int, str]) -> ex.Expr:
+    """Rebuild an expression tree with SubqueryScalar nodes replaced by
+    ColumnRefs into the augmented column dict."""
+    if isinstance(e, ex.SubqueryScalar):
+        return ex.ColumnRef(mapping[id(e)], e.dtype)
+    if isinstance(e, ex.BinOp):
+        return ex.BinOp(e.op, _substitute_subqueries(e.left, mapping),
+                        _substitute_subqueries(e.right, mapping), e.dtype)
+    if isinstance(e, ex.UnaryOp):
+        return ex.UnaryOp(e.op, _substitute_subqueries(e.operand, mapping),
+                          e.dtype)
+    if isinstance(e, ex.Cast):
+        return ex.Cast(_substitute_subqueries(e.operand, mapping), e.dtype)
+    if isinstance(e, ex.Func):
+        return ex.Func(e.name, tuple(_substitute_subqueries(a, mapping)
+                                     for a in e.args), e.dtype)
+    if isinstance(e, ex.CaseWhen):
+        return ex.CaseWhen(
+            tuple((_substitute_subqueries(c, mapping),
+                   _substitute_subqueries(v, mapping)) for c, v in e.whens),
+            _substitute_subqueries(e.otherwise, mapping)
+            if e.otherwise is not None else None, e.dtype)
+    if isinstance(e, ex.DictLookup):
+        return ex.DictLookup(_substitute_subqueries(e.column, mapping),
+                             e.table, e.dtype)
+    return e

@@ -176,9 +176,12 @@ def group_aggregate(
         if v is not None:
             v = v[perm]
         if spec.func == "count":
-            # COUNT(*) and COUNT(col) agree while columns are non-nullable;
-            # null-aware COUNT(col) will weigh v's validity here.
             ones = s_sel.astype(jnp.int64)
+            out = jax.ops.segment_sum(ones, gid, num_segments=nseg + 1)[:nseg]
+        elif spec.func == "count_nn":
+            # COUNT(col) over a nullable (outer-join) column: v is the
+            # validity mask
+            ones = (s_sel & v).astype(jnp.int64)
             out = jax.ops.segment_sum(ones, gid, num_segments=nseg + 1)[:nseg]
         elif spec.func == "sum":
             vv = jnp.where(s_sel, v, 0)
@@ -241,6 +244,8 @@ def group_aggregate_dense(
             v = agg_values.get(spec.out_name)
             if spec.func == "count":
                 out[spec.out_name] = counts
+            elif spec.func == "count_nn":
+                out[spec.out_name] = seg((sel & v).astype(jnp.int64))
             elif spec.func == "sum":
                 out[spec.out_name] = seg(jnp.where(sel, v, 0))
             elif spec.func == "min":
@@ -259,6 +264,9 @@ def group_aggregate_dense(
         v = agg_values.get(spec.out_name)
         if spec.func == "count":
             out[spec.out_name] = counts
+        elif spec.func == "count_nn":
+            out[spec.out_name] = jnp.stack(
+                [(m & v).sum(dtype=jnp.int64) for m in cell_masks])
         elif spec.func == "sum":
             out[spec.out_name] = jnp.stack(
                 [jnp.where(m, v, 0).sum() for m in cell_masks])
@@ -290,6 +298,8 @@ def global_aggregate(
         v = agg_values.get(spec.out_name)
         if spec.func == "count":
             out[spec.out_name] = jnp.sum(sel.astype(jnp.int64))[None]
+        elif spec.func == "count_nn":
+            out[spec.out_name] = jnp.sum((sel & v).astype(jnp.int64))[None]
         elif spec.func == "sum":
             out[spec.out_name] = jnp.sum(jnp.where(sel, v, 0))[None]
         elif spec.func == "min":
@@ -358,6 +368,53 @@ def gather_payload(cols: Columns, idx: jnp.ndarray, matched: jnp.ndarray) -> Col
         g = jnp.take(c, idx, axis=0)
         out[name] = jnp.where(matched, g, jnp.zeros((), dtype=c.dtype))
     return out
+
+
+def join_expand(
+    build_key: Sequence[jnp.ndarray],
+    build_sel: jnp.ndarray,
+    probe_key: Sequence[jnp.ndarray],
+    probe_sel: jnp.ndarray,
+    out_capacity: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Many-to-many join: emit ONE OUTPUT ROW PER MATCH PAIR.
+
+    Sorted-build range lookup: probe row i matches the build range
+    [start_i, end_i); match pairs are laid out consecutively by probe row
+    (offsets = cumsum of per-probe match counts), and output slot j maps back
+    to (probe row, k-th match) by binary search on the offsets — fully
+    vectorized, no data-dependent shapes. Total matches beyond
+    ``out_capacity`` are reported, never silently dropped.
+
+    Returns (probe_row[out_cap], build_row[out_cap], out_sel[out_cap],
+             matched[probe_cap] (per-probe any-match, for outer joins),
+             total_matches scalar).
+    """
+    ranges = key_ranges(list(build_key), build_sel)
+    kb = pack_with_ranges(list(build_key), ranges)
+    kp = pack_with_ranges(list(probe_key), ranges)
+    kb_masked = jnp.where(build_sel, kb, _U64_MAX)
+    order = jnp.argsort(kb_masked)
+    kb_sorted = kb_masked[order]
+
+    start = jnp.searchsorted(kb_sorted, kp, side="left")
+    end = jnp.searchsorted(kb_sorted, kp, side="right")
+    ok = probe_sel & (kp != _U64_MAX)
+    cnt = jnp.where(ok, end - start, 0)
+    matched = cnt > 0
+
+    offsets = jnp.cumsum(cnt)
+    total = offsets[-1] if cnt.shape[0] else jnp.asarray(0, cnt.dtype)
+    j = jnp.arange(out_capacity, dtype=offsets.dtype)
+    # probe row for output slot j: first i with offsets[i] > j
+    pi = jnp.searchsorted(offsets, j, side="right")
+    pi_c = jnp.clip(pi, 0, cnt.shape[0] - 1)
+    base = offsets[pi_c] - cnt[pi_c]          # first slot of probe row pi
+    k = j - base
+    out_sel = j < total
+    build_pos = jnp.clip(start[pi_c] + k, 0, kb_sorted.shape[0] - 1)
+    build_row = order[build_pos].astype(jnp.int32)
+    return pi_c.astype(jnp.int32), build_row, out_sel, matched, total
 
 
 # --------------------------------------------------------------------------

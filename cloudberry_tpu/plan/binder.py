@@ -143,7 +143,19 @@ def _unique_sets(plan: N.PlanNode, catalog: Catalog) -> list[frozenset[str]]:
 def _build_is_unique(plan: N.PlanNode, keys: list[ex.Expr],
                      catalog: Catalog) -> bool:
     names = {k.name for k in keys if isinstance(k, ex.ColumnRef)}
-    return any(s <= names for s in _unique_sets(plan, catalog))
+    if any(s <= names for s in _unique_sets(plan, catalog)):
+        return True
+    # composite PK on a (possibly filtered) base scan, e.g. partsupp's
+    # (ps_partkey, ps_suppkey)
+    p = plan
+    while isinstance(p, (N.PFilter, N.PSort, N.PLimit, N.PMotion)):
+        p = p.children()[0]
+    if isinstance(p, N.PScan) and p.table_name != "$dual" and names:
+        rev = {v: k for k, v in p.column_map.items()}
+        phys = [rev.get(n) for n in names]
+        if all(x is not None for x in phys):
+            return catalog.table(p.table_name).is_unique_cols(tuple(phys))
+    return False
 
 
 class Binder:
@@ -170,7 +182,9 @@ class Binder:
             # FROM-less SELECT (select 1): one-row dummy
             plan = _const_row()
         else:
-            conjuncts = _split_conjuncts(sel.where) if sel.where else []
+            all_conjuncts = _split_conjuncts(sel.where) if sel.where else []
+            conjuncts = [c for c in all_conjuncts if not _contains_subquery(c)]
+            subq_preds = [c for c in all_conjuncts if _contains_subquery(c)]
             edges, per_alias, residual = self._classify(conjuncts, scope)
             for alias, preds in per_alias.items():
                 if alias not in plans:
@@ -185,6 +199,13 @@ class Binder:
             plan = self._join_tree(plans, edges, scope)
             for pred in residual:
                 plan = self._filter(plan, self.bind_scalar(pred, scope))
+            for pred in subq_preds:
+                plan = self._apply_subquery_pred(pred, plan, scope)
+            # every range entry now resolves against the final joined plan —
+            # stale pointers would defeat resolve()'s same-source dedupe
+            for e in scope.entries:
+                if _plan_contains(plan, e.plan):
+                    e.plan = plan
 
         # -------- aggregation
         has_agg = (bool(sel.group_by) or sel.having is not None
@@ -258,7 +279,9 @@ class Binder:
                                      ex.ColumnRef(f.name, f.type))
                                     for f in sub.fields])
             proj.fields = [N.PlanField(f"{alias}.{f.name.split('.')[-1]}",
-                                       f.type, f.sdict) for f in sub.fields]
+                                       f.type, f.sdict,
+                                       null_mask=f.null_mask)
+                           for f in sub.fields]
             scope.entries.append(RangeEntry(alias, proj))
             return alias, proj
         if isinstance(ref, ast.JoinRef):
@@ -289,6 +312,29 @@ class Binder:
             residual.append(c)
         if not lkeys:
             raise BindError("JOIN requires at least one equi-condition")
+        if ref.kind in ("left", "right"):
+            # ON-clause extras must filter the NON-preserved side BEFORE the
+            # join (post-join filtering would drop preserved rows)
+            inner_alias = ralias if ref.kind == "left" else lalias
+            inner_plan = rplan if ref.kind == "left" else lplan
+            inner_aliases = {e.alias for e in scope.entries
+                             if e.plan is inner_plan}
+            keep = []
+            for c in residual:
+                if scope.aliases_of(c) <= inner_aliases:
+                    inner_plan = self._filter(
+                        inner_plan, self.bind_scalar(c, scope))
+                else:
+                    keep.append(c)
+            if keep:
+                raise BindError("OUTER JOIN ON condition referencing the "
+                                "preserved side is not supported yet")
+            residual = []
+            _rebind_scope(scope, inner_alias, inner_plan)
+            if ref.kind == "left":
+                rplan = inner_plan
+            else:
+                lplan = inner_plan
         if ref.kind == "inner":
             # build side must be unique on its keys; prefer the smaller side
             l_uniq = _build_is_unique(lplan, lkeys, self.catalog)
@@ -410,15 +456,18 @@ class Binder:
             cur_unique = _build_is_unique(current, cur_keys, self.catalog)
             for e in used:
                 edges.remove(e)
-            # orientation: build must be unique; prefer the smaller side
-            if new_unique and (not cur_unique
-                               or _plan_capacity(other)
-                               <= _plan_capacity(current)):
+            # orientation: prefer a unique build side (lookup join); with
+            # neither unique (expansion join) build the smaller side
+            new_smaller = _plan_capacity(other) <= _plan_capacity(current)
+            if new_unique and (not cur_unique or new_smaller):
                 current = self._make_join("inner", other, current,
                                           new_keys, cur_keys)
-            else:
+            elif cur_unique or not new_smaller:
                 current = self._make_join("inner", current, other,
                                           cur_keys, new_keys)
+            else:
+                current = self._make_join("inner", other, current,
+                                          new_keys, cur_keys)
             joined_aliases |= groups[gid]
             remaining.discard(gid)
             for e in scope.entries:
@@ -429,12 +478,22 @@ class Binder:
     def _make_join(self, kind: str, build: N.PlanNode, probe: N.PlanNode,
                    build_keys: list[ex.Expr], probe_keys: list[ex.Expr]
                    ) -> N.PJoin:
-        payload = [f.name for f in build.fields]
+        # semi/anti only filter the probe side: no build columns in output
+        payload = [f.name for f in build.fields] \
+            if kind in ("inner", "left") else []
         match_name = self.gensym("match")
         j = N.PJoin(kind, build, probe, build_keys, probe_keys,
                     payload, match_name)
+        # semi/anti joins only test membership — build duplicates are fine;
+        # inner/left joins with a non-unique build need pair expansion
+        if kind in ("inner", "left") \
+                and not _build_is_unique(build, build_keys, self.catalog):
+            j.unique_build = False
+            j.out_capacity = _plan_capacity(build) + _plan_capacity(probe)
+        nm = match_name if kind == "left" else None
         j.fields = list(probe.fields) + [
-            N.PlanField(f.name, f.type, f.sdict) for f in build.fields]
+            N.PlanField(f.name, f.type, f.sdict, null_mask=nm)
+            for f in build.fields if kind in ("inner", "left")]
         return j
 
     def _filter(self, child: N.PlanNode, pred: ex.Expr) -> N.PFilter:
@@ -464,6 +523,9 @@ class Binder:
 
         def extract(node: ast.ExprNode) -> ast.ExprNode:
             """Replace aggregate calls with references to agg outputs."""
+            if isinstance(node, (ast.ScalarSubquery, ast.InSubquery,
+                                 ast.Exists)):
+                return node
             if isinstance(node, ast.FuncCall) and node.name in AGG_FUNCS:
                 key = _ast_key(node)
                 if key not in agg_names:
@@ -496,6 +558,10 @@ class Binder:
         rewritten_having = extract(sel.having) if sel.having else None
         rewritten_order = [(extract(o.expr), o.ascending)
                            for o in sel.order_by]
+
+        if any(c.func == "count_distinct" for _, c in aggs):
+            plan, group_keys, aggs = self._rewrite_count_distinct(
+                plan, group_keys, aggs)
 
         agg = N.PAgg(plan, group_keys, aggs,
                      capacity=_agg_capacity(plan, group_keys))
@@ -546,13 +612,17 @@ class Binder:
                         seen_sources.add(f.name)
                         name = _uniquify(f.name.split(".")[-1], taken)
                         exprs.append((name, _colref(f)))
-                        fields.append(N.PlanField(name, f.type, f.sdict))
+                        fields.append(N.PlanField(
+                            name, f.type, f.sdict,
+                            null_mask="$lost" if f.null_mask else None))
                 continue
             bound = self.bind_scalar(item.expr, scope)
             name = item.alias or _default_name(item.expr) or self.gensym("col")
             name = _uniquify(name, taken)
             exprs.append((name, bound))
-            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound)))
+            nm = getattr(bound, "_null_mask", None)
+            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound),
+                                      null_mask="$lost" if nm else None))
         proj = N.PProject(plan, exprs)
         proj.fields = fields
         self._rewritten_order = {}
@@ -656,8 +726,16 @@ class Binder:
         if isinstance(node, ast.IsNull):
             e = b(node.operand)
             if isinstance(e, ex.IsValid):
-                # match-mask column: IS NULL ⇔ not matched
                 return ex.IsValid(e.mask_name, negate=not node.negated)
+            mask = getattr(e, "_null_mask", None)
+            if mask == "$lost":
+                raise BindError(
+                    "IS NULL on a nullable column exported through a "
+                    "derived table is not supported yet (null provenance "
+                    "is lost at the projection)")
+            if mask is not None:
+                # column from an outer join's nullable side: NULL ⇔ unmatched
+                return ex.IsValid(mask, negate=not node.negated)
             # non-nullable columns: IS NULL is constant false
             return ex.Literal(bool(node.negated), T.BOOL)
 
@@ -691,6 +769,9 @@ class Binder:
         if isinstance(node, ast.SubstringExpr):
             return self._bind_substring(node, scope)
 
+        if isinstance(node, ast.ScalarSubquery):
+            return self._bind_uncorrelated_scalar(node)
+
         if isinstance(node, ast.FuncCall):
             if node.name in AGG_FUNCS:
                 raise BindError(f"aggregate {node.name}() not allowed here")
@@ -711,6 +792,289 @@ class Binder:
             ex.Literal(-1, T.STRING)
         out = ex.CaseWhen(whens, otherwise, T.STRING)
         object.__setattr__(out, "_out_dict", out_dict)
+        return out
+
+    def _rewrite_count_distinct(self, plan, group_keys, aggs):
+        """DQA split (cdbgroupingpaths.c / TupleSplit analog): rewrite
+        count(distinct x) group by k as a distinct-on-(k,x) inner aggregation
+        followed by count per k."""
+        if not all(c.func == "count_distinct" for _, c in aggs):
+            raise BindError("count(distinct) mixed with other aggregates "
+                            "is not supported yet")
+        inner_keys = list(group_keys)
+        arg_of: list[tuple[str, str]] = []
+        for name, call in aggs:
+            assert call.arg is not None
+            aname = self.gensym("darg")
+            inner_keys.append((aname, call.arg))
+            arg_of.append((name, aname))
+        inner = N.PAgg(plan, inner_keys, [],
+                       capacity=_agg_capacity(plan, inner_keys))
+        inner.fields = [N.PlanField(n, e.dtype, _expr_dict(e))
+                        for n, e in inner_keys]
+        new_group = [(n, _colref(inner.field(n))) for n, _ in group_keys]
+        new_aggs = [(name, ex.AggCall("count", _colref(inner.field(aname))))
+                    for name, aname in arg_of]
+        return inner, new_group, new_aggs
+
+    # -------------------------------------------------- subquery predicates
+    # The cdbsubselect.c analog: EXISTS/IN/scalar subqueries in WHERE become
+    # semi/anti/inner joins against a (possibly grouped) subplan.
+
+    def _apply_subquery_pred(self, pred: ast.ExprNode, plan: N.PlanNode,
+                             scope: Scope) -> N.PlanNode:
+        negated = False
+        node = pred
+        if isinstance(node, ast.UnaryOp) and node.op == "not":
+            negated = True
+            node = node.operand
+        if isinstance(node, ast.Exists):
+            return self._apply_exists(node.select, plan, scope,
+                                      negated or node.negated)
+        if isinstance(node, ast.InSubquery):
+            return self._apply_in_subquery(node, plan, scope,
+                                           negated != node.negated)
+        if isinstance(node, ast.BinOp) and node.op in (
+                "=", "<>", "<", "<=", ">", ">="):
+            out = self._apply_scalar_comparison(node, plan, scope, negated)
+            if out is not None:
+                return out
+        # fallback: bind as a plain filter (uncorrelated scalar subqueries
+        # inside arbitrary expressions)
+        return self._filter(plan, self.bind_scalar(pred, scope))
+
+    def _bind_uncorrelated_scalar(self, node: ast.ScalarSubquery) -> ex.Expr:
+        sub = Binder(self.catalog)
+        sub._counter = self._counter + 1000
+        plan = sub.bind_select(node.select)
+        if len(plan.fields) != 1:
+            raise BindError("scalar subquery must return one column")
+        f = plan.fields[0]
+        e = ex.SubqueryScalar(plan, f.type)
+        if f.sdict is not None:
+            object.__setattr__(e, "_sdict", f.sdict)
+        return e
+
+    def _scratch_inner_scope(self, sub: ast.Select) -> Scope:
+        inner = Scope()
+        sb = Binder(self.catalog)
+        sb._counter = self._counter + 2000
+        dump: list = []
+        for ref in sub.from_refs:
+            sb.bind_table_ref(ref, inner, dump)
+        return inner
+
+    def _split_correlation(self, sub: ast.Select, outer: Scope):
+        """Partition the subquery's WHERE into (corr_pairs, inner_conjs,
+        residual_conjs): corr_pairs are inner=outer equi conditions,
+        residuals reference both sides non-equi."""
+        inner = self._scratch_inner_scope(sub)
+
+        def owner(e: ast.ExprNode) -> str:
+            owners = set()
+
+            def walk(n):
+                if isinstance(n, ast.Select):
+                    return  # nested subquery: resolved when it is bound
+                if isinstance(n, ast.Name):
+                    try:
+                        inner.resolve(n.parts)
+                        owners.add("inner")
+                        return
+                    except BindError:
+                        pass
+                    outer.resolve(n.parts)  # raises if unknown anywhere
+                    owners.add("outer")
+                for v in vars(n).values() if isinstance(n, ast.Node) else ():
+                    if isinstance(v, ast.Node):
+                        walk(v)
+                    elif isinstance(v, (list, tuple)):
+                        for x in v:
+                            if isinstance(x, ast.Node):
+                                walk(x)
+                            elif isinstance(x, tuple):
+                                for y in x:
+                                    if isinstance(y, ast.Node):
+                                        walk(y)
+
+            walk(e)
+            if not owners:
+                return "none"
+            if owners == {"inner"}:
+                return "inner"
+            if owners == {"outer"}:
+                return "outer"
+            return "mixed"
+
+        corr_pairs: list[tuple[ast.ExprNode, ast.ExprNode]] = []  # (outer, inner)
+        inner_conjs: list[ast.ExprNode] = []
+        residual: list[ast.ExprNode] = []
+        for c in _split_conjuncts(sub.where):
+            o = owner(c)
+            if o in ("inner", "none"):
+                inner_conjs.append(c)
+            elif o == "outer":
+                residual.append(c)
+            elif isinstance(c, ast.BinOp) and c.op == "=" \
+                    and owner(c.left) in ("inner", "outer") \
+                    and owner(c.right) in ("inner", "outer") \
+                    and owner(c.left) != owner(c.right):
+                if owner(c.left) == "outer":
+                    corr_pairs.append((c.left, c.right))
+                else:
+                    corr_pairs.append((c.right, c.left))
+            else:
+                residual.append(c)
+        return inner, corr_pairs, inner_conjs, residual
+
+    def _mangle_inner(self, nodes_: list[ast.ExprNode], inner: Scope):
+        """Collect inner column references in ``nodes_`` → (select items
+        materializing them, rewrite fn replacing them with mangled names)."""
+        tag = self.gensym("sq").strip("$")
+        mapping: dict[str, str] = {}   # inner physical name -> mangled
+        items: list[ast.SelectItem] = []
+
+        def mangle_of(parts) -> Optional[str]:
+            try:
+                _, f = inner.resolve(parts)
+            except BindError:
+                return None
+            if f.name not in mapping:
+                m = f"${tag}_{len(mapping)}"
+                mapping[f.name] = m
+                items.append(ast.SelectItem(ast.Name(parts), m))
+            return mapping[f.name]
+
+        def rewrite(n):
+            if isinstance(n, ast.Name):
+                m = mangle_of(n.parts)
+                return ast.Name((m,)) if m is not None else n
+            if not isinstance(n, ast.Node):
+                return n
+            out = n.__class__(**vars(n))
+            for k, v in vars(n).items():
+                if isinstance(v, ast.Node):
+                    setattr(out, k, rewrite(v))
+                elif isinstance(v, list):
+                    setattr(out, k, [
+                        rewrite(x) if isinstance(x, ast.Node) else
+                        tuple(rewrite(y) for y in x) if isinstance(x, tuple)
+                        else x for x in v])
+            return out
+
+        rewritten = [rewrite(n) for n in nodes_]
+        return items, rewritten
+
+    def _corr_items(self, corr) -> list[ast.SelectItem]:
+        tag = self.gensym("ck").strip("$")
+        return [ast.SelectItem(iexpr, f"${tag}_{i}")
+                for i, (_, iexpr) in enumerate(corr)]
+
+    def _apply_exists(self, sub: ast.Select, plan: N.PlanNode, scope: Scope,
+                      negated: bool) -> N.PlanNode:
+        inner, corr, inner_conjs, residual = self._split_correlation(sub, scope)
+        if not corr:
+            raise BindError("uncorrelated EXISTS not supported yet")
+        corr_items = self._corr_items(corr)
+        res_items, res_rw = self._mangle_inner(residual, inner)
+        items = corr_items + res_items
+        sub2 = ast.Select(items=items, from_refs=sub.from_refs,
+                          where=_and_all(inner_conjs))
+        subplan = self.bind_select(sub2)
+        probe_keys = [self.bind_scalar(o, scope) for o, _ in corr]
+        build_keys = [self.bind_scalar(ast.Name((it.alias,)),
+                                       Scope([RangeEntry("$sq", subplan)]))
+                      for it in corr_items]
+        kind = "anti" if negated else "semi"
+        j = N.PJoin(kind, subplan, plan, build_keys, probe_keys, [],
+                    self.gensym("match"))
+        j.fields = list(plan.fields)
+        if res_rw:
+            # residual references outer names + mangled subplan names
+            combined = Scope(list(scope.entries)
+                             + [RangeEntry("$sq", subplan)])
+            j.residual = self.bind_scalar(_and_all(res_rw), combined)
+            j.build_payload = [f.name for f in subplan.fields]
+            j.out_capacity = _plan_capacity(subplan) + _plan_capacity(plan)
+        return j
+
+    def _apply_in_subquery(self, node: ast.InSubquery, plan: N.PlanNode,
+                           scope: Scope, negated: bool) -> N.PlanNode:
+        sub = node.select
+        inner, corr, inner_conjs, residual = self._split_correlation(sub, scope)
+        if residual:
+            raise BindError("IN subquery with non-equi correlation "
+                            "not supported yet")
+        if len(sub.items) != 1:
+            raise BindError("IN subquery must return one column")
+        del inner
+        key_alias = self.gensym("inkey").strip("$")
+        items = [ast.SelectItem(sub.items[0].expr, f"${key_alias}")]
+        corr_items = self._corr_items(corr)
+        items += corr_items
+        # keep the subquery's own grouping if it has one (Q18 pattern:
+        # IN (select o_orderkey ... group by o_orderkey having ...))
+        sub2 = ast.Select(items=items, from_refs=sub.from_refs,
+                          where=_and_all(inner_conjs),
+                          group_by=sub.group_by, having=sub.having)
+        subplan = self.bind_select(sub2)
+        sq_scope = Scope([RangeEntry("$sq", subplan)])
+        build_keys = [self.bind_scalar(ast.Name((f"${key_alias}",)), sq_scope)]
+        probe_keys = [self.bind_scalar(node.expr, scope)]
+        for (o, _), it in zip(corr, corr_items):
+            probe_keys.append(self.bind_scalar(o, scope))
+            build_keys.append(self.bind_scalar(ast.Name((it.alias,)), sq_scope))
+        kind = "anti" if negated else "semi"
+        j = N.PJoin(kind, subplan, plan, build_keys, probe_keys, [],
+                    self.gensym("match"))
+        j.fields = list(plan.fields)
+        return j
+
+    def _apply_scalar_comparison(self, node: ast.BinOp, plan: N.PlanNode,
+                                 scope: Scope, negated: bool
+                                 ) -> Optional[N.PlanNode]:
+        """lhs op (select agg(...) from ... where corr) → decorrelate into a
+        grouped subplan + lookup join + filter. Returns None if the pattern
+        doesn't apply (caller falls back to expression binding)."""
+        lhs, rhs, op = node.left, node.right, node.op
+        if isinstance(lhs, ast.ScalarSubquery) and not isinstance(
+                rhs, ast.ScalarSubquery):
+            lhs, rhs = rhs, lhs
+            op = _flip_op(op)
+        if not isinstance(rhs, ast.ScalarSubquery) or _contains_subquery(lhs):
+            return None
+        sub = rhs.select
+        if len(sub.items) != 1 or not _has_agg(sub.items[0].expr):
+            return None
+        inner, corr, inner_conjs, residual = self._split_correlation(sub, scope)
+        if residual:
+            return None
+        if not corr:
+            return None  # uncorrelated → expression path handles it
+        del inner
+        corr_items = self._corr_items(corr)
+        val_name = self.gensym("sval").strip("$")
+        items = [ast.SelectItem(sub.items[0].expr, f"${val_name}")]
+        sub2 = ast.Select(items=corr_items + items, from_refs=sub.from_refs,
+                          where=_and_all(inner_conjs),
+                          group_by=[it.expr for it in corr_items])
+        subplan = self.bind_select(sub2)
+        sq_scope = Scope([RangeEntry("$sq", subplan)])
+        build_keys = [self.bind_scalar(ast.Name((it.alias,)), sq_scope)
+                      for it in corr_items]
+        probe_keys = [self.bind_scalar(o, scope) for o, _ in corr]
+        j = N.PJoin("inner", subplan, plan, build_keys, probe_keys,
+                    [f.name for f in subplan.fields], self.gensym("match"))
+        j.fields = list(plan.fields) + [
+            N.PlanField(f.name, f.type, f.sdict) for f in subplan.fields]
+        cmp_scope = Scope(list(scope.entries) + [RangeEntry("$sq", j)])
+        cmp = self._bind_comparison(
+            op, self.bind_scalar(lhs, scope),
+            self.bind_scalar(ast.Name((f"${val_name}",)), cmp_scope))
+        if negated:
+            cmp = ex.UnaryOp("not", cmp, T.BOOL)
+        out = self._filter(j, cmp)
+        out.fields = list(plan.fields)  # drop subplan columns from output
         return out
 
     def _bind_substring(self, node: ast.SubstringExpr, scope: Scope) -> ex.Expr:
@@ -843,15 +1207,15 @@ class Binder:
                 return ex.BinOp(op, ex.DictLookup(left, lr, T.INT32),
                                 ex.DictLookup(right, rr, T.INT32), T.BOOL)
             raise BindError("string comparison requires a literal or column")
+        if lt.base == DType.FLOAT64 or rt.base == DType.FLOAT64:
+            return ex.BinOp(op, self._coerce(left, T.FLOAT64),
+                            self._coerce(right, T.FLOAT64), T.BOOL)
         if lt.base == DType.DECIMAL or rt.base == DType.DECIMAL:
             l = self._as_decimal(left)
             r = self._as_decimal(right)
             scale = max(l.dtype.scale, r.dtype.scale)
             return ex.BinOp(op, self._coerce(l, T.DECIMAL(scale)),
                             self._coerce(r, T.DECIMAL(scale)), T.BOOL)
-        if lt.base == DType.FLOAT64 or rt.base == DType.FLOAT64:
-            return ex.BinOp(op, self._coerce(left, T.FLOAT64),
-                            self._coerce(right, T.FLOAT64), T.BOOL)
         return ex.BinOp(op, left, right, T.BOOL)
 
     def _fold_date_interval(self, node: ast.BinOp, scope: Scope
@@ -894,10 +1258,13 @@ class Binder:
 
 
 def _colref(f: N.PlanField) -> ex.ColumnRef:
-    """ColumnRef carrying the field's dictionary (string ops need it)."""
+    """ColumnRef carrying the field's dictionary (string ops need it) and
+    its outer-join null mask."""
     c = ex.ColumnRef(f.name, f.type)
     if f.sdict is not None:
         object.__setattr__(c, "_sdict", f.sdict)
+    if f.null_mask is not None:
+        object.__setattr__(c, "_null_mask", f.null_mask)
     return c
 
 
@@ -940,10 +1307,14 @@ def _plan_capacity(p: N.PlanNode) -> int:
         return p.capacity
     if isinstance(p, (N.PAgg,)):
         return p.capacity
+    if isinstance(p, N.PMotion):
+        return p.out_capacity or _plan_capacity(p.child)
     kids = p.children()
     if not kids:
         return 1
     if isinstance(p, N.PJoin):
+        if not p.unique_build:
+            return p.out_capacity
         return _plan_capacity(p.probe)
     return max(_plan_capacity(c) for c in kids)
 
@@ -963,6 +1334,35 @@ def _agg_capacity(child: N.PlanNode, group_keys) -> int:
     if prod is not None:
         return min(max(prod, 8), cap)
     return cap
+
+
+def _contains_subquery(node: ast.Node) -> bool:
+    if isinstance(node, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+        return True
+    for v in vars(node).values() if isinstance(node, ast.Node) else ():
+        if isinstance(v, ast.Node) and not isinstance(v, ast.Select):
+            if _contains_subquery(v):
+                return True
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ast.Node) and not isinstance(x, ast.Select) \
+                        and _contains_subquery(x):
+                    return True
+                if isinstance(x, tuple) and any(
+                        isinstance(y, ast.Node)
+                        and not isinstance(y, ast.Select)
+                        and _contains_subquery(y) for y in x):
+                    return True
+    return False
+
+
+def _and_all(conjs: list[ast.ExprNode]):
+    if not conjs:
+        return None
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = ast.BinOp("and", out, c)
+    return out
 
 
 def _or_branches(e: ast.ExprNode) -> list[ast.ExprNode]:
@@ -992,6 +1392,8 @@ def _split_conjuncts(e: Optional[ast.ExprNode]) -> list[ast.ExprNode]:
 
 
 def _has_agg(node: ast.ExprNode) -> bool:
+    if isinstance(node, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+        return False  # subquery aggregates belong to the subquery
     if isinstance(node, ast.FuncCall) and node.name in AGG_FUNCS:
         return True
     for v in vars(node).values():

@@ -47,6 +47,7 @@ class Distributor:
     # -------------------------------------------------------------- walking
 
     def walk(self, node: N.PlanNode) -> tuple[N.PlanNode, int]:
+        self._walk_subqueries(node)
         if isinstance(node, N.PScan):
             return self._scan(node)
         if isinstance(node, N.PFilter):
@@ -78,6 +79,20 @@ class Distributor:
             node.sharding = child.sharding
             return node, cap
         raise ValueError(f"distribute: unhandled node {type(node).__name__}")
+
+    def _walk_subqueries(self, node: N.PlanNode) -> None:
+        """Uncorrelated scalar subqueries ride inside expressions (InitPlan
+        analog): distribute each one and make its one-row result available
+        on every segment (gather → replicated compute)."""
+        for e in _node_exprs(node):
+            for sub in ex.walk(e):
+                if isinstance(sub, ex.SubqueryScalar) \
+                        and not getattr(sub, "_distributed", False):
+                    plan, cap = self.walk(sub.plan)
+                    if plan.sharding.is_partitioned:
+                        plan, cap = self.gather(plan, cap)
+                    sub.plan = plan
+                    sub._distributed = True
 
     def _scan(self, node: N.PScan) -> tuple[N.PlanNode, int]:
         if node.table_name == "$dual":
@@ -176,7 +191,7 @@ class Distributor:
                                      else Sharding.strewn())
                 else:
                     node.sharding = Sharding.strewn()
-                return node, pcap
+                return node, _join_out_cap(node, bcap, pcap)
             # left/anti joins select probe rows that match NOWHERE — every
             # segment must see the whole build side to decide that
             build, bcap = self.broadcast(build, bcap)
@@ -186,9 +201,10 @@ class Distributor:
         node.sharding = probe.sharding if p_part else (
             Sharding.strewn() if build.sharding.is_partitioned
             else probe.sharding)
-        return node, pcap
+        return node, _join_out_cap(node, bcap, pcap)
 
     # ------------------------------------------------------------------ agg
+
 
     def _agg(self, node: N.PAgg) -> tuple[N.PlanNode, int]:
         child, cap = self.walk(node.child)
@@ -259,6 +275,19 @@ class Distributor:
         return out, 1
 
 
+def _join_out_cap(node: N.PJoin, bcap: int, pcap: int) -> int:
+    """Per-segment output capacity; expansion joins get resized to the
+    post-motion per-segment inputs."""
+    if node.residual is not None:
+        # semi/anti residual: pairs expand internally, output rides probe
+        node.out_capacity = bcap + pcap
+        return pcap
+    if not node.unique_build:
+        node.out_capacity = bcap + pcap
+        return node.out_capacity
+    return pcap
+
+
 # ---------------------------------------------------------------- agg split
 
 
@@ -318,6 +347,28 @@ def _finalize_project(final: N.PAgg, node: N.PAgg, finalize) -> N.PlanNode:
 
 
 # ------------------------------------------------------------------ helpers
+
+
+def _node_exprs(node: N.PlanNode):
+    if isinstance(node, N.PFilter):
+        yield node.predicate
+    elif isinstance(node, N.PProject):
+        for _, e in node.exprs:
+            yield e
+    elif isinstance(node, N.PAgg):
+        for _, e in node.group_keys:
+            yield e
+        for _, c in node.aggs:
+            if c.arg is not None:
+                yield c.arg
+    elif isinstance(node, N.PSort):
+        for e, _ in node.keys:
+            yield e
+    elif isinstance(node, N.PJoin):
+        yield from node.build_keys
+        yield from node.probe_keys
+        if node.residual is not None:
+            yield node.residual
 
 
 def _field_ref(plan: N.PlanNode, name: str) -> ex.ColumnRef:

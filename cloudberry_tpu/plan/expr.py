@@ -114,6 +114,17 @@ class DictLookup(Expr):
         return (self.column,)
 
 
+@dataclass(eq=False)
+class SubqueryScalar(Expr):
+    """Uncorrelated scalar subquery: a full plan whose single-row, single-
+    column result is broadcast into the enclosing expression (the InitPlan
+    analog). The executor lowers ``plan`` inside the same XLA program;
+    the distribution pass walks into it."""
+
+    plan: object  # N.PlanNode (untyped to avoid the import cycle)
+    dtype: "SqlType" = None  # type: ignore[assignment]
+
+
 @dataclass(frozen=True)
 class IsValid(Expr):
     """True where an outer-join matched (IS NOT NULL on nullable side)."""

@@ -27,6 +27,8 @@ class PlanField:
     name: str
     type: SqlType
     sdict: Optional[StringDictionary] = None  # for STRING columns
+    # bool column name indicating validity (outer-join nullable side)
+    null_mask: Optional[str] = None
 
 
 @dataclass
@@ -90,8 +92,11 @@ class PProject(PlanNode):
 
 @dataclass
 class PJoin(PlanNode):
-    """Sorted-build lookup join. ``build`` must be unique on build_keys —
-    verified at runtime (dup detection), the nodeHashjoin analog."""
+    """Join (nodeHashjoin analog). Two execution shapes:
+    - unique_build=True: sorted-build lookup, output rides the probe's
+      capacity; build uniqueness verified at runtime (dup detection);
+    - unique_build=False: many-to-many expansion (one output row per match
+      pair) at ``out_capacity`` with overflow detection."""
 
     kind: str  # 'inner' | 'left' | 'semi' | 'anti'
     build: PlanNode
@@ -102,6 +107,12 @@ class PJoin(PlanNode):
     build_payload: list[str] = dc_field(default_factory=list)
     # name of the bool match-mask output column (left join null tests)
     match_name: Optional[str] = None
+    unique_build: bool = True
+    out_capacity: int = 0  # expansion joins only
+    # semi/anti residual predicate over (probe cols + build cols) — the
+    # correlated-EXISTS extra conditions (e.g. Q21's l2.l_suppkey <>
+    # l1.l_suppkey); forces pair-expansion evaluation
+    residual: Optional[ex.Expr] = None
 
     def children(self):
         return [self.build, self.probe]

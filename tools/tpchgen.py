@@ -192,7 +192,11 @@ def generate(sf: float = 0.01, seed: int = 0) -> dict[str, dict[str, np.ndarray]
     }
 
     ok = np.arange(1, n_ord + 1, dtype=np.int64)
-    o_custkey = rng.integers(1, n_cust + 1, n_ord).astype(np.int64)
+    # dbgen rule: customers with custkey % 3 == 0 place no orders — keeps
+    # anti-join queries (Q13 zero-order bucket, Q22 NOT EXISTS) non-vacuous
+    cust_pool = np.asarray([k for k in range(1, n_cust + 1) if k % 3 != 0],
+                           dtype=np.int64)
+    o_custkey = cust_pool[rng.integers(0, len(cust_pool), n_ord)]
     start, end = D("1992-01-01"), D("1998-08-02")
     o_orderdate = rng.integers(start, end + 1, n_ord).astype(np.int64)
     n_lines_per = rng.integers(1, 8, n_ord)
