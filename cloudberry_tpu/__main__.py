@@ -1,0 +1,3 @@
+from cloudberry_tpu.mgmt.cli import main
+
+raise SystemExit(main())

@@ -31,14 +31,12 @@ from cloudberry_tpu.plan import nodes as N
 from cloudberry_tpu.utils import hashing
 
 
-def execute_distributed(plan: N.PlanNode, session) -> ColumnBatch:
-    nseg = session.config.n_segments
-    mesh = segment_mesh(nseg)
-    table_names = sorted({s.table_name for s in X.scans_of(plan)})
-
+def prepare_dist_inputs(plan: N.PlanNode, session):
+    """(inputs, in_specs) for every scanned table: partitioned columns as
+    (nseg, cap) arrays split on the seg axis, replicated tables whole."""
     inputs = {}
     in_specs = {}
-    for name in table_names:
+    for name in sorted({s.table_name for s in X.scans_of(plan)}):
         st = session.sharded_table(name)
         if st.replicated:
             inputs[name] = {"$cols": dict(st.columns),
@@ -51,6 +49,13 @@ def execute_distributed(plan: N.PlanNode, session) -> ColumnBatch:
             in_specs[name] = {"$cols": {c: P(SEG_AXIS, None)
                                         for c in st.columns},
                               "$nrows": P(SEG_AXIS)}
+    return inputs, in_specs
+
+
+def execute_distributed(plan: N.PlanNode, session) -> ColumnBatch:
+    nseg = session.config.n_segments
+    mesh = segment_mesh(nseg)
+    inputs, in_specs = prepare_dist_inputs(plan, session)
 
     def seg_fn(tables):
         low = DistLowerer(tables, nseg)

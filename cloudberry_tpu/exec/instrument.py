@@ -1,0 +1,196 @@
+"""Query instrumentation — the instrument.c / explain_gp.c analog.
+
+The reference times every executor node per tuple (InstrStartNode/
+InstrStopNode) and ships per-QE stats to the QD for distributed EXPLAIN
+ANALYZE (cdbexplain_sendExecStats, explain_gp.c:384). Here the whole plan is
+ONE fused XLA program, so per-node wall time is not separable — but per-node
+ROW COUNTS are (cheap in-program reductions), and they answer the questions
+EXPLAIN ANALYZE usually answers (selectivity, join fanout, motion width).
+Whole-query compile and execute wall times complete the picture.
+
+The ``metrics_hook`` list on a Session is the query_info_collect_hook analog
+(src/include/utils/metrics_utils.h:39): every instrumented run emits a
+QueryMetrics record to each registered hook.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from cloudberry_tpu.plan import nodes as N
+
+
+@dataclass
+class QueryMetrics:
+    """One executed statement's stats (the metrics-collector payload)."""
+    query: str
+    wall_s: float
+    compile_s: float
+    rows_out: int
+    # plan-order list of (node title, sharding, rows selected after the node)
+    node_rows: list[tuple[str, str, int]] = field(default_factory=list)
+
+
+class InstrumentingMixin:
+    """Mixes into a Lowerer: records post-node selected-row counts."""
+
+    def __init_instrument__(self):
+        self.node_counts: dict[int, jnp.ndarray] = {}
+
+    def lower(self, node):  # type: ignore[override]
+        cols, sel = super().lower(node)  # type: ignore[misc]
+        self.node_counts[id(node)] = jnp.sum(sel.astype(jnp.int64))
+        return cols, sel
+
+
+def plan_nodes_in_order(plan: N.PlanNode) -> list[N.PlanNode]:
+    out = []
+
+    def rec(n):
+        out.append(n)
+        for c in n.children():
+            rec(c)
+
+    rec(plan)
+    return out
+
+
+def explain_analyze_text(plan: N.PlanNode, counts: dict[int, int],
+                         wall_s: float, compile_s: float) -> str:
+    """Render the plan tree with actual row counts (EXPLAIN ANALYZE)."""
+
+    def rec(n: N.PlanNode, indent: int) -> list[str]:
+        rows = counts.get(id(n))
+        extra = f"  rows={rows}" if rows is not None else ""
+        sh = f"  [{n.sharding}]" if n.sharding else ""
+        lines = [" " * indent + "-> " + n.title() + sh + extra]
+        for c in n.children():
+            lines += rec(c, indent + 3)
+        return lines
+
+    lines = rec(plan, 0)
+    lines.append(f"Execution time: {wall_s * 1000:.2f} ms "
+                 f"(compile {compile_s * 1000:.2f} ms)")
+    return "\n".join(lines)
+
+
+def run_instrumented(plan: N.PlanNode, session, query: str = ""):
+    """Execute with instrumentation; returns (ColumnBatch, QueryMetrics).
+
+    Single-segment path; distributed instrumentation sums per-segment counts.
+    """
+    import jax
+
+    from cloudberry_tpu.exec import executor as X
+
+    if session.config.n_segments > 1:
+        return _run_instrumented_dist(plan, session, query)
+
+    class InstrLowerer(InstrumentingMixin, X.Lowerer):
+        def __init__(self, tables, platform=None):
+            X.Lowerer.__init__(self, tables, platform)
+            self.__init_instrument__()
+
+    def run(tables):
+        low = InstrLowerer(tables)
+        cols, sel = low.lower(plan)
+        out = {f.name: cols[f.name] for f in plan.fields}
+        return out, sel, low.checks, low.node_counts
+
+    fn = jax.jit(run)
+    tables = X.prepare_tables(
+        sorted({s.table_name for s in X.scans_of(plan)}), session)
+    t0 = time.time()
+    result = fn(tables)
+    jax.block_until_ready(result)
+    compile_s = time.time() - t0
+    t1 = time.time()
+    cols, sel, checks, counts = fn(tables)
+    jax.block_until_ready(sel)
+    wall_s = time.time() - t1
+    X.raise_checks(checks)
+    batch = X.make_batch(plan, cols, sel)
+
+    counts_host = {k: int(np.asarray(v)) for k, v in counts.items()}
+    metrics = _metrics(plan, counts_host, query, wall_s, compile_s,
+                       int(np.asarray(sel).sum()))
+    _emit(session, metrics)
+    return batch, metrics
+
+
+def _run_instrumented_dist(plan: N.PlanNode, session, query: str):
+    """Distributed: per-node counts summed over segments (post-gather nodes
+    count once via segment 0 — they are replicated)."""
+    import jax
+
+    from cloudberry_tpu.exec import dist_executor as DX
+    from cloudberry_tpu.exec import executor as X
+    from jax.sharding import PartitionSpec as P
+
+    # reuse the dist executor wiring but with an instrumenting lowerer
+    nseg = session.config.n_segments
+    mesh = DX.segment_mesh(nseg)
+    inputs, in_specs = DX.prepare_dist_inputs(plan, session)
+
+    class InstrDistLowerer(InstrumentingMixin, DX.DistLowerer):
+        def __init__(self, tables, nseg):
+            DX.DistLowerer.__init__(self, tables, nseg)
+            self.__init_instrument__()
+
+    def seg_fn(tables):
+        low = InstrDistLowerer(tables, nseg)
+        cols, sel = low.lower(plan)
+        out = {f.name: cols[f.name][None] for f in plan.fields}
+        checks = {k: jnp.asarray(v).reshape(1) for k, v in low.checks.items()}
+        counts = {k: jnp.asarray(v).reshape(1)
+                  for k, v in low.node_counts.items()}
+        return out, sel[None], checks, counts
+
+    out_specs = ({f.name: P(DX.SEG_AXIS) for f in plan.fields},
+                 P(DX.SEG_AXIS), P(DX.SEG_AXIS), P(DX.SEG_AXIS))
+    fn = jax.jit(DX._shard_map(seg_fn, mesh, (in_specs,), out_specs))
+    t0 = time.time()
+    result = fn(inputs)
+    jax.block_until_ready(result)
+    compile_s = time.time() - t0
+    t1 = time.time()
+    cols, sel, checks, counts = fn(inputs)
+    jax.block_until_ready(sel)
+    wall_s = time.time() - t1
+    X.raise_checks(checks)
+    host_cols = {k: np.asarray(v)[0] for k, v in cols.items()}
+    host_sel = np.asarray(sel)[0]
+    batch = X.make_batch(plan, host_cols, host_sel)
+
+    nodes = plan_nodes_in_order(plan)
+    counts_host = {}
+    for n in nodes:
+        arr = counts.get(id(n))
+        if arr is None:
+            continue
+        per_seg = np.asarray(arr)
+        if n.sharding is not None and n.sharding.is_partitioned:
+            counts_host[id(n)] = int(per_seg.sum())
+        else:
+            counts_host[id(n)] = int(per_seg[0])  # replicated: count once
+    metrics = _metrics(plan, counts_host, query, wall_s, compile_s,
+                       int(host_sel.sum()))
+    _emit(session, metrics)
+    return batch, metrics
+
+
+def _metrics(plan, counts_host, query, wall_s, compile_s, rows_out):
+    node_rows = [(n.title(), str(n.sharding) if n.sharding else "",
+                  counts_host.get(id(n), -1))
+                 for n in plan_nodes_in_order(plan)]
+    return QueryMetrics(query=query, wall_s=wall_s, compile_s=compile_s,
+                        rows_out=rows_out, node_rows=node_rows)
+
+
+def _emit(session, metrics: QueryMetrics) -> None:
+    for hook in getattr(session, "metrics_hooks", []):
+        hook(metrics)

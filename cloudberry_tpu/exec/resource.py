@@ -1,0 +1,107 @@
+"""Resource governance — the vmem-tracker / resource-group analog.
+
+The reference tracks per-segment virtual memory in chunks with a red zone and
+a runaway killer (vmem_tracker.c:94, redzone_handler.c, runaway_cleaner.c),
+and gates statement admission through a shared slot pool (resgroup.c:135).
+Here memory is PREDICTABLE — every node's capacity and column widths are
+static at plan time — so governance is:
+
+- a plan-time memory estimator (sum of live intermediate arrays, an upper
+  bound analogous to per-operator memory quotas), refusing queries whose
+  estimate exceeds ``resource.query_mem_bytes`` BEFORE compiling (the
+  admission decision the reference can only make with runtime tracking);
+- a concurrency gate (slot pool) limiting simultaneous statements.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from cloudberry_tpu.plan import nodes as N
+
+
+class ResourceError(RuntimeError):
+    pass
+
+
+@dataclass
+class MemoryEstimate:
+    peak_bytes: int
+    per_node: list[tuple[str, int]]
+
+
+def estimate_plan_memory(plan: N.PlanNode) -> MemoryEstimate:
+    """Upper-bound device bytes per segment for one query.
+
+    Node capacities are already per-segment after the distribution pass
+    (scan capacities are shard capacities, motion capacities are receive
+    buffers), so summing capacity × Σ column widths (+ masks) directly gives
+    the per-segment bound. An over-estimate (XLA frees fused intermediates)
+    but shape-exact — the point is a hard admission bound, not a profile."""
+    per_node: list[tuple[str, int]] = []
+    total = 0
+
+    def width(node: N.PlanNode) -> int:
+        w = 1  # selection mask
+        for f in node.fields:
+            w += f.type.np_dtype.itemsize
+        return w
+
+    def cap_of(node: N.PlanNode) -> int:
+        if isinstance(node, N.PScan):
+            return node.capacity
+        if isinstance(node, N.PAgg):
+            return node.capacity
+        if isinstance(node, N.PMotion):
+            return node.out_capacity or cap_of(node.child)
+        if isinstance(node, N.PJoin):
+            if not node.unique_build:
+                return node.out_capacity
+            return cap_of(node.probe)
+        kids = node.children()
+        return max((cap_of(c) for c in kids), default=1)
+
+    def rec(node: N.PlanNode):
+        nonlocal total
+        b = cap_of(node) * width(node)
+        per_node.append((node.title(), b))
+        total += b
+        for c in node.children():
+            rec(c)
+
+    rec(plan)
+    return MemoryEstimate(total, per_node)
+
+
+def check_admission(plan: N.PlanNode, session) -> MemoryEstimate:
+    est = estimate_plan_memory(plan)
+    budget = session.config.resource.query_mem_bytes
+    if est.peak_bytes > budget:
+        top = sorted(est.per_node, key=lambda x: -x[1])[:3]
+        raise ResourceError(
+            f"query memory estimate {est.peak_bytes >> 20} MiB exceeds the "
+            f"per-query budget {budget >> 20} MiB "
+            f"(largest nodes: {top}); raise "
+            "config.resource.query_mem_bytes or reduce capacities")
+    return est
+
+
+class AdmissionGate:
+    """Slot-pool concurrency limit (ResGroupSlotData free list analog)."""
+
+    def __init__(self, max_concurrency: int):
+        self._sem = threading.BoundedSemaphore(max_concurrency)
+        self.max_concurrency = max_concurrency
+
+    def __enter__(self):
+        acquired = self._sem.acquire(timeout=60.0)
+        if not acquired:
+            raise ResourceError(
+                "admission timeout: all "
+                f"{self.max_concurrency} statement slots busy for 60s")
+        return self
+
+    def __exit__(self, *exc):
+        self._sem.release()
+        return False

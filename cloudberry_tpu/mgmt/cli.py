@@ -1,0 +1,226 @@
+"""Management CLI — the gpMgmt plane analog (`python -m cloudberry_tpu`).
+
+Reference tools → subcommands (SURVEY §2.7):
+- gpinitsystem → ``init``      (create a cluster: store root + topology)
+- gpstate      → ``state``     (topology, devices, health, tables)
+- FTS probe    → ``probe``     (one health probe round)
+- gpexpand /
+  gpshrink     → ``expand``    (resize topology; reports the moved-row
+                                fraction, which jump_consistent_hash keeps
+                                ≈ delta/N — the gpexpand minimal-movement
+                                promise, cdbhash.c:55)
+- gpcheckcat   → ``check``     (storage/catalog consistency scan)
+- psql -c      → ``sql``       (run a statement against the cluster store)
+
+The "cluster" is a store directory plus ``cluster.json`` (the
+gp_segment_configuration analog). Segments are mesh slots, so start/stop are
+process-lifecycle no-ops; recovery is re-execution (see parallel/health.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _cluster_path(store: str) -> str:
+    return os.path.join(store, "cluster.json")
+
+
+def load_cluster(store: str) -> dict:
+    try:
+        with open(_cluster_path(store)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        raise SystemExit(
+            f"error: no cluster at {store!r} — run "
+            f"`python -m cloudberry_tpu --store {store} init` first")
+
+
+def _open_session(store: str):
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import Config
+    from cloudberry_tpu.storage.table_store import TableStore
+
+    cfg = load_cluster(store)
+    s = cb.Session(Config(n_segments=cfg["n_segments"]))
+    ts = TableStore(store)
+    for name in sorted(os.listdir(store)):
+        if os.path.isdir(os.path.join(store, name, "_manifests")):
+            ts.load_table(s.catalog, name)
+    return s, ts
+
+
+def cmd_init(args) -> int:
+    os.makedirs(args.store, exist_ok=True)
+    if os.path.exists(_cluster_path(args.store)) and not args.force:
+        print(f"error: cluster already initialized at {args.store}",
+              file=sys.stderr)
+        return 1
+    cfg = {"n_segments": args.segments, "created": time.time(),
+           "format": 1}
+    with open(_cluster_path(args.store), "w") as f:
+        json.dump(cfg, f)
+    print(f"initialized cluster: {args.segments} segments at {args.store}")
+    return 0
+
+
+def cmd_state(args) -> int:
+    import jax
+
+    from cloudberry_tpu.parallel import health
+
+    cfg = load_cluster(args.store)
+    devices = jax.devices()
+    r = health.probe()
+    print(f"cluster store:   {args.store}")
+    print(f"segments:        {cfg['n_segments']}")
+    print(f"devices visible: {len(devices)} ({devices[0].platform})")
+    print(f"health probe:    {'OK' if r.ok else 'FAILED: ' + str(r.error)}"
+          f" ({r.latency_s * 1000:.1f} ms)")
+    from cloudberry_tpu.storage.table_store import TableStore
+
+    ts = TableStore(args.store)  # manifests only: no data decode for status
+    for name in sorted(os.listdir(args.store)):
+        mdir = os.path.join(args.store, name, "_manifests")
+        if os.path.isdir(mdir):
+            man = ts.read_manifest(name)
+            rows = sum(p["num_rows"] - len(p["deleted"])
+                       for p in man["partitions"])
+            print(f"table {name}: v{man['version']}, "
+                  f"{len(man['partitions'])} partitions, {rows} rows")
+    return 0
+
+
+def cmd_probe(args) -> int:
+    from cloudberry_tpu.parallel import health
+
+    r = health.probe()
+    print(json.dumps({"ok": r.ok, "devices": r.n_devices,
+                      "latency_ms": round(r.latency_s * 1000, 2),
+                      "error": r.error}))
+    return 0 if r.ok else 1
+
+
+def cmd_expand(args) -> int:
+    import numpy as np
+
+    from cloudberry_tpu.utils import hashing
+
+    cfg = load_cluster(args.store)
+    old_n, new_n = cfg["n_segments"], args.segments
+    s, ts = _open_session(args.store)
+    moved_frac = []
+    for name, t in s.catalog.tables.items():
+        if t.policy.kind != "hashed" or t.num_rows == 0:
+            continue
+        cols = [np.asarray(t.data[k]) for k in t.policy.keys]
+        h = hashing.hash_columns_np(cols)
+        a = hashing.jump_consistent_hash_np(h, old_n)
+        b = hashing.jump_consistent_hash_np(h, new_n)
+        moved_frac.append((name, float((a != b).mean())))
+    cfg["n_segments"] = new_n
+    with open(_cluster_path(args.store), "w") as f:
+        json.dump(cfg, f)
+    verb = "expanded" if new_n > old_n else "shrunk"
+    print(f"{verb} cluster {old_n} → {new_n} segments")
+    for name, frac in moved_frac:
+        print(f"  {name}: {frac * 100:.1f}% of rows move "
+              f"(jump-hash minimal movement)")
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Storage consistency scan (gpcheckcat analog): every partition file
+    must parse, row counts and dictionary code ranges must agree."""
+    from cloudberry_tpu.storage import micropartition as mp
+    from cloudberry_tpu.storage.table_store import TableStore
+
+    ts = TableStore(args.store)
+    problems = 0
+    for name in sorted(os.listdir(args.store)):
+        mdir = os.path.join(args.store, name, "_manifests")
+        if not os.path.isdir(mdir):
+            continue
+        man = ts.read_manifest(name)
+        for part in man["partitions"]:
+            path = os.path.join(args.store, name, part["file"])
+            try:
+                footer = mp.read_footer(path)
+                if footer["num_rows"] != part["num_rows"]:
+                    print(f"MISMATCH {name}/{part['file']}: manifest rows "
+                          f"{part['num_rows']} != footer {footer['num_rows']}")
+                    problems += 1
+                cols = mp.read_columns(path)
+                for cname, values in man["dicts"].items():
+                    if cname in cols and len(cols[cname]) \
+                            and cols[cname].max() >= len(values):
+                        print(f"BAD DICT {name}/{part['file']}: column "
+                              f"{cname} code {cols[cname].max()} out of "
+                              f"range {len(values)}")
+                        problems += 1
+            except Exception as e:  # noqa: BLE001
+                print(f"CORRUPT {name}/{part['file']}: {e}")
+                problems += 1
+    print(f"check complete: {problems} problem(s)")
+    return 0 if problems == 0 else 1
+
+
+def cmd_sql(args) -> int:
+    s, ts = _open_session(args.store)
+    versions = {n: getattr(t, "_version", 0)
+                for n, t in s.catalog.tables.items()}
+    out = s.sql(args.query)
+    if hasattr(out, "to_pandas"):
+        print(out.to_pandas().to_string(index=False))
+    else:
+        print(out)  # DDL/DML status tag
+        if args.save:
+            # persist only tables the statement actually changed
+            for n, t in s.catalog.tables.items():
+                if getattr(t, "_version", 0) != versions.get(n):
+                    ts.save_table(t)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cloudberry_tpu",
+        description="TPU-native MPP SQL cluster management")
+    p.add_argument("--store", default=os.environ.get("CBTPU_STORE", "./cbtpu"),
+                   help="cluster store directory")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pi = sub.add_parser("init", help="create a cluster (gpinitsystem)")
+    pi.add_argument("--segments", type=int, default=1)
+    pi.add_argument("--force", action="store_true")
+    pi.set_defaults(fn=cmd_init)
+
+    ps = sub.add_parser("state", help="cluster status (gpstate)")
+    ps.set_defaults(fn=cmd_state)
+
+    pp = sub.add_parser("probe", help="health probe (FTS)")
+    pp.set_defaults(fn=cmd_probe)
+
+    pe = sub.add_parser("expand", help="resize segments (gpexpand/gpshrink)")
+    pe.add_argument("--segments", type=int, required=True)
+    pe.set_defaults(fn=cmd_expand)
+
+    pc = sub.add_parser("check", help="storage consistency (gpcheckcat)")
+    pc.set_defaults(fn=cmd_check)
+
+    pq = sub.add_parser("sql", help="run a statement")
+    pq.add_argument("query")
+    pq.add_argument("--save", action="store_true",
+                    help="persist modified tables back to the store")
+    pq.set_defaults(fn=cmd_sql)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,111 @@
+"""Failure detection — the FTS analog.
+
+The reference's fault-tolerance service probes every segment postmaster on an
+interval, runs a per-segment state machine, and promotes mirrors on failure
+(src/backend/fts/fts.c:118, ftsprobe.c:60-95). Mesh slots have no mirrors —
+recovery is re-execution (immutable storage makes segments stateless, SURVEY
+§7.1) — so the analog is:
+
+- ``probe()``: run a tiny collective across every device and report per-slot
+  health (the FTS_MSG_PROBE analog);
+- ``HealthMonitor``: background interval prober with status history and a
+  failure callback (the bgworker loop);
+- ``run_with_retry``: re-dispatch a failed query (device loss surfaces as an
+  XLA error; the job-restart recovery model).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class ProbeResult:
+    ok: bool
+    n_devices: int
+    latency_s: float
+    error: Optional[str] = None
+
+
+def probe(n_devices: Optional[int] = None) -> ProbeResult:
+    """One health probe: a tiny reduction touching every device."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.time()
+    try:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+        outs = []
+        for d in devices:
+            x = jax.device_put(jnp.ones((8,), dtype=jnp.float32), d)
+            outs.append(jnp.sum(x))
+        jax.block_until_ready(outs)
+        vals = [float(o) for o in outs]
+        ok = all(v == 8.0 for v in vals)
+        return ProbeResult(ok, len(devices), time.time() - t0,
+                           None if ok else f"bad probe sums {vals}")
+    except Exception as e:  # noqa: BLE001 — any device failure is a finding
+        return ProbeResult(False, 0, time.time() - t0, str(e))
+
+
+@dataclass
+class HealthMonitor:
+    """Interval prober (FtsProbeMain loop analog)."""
+
+    interval_s: float = 30.0
+    on_failure: Optional[Callable[[ProbeResult], None]] = None
+    history: list[ProbeResult] = field(default_factory=list)
+    _stop: threading.Event = field(default_factory=threading.Event)
+    _thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()  # allow stop() → start() restarts
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                r = probe()
+                self.history.append(r)
+                if not r.ok and self.on_failure is not None:
+                    self.on_failure(r)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="cb-fts-probe")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def probe_now(self) -> ProbeResult:
+        r = probe()
+        self.history.append(r)
+        if not r.ok and self.on_failure is not None:
+            self.on_failure(r)
+        return r
+
+
+def run_with_retry(fn: Callable, retries: int = 1,
+                   backoff_s: float = 0.5) -> object:
+    """Re-dispatch on device/runtime failure (the recovery model: stateless
+    segments over immutable storage → failed statements simply re-run)."""
+    last: Exception | None = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            name = type(e).__name__
+            retriable = "XlaRuntimeError" in name or "JaxRuntimeError" in name
+            if not retriable or attempt == retries:
+                raise
+            last = e
+            time.sleep(backoff_s * (2 ** attempt))
+    raise last  # unreachable

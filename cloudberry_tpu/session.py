@@ -39,17 +39,29 @@ class Session:
         self.config = config or get_config()
         self.catalog = Catalog()
         self._shard_cache: dict[str, ShardedTable] = {}
+        # query_info_collect_hook analog: callables receiving QueryMetrics
+        self.metrics_hooks: list = []
+        from cloudberry_tpu.exec.resource import AdmissionGate
+
+        self._gate = AdmissionGate(self.config.resource.max_concurrency)
 
     def sql(self, query: str, **params: Any):
-        from cloudberry_tpu.sql.parser import parse_sql
-        from cloudberry_tpu.plan.planner import plan_statement
         from cloudberry_tpu.exec.executor import execute
+        from cloudberry_tpu.exec.resource import check_admission
+        from cloudberry_tpu.plan.planner import plan_statement
+        from cloudberry_tpu.sql.parser import parse_sql
+        from cloudberry_tpu.utils.faultinject import fault_point
 
         stmt = parse_sql(query)
         result = plan_statement(stmt, self, params)
         if result.is_ddl:
             return result.ddl_result
-        return execute(result.plan, self)
+        # admission control: memory budget check + statement slot
+        # (vmem-tracker / resgroup analog, exec/resource.py)
+        check_admission(result.plan, self)
+        fault_point("dispatch_start")
+        with self._gate:
+            return execute(result.plan, self)
 
     def explain(self, query: str) -> str:
         from cloudberry_tpu.sql.parser import parse_sql
@@ -60,6 +72,25 @@ class Session:
         if result.is_ddl:
             return str(result.ddl_result)
         return result.plan.explain()
+
+    def explain_analyze(self, query: str) -> str:
+        """Execute with instrumentation; returns the annotated plan (the
+        distributed EXPLAIN ANALYZE analog, explain_gp.c)."""
+        from cloudberry_tpu.exec.instrument import (
+            explain_analyze_text, plan_nodes_in_order, run_instrumented)
+        from cloudberry_tpu.plan.planner import plan_statement
+        from cloudberry_tpu.sql.parser import parse_sql
+
+        stmt = parse_sql(query)
+        result = plan_statement(stmt, self, {})
+        if result.is_ddl:
+            return str(result.ddl_result)
+        _, metrics = run_instrumented(result.plan, self, query)
+        counts = {id(n): r for n, (_, _, r) in
+                  zip(plan_nodes_in_order(result.plan), metrics.node_rows)
+                  if r >= 0}
+        return explain_analyze_text(result.plan, counts,
+                                    metrics.wall_s, metrics.compile_s)
 
     # ------------------------------------------------------- data placement
 

@@ -91,10 +91,21 @@ def write_micropartition(path: str, data: dict[str, np.ndarray],
         arr = np.ascontiguousarray(data[f.name])
         enc: dict = {"name": f.name, "codec": codec}
         rle = _rle_encode(arr)
+        dv = None
+        if rle is None and arr.dtype == np.int64 and len(arr):
+            # delta varint (native codec) wins on keys/sorted-ish int64
+            from cloudberry_tpu import native
+
+            dv = native.dvarint_encode(arr)
+            if len(dv) * 2 > arr.nbytes:
+                dv = None  # not worth it
         if rle is not None:
             raw, n_runs = rle
             enc["encoding"] = "rle"
             enc["n_runs"] = n_runs
+        elif dv is not None:
+            raw = dv
+            enc["encoding"] = "dvarint"
         else:
             raw = arr.tobytes()
             enc["encoding"] = "raw"
@@ -162,6 +173,11 @@ def read_columns(path: str, names: Iterable[str] | None = None,
             if enc["encoding"] == "rle":
                 out[name] = _rle_decode(raw, enc["n_runs"], dt,
                                         footer["num_rows"])
+            elif enc["encoding"] == "dvarint":
+                from cloudberry_tpu import native
+
+                out[name] = native.dvarint_decode(raw, footer["num_rows"]) \
+                    .astype(dt)
             else:
                 out[name] = np.frombuffer(raw, dtype=dt,
                                           count=footer["num_rows"]).copy()

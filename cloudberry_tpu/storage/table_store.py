@@ -81,7 +81,13 @@ class TableStore:
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
-        # atomic CURRENT swap — the commit point
+        # atomic CURRENT swap — the commit point; the fault point simulates
+        # a crash in the window after the manifest is written but before the
+        # commit becomes visible (chaos tests verify the old snapshot wins)
+        from cloudberry_tpu.utils.faultinject import fault_point
+
+        if fault_point("storage_commit_before_current"):
+            return v
         fd, tmp = tempfile.mkstemp(dir=mdir)
         with os.fdopen(fd, "w") as f:
             f.write(str(v))
@@ -95,7 +101,7 @@ class TableStore:
     def append(self, table: str, data: dict[str, np.ndarray], schema: Schema,
                dicts: dict[str, StringDictionary] | None = None,
                rows_per_partition: int = 1 << 20,
-               replace: bool = False) -> int:
+               replace: bool = False, policy=None) -> int:
         """Append rows as new micro-partitions (``replace=True``: the new
         snapshot contains ONLY these rows — still one atomic commit, so a
         crash mid-write never publishes an empty intermediate).
@@ -124,6 +130,8 @@ class TableStore:
         # decoding correctly); anything else is a caller error, not silent
         # corruption.
         man["schema"] = [mp._field_json(f) for f in schema.fields]
+        if policy is not None:
+            man["policy"] = {"kind": policy.kind, "keys": list(policy.keys)}
         old_dicts = man.get("dicts", {}) if not replace else {}
         new_dicts = {k: list(d.values) for k, d in (dicts or {}).items()}
         for k, old in old_dicts.items():
@@ -194,7 +202,8 @@ class TableStore:
     def save_table(self, t) -> int:
         """Persist a catalog Table's current data as a fresh snapshot
         (one atomic commit)."""
-        return self.append(t.name, t.data, t.schema, t.dicts, replace=True)
+        return self.append(t.name, t.data, t.schema, t.dicts, replace=True,
+                           policy=t.policy)
 
     def load_table(self, catalog, name: str,
                    version: Optional[int] = None):
@@ -202,11 +211,14 @@ class TableStore:
         from cloudberry_tpu.catalog.catalog import DistributionPolicy
 
         data, schema, dicts = self.scan(name, version=version)
+        pol = self.read_manifest(name, version).get("policy")
+        policy = (DistributionPolicy(pol["kind"], tuple(pol["keys"]))
+                  if pol else DistributionPolicy.random())
         if name in catalog.tables:
             t = catalog.table(name)
+            t.policy = policy
         else:
-            t = catalog.create_table(name, schema,
-                                     DistributionPolicy.random())
+            t = catalog.create_table(name, schema, policy)
         t.dicts = dicts
         t.set_data(data, dicts)
         return t

@@ -1,0 +1,80 @@
+"""Deterministic fault injection — the faultinjector.c analog.
+
+The reference compiles ~230 named fault points into the server, armed at
+runtime via gp_inject_fault() with actions (error/sleep/skip/suspend) and hit
+counts (src/backend/utils/misc/faultinjector.c, SURVEY §4.2). Same model
+here: code declares FAULT_POINT("name") at interesting seams; tests arm
+actions. Used to provoke races/failures deterministically instead of hoping
+load finds them (the reference's stance — no TSan harness, deterministic
+provocation, §5.2).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+class InjectedFault(RuntimeError):
+    pass
+
+
+@dataclass
+class _Arm:
+    action: str           # 'error' | 'sleep' | 'skip' | 'hang'
+    sleep_s: float = 0.0
+    start_hit: int = 1    # trigger from the Nth hit...
+    end_hit: int = 1 << 30  # ...through this hit
+    hits: int = 0
+
+
+_registry: dict[str, _Arm] = {}
+_seen: set[str] = set()
+_lock = threading.Lock()
+
+
+def inject_fault(name: str, action: str = "error", sleep_s: float = 0.0,
+                 start_hit: int = 1, end_hit: int = 1 << 30) -> None:
+    """Arm a fault point (the gp_inject_fault() analog)."""
+    with _lock:
+        _registry[name] = _Arm(action, sleep_s, start_hit, end_hit)
+
+
+def reset_fault(name: Optional[str] = None) -> None:
+    with _lock:
+        if name is None:
+            _registry.clear()
+        else:
+            _registry.pop(name, None)
+
+
+def fault_point(name: str) -> bool:
+    """Declare a fault point. Returns True if the caller should SKIP the
+    guarded step ('skip' action); raises/sleeps for other armed actions."""
+    _seen.add(name)
+    with _lock:
+        arm = _registry.get(name)
+        if arm is None:
+            return False
+        arm.hits += 1
+        if not (arm.start_hit <= arm.hits <= arm.end_hit):
+            return False
+        action = arm.action
+        sleep_s = arm.sleep_s
+    if action == "error":
+        raise InjectedFault(f"fault injected at {name!r}")
+    if action == "sleep":
+        time.sleep(sleep_s)
+        return False
+    if action == "skip":
+        return True
+    if action == "hang":
+        time.sleep(3600.0)
+    return False
+
+
+def known_fault_points() -> set[str]:
+    """Fault points hit at least once this process (discovery aid)."""
+    return set(_seen)

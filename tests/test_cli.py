@@ -1,0 +1,87 @@
+"""Management CLI (gpMgmt analog) — driven through main(argv)."""
+
+import json
+import os
+
+import pytest
+
+from cloudberry_tpu.mgmt.cli import main
+
+
+@pytest.fixture
+def store(tmp_path):
+    return str(tmp_path / "cluster")
+
+
+def run(capsys, *argv):
+    rc = main(list(argv))
+    return rc, capsys.readouterr().out
+
+
+def test_init_state_sql_roundtrip(store, capsys):
+    rc, out = run(capsys, "--store", store, "init", "--segments", "4")
+    assert rc == 0 and "4 segments" in out
+    # double init refuses without --force
+    assert main(["--store", store, "init", "--segments", "2"]) == 1
+
+    rc, _ = run(capsys, "--store", store, "sql", "--save",
+                "create table kv (k bigint, v decimal(10,2)) distributed by (k)")
+    assert rc == 0
+    # reopen: insert + save
+    rc, _ = run(capsys, "--store", store, "sql", "--save",
+                "insert into kv values (1, 1.5), (2, 2.5), (3, 3.5)")
+    assert rc == 0
+    rc, out = run(capsys, "--store", store, "sql",
+                  "select sum(v) as s, count(*) as n from kv")
+    assert rc == 0 and "7.5" in out and "3" in out
+
+    rc, out = run(capsys, "--store", store, "state")
+    assert rc == 0
+    assert "segments:        4" in out
+    assert "health probe:    OK" in out
+    assert "table kv" in out and "3 rows" in out
+
+
+def test_probe(store, capsys):
+    rc, out = run(capsys, "--store", store, "probe")
+    assert rc == 0
+    j = json.loads(out)
+    assert j["ok"] and j["devices"] >= 1
+
+
+def test_expand_minimal_movement(store, capsys):
+    run(capsys, "--store", store, "init", "--segments", "4")
+    run(capsys, "--store", store, "sql", "--save",
+        "create table m (k bigint) distributed by (k)")
+    rows = ",".join(f"({i})" for i in range(2000))
+    run(capsys, "--store", store, "sql", "--save",
+        f"insert into m values {rows}")
+    rc, out = run(capsys, "--store", store, "expand", "--segments", "5")
+    assert rc == 0 and "4 → 5" in out
+    # jump hash moves ~1/5 = 20% on 4→5; modulo would move ~80%
+    frac = float(out.split("m: ")[1].split("%")[0])
+    assert frac < 30.0
+    # config updated
+    from cloudberry_tpu.mgmt.cli import load_cluster
+    assert load_cluster(store)["n_segments"] == 5
+    # queries still correct after expand
+    rc, out = run(capsys, "--store", store, "sql",
+                  "select count(*) as n from m")
+    assert rc == 0 and "2000" in out
+
+
+def test_check_detects_corruption(store, capsys):
+    run(capsys, "--store", store, "init", "--segments", "2")
+    run(capsys, "--store", store, "sql", "--save",
+        "create table c (x bigint, s text)")
+    run(capsys, "--store", store, "sql", "--save",
+        "insert into c values (1, 'aa'), (2, 'bb')")
+    rc, out = run(capsys, "--store", store, "check")
+    assert rc == 0 and "0 problem(s)" in out
+    # corrupt a partition file
+    tdir = os.path.join(store, "c")
+    part = [f for f in os.listdir(tdir) if f.endswith(".cbmp")][0]
+    with open(os.path.join(tdir, part), "r+b") as fh:
+        fh.write(b"GARBAGE!")
+    rc, out = run(capsys, "--store", store, "check")
+    assert rc == 1 and "CORRUPT" in out

@@ -1,0 +1,57 @@
+"""Standalone native-codec benchmark — the ic_bench.c / pax_gbench analog:
+component performance measured with no cluster or engine involved.
+
+Usage: python tools/codec_bench.py [n_values]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cloudberry_tpu import native  # noqa: E402
+
+
+def bench(name, fn, reps=5):
+    best = float("inf")
+    for _ in range(reps):
+        t = time.time()
+        out = fn()
+        best = min(best, time.time() - t)
+    return best, out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    lib = native.load_native()
+    print(f"native codec: {'loaded' if lib else 'UNAVAILABLE (fallback)'}")
+    keys = np.arange(n, dtype=np.int64) * 7 // 3   # sorted-ish keys
+    rng = np.random.default_rng(0)
+    mixed = keys + rng.integers(-100, 100, n)
+
+    for label, arr in [("sorted keys", keys), ("near-sorted", mixed)]:
+        t_enc, buf = bench(f"enc {label}", lambda: native.dvarint_encode(arr))
+        t_dec, out = bench(f"dec {label}",
+                           lambda: native.dvarint_decode(buf, n))
+        assert (out == arr).all()
+        mb = arr.nbytes / 1e6
+        print(f"{label:12s}: encode {mb / t_enc:8.0f} MB/s   "
+              f"decode {mb / t_dec:8.0f} MB/s   "
+              f"ratio {arr.nbytes / len(buf):5.1f}x")
+
+    lines = b"\n".join(
+        b"%d|name%d|%d.%02d" % (i, i, i % 1000, i % 100)
+        for i in range(min(n, 2_000_000)))
+    t_csv, ids = bench("csv int64",
+                       lambda: native.parse_int64_column(lines, 0))
+    print(f"csv int64   : parse  {len(lines) / 1e6 / t_csv:8.0f} MB/s   "
+          f"({len(ids)} rows)")
+
+
+if __name__ == "__main__":
+    main()
