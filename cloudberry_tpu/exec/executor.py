@@ -141,6 +141,14 @@ class Lowerer:
             return cols, K.limit_mask(sel, node.limit, node.offset)
         if isinstance(node, N.PMotion):
             return self.motion(node)
+        if isinstance(node, N.PWindow):
+            return self.window(node)
+        if isinstance(node, N.PConcat):
+            outs = [self.lower(c) for c in node.inputs]
+            cols = {f.name: jnp.concatenate([o[0][f.name] for o in outs])
+                    for f in node.fields}
+            sel = jnp.concatenate([o[1] for o in outs])
+            return cols, sel
         raise ExecError(f"cannot execute node {type(node).__name__}")
 
     # ------------------------------------------------------------ hookable
@@ -206,14 +214,14 @@ class Lowerer:
             return self._join_expand(node, bcols, bsel, bkeys,
                                      pcols, psel, pkeys)
 
-        idx, matched = K.join_lookup(bkeys, bsel, pkeys, psel)
+        idx, matched, has_dup = K.join_lookup(bkeys, bsel, pkeys, psel)
         if node.kind in ("inner", "left"):
             # semi/anti only test membership; inner/left rely on the
-            # planner's uniqueness proof — verify it at runtime
+            # planner's uniqueness proof — verify it at runtime (free:
+            # adjacent-equal test on the join's own sorted build keys)
             self.checks[
                 f"join build side has duplicate keys (node {id(node)}) but "
-                "the planner assumed a unique (PK) build side"] = \
-                _dup_keys_flag(bkeys, bsel)
+                "the planner assumed a unique (PK) build side"] = has_dup
         payload = K.gather_payload({n: bcols[n] for n in node.build_payload},
                                    idx, matched)
         cols = {**pcols, **payload}
@@ -228,6 +236,96 @@ class Lowerer:
         else:
             raise ExecError(f"join kind {node.kind}")
         return cols, sel
+
+    def window(self, node: N.PWindow):
+        """Windows over sorted partitions — scatter-free: boundary flags,
+        compacted starts, cumulative-sum differences (nodeWindowAgg analog;
+        with ORDER BY the frame is RANGE UNBOUNDED PRECEDING..CURRENT ROW,
+        peers included, per the SQL default)."""
+        cols, sel = self.lower(node.child)
+        cap = sel.shape[0]
+        pk = [self.expr(e, cols) for e in node.partition_keys]
+        # ORDER BY on strings sorts by collation rank, not dictionary code
+        # (same rule PSort applies via _sortable)
+        ok = [_sortable(e, node.child, cols) for e, _ in node.order_keys]
+        desc = [not asc for _, asc in node.order_keys]
+        perm = K.sort_indices(pk + ok, sel,
+                              descending=[False] * len(pk) + desc)
+        inv = jnp.argsort(perm)
+        s_sel = sel[perm]
+        n_sel = jnp.sum(s_sel.astype(jnp.int32))
+        idx = jnp.arange(cap)
+
+        def flags(keys):
+            f = jnp.zeros(cap, dtype=jnp.bool_)
+            for k in keys:
+                ks = k[perm]
+                f = f | (ks != jnp.roll(ks, 1))
+            return (f.at[0].set(True)) & s_sel
+
+        seg_flag = flags(pk) if pk else \
+            (jnp.zeros(cap, dtype=jnp.bool_).at[0].set(True) & s_sel)
+        run_flag = (seg_flag | flags(ok)) if ok else seg_flag
+
+        seg_starts_c = jnp.argsort(~seg_flag, stable=True)
+        seg_cum = jnp.cumsum(seg_flag.astype(jnp.int32))
+        seg_id0 = jnp.clip(seg_cum - 1, 0, cap - 1)
+        n_segs = jnp.sum(seg_flag.astype(jnp.int32))
+        seg_start = seg_starts_c[seg_id0]
+        nxt = seg_starts_c[jnp.clip(seg_id0 + 1, 0, cap - 1)]
+        seg_end = jnp.where(seg_id0 + 1 < n_segs, nxt - 1, n_sel - 1)
+
+        run_starts_c = jnp.argsort(~run_flag, stable=True)
+        run_cum = jnp.cumsum(run_flag.astype(jnp.int32))
+        run_id0 = jnp.clip(run_cum - 1, 0, cap - 1)
+        n_runs = jnp.sum(run_flag.astype(jnp.int32))
+        rnxt = run_starts_c[jnp.clip(run_id0 + 1, 0, cap - 1)]
+        run_start = run_starts_c[run_id0]
+        run_end = jnp.where(run_id0 + 1 < n_runs, rnxt - 1, n_sel - 1)
+
+        def pref(vals):
+            csum = jnp.cumsum(vals)
+            return jnp.concatenate(
+                [jnp.zeros((1,), dtype=csum.dtype), csum])
+
+        out_cols = dict(cols)
+        for name, func, arg in node.calls:
+            if func == "row_number":
+                o = (idx - seg_start + 1).astype(jnp.int64)
+            elif func == "rank":
+                o = (run_start - seg_start + 1).astype(jnp.int64)
+            elif func == "dense_rank":
+                o = (run_cum - run_cum[seg_start] + 1).astype(jnp.int64)
+            elif func in ("sum", "count", "avg"):
+                if func == "count" and arg is None:
+                    v = s_sel.astype(jnp.int64)
+                else:
+                    v = jnp.where(s_sel, self.expr(arg, cols)[perm], 0) \
+                        if func != "count" else s_sel.astype(jnp.int64)
+                S = pref(v)
+                hi = (run_end if node.order_keys else seg_end)
+                o = S[hi + 1] - S[seg_start]
+                if func == "avg":
+                    C = pref(s_sel.astype(jnp.int64))
+                    cnt = C[hi + 1] - C[seg_start]
+                    o = o.astype(jnp.float64) / jnp.maximum(cnt, 1)
+                    if arg is not None and arg.dtype.base == DType.DECIMAL:
+                        o = o / (10.0 ** arg.dtype.scale)
+            elif func in ("min", "max"):
+                # whole-partition extreme: re-sort with the value last; the
+                # extreme lands on each partition's boundary row (strings
+                # order by collation rank, output keeps the code)
+                v = self.expr(arg, cols)
+                vkey = _sortable(arg, node.child, cols)
+                p2 = K.sort_indices(pk + [vkey], sel,
+                                    descending=[False] * len(pk)
+                                    + [func == "max"])
+                o = v[p2][seg_start]
+            else:
+                raise ExecError(f"window function {func}")
+            o = jnp.where(s_sel, o, jnp.zeros((), dtype=o.dtype))
+            out_cols[name] = o[inv]  # back to the child's row order
+        return out_cols, sel
 
     def _join_semi_residual(self, node: N.PJoin, bcols, bsel, bkeys,
                             pcols, psel, pkeys):
@@ -402,14 +500,6 @@ class Lowerer:
             out_aggs = {n: jnp.pad(c, (0, pad)) for n, c in out_aggs.items()}
             occupied = jnp.pad(occupied, (0, pad))
         return {**out_keys, **out_aggs}, occupied
-
-
-def _dup_keys_flag(bkeys, bsel) -> jnp.ndarray:
-    kb = K.pack_keys(list(bkeys), bsel)
-    kb = jnp.where(bsel, kb, K._U64_MAX)
-    s = jnp.sort(kb)
-    eq = (s[1:] == s[:-1]) & (s[1:] != K._U64_MAX)
-    return eq.any()
 
 
 def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:

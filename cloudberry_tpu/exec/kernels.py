@@ -147,7 +147,8 @@ def group_aggregate(
     ic_udpifc.c:3018 applied to shapes).
     """
     names = list(key_cols)
-    perm = sort_indices([key_cols[n] for n in names], sel)
+    key_list = [key_cols[n] for n in names]
+    perm = sort_indices(key_list, sel)
     s_sel = sel[perm]
     s_keys = {n: key_cols[n][perm] for n in names}
 
@@ -158,47 +159,66 @@ def group_aggregate(
     new_grp = new_grp.at[0].set(True)
     new_grp = new_grp & s_sel
 
-    gid = jnp.cumsum(new_grp.astype(jnp.int32)) - 1
     n_groups = jnp.sum(new_grp.astype(jnp.int32))
-    # invalid rows → dumped into segment `out_capacity` and dropped
-    gid = jnp.where(s_sel, jnp.clip(gid, 0, out_capacity - 1), out_capacity)
+    n_sel = jnp.sum(s_sel.astype(jnp.int32))
+
+    # Scatter-free segmented reduction (TPU serializes big scatters):
+    # boundary positions compact to the front via a stable bool argsort, then
+    # every per-group aggregate is a cumulative-sum DIFFERENCE between
+    # consecutive boundaries — pure sort/scan/gather, the VPU formulation.
+    starts_all = jnp.argsort(~new_grp, stable=True)
+    g = jnp.arange(out_capacity)
+    starts = starts_all[jnp.clip(g, 0, starts_all.shape[0] - 1)]
+    next_start = starts_all[jnp.clip(g + 1, 0, starts_all.shape[0] - 1)]
+    valid = g < n_groups
+    ends = jnp.where(g + 1 < n_groups, next_start - 1, n_sel - 1)
+    starts = jnp.where(valid, starts, 0)
+    ends = jnp.where(valid, ends, 0)
 
     out_keys: Columns = {}
-    scatter_idx = jnp.where(new_grp, gid, out_capacity)
     for n in names:
-        buf = jnp.zeros((out_capacity,), dtype=s_keys[n].dtype)
-        out_keys[n] = buf.at[scatter_idx].set(s_keys[n], mode="drop")
+        out_keys[n] = jnp.where(valid, s_keys[n][starts],
+                                jnp.zeros((), dtype=s_keys[n].dtype))
 
-    nseg = out_capacity
+    def seg_sum(vals):
+        csum = jnp.cumsum(vals)
+        c0 = jnp.concatenate([jnp.zeros((1,), dtype=csum.dtype), csum])
+        return jnp.where(valid, c0[ends + 1] - c0[starts], 0)
+
+    counts = jnp.where(valid, (ends - starts + 1), 0).astype(jnp.int64)
+
+    extreme_perm_cache: dict[bool, jnp.ndarray] = {}
+
+    def seg_extreme(v_unpermuted, want_max: bool):
+        # re-sort with the value as the last key: each group's extreme lands
+        # on its boundary row (one extra sort only when min/max is used)
+        if want_max not in extreme_perm_cache:
+            extreme_perm_cache[want_max] = sort_indices(
+                key_list + [v_unpermuted], sel,
+                descending=[False] * len(key_list) + [want_max])
+        p2 = extreme_perm_cache[want_max]
+        return v_unpermuted[p2][starts]
+
     out_aggs: Columns = {}
     for spec in aggs:
         v = agg_values.get(spec.out_name)
-        if v is not None:
-            v = v[perm]
         if spec.func == "count":
-            ones = s_sel.astype(jnp.int64)
-            out = jax.ops.segment_sum(ones, gid, num_segments=nseg + 1)[:nseg]
+            out = counts
         elif spec.func == "count_nn":
-            # COUNT(col) over a nullable (outer-join) column: v is the
-            # validity mask
-            ones = (s_sel & v).astype(jnp.int64)
-            out = jax.ops.segment_sum(ones, gid, num_segments=nseg + 1)[:nseg]
+            out = seg_sum((s_sel & v[perm]).astype(jnp.int64))
         elif spec.func == "sum":
-            vv = jnp.where(s_sel, v, 0)
-            out = jax.ops.segment_sum(vv, gid, num_segments=nseg + 1)[:nseg]
+            out = seg_sum(jnp.where(s_sel, v[perm], 0))
         elif spec.func == "min":
-            out = jax.ops.segment_min(jnp.where(s_sel, v, _dtype_max(v.dtype)),
-                                      gid, num_segments=nseg + 1)[:nseg]
+            ident = _dtype_max(v.dtype)
+            out = jnp.where(valid & (counts > 0),
+                            seg_extreme(v, want_max=False), ident)
         elif spec.func == "max":
-            out = jax.ops.segment_max(jnp.where(s_sel, v, _dtype_min(v.dtype)),
-                                      gid, num_segments=nseg + 1)[:nseg]
+            ident = _dtype_min(v.dtype)
+            out = jnp.where(valid & (counts > 0),
+                            seg_extreme(v, want_max=True), ident)
         elif spec.func == "avg":
-            vv = jnp.where(s_sel, v, 0)
-            ssum = jax.ops.segment_sum(vv.astype(jnp.float64), gid,
-                                       num_segments=nseg + 1)[:nseg]
-            cnt = jax.ops.segment_sum(s_sel.astype(jnp.int64), gid,
-                                      num_segments=nseg + 1)[:nseg]
-            out = ssum / jnp.maximum(cnt, 1)
+            ssum = seg_sum(jnp.where(s_sel, v[perm], 0).astype(jnp.float64))
+            out = ssum / jnp.maximum(counts, 1)
         else:
             raise NotImplementedError(spec.func)
         out_aggs[spec.out_name] = out
@@ -343,7 +363,9 @@ def join_lookup(
     Requires the build side unique on the key (the planner puts the PK side
     here — same choice nodeHash.c makes for the hash side). Exact: compares
     packed keys, and packing is order-preserving/injective for in-range ints.
-    Returns (build_row_idx int32[cap_p], matched bool[cap_p]).
+    Returns (build_row_idx int32[cap_p], matched bool[cap_p],
+    has_dup scalar bool — duplicate build keys detected, for free off the
+    already-sorted keys).
     """
     ranges = key_ranges(list(build_key), build_sel)
     kb = pack_with_ranges(list(build_key), ranges)
@@ -358,7 +380,10 @@ def join_lookup(
     # empty-build case (kb_sorted all sentinel) correctly match nothing.
     matched = (kb_sorted[pos_c] == kp) & probe_sel & (kp != big)
     build_row = order[pos_c].astype(jnp.int32)
-    return build_row, matched
+    has_dup = ((kb_sorted[1:] == kb_sorted[:-1])
+               & (kb_sorted[1:] != big)).any() \
+        if kb_sorted.shape[0] > 1 else jnp.asarray(False)
+    return build_row, matched, has_dup
 
 
 def gather_payload(cols: Columns, idx: jnp.ndarray, matched: jnp.ndarray) -> Columns:

@@ -59,6 +59,8 @@ def estimate_plan_memory(plan: N.PlanNode) -> MemoryEstimate:
             if not node.unique_build:
                 return node.out_capacity
             return cap_of(node.probe)
+        if isinstance(node, N.PConcat):
+            return sum(cap_of(c) for c in node.inputs)
         kids = node.children()
         return max((cap_of(c) for c in kids), default=1)
 

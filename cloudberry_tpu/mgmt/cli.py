@@ -183,6 +183,13 @@ def cmd_sql(args) -> int:
             for n, t in s.catalog.tables.items():
                 if getattr(t, "_version", 0) != versions.get(n):
                     ts.save_table(t)
+            # dropped tables: remove their store directories too
+            import shutil
+
+            for n in set(versions) - set(s.catalog.tables):
+                tdir = os.path.join(args.store, n)
+                if os.path.isdir(os.path.join(tdir, "_manifests")):
+                    shutil.rmtree(tdir)
     return 0
 
 

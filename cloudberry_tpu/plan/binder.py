@@ -169,6 +169,120 @@ class Binder:
 
     # ------------------------------------------------------------ statements
 
+    def bind_query(self, node: ast.Node) -> N.PlanNode:
+        if isinstance(node, ast.SetOp):
+            return self.bind_setop(node)
+        return self.bind_select(node)
+
+    def bind_setop(self, node: ast.SetOp) -> N.PlanNode:
+        """UNION/INTERSECT/EXCEPT (the cdbsetop.c flow): align both sides
+        to common types/dictionaries, then Append(+distinct) / semi / anti."""
+        left = self.bind_query(node.left)
+        right = self.bind_query(node.right)
+        if len(left.fields) != len(right.fields):
+            raise BindError(
+                f"set operation arity mismatch: {len(left.fields)} vs "
+                f"{len(right.fields)} columns")
+        left, right, out_fields = self._align_setop_sides(left, right)
+
+        if node.op == "union":
+            plan: N.PlanNode = N.PConcat([left, right])
+            plan.fields = out_fields
+            if not node.all:
+                plan = self._distinct_on_all(plan)
+        elif node.op in ("intersect", "except"):
+            if node.all:
+                raise BindError(
+                    f"{node.op.upper()} ALL is not supported yet "
+                    "(bag semantics need per-row multiplicity)")
+            # distinct(left) filtered by membership in right
+            probe = self._distinct_on_all(left)
+            kind = "semi" if node.op == "intersect" else "anti"
+            keys_b = [_colref(f) for f in right.fields]
+            keys_p = [_colref(f) for f in probe.fields]
+            j = N.PJoin(kind, right, probe, keys_b, keys_p, [],
+                        self.gensym("match"))
+            j.fields = list(probe.fields)
+            plan = j
+        else:
+            raise BindError(f"unknown set operation {node.op!r}")
+
+        if node.order_by:
+            keys = []
+            out_scope = Scope([RangeEntry("$set", plan)])
+            for oi in node.order_by:
+                keys.append((self.bind_scalar(oi.expr, out_scope),
+                             oi.ascending))
+            srt = N.PSort(plan, keys)
+            srt.fields = list(plan.fields)
+            plan = srt
+        if node.limit is not None or node.offset:
+            lim = N.PLimit(plan, node.limit if node.limit is not None
+                           else (1 << 62), node.offset)
+            lim.fields = list(plan.fields)
+            plan = lim
+        return plan
+
+    def _distinct_on_all(self, plan: N.PlanNode) -> N.PAgg:
+        agg = N.PAgg(plan, [(f.name, _colref(f)) for f in plan.fields], [],
+                     capacity=_plan_capacity(plan))
+        agg.fields = [N.PlanField(f.name, f.type, f.sdict)
+                      for f in plan.fields]
+        return agg
+
+    def _align_setop_sides(self, left: N.PlanNode, right: N.PlanNode):
+        """Project both sides to common types under the LEFT side's column
+        names; string columns re-code into the left dictionary (extended)."""
+        lex, rex, lfields, rfields = [], [], [], []
+        changed_l = changed_r = False
+        for lf, rf in zip(left.fields, right.fields):
+            le: ex.Expr = _colref(lf)
+            re_: ex.Expr = _colref(rf)
+            if lf.type.base == DType.STRING or rf.type.base == DType.STRING:
+                if lf.type.base != rf.type.base:
+                    raise BindError("set operation mixes string and "
+                                    "non-string columns")
+                ld, rd = lf.sdict, rf.sdict
+                if ld is None or rd is None:
+                    raise BindError("set operation requires dictionary-"
+                                    "encoded string columns")
+                if ld is not rd:
+                    # fresh output dictionary: left codes stay valid (prefix
+                    # copy), right codes translate — binding must NOT mutate
+                    # the catalog's dictionary (EXPLAIN would bloat tables)
+                    out_d = StringDictionary(ld.values)
+                    xlat = np.fromiter((out_d.add(v) for v in rd.values),
+                                       dtype=np.int32, count=len(rd))
+                    re_ = ex.DictLookup(re_, xlat, T.STRING)
+                    object.__setattr__(re_, "_out_dict", out_d)
+                    changed_r = True
+                    sdict = out_d
+                else:
+                    sdict = ld
+                out_t = lf.type
+            else:
+                out_t = _common_type([lf.type, rf.type])
+                if le.dtype != out_t:
+                    le = self._coerce(le, out_t)
+                    changed_l = True
+                if re_.dtype != out_t:
+                    re_ = self._coerce(re_, out_t)
+                    changed_r = True
+                sdict = None
+            lex.append((lf.name, le))
+            rex.append((lf.name, re_))
+            lfields.append(N.PlanField(lf.name, out_t, sdict))
+            rfields.append(N.PlanField(lf.name, out_t, sdict))
+        if changed_l or [n for n, _ in lex] != left.names:
+            p = N.PProject(left, lex)
+            p.fields = lfields
+            left = p
+        out_r = N.PProject(right, rex)
+        out_r.fields = rfields
+        right = out_r
+        del changed_r
+        return left, right, lfields
+
     def bind_select(self, sel: ast.Select) -> N.PlanNode:
         scope = Scope()
         plans: dict[str, N.PlanNode] = {}
@@ -597,6 +711,9 @@ class Binder:
 
     def _bind_projection(self, sel: ast.Select, plan: N.PlanNode,
                          scope: Scope) -> N.PlanNode:
+        if any(_has_window(i.expr) for i in sel.items):
+            plan, sel = self._extract_windows(sel, plan, scope)
+            scope = self._win_scope
         exprs: list[tuple[str, ex.Expr]] = []
         fields: list[N.PlanField] = []
         taken: set[str] = set()
@@ -628,6 +745,81 @@ class Binder:
         self._rewritten_order = {}
         self._agg_scope = None
         return proj
+
+    WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "count",
+                    "avg", "min", "max"}
+
+    def _extract_windows(self, sel: ast.Select, plan: N.PlanNode,
+                         scope: Scope):
+        """Pull WindowExpr nodes out of the select list into PWindow nodes
+        (one per distinct OVER spec), rewriting items to reference the new
+        columns (the WindowAgg planning step)."""
+        specs: dict[str, tuple] = {}
+
+        def replace(node):
+            if isinstance(node, ast.WindowExpr):
+                if node.func not in self.WINDOW_FUNCS:
+                    raise BindError(f"unknown window function {node.func!r}")
+                key = _ast_key(ast.Select(
+                    items=[], group_by=list(node.partition_by),
+                    order_by=list(node.order_by)))
+                if key not in specs:
+                    specs[key] = (node.partition_by, node.order_by, [])
+                name = self.gensym("win")
+                arg = node.args[0] if node.args else None
+                specs[key][2].append((name, node.func, arg))
+                return ast.Name((name,))
+            if not isinstance(node, ast.Node) or isinstance(
+                    node, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+                return node
+            out = node.__class__(**vars(node))
+            for k, v in vars(node).items():
+                if isinstance(v, ast.ExprNode):
+                    setattr(out, k, replace(v))
+                elif isinstance(v, list):
+                    setattr(out, k, [
+                        replace(x) if isinstance(x, ast.ExprNode) else
+                        tuple(replace(y) if isinstance(y, ast.ExprNode)
+                              else y for y in x) if isinstance(x, tuple)
+                        else x for x in v])
+            return out
+
+        new_items = [ast.SelectItem(replace(i.expr), i.alias)
+                     for i in sel.items]
+        for part_asts, order_asts, calls in specs.values():
+            pk = [self.bind_scalar(a, scope) for a in part_asts]
+            okeys = [(self.bind_scalar(o.expr, scope), o.ascending)
+                     for o in order_asts]
+            bound_calls = []
+            new_fields = []
+            for name, func, arg_ast in calls:
+                arg = self.bind_scalar(arg_ast, scope)                     if arg_ast is not None else None
+                if func in ("row_number", "rank", "dense_rank", "count"):
+                    t = T.INT64
+                elif func == "avg":
+                    t = T.FLOAT64
+                else:
+                    assert arg is not None, f"{func}() needs an argument"
+                    t = arg.dtype
+                if func in ("min", "max") and okeys:
+                    raise BindError("running min/max windows not "
+                                    "supported yet (drop ORDER BY)")
+                bound_calls.append((name, func, arg))
+                new_fields.append(N.PlanField(name, t, None))
+            w = N.PWindow(plan, pk, okeys, bound_calls)
+            w.fields = list(plan.fields) + new_fields
+            plan = w
+        # window outputs resolve by exact generated name; rebind existing
+        # entries onto the window plan so resolve()'s dedupe sees one source
+        for e in scope.entries:
+            if _plan_contains(plan, e.plan):
+                e.plan = plan
+        scope = Scope(list(scope.entries) + [RangeEntry("$win", plan)])
+        sel2 = ast.Select(items=new_items, from_refs=sel.from_refs,
+                          order_by=sel.order_by, limit=sel.limit,
+                          offset=sel.offset, distinct=sel.distinct)
+        self._win_scope = scope
+        return plan, sel2
 
     def _bind_output_expr(self, e: ast.ExprNode, plan: N.PlanNode,
                           scope: Scope) -> ex.Expr:
@@ -1307,6 +1499,10 @@ def _plan_capacity(p: N.PlanNode) -> int:
         return p.capacity
     if isinstance(p, (N.PAgg,)):
         return p.capacity
+    if isinstance(p, N.PConcat):
+        return sum(_plan_capacity(c) for c in p.inputs)
+    if isinstance(p, N.PWindow):
+        return _plan_capacity(p.child)
     if isinstance(p, N.PMotion):
         return p.out_capacity or _plan_capacity(p.child)
     kids = p.children()
@@ -1389,6 +1585,19 @@ def _split_conjuncts(e: Optional[ast.ExprNode]) -> list[ast.ExprNode]:
     if isinstance(e, ast.BinOp) and e.op == "and":
         return _split_conjuncts(e.left) + _split_conjuncts(e.right)
     return [e]
+
+
+def _has_window(node: ast.ExprNode) -> bool:
+    if isinstance(node, ast.WindowExpr):
+        return True
+    for v in vars(node).values() if isinstance(node, ast.Node) else ():
+        if isinstance(v, ast.ExprNode) and _has_window(v):
+            return True
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ast.ExprNode) and _has_window(x):
+                    return True
+    return False
 
 
 def _has_agg(node: ast.ExprNode) -> bool:

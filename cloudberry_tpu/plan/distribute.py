@@ -78,6 +78,35 @@ class Distributor:
             node.child = child
             node.sharding = child.sharding
             return node, cap
+        if isinstance(node, N.PWindow):
+            child, cap = self.walk(node.child)
+            if child.sharding.is_partitioned:
+                names = [e.name for e in node.partition_keys
+                         if isinstance(e, ex.ColumnRef)]
+                ok_coloc = (child.sharding.kind == "hashed"
+                            and child.sharding.keys
+                            and set(child.sharding.keys) <= set(names))
+                if not ok_coloc:
+                    if node.partition_keys and                             len(names) == len(node.partition_keys):
+                        child, cap = self.redistribute(
+                            child, cap, list(node.partition_keys))
+                    else:
+                        child, cap = self.gather(child, cap)
+            node.child = child
+            node.sharding = child.sharding
+            return node, cap
+        if isinstance(node, N.PConcat):
+            total = 0
+            new_inputs = []
+            for c in node.inputs:
+                cc, cap = self.walk(c)
+                if cc.sharding.is_partitioned:
+                    cc, cap = self.gather(cc, cap)
+                new_inputs.append(cc)
+                total += cap
+            node.inputs = new_inputs
+            node.sharding = Sharding.singleton()
+            return node, total
         raise ValueError(f"distribute: unhandled node {type(node).__name__}")
 
     def _walk_subqueries(self, node: N.PlanNode) -> None:
@@ -106,9 +135,14 @@ class Distributor:
         shard_cap = self.session.shard_capacity(node.table_name)
         node.capacity = shard_cap
         node.num_rows = -2  # per-segment count provided at runtime
-        if policy.kind == "hashed":
+        if policy.kind == "hashed" and all(k in node.column_map
+                                           for k in policy.keys):
             keys = tuple(node.column_map[k] for k in policy.keys)
             node.sharding = Sharding.hashed(*keys)
+        elif policy.kind == "hashed":
+            # distribution keys pruned out of the scan: rows are still
+            # hash-placed, but the planner can no longer NAME the keys
+            node.sharding = Sharding.strewn()
         else:
             node.sharding = Sharding.strewn()
         return node, shard_cap

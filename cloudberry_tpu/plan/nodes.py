@@ -163,6 +163,39 @@ class PLimit(PlanNode):
 
 
 @dataclass
+class PWindow(PlanNode):
+    """Window computation over one (PARTITION BY, ORDER BY) spec; appends
+    one output column per call. funcs: row_number | rank | dense_rank |
+    sum | count | avg | min | max (running when ordered — RANGE UNBOUNDED
+    PRECEDING TO CURRENT ROW, peers included — else whole-partition)."""
+
+    child: PlanNode
+    partition_keys: list[ex.Expr]
+    order_keys: list[tuple[ex.Expr, bool]]
+    calls: list[tuple[str, str, Optional[ex.Expr]]]  # (out, func, arg)
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        return f"Window [{', '.join(f for _, f, _ in self.calls)}]"
+
+
+@dataclass
+class PConcat(PlanNode):
+    """Append inputs (UNION ALL / the setop flow's Append, cdbsetop.c
+    analog); output capacity = Σ child capacities."""
+
+    inputs: list[PlanNode]
+
+    def children(self):
+        return list(self.inputs)
+
+    def title(self):
+        return f"Append x{len(self.inputs)}"
+
+
+@dataclass
 class PMotion(PlanNode):
     """The Motion node (nodeMotion.c analog). kind:
     'gather'       — all segments → singleton (GATHER_MOTION)

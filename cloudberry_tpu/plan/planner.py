@@ -59,17 +59,24 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
 
     if isinstance(stmt, ast.Explain):
         binder = Binder(catalog)
-        plan = binder.bind_select(stmt.stmt)
-        plan = _distribute(plan, session)
+        plan = binder.bind_query(stmt.stmt)
+        plan = _optimize(plan, session)
         return PlanResult(is_ddl=True, ddl_result=plan.explain())
 
-    if isinstance(stmt, ast.Select):
+    if isinstance(stmt, (ast.Select, ast.SetOp)):
         binder = Binder(catalog)
-        plan = binder.bind_select(stmt)
-        plan = _distribute(plan, session)
+        plan = binder.bind_query(stmt)
+        plan = _optimize(plan, session)
         return PlanResult(plan=plan)
 
     raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+
+def _optimize(plan: N.PlanNode, session) -> N.PlanNode:
+    from cloudberry_tpu.plan.prune import prune_plan
+
+    plan = prune_plan(plan)
+    return _distribute(plan, session)
 
 
 def _distribute(plan: N.PlanNode, session) -> N.PlanNode:

@@ -1,0 +1,125 @@
+"""Column pruning — the targetlist-narrowing the reference's planner does
+(and PAX's column projection exploits, SURVEY §2.5): each node keeps only
+the columns its ancestors actually use. On TPU this directly cuts HBM
+traffic — every pruned column is one less array scanned, gathered through
+joins, permuted by sorts, and shuffled by motions.
+
+Run BEFORE the distribution pass so motions move only live columns.
+"""
+
+from __future__ import annotations
+
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+
+
+def prune_plan(plan: N.PlanNode) -> N.PlanNode:
+    _prune(plan, set(plan.names))
+    return plan
+
+
+def _expr_cols(e: ex.Expr) -> set[str]:
+    out = ex.columns_used(e)
+    for node in ex.walk(e):
+        mask = getattr(node, "_null_mask", None)
+        if mask and mask != "$lost":
+            out.add(mask)
+        if isinstance(node, ex.SubqueryScalar):
+            _prune(node.plan, set(node.plan.names))
+    return out
+
+
+def _prune(node: N.PlanNode, req: set[str]) -> None:
+    if isinstance(node, N.PScan):
+        keep = {phys: out for phys, out in node.column_map.items()
+                if out in req}
+        node.column_map = keep
+        node.fields = [f for f in node.fields if f.name in req]
+        return
+
+    if isinstance(node, N.PFilter):
+        _prune(node.child, req | _expr_cols(node.predicate))
+        return
+
+    if isinstance(node, N.PProject):
+        node.exprs = [(n, e) for n, e in node.exprs if n in req]
+        node.fields = [f for f in node.fields if f.name in req]
+        child_req = set()
+        for _, e in node.exprs:
+            child_req |= _expr_cols(e)
+        _prune(node.child, child_req)
+        return
+
+    if isinstance(node, N.PJoin):
+        build_req = set()
+        probe_req = set()
+        for k in node.build_keys:
+            build_req |= _expr_cols(k)
+        for k in node.probe_keys:
+            probe_req |= _expr_cols(k)
+        if node.residual is not None:
+            rcols = _expr_cols(node.residual)
+            build_names = set(node.build.names)
+            build_req |= rcols & build_names
+            probe_req |= rcols - build_names
+        node.build_payload = [c for c in node.build_payload
+                              if c in req or c in
+                              (_expr_cols(node.residual)
+                               if node.residual is not None else ())]
+        build_req |= set(node.build_payload)
+        probe_req |= req - set(node.build_payload) - {node.match_name}
+        probe_req &= set(node.probe.names)
+        _prune(node.build, build_req)
+        _prune(node.probe, probe_req)
+        node.fields = [f for f in node.fields
+                       if f.name in req or f.name in node.build_payload]
+        return
+
+    if isinstance(node, N.PAgg):
+        child_req = set()
+        for _, e in node.group_keys:
+            child_req |= _expr_cols(e)
+        for _, c in node.aggs:
+            if c.arg is not None:
+                child_req |= _expr_cols(c.arg)
+        _prune(node.child, child_req)
+        return
+
+    if isinstance(node, N.PSort):
+        child_req = set(req)
+        for e, _ in node.keys:
+            child_req |= _expr_cols(e)
+        _prune(node.child, child_req)
+        return
+
+    if isinstance(node, N.PLimit):
+        _prune(node.child, set(req))
+        return
+
+    if isinstance(node, N.PMotion):
+        child_req = set(req)
+        for e in node.hash_keys:
+            child_req |= _expr_cols(e)
+        _prune(node.child, child_req)
+        node.fields = [f for f in node.fields if f.name in child_req]
+        return
+
+    if isinstance(node, N.PWindow):
+        child_req = req - {n for n, _, _ in node.calls}
+        for e in node.partition_keys:
+            child_req |= _expr_cols(e)
+        for e, _ in node.order_keys:
+            child_req |= _expr_cols(e)
+        for _, _, arg in node.calls:
+            if arg is not None:
+                child_req |= _expr_cols(arg)
+        _prune(node.child, child_req)
+        return
+
+    if isinstance(node, N.PConcat):
+        for c in node.inputs:
+            _prune(c, set(req))
+        return
+
+    # unknown/leaf nodes: nothing to prune
+    return

@@ -144,6 +144,14 @@ class CastExpr(ExprNode):
 
 
 @dataclass
+class WindowExpr(ExprNode):
+    func: str
+    args: list[ExprNode]
+    partition_by: list[ExprNode]
+    order_by: list["OrderItem"]
+
+
+@dataclass
 class ScalarSubquery(ExprNode):
     select: "Select"
 
@@ -214,6 +222,18 @@ class Select(Node):
     limit: Optional[int] = None
     offset: int = 0
     distinct: bool = False
+
+
+@dataclass
+class SetOp(Node):
+    """UNION/INTERSECT/EXCEPT chain; ORDER BY/LIMIT apply to the whole."""
+    op: str                      # 'union' | 'intersect' | 'except'
+    all: bool
+    left: Node                   # Select or SetOp
+    right: Node
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
 
 
 @dataclass

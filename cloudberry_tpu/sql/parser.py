@@ -83,12 +83,12 @@ class Parser:
     # ----------------------------------------------------------- statements
 
     def parse_statement(self) -> ast.Node:
-        if self.at_kw("select"):
-            return self.parse_select()
+        if self.at_kw("select") or self.at_op("("):
+            return self.parse_query()
         if self.at_kw("explain"):
             self.advance()
             analyze = bool(self.accept_kw("analyze"))
-            return ast.Explain(self.parse_select(), analyze)
+            return ast.Explain(self.parse_query(), analyze)
         if self.at_kw("create"):
             return self.parse_create_table()
         if self.at_kw("drop"):
@@ -174,7 +174,61 @@ class Parser:
 
     # --------------------------------------------------------------- SELECT
 
-    def parse_select(self) -> ast.Select:
+    def parse_query(self) -> ast.Node:
+        """select-core (UNION|INTERSECT|EXCEPT select-core)* [ORDER BY]
+        [LIMIT]; set operations own the trailing ORDER BY/LIMIT."""
+        node: ast.Node = self._parse_intersect_chain()
+        while self.at_kw("union", "except"):
+            op = self.advance().text
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self._parse_intersect_chain()
+            node = ast.SetOp(op, all_, node, right)
+        if isinstance(node, ast.SetOp):
+            if self.accept_kw("order"):
+                self.expect_kw("by")
+                node.order_by = [self.parse_order_item()]
+                while self.accept_op(","):
+                    node.order_by.append(self.parse_order_item())
+            if self.accept_kw("limit"):
+                node.limit = int(self.advance().text)
+            if self.accept_kw("offset"):
+                node.offset = int(self.advance().text)
+        else:
+            node = self._parse_select_tail(node)
+        return node
+
+    def _parse_intersect_chain(self) -> ast.Node:
+        # INTERSECT binds tighter than UNION/EXCEPT (SQL precedence)
+        node: ast.Node = self._parse_core()
+        while self.at_kw("intersect"):
+            self.advance()
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            node = ast.SetOp("intersect", all_, node, self._parse_core())
+        return node
+
+    def _parse_core(self) -> ast.Node:
+        if self.at_op("("):
+            self.advance()
+            inner = self.parse_query()
+            self.expect_op(")")
+            return inner
+        return self.parse_select(allow_tail=False)
+
+    def _parse_select_tail(self, sel: ast.Select) -> ast.Select:
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            sel.order_by = [self.parse_order_item()]
+            while self.accept_op(","):
+                sel.order_by.append(self.parse_order_item())
+        if self.accept_kw("limit"):
+            sel.limit = int(self.advance().text)
+        if self.accept_kw("offset"):
+            sel.offset = int(self.advance().text)
+        return sel
+
+    def parse_select(self, allow_tail: bool = True) -> ast.Select:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         self.accept_kw("all")
@@ -195,15 +249,8 @@ class Parser:
                 sel.group_by.append(self.parse_expr())
         if self.accept_kw("having"):
             sel.having = self.parse_expr()
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            sel.order_by = [self.parse_order_item()]
-            while self.accept_op(","):
-                sel.order_by.append(self.parse_order_item())
-        if self.accept_kw("limit"):
-            sel.limit = int(self.advance().text)
-        if self.accept_kw("offset"):
-            sel.offset = int(self.advance().text)
+        if allow_tail:
+            sel = self._parse_select_tail(sel)
         return sel
 
     def parse_select_item(self) -> ast.SelectItem:
@@ -458,6 +505,8 @@ class Parser:
             self.advance()  # (
             if self.accept_op("*"):
                 self.expect_op(")")
+                if self.at_kw("over"):
+                    return self._parse_over(fname, [])
                 return ast.FuncCall(fname, [], star=True)
             distinct = bool(self.accept_kw("distinct"))
             args: list[ast.ExprNode] = []
@@ -466,12 +515,32 @@ class Parser:
                 while self.accept_op(","):
                     args.append(self.parse_expr())
             self.expect_op(")")
+            if self.at_kw("over"):
+                return self._parse_over(fname, args)
             return ast.FuncCall(fname, args, distinct=distinct)
         parts = [self.advance().text]
         while self.at_op(".") and self.toks[self.i + 1].kind == "ident":
             self.advance()
             parts.append(self.advance().text)
         return ast.Name(tuple(parts))
+
+    def _parse_over(self, fname: str, args) -> ast.WindowExpr:
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition: list[ast.ExprNode] = []
+        order: list[ast.OrderItem] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.parse_order_item())
+            while self.accept_op(","):
+                order.append(self.parse_order_item())
+        self.expect_op(")")
+        return ast.WindowExpr(fname, args, partition, order)
 
     def parse_case(self) -> ast.CaseExpr:
         self.expect_kw("case")

@@ -112,7 +112,8 @@ def test_join_lookup_pk_fk(jit):
     fn = K.join_lookup
     if jit:
         fn = jax.jit(fn)
-    idx, matched = fn([jnp.asarray(bkey)], bsel, [jnp.asarray(pkey)], psel)
+    idx, matched, has_dup = fn([jnp.asarray(bkey)], bsel, [jnp.asarray(pkey)], psel)
+    assert not bool(has_dup)
     m = np.asarray(matched)
     np.testing.assert_array_equal(
         m[:8], [True, True, False, True, True, True, True, False])
@@ -127,7 +128,7 @@ def test_join_lookup_multikey():
     pk1 = np.array([1, 2, 2, 3], dtype=np.int64)
     pk2 = np.array([2, 1, 9, 1], dtype=np.int64)
     psel = jnp.ones(4, dtype=bool)
-    idx, matched = K.join_lookup(
+    idx, matched, _ = K.join_lookup(
         [jnp.asarray(bk1), jnp.asarray(bk2)], bsel,
         [jnp.asarray(pk1), jnp.asarray(pk2)], psel)
     np.testing.assert_array_equal(np.asarray(matched), [True, True, False, False])
@@ -140,7 +141,7 @@ def test_join_empty_build():
     bsel = jnp.zeros(4, dtype=bool)
     psel = jnp.ones(4, dtype=bool)
     k = jnp.asarray(np.array([1, 2, 3, 4], dtype=np.int64))
-    _, matched = K.join_lookup([k], bsel, [k], psel)
+    _, matched, _ = K.join_lookup([k], bsel, [k], psel)
     assert not bool(np.asarray(matched).any())
 
 

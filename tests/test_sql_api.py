@@ -74,3 +74,109 @@ def test_decimal_exactness(sess):
     sess.sql(f"insert into m values {rows}")
     df = sess.sql("select sum(v) as s from m").to_pandas()
     assert df["s"][0] == 10.0  # exactly, no 9.999999...
+
+
+def test_set_operations(sess):
+    sess.sql("create table sa (x int, s text)")
+    sess.sql("insert into sa values (1,'a'),(2,'b'),(2,'b'),(3,'c')")
+    sess.sql("create table sb (x int, s text)")
+    sess.sql("insert into sb values (2,'b'),(4,'d'),(3,'zz')")
+
+    df = sess.sql("select x, s from sa union all select x, s from sb "
+                  "order by x, s").to_pandas()
+    assert len(df) == 7 and df["x"].tolist() == [1, 2, 2, 2, 3, 3, 4]
+    assert df["s"].tolist() == ["a", "b", "b", "b", "c", "zz", "d"]
+
+    df = sess.sql("select x, s from sa union select x, s from sb "
+                  "order by x, s").to_pandas()
+    assert list(zip(df["x"], df["s"])) == [
+        (1, "a"), (2, "b"), (3, "c"), (3, "zz"), (4, "d")]
+
+    df = sess.sql("select x, s from sa intersect select x, s from sb "
+                  "order by x").to_pandas()
+    assert list(zip(df["x"], df["s"])) == [(2, "b")]
+
+    df = sess.sql("select x, s from sa except select x, s from sb "
+                  "order by x").to_pandas()
+    assert list(zip(df["x"], df["s"])) == [(1, "a"), (3, "c")]
+
+
+def test_set_op_type_coercion(sess):
+    sess.sql("create table ca (v int)")
+    sess.sql("insert into ca values (1),(2)")
+    sess.sql("create table cb (v decimal(10,2))")
+    sess.sql("insert into cb values (2.5),(1.0)")
+    df = sess.sql("select v from ca union all select v from cb "
+                  "order by v").to_pandas()
+    assert df["v"].tolist() == [1.0, 1.0, 2.0, 2.5]
+
+
+def test_set_op_arity_error(sess):
+    sess.sql("create table e1 (a int, b int)")
+    with pytest.raises(BindError):
+        sess.sql("select a, b from e1 union select a from e1")
+
+
+def test_window_functions(sess):
+    sess.sql("create table w (g text, o int, v decimal(10,2))")
+    sess.sql("""insert into w values
+        ('a', 1, 10.0), ('a', 2, 20.0), ('a', 2, 5.0), ('a', 3, 1.0),
+        ('b', 1, 100.0), ('b', 2, 50.0)""")
+    df = sess.sql("""select g, o, v,
+                row_number() over (partition by g order by o, v) as rn,
+                rank() over (partition by g order by o) as rk,
+                dense_rank() over (partition by g order by o) as dr,
+                sum(v) over (partition by g order by o) as running,
+                sum(v) over (partition by g) as total,
+                count(*) over (partition by g) as n,
+                max(v) over (partition by g) as mx
+            from w order by g, o, v""").to_pandas()
+    assert df["rn"].tolist() == [1, 2, 3, 4, 1, 2]
+    assert df["rk"].tolist() == [1, 2, 2, 4, 1, 2]
+    assert df["dr"].tolist() == [1, 2, 2, 3, 1, 2]
+    # running sum with ORDER BY includes peers (RANGE frame)
+    assert df["running"].tolist() == [10.0, 35.0, 35.0, 36.0, 100.0, 150.0]
+    assert df["total"].tolist() == [36.0] * 4 + [150.0] * 2
+    assert df["n"].tolist() == [4, 4, 4, 4, 2, 2]
+    assert df["mx"].tolist() == [20.0] * 4 + [100.0] * 2
+
+
+def test_window_no_partition(sess):
+    sess.sql("create table wn (v int)")
+    sess.sql("insert into wn values (3),(1),(2)")
+    df = sess.sql("select v, row_number() over (order by v) as rn, "
+                  "sum(v) over () as t from wn order by v").to_pandas()
+    assert df["rn"].tolist() == [1, 2, 3]
+    assert df["t"].tolist() == [6, 6, 6]
+
+
+def test_window_string_order_collation(sess):
+    # dictionary insertion order deliberately != lexical order
+    sess.sql("create table wc (s text)")
+    sess.sql("insert into wc values ('pear'),('apple'),('zebra')")
+    df = sess.sql("select s, row_number() over (order by s) as rn "
+                  "from wc order by s").to_pandas()
+    assert list(zip(df.s, df.rn)) == [("apple", 1), ("pear", 2), ("zebra", 3)]
+
+
+def test_intersect_precedence(sess):
+    sess.sql("create table p1 (x int)"); sess.sql("insert into p1 values (1)")
+    sess.sql("create table p2 (x int)"); sess.sql("insert into p2 values (2)")
+    # 1 UNION (2 INTERSECT 2) = {1,2}; left-assoc would give {2}
+    df = sess.sql("select x from p1 union select x from p2 "
+                  "intersect select x from p2 order by x").to_pandas()
+    assert df["x"].tolist() == [1, 2]
+
+
+def test_except_all_rejected(sess):
+    sess.sql("create table q1 (x int)")
+    with pytest.raises(BindError):
+        sess.sql("select x from q1 except all select x from q1")
+
+
+def test_explain_does_not_mutate_dictionary(sess):
+    sess.sql("create table da (s text)"); sess.sql("insert into da values ('a')")
+    sess.sql("create table db2 (s text)"); sess.sql("insert into db2 values ('zzz')")
+    before = list(sess.catalog.table("da").dicts["s"].values)
+    sess.explain("select s from da union select s from db2")
+    assert sess.catalog.table("da").dicts["s"].values == before
