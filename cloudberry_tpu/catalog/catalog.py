@@ -16,6 +16,8 @@ from typing import Literal, Optional
 
 import numpy as np
 
+import itertools
+
 from cloudberry_tpu.columnar.dictionary import StringDictionary
 from cloudberry_tpu.types import Schema
 from cloudberry_tpu.utils import hashing
@@ -68,8 +70,9 @@ class Table:
         n = len(next(iter(data.values()))) if data else 0
         self.stats.row_count = n
         self.stats.unique = {}
-        # bump version so session-level sharded layouts are invalidated
-        self._version = getattr(self, "_version", 0) + 1
+        # globally-unique version: a DROP+CREATE+INSERT sequence must never
+        # reproduce an old version (statement caches key on it)
+        self._version = next(_VERSION_COUNTER)
         for f in self.schema.fields:
             arr = data.get(f.name)
             if arr is not None and arr.dtype.kind in "if" and n:
@@ -138,6 +141,9 @@ class Table:
         return hashing.jump_consistent_hash_np(h, n_segments)
 
 
+_VERSION_COUNTER = itertools.count(1)
+
+
 class Catalog:
     def __init__(self):
         self.tables: dict[str, Table] = {}
@@ -154,6 +160,7 @@ class Catalog:
         # empty columns from the start so scans of unpopulated tables work
         t.data = {f.name: np.zeros(0, dtype=f.type.np_dtype)
                   for f in schema.fields}
+        t._version = next(_VERSION_COUNTER)
         self.tables[name] = t
         return t
 

@@ -50,6 +50,11 @@ class ExecConfig:
     # Runtime bloom-style filters pushed from join build to probe scan
     # (reference: nodeRuntimeFilter.c).
     enable_runtime_filters: bool = True
+    # Fused Pallas dense-aggregation kernel (exec/pallas_kernels.py):
+    # float32 MXU accumulation — pair with compute_dtype='float32'; off by
+    # default until re-measured on hardware (exact int64 money sums need
+    # the XLA path).
+    use_pallas: bool = False
 
 
 @dataclass(frozen=True)

@@ -52,10 +52,13 @@ def prepare_dist_inputs(plan: N.PlanNode, session):
     return inputs, in_specs
 
 
-def execute_distributed(plan: N.PlanNode, session) -> ColumnBatch:
+def compile_distributed(plan: N.PlanNode, session):
+    """Build the jitted SPMD program once; reusable across calls (the
+    prepared-statement analog — inputs are re-prepared per call from the
+    session's sharded-table cache)."""
     nseg = session.config.n_segments
     mesh = segment_mesh(nseg)
-    inputs, in_specs = prepare_dist_inputs(plan, session)
+    _, in_specs = prepare_dist_inputs(plan, session)
 
     def seg_fn(tables):
         low = DistLowerer(tables, nseg)
@@ -64,8 +67,15 @@ def execute_distributed(plan: N.PlanNode, session) -> ColumnBatch:
         checks = {k: jnp.asarray(v).reshape(1) for k, v in low.checks.items()}
         return out, sel[None], checks
 
-    fn = jax.jit(_shard_map(seg_fn, mesh, (in_specs,),
-                            _out_specs_like(plan)))
+    return jax.jit(_shard_map(seg_fn, mesh, (in_specs,),
+                              _out_specs_like(plan)))
+
+
+def execute_distributed(plan: N.PlanNode, session,
+                        fn=None) -> ColumnBatch:
+    if fn is None:
+        fn = compile_distributed(plan, session)
+    inputs, _ = prepare_dist_inputs(plan, session)
     cols, sel, checks = fn(inputs)
     X.raise_checks(checks)
     # every segment computed the (gathered) final result; take segment 0

@@ -52,9 +52,10 @@ def compile_plan(plan: N.PlanNode, session,
                  platform: str | None = None) -> Executable:
     table_names = sorted({s.table_name for s in scans_of(plan)})
     platform = platform or jax.default_backend()
+    use_pallas = session.config.exec.use_pallas
 
     def run(tables):
-        low = Lowerer(tables, platform=platform)
+        low = Lowerer(tables, platform=platform, use_pallas=use_pallas)
         cols, sel = low.lower(plan)
         out = {f.name: cols[f.name] for f in plan.fields}
         return out, sel, low.checks
@@ -104,14 +105,17 @@ class Lowerer:
     """Traces a plan into jax ops. Subclassed by the distributed executor,
     which overrides scan (per-segment inputs) and motion (collectives)."""
 
-    def __init__(self, tables, platform: str | None = None):
+    def __init__(self, tables, platform: str | None = None,
+                 use_pallas: bool = False):
         self.tables = tables
         self.checks: dict[str, jnp.ndarray] = {}
         self._subcache: dict[int, jnp.ndarray] = {}
         # scatter (segment ops) lower well on CPU; TPU serializes large
         # scatters, so it gets unrolled masked reductions instead
         platform = platform or jax.default_backend()
+        self.platform = platform
         self.dense_strategy = "segment" if platform == "cpu" else "reduce"
+        self.use_pallas = use_pallas
 
     def lower(self, node: N.PlanNode) -> tuple[dict, jnp.ndarray]:
         if isinstance(node, N.PScan):
@@ -122,7 +126,12 @@ class Lowerer:
             return cols, sel & mask
         if isinstance(node, N.PProject):
             cols, sel = self.lower(node.child)
-            out = {name: self.expr(e, cols) for name, e in node.exprs}
+            out = {}
+            for name, e in node.exprs:
+                v = self.expr(e, cols)
+                if v.ndim == 0:  # constant expression → full column
+                    v = jnp.broadcast_to(v, sel.shape)
+                out[name] = v
             return out, sel
         if isinstance(node, N.PJoin):
             return self.join(node)
@@ -449,6 +458,35 @@ class Lowerer:
         return {**out_keys, **out_aggs}, out_sel
 
 
+    def _dense_agg_pallas(self, gid, n_cells, agg_specs, agg_values, sel):
+        """Fused one-pass Pallas path (config.exec.use_pallas): float32 MXU
+        accumulation for sum/count/avg over a small cell domain. Returns
+        None when ineligible (exact int64 sums, min/max) → XLA path."""
+        if not self.use_pallas:
+            return None
+        if any(s.func not in ("sum", "count", "avg") for s in agg_specs):
+            return None
+        from cloudberry_tpu.exec.pallas_kernels import dense_agg_pallas
+
+        tile = 2048
+        sum_specs = [s for s in agg_specs if s.func in ("sum", "avg")]
+        vals = [agg_values[s.out_name].astype(jnp.float32)
+                for s in sum_specs]
+        stacked = jnp.stack(vals) if vals else             jnp.zeros((0, gid.shape[0]), jnp.float32)
+        counts, sums = dense_agg_pallas(
+            _pallas_pad(gid.astype(jnp.int32), tile),
+            _pallas_pad(stacked, tile),
+            _pallas_pad(sel, tile),
+            n_cells=n_cells, tile=tile,
+            interpret=(self.platform == "cpu"))
+        out = {}
+        for i, s in enumerate(sum_specs):
+            out[s.out_name] = sums[i] if s.func == "sum" else                 sums[i] / jnp.maximum(counts, 1.0)
+        for s in agg_specs:
+            if s.func == "count":
+                out[s.out_name] = counts.astype(jnp.int64)
+        return out, counts > 0
+
     def _dense_agg(self, node: N.PAgg, cols, sel, agg_specs, agg_values,
                    post_scale):
         """Perfect-hash aggregation when ALL group keys are dictionary-coded
@@ -482,9 +520,14 @@ class Lowerer:
         for (name, e), stride in zip(node.group_keys, strides):
             gid = gid + self.expr(e, cols).astype(jnp.int32) \
                 * np.int32(stride)
-        out_aggs, occupied = K.group_aggregate_dense(
-            gid, prod, agg_values, agg_specs, sel,
-            strategy=self.dense_strategy)
+        pallas_out = self._dense_agg_pallas(gid, prod, agg_specs,
+                                            agg_values, sel)
+        if pallas_out is not None:
+            out_aggs, occupied = pallas_out
+        else:
+            out_aggs, occupied = K.group_aggregate_dense(
+                gid, prod, agg_values, agg_specs, sel,
+                strategy=self.dense_strategy)
         for name, div in post_scale.items():
             out_aggs[name] = out_aggs[name] / div
 
@@ -519,6 +562,15 @@ def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:
             safe = jnp.clip(arr, 0, rank.shape[0] - 1)
             return jnp.where(arr >= 0, jnp.take(rank, safe), -1)
     return arr
+
+
+def _pallas_pad(a, tile):
+    n = a.shape[-1]
+    pad = (-n) % tile
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+    return jnp.pad(a, widths)
 
 
 def _substitute_subqueries(e: ex.Expr, mapping: dict[int, str]) -> ex.Expr:

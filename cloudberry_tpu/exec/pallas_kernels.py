@@ -1,0 +1,75 @@
+"""Pallas TPU kernels for the hottest executor op.
+
+The single hottest loop in the engine is grouped aggregation over a scan
+(Q1's shape: 6M rows → 6 cells × ~8 aggregates). The XLA formulation
+(exec/kernels.group_aggregate_dense) is a chain of masked reductions; this
+Pallas kernel fuses the whole thing into ONE pass over HBM:
+
+  per row-tile (grid is sequential on TPU, so accumulating into the output
+  block is safe):
+      onehot = (gid == cell_ids) & sel          # (cells, TILE) in VMEM
+      counts += sum(onehot, axis=1)
+      sums   += values @ onehot.T               # (K, cells) on the MXU
+
+The matmul accumulates in float32 on the MXU; exact int64-cent money sums
+keep the XLA path. Gated by ``config.exec.use_pallas`` (wired through
+Lowerer._dense_agg_pallas), default off until re-measured on hardware — the
+dev TPU tunnel died mid-session. Decimal sums through this path round to
+float32: acceptable for approximate analytics, not for money reconciliation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _dense_agg_kernel(gid_ref, vals_ref, sel_ref, out_ref, *, n_cells: int):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    g = gid_ref[:]                       # (TILE,)
+    s = sel_ref[:]                       # (TILE,)
+    v = vals_ref[:]                      # (K, TILE)
+    cells = jax.lax.broadcasted_iota(jnp.int32, (n_cells, g.shape[0]), 0)
+    onehot = (g[None, :] == cells) & s[None, :]          # (cells, TILE)
+    oh_f = onehot.astype(jnp.float32)
+    counts = jnp.sum(oh_f, axis=1)                       # (cells,)
+    sums = jnp.dot(v, oh_f.T,
+                   preferred_element_type=jnp.float32)   # (K, cells) on MXU
+    out_ref[0, :] += counts
+    out_ref[1:, :] += sums
+
+
+@functools.partial(jax.jit, static_argnames=("n_cells", "tile", "interpret"))
+def dense_agg_pallas(gid: jnp.ndarray, vals: jnp.ndarray, sel: jnp.ndarray,
+                     n_cells: int, tile: int = 2048,
+                     interpret: bool = False):
+    """Fused one-pass grouped sum+count for a small static cell domain.
+
+    gid: int32[N] cell per row; vals: float32[K, N]; sel: bool[N].
+    Returns (counts f32[cells], sums f32[K, cells]).
+    N must be a multiple of ``tile`` (caller pads; sel masks padding).
+    """
+    k, n = vals.shape
+    assert n % tile == 0, "pad rows to a tile multiple"
+    grid = (n // tile,)
+    out = pl.pallas_call(
+        functools.partial(_dense_agg_kernel, n_cells=n_cells),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((k, tile), lambda i: (0, i)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((k + 1, n_cells), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k + 1, n_cells), jnp.float32),
+        interpret=interpret,
+    )(gid, vals, sel)
+    return out[0], out[1:]

@@ -972,13 +972,28 @@ class Binder:
         raise BindError(f"unsupported expression {type(node).__name__}")
 
     def _bind_string_case(self, whens, otherwise, result_exprs) -> ex.Expr:
-        """CASE yielding strings: all results must be literals (or one shared
-        dictionary column); literals get a fresh output dictionary."""
-        if not all(isinstance(e, ex.Literal) for e in result_exprs):
-            raise BindError("string CASE requires literal results "
-                            "(dictionary merge not supported yet)")
-        out_dict = StringDictionary()
-        enc = lambda e: ex.Literal(out_dict.add(e.value), T.STRING)
+        """CASE yielding strings: literal results get codes in an output
+        dictionary; non-literal results must share ONE dictionary, which the
+        output dictionary extends (so their codes pass through unchanged —
+        the UPDATE col = CASE WHEN … THEN 'lit' ELSE col END shape)."""
+        col_dicts = {id(_expr_dict(e)): _expr_dict(e)
+                     for e in result_exprs
+                     if not isinstance(e, ex.Literal)
+                     and _expr_dict(e) is not None}
+        if any(not isinstance(e, ex.Literal) and _expr_dict(e) is None
+               for e in result_exprs):
+            raise BindError("string CASE branch has no dictionary")
+        if len(col_dicts) > 1:
+            raise BindError("string CASE mixing columns from different "
+                            "dictionaries is not supported yet")
+        base = next(iter(col_dicts.values()), None)
+        out_dict = StringDictionary(base.values if base else ())
+
+        def enc(e):
+            if isinstance(e, ex.Literal):
+                return ex.Literal(out_dict.add(e.value), T.STRING)
+            return e  # column codes valid: out_dict extends its dictionary
+
         whens = tuple((c, enc(v)) for c, v in whens)
         otherwise = enc(otherwise) if otherwise is not None else \
             ex.Literal(-1, T.STRING)

@@ -69,7 +69,138 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
         plan = _optimize(plan, session)
         return PlanResult(plan=plan)
 
+    if isinstance(stmt, ast.Delete):
+        return PlanResult(is_ddl=True, ddl_result=_delete(session, stmt))
+
+    if isinstance(stmt, ast.Update):
+        return PlanResult(is_ddl=True, ddl_result=_update(session, stmt))
+
+    if isinstance(stmt, ast.InsertSelect):
+        return PlanResult(is_ddl=True,
+                          ddl_result=_insert_select(session, stmt))
+
     raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+
+def _run_internal(session, query: ast.Node):
+    """Plan + execute a synthetic query (DML rewrite machinery) — under
+    the same admission control and statement slot as user queries."""
+    from cloudberry_tpu.exec.executor import execute
+    from cloudberry_tpu.exec.resource import check_admission
+
+    binder = Binder(session.catalog)
+    plan = _optimize(binder.bind_query(query), session)
+    check_admission(plan, session)
+    with session._gate:
+        return execute(plan, session)
+
+
+def _delete(session, stmt: ast.Delete) -> str:
+    """DELETE = keep the complement (delete-and-rewrite over immutable
+    columns — the visimap-style store path lives in storage/table_store)."""
+    table = session.catalog.table(stmt.table)
+    before = table.num_rows
+    if stmt.where is None:
+        table.set_data({f.name: np.zeros(0, dtype=f.type.np_dtype)
+                        for f in table.schema.fields}, table.dicts)
+        return f"DELETE {before}"
+    keep = ast.Select(
+        items=[ast.SelectItem(ast.Name((f.name,)), f.name)
+               for f in table.schema.fields],
+        from_refs=[ast.TableName(stmt.table)],
+        where=ast.UnaryOp("not", stmt.where))
+    batch = _run_internal(session, keep)
+    sel = np.asarray(batch.sel)
+    new_data = {f.name: np.asarray(batch.columns[f.name])[sel]
+                for f in table.schema.fields}
+    table.set_data(new_data, table.dicts)
+    return f"DELETE {before - int(sel.sum())}"
+
+
+_TYPE_NAME = {T.DType.BOOL: ("boolean", None), T.DType.INT32: ("integer", None),
+              T.DType.INT64: ("bigint", None),
+              T.DType.FLOAT64: ("double", None),
+              T.DType.DATE: ("date", None), T.DType.STRING: ("text", None)}
+
+
+def _update(session, stmt: ast.Update) -> str:
+    """UPDATE col = CASE WHEN pred THEN expr ELSE col END, rewritten through
+    the normal executor (distributed UPDATE without SplitUpdate: the result
+    re-shards on the next statement if a distribution key changed)."""
+    table = session.catalog.table(stmt.table)
+    set_cols = {c for c, _ in stmt.sets}
+    unknown = set_cols - set(table.schema.names)
+    if unknown:
+        raise BindError(f"UPDATE of unknown column(s) {sorted(unknown)}")
+    items = []
+    for f in table.schema.fields:
+        src: ast.ExprNode = ast.Name((f.name,))
+        expr = dict(stmt.sets).get(f.name)
+        if expr is not None:
+            if stmt.where is not None:
+                val = ast.CaseExpr([(stmt.where, expr)], src)
+            elif f.dtype == T.DType.STRING:
+                # CASE wrapper even without WHERE: the string-CASE binder is
+                # what assigns dictionary codes to string literals
+                val = ast.CaseExpr([(ast.BoolLit(True), expr)], src)
+            else:
+                val = expr
+            if f.dtype == T.DType.DECIMAL:
+                val = ast.CastExpr(val, "decimal", f.type.scale)
+            elif f.dtype != T.DType.STRING:
+                tname, _ = _TYPE_NAME[f.dtype]
+                val = ast.CastExpr(val, tname)
+            src = val
+        items.append(ast.SelectItem(src, f.name))
+    if stmt.where is not None:
+        items.append(ast.SelectItem(stmt.where, "$updated"))
+    q = ast.Select(items=items, from_refs=[ast.TableName(stmt.table)])
+    batch = _run_internal(session, q)
+    sel = np.asarray(batch.sel)
+    n_upd = int(np.asarray(batch.columns["$updated"])[sel].sum()) \
+        if stmt.where is not None else int(sel.sum())
+    new_data = {}
+    dicts = dict(table.dicts)
+    for f in table.schema.fields:
+        arr = np.asarray(batch.columns[f.name])[sel]
+        bf = batch.schema.field(f.name)
+        if f.dtype == T.DType.STRING:
+            # the query may have produced codes in a NEW dictionary
+            # (string CASE/literal): adopt it — old codes stay valid only
+            # if it extends the old one, which _bind_string_case guarantees
+            nd = batch.dicts.get(f.name)
+            if nd is not None:
+                dicts[f.name] = nd
+        new_data[f.name] = arr.astype(f.type.np_dtype)
+    table.set_data(new_data, dicts)
+    return f"UPDATE {n_upd}"
+
+
+def _insert_select(session, stmt: ast.InsertSelect) -> str:
+    from cloudberry_tpu.columnar.batch import encode_column
+
+    table = session.catalog.table(stmt.table)
+    cols = stmt.columns or table.schema.names
+    if list(cols) != list(table.schema.names):
+        raise BindError("INSERT ... SELECT must target all columns in "
+                        "schema order (no defaults yet)")
+    batch = _run_internal(session, stmt.query)
+    if len(batch.schema.fields) != len(table.schema.fields):
+        raise BindError(
+            f"INSERT arity mismatch: query returns "
+            f"{len(batch.schema.fields)} columns, table has "
+            f"{len(table.schema.fields)}")
+    df = batch.to_pandas()  # decode, then re-encode into the table's dicts
+    new_rows = len(df)
+    new_data = {}
+    for f, qname in zip(table.schema.fields, df.columns):
+        vals = df[qname].to_numpy()
+        arr = encode_column(vals, f, table.dicts)
+        old = table.data.get(f.name)
+        new_data[f.name] = arr if old is None or len(old) == 0 \
+            else np.concatenate([old, arr])
+    table.set_data(new_data, table.dicts)
+    return f"INSERT {new_rows}"
 
 
 def _optimize(plan: N.PlanNode, session) -> N.PlanNode:

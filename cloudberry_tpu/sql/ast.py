@@ -267,6 +267,26 @@ class InsertValues(Node):
 
 
 @dataclass
+class InsertSelect(Node):
+    table: str
+    columns: list[str]
+    query: Node  # Select or SetOp
+
+
+@dataclass
+class Update(Node):
+    table: str
+    sets: list[tuple[str, ExprNode]]
+    where: Optional[ExprNode] = None
+
+
+@dataclass
+class Delete(Node):
+    table: str
+    where: Optional[ExprNode] = None
+
+
+@dataclass
 class Explain(Node):
     stmt: Select
     analyze: bool = False

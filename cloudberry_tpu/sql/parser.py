@@ -101,6 +101,14 @@ class Parser:
             return ast.DropTable(self.expect_ident(), if_exists)
         if self.at_kw("insert"):
             return self.parse_insert()
+        if self.at_kw("update"):
+            return self.parse_update()
+        if self.at_kw("delete"):
+            self.advance()
+            self.expect_kw("from")
+            table = self.expect_ident()
+            where = self.parse_expr() if self.accept_kw("where") else None
+            return ast.Delete(table, where)
         raise ParseError(f"unsupported statement start {self.cur.text!r}")
 
     def parse_create_table(self) -> ast.CreateTable:
@@ -149,7 +157,7 @@ class Parser:
                 raise ParseError("expected BY/REPLICATED/RANDOMLY after DISTRIBUTED")
         return ast.CreateTable(name, cols, distribution, keys, if_not_exists)
 
-    def parse_insert(self) -> ast.InsertValues:
+    def parse_insert(self):
         self.expect_kw("insert")
         self.expect_kw("into")
         table = self.expect_ident()
@@ -159,6 +167,8 @@ class Parser:
             while self.accept_op(","):
                 columns.append(self.expect_ident())
             self.expect_op(")")
+        if self.at_kw("select") or self.at_op("("):
+            return ast.InsertSelect(table, columns, self.parse_query())
         self.expect_kw("values")
         rows = []
         while True:
@@ -171,6 +181,20 @@ class Parser:
             if not self.accept_op(","):
                 break
         return ast.InsertValues(table, columns, rows)
+
+    def parse_update(self) -> ast.Update:
+        self.expect_kw("update")
+        table = self.expect_ident()
+        self.expect_kw("set")
+        sets = []
+        while True:
+            col = self.expect_ident()
+            self.expect_op("=")
+            sets.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return ast.Update(table, sets, where)
 
     # --------------------------------------------------------------- SELECT
 

@@ -180,3 +180,75 @@ def test_explain_does_not_mutate_dictionary(sess):
     before = list(sess.catalog.table("da").dicts["s"].values)
     sess.explain("select s from da union select s from db2")
     assert sess.catalog.table("da").dicts["s"].values == before
+
+
+def test_delete(sess):
+    sess.sql("create table del_t (k int, v decimal(10,2))")
+    sess.sql("insert into del_t values (1,1.0),(2,2.0),(3,3.0),(4,4.0)")
+    assert sess.sql("delete from del_t where k > 2") == "DELETE 2"
+    df = sess.sql("select k from del_t order by k").to_pandas()
+    assert df["k"].tolist() == [1, 2]
+    assert sess.sql("delete from del_t") == "DELETE 2"
+    assert len(sess.sql("select k from del_t").to_pandas()) == 0
+
+
+def test_update(sess):
+    sess.sql("create table up_t (k int, v decimal(10,2), s text)")
+    sess.sql("insert into up_t values (1,1.0,'a'),(2,2.0,'b'),(3,3.0,'c')")
+    assert sess.sql("update up_t set v = v * 2 where k >= 2") == "UPDATE 2"
+    df = sess.sql("select k, v from up_t order by k").to_pandas()
+    assert df["v"].tolist() == [1.0, 4.0, 6.0]
+    # string update with a NEW literal value
+    assert sess.sql("update up_t set s = 'zzz' where k = 1") == "UPDATE 1"
+    df = sess.sql("select s from up_t order by k").to_pandas()
+    assert df["s"].tolist() == ["zzz", "b", "c"]
+    # unconditional update
+    assert sess.sql("update up_t set v = 0.5") == "UPDATE 3"
+    assert sess.sql("select sum(v) as t from up_t").to_pandas()["t"][0] == 1.5
+
+
+def test_insert_select(sess):
+    sess.sql("create table src_t (k int, s text)")
+    sess.sql("insert into src_t values (1,'x'),(2,'y')")
+    sess.sql("create table dst_t (k int, s text)")
+    assert sess.sql("insert into dst_t select k * 10, s from src_t") == "INSERT 2"
+    assert sess.sql("insert into dst_t select k, s from src_t where k = 1") == "INSERT 1"
+    df = sess.sql("select k, s from dst_t order by k").to_pandas()
+    assert list(zip(df.k, df.s)) == [(1, "x"), (10, "x"), (20, "y")]
+
+
+def test_dml_distributed():
+    s = cb.Session(cb.Config(n_segments=4))
+    s.sql("create table dd (k bigint, v decimal(10,2)) distributed by (k)")
+    s.sql("insert into dd values " + ",".join(f"({i},{i}.0)" for i in range(40)))
+    assert s.sql("delete from dd where k >= 30") == "DELETE 10"
+    assert s.sql("update dd set v = v + 100.0 where k < 10") == "UPDATE 10"
+    df = s.sql("select count(*) as n, sum(v) as t from dd").to_pandas()
+    assert int(df["n"][0]) == 30
+    assert float(df["t"][0]) == sum(i + 100 for i in range(10)) + sum(range(10, 30))
+
+
+def test_statement_cache_reuse_and_invalidation(sess):
+    sess.sql("create table sc (k int)")
+    sess.sql("insert into sc values (1),(2),(3)")
+    q = "select sum(k) as s from sc"
+    assert sess.sql(q).to_pandas()["s"][0] == 6
+    runner1 = sess._stmt_cache[q][3]
+    assert sess.sql(q).to_pandas()["s"][0] == 6
+    assert sess._stmt_cache[q][3] is runner1  # reused, not rebuilt
+    # DML bumps the table version -> cache invalidated, result fresh
+    sess.sql("insert into sc values (10)")
+    assert sess.sql(q).to_pandas()["s"][0] == 16
+    assert sess._stmt_cache[q][3] is not runner1
+
+
+def test_statement_cache_drop_recreate_not_stale(sess):
+    sess.sql("create table scd (s text)")
+    sess.sql("insert into scd values ('a'),('b'),('b')")
+    q = "select count(*) as n from scd where s = 'b'"
+    assert int(sess.sql(q).to_pandas()["n"][0]) == 2
+    sess.sql("drop table scd")
+    sess.sql("create table scd (s text)")
+    sess.sql("insert into scd values ('b'),('z'),('z')")
+    # recreated table: dictionary codes differ; cache must NOT replay
+    assert int(sess.sql(q).to_pandas()["n"][0]) == 1
