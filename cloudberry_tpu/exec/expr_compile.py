@@ -124,6 +124,9 @@ def _compile_func(e: ex.Func):
         return lambda cols: _civil_from_days(args[0](cols))[1]
     if name == "abs":
         return lambda cols: jnp.abs(args[0](cols))
+    if name == "sqrt":
+        # guard tiny negative values from the stddev identity's cancellation
+        return lambda cols: jnp.sqrt(jnp.maximum(args[0](cols), 0.0))
     if name == "scale_down":
         # args: (decimal expr, literal k) — binder-inserted rescale after
         # decimal multiplication.

@@ -33,7 +33,7 @@ from cloudberry_tpu.plan import nodes as N
 from cloudberry_tpu.sql import ast
 from cloudberry_tpu.types import DType, SqlType
 
-AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+AGG_FUNCS = {"sum", "count", "min", "max", "avg", "stddev_samp"}
 MAX_DECIMAL_SCALE = 6
 
 
@@ -640,6 +640,37 @@ class Binder:
             if isinstance(node, (ast.ScalarSubquery, ast.InSubquery,
                                  ast.Exists)):
                 return node
+            if isinstance(node, ast.FuncCall) \
+                    and node.name == "stddev_samp":
+                # sample stddev via the sum/sum-of-squares/count identity:
+                # sqrt((Σx² − (Σx)²/n) / (n−1)); n ≤ 1 yields 0 (SQL: NULL)
+                if node.distinct:
+                    raise BindError(
+                        "stddev_samp(DISTINCT ...) is not supported yet")
+                if node.star or not node.args:
+                    raise BindError("stddev_samp() requires an argument")
+                key = _ast_key(node)
+                if key not in agg_names:
+                    # accumulate Σx and Σx² in FLOAT64: the integer dtypes
+                    # of the column would overflow on the square / its sum
+                    arg = self._coerce(
+                        self.bind_scalar(node.args[0], scope), T.FLOAT64)
+                    sq = ex.BinOp("*", arg, arg, T.FLOAT64)
+                    names3 = (self.gensym("agg"), self.gensym("agg"),
+                              self.gensym("agg"))
+                    aggs.append((names3[0], ex.AggCall("sum", arg)))
+                    aggs.append((names3[1], ex.AggCall("sum", sq)))
+                    aggs.append((names3[2], ex.AggCall("count", arg)))
+                    agg_names[key] = names3
+                s_, q_, c_ = agg_names[key]
+                sn, qn, cn = (ast.Name((s_,)), ast.Name((q_,)),
+                              ast.Name((c_,)))
+                var = ast.BinOp(
+                    "/",
+                    ast.BinOp("-", qn,
+                              ast.BinOp("/", ast.BinOp("*", sn, sn), cn)),
+                    ast.BinOp("-", cn, ast.NumberLit("1")))
+                return ast.FuncCall("sqrt", [var])
             if isinstance(node, ast.FuncCall) and node.name in AGG_FUNCS:
                 key = _ast_key(node)
                 if key not in agg_names:
@@ -965,6 +996,9 @@ class Binder:
             return self._bind_uncorrelated_scalar(node)
 
         if isinstance(node, ast.FuncCall):
+            if node.name == "sqrt":
+                arg = self._coerce(b(node.args[0]), T.FLOAT64)
+                return ex.Func("sqrt", (arg,), T.FLOAT64)
             if node.name in AGG_FUNCS:
                 raise BindError(f"aggregate {node.name}() not allowed here")
             raise BindError(f"unknown function {node.name!r}")

@@ -1,0 +1,99 @@
+"""TPC-DS join-heavy subset (standard benchmark SQL; BASELINE config #5).
+q17 includes the stddev_samp aggregates of the official query."""
+
+DS_QUERIES: dict[str, str] = {}
+
+DS_QUERIES["q17"] = """
+select
+    i_item_id, i_item_desc, s_state,
+    count(ss_quantity) as store_sales_quantitycount,
+    avg(ss_quantity) as store_sales_quantityave,
+    stddev_samp(ss_quantity) as store_sales_quantitystdev,
+    count(sr_return_quantity) as store_returns_quantitycount,
+    avg(sr_return_quantity) as store_returns_quantityave,
+    stddev_samp(sr_return_quantity) as store_returns_quantitystdev,
+    count(cs_quantity) as catalog_sales_quantitycount,
+    avg(cs_quantity) as catalog_sales_quantityave,
+    stddev_samp(cs_quantity) as catalog_sales_quantitystdev
+from
+    store_sales, store_returns, catalog_sales,
+    date_dim d1, date_dim d2, date_dim d3, store, item
+where
+    d1.d_quarter_name = '2000Q1'
+    and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk
+    and s_store_sk = ss_store_sk
+    and ss_customer_sk = sr_customer_sk
+    and ss_item_sk = sr_item_sk
+    and ss_ticket_number = sr_ticket_number
+    and sr_returned_date_sk = d2.d_date_sk
+    and d2.d_quarter_name in ('2000Q1', '2000Q2', '2000Q3')
+    and sr_customer_sk = cs_bill_customer_sk
+    and sr_item_sk = cs_item_sk
+    and cs_sold_date_sk = d3.d_date_sk
+    and d3.d_quarter_name in ('2000Q1', '2000Q2', '2000Q3')
+group by i_item_id, i_item_desc, s_state
+order by i_item_id, i_item_desc, s_state
+limit 100
+"""
+
+DS_QUERIES["q25"] = """
+select
+    i_item_id, i_item_desc, s_store_id, s_store_name,
+    sum(ss_net_profit) as store_sales_profit,
+    sum(sr_net_loss) as store_returns_loss,
+    sum(cs_net_profit) as catalog_sales_profit
+from
+    store_sales, store_returns, catalog_sales,
+    date_dim d1, date_dim d2, date_dim d3, store, item
+where
+    d1.d_moy = 4
+    and d1.d_year = 2000
+    and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk
+    and s_store_sk = ss_store_sk
+    and ss_customer_sk = sr_customer_sk
+    and ss_item_sk = sr_item_sk
+    and ss_ticket_number = sr_ticket_number
+    and sr_returned_date_sk = d2.d_date_sk
+    and d2.d_moy between 4 and 10
+    and d2.d_year = 2000
+    and sr_customer_sk = cs_bill_customer_sk
+    and sr_item_sk = cs_item_sk
+    and cs_sold_date_sk = d3.d_date_sk
+    and d3.d_moy between 4 and 10
+    and d3.d_year = 2000
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+
+DS_QUERIES["q29"] = """
+select
+    i_item_id, i_item_desc, s_store_id, s_store_name,
+    sum(ss_quantity) as store_sales_quantity,
+    sum(sr_return_quantity) as store_returns_quantity,
+    sum(cs_quantity) as catalog_sales_quantity
+from
+    store_sales, store_returns, catalog_sales,
+    date_dim d1, date_dim d2, date_dim d3, store, item
+where
+    d1.d_moy = 4
+    and d1.d_year = 1999
+    and d1.d_date_sk = ss_sold_date_sk
+    and i_item_sk = ss_item_sk
+    and s_store_sk = ss_store_sk
+    and ss_customer_sk = sr_customer_sk
+    and ss_item_sk = sr_item_sk
+    and ss_ticket_number = sr_ticket_number
+    and sr_returned_date_sk = d2.d_date_sk
+    and d2.d_moy between 4 and 7
+    and d2.d_year = 1999
+    and sr_customer_sk = cs_bill_customer_sk
+    and sr_item_sk = cs_item_sk
+    and cs_sold_date_sk = d3.d_date_sk
+    and d3.d_year in (1999, 2000, 2001)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""

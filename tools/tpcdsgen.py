@@ -1,0 +1,139 @@
+"""tpcds-lite: numpy generator for the TPC-DS tables the join-heavy subset
+(q17/q25/q29, BASELINE.md config #5) touches. Same stance as tpchgen: the
+pandas oracle runs over the SAME generated data, so simplified value
+distributions are fine; what matters is the join topology — store_sales ⋈
+store_returns on the composite (customer, item, ticket) key, a many-to-many
+catalog_sales join, and three date_dim roles."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cloudberry_tpu import types as T
+from cloudberry_tpu.types import Schema, date_to_days
+
+SCHEMAS: dict[str, Schema] = {
+    "date_dim": Schema.of(d_date_sk=T.INT64, d_date=T.DATE, d_year=T.INT32,
+                          d_moy=T.INT32, d_quarter_name=T.STRING),
+    "item": Schema.of(i_item_sk=T.INT64, i_item_id=T.STRING,
+                      i_item_desc=T.STRING, i_current_price=T.DECIMAL(2)),
+    "store": Schema.of(s_store_sk=T.INT64, s_store_id=T.STRING,
+                       s_store_name=T.STRING, s_state=T.STRING),
+    "customer": Schema.of(c_customer_sk=T.INT64),
+    "store_sales": Schema.of(ss_sold_date_sk=T.INT64, ss_item_sk=T.INT64,
+                             ss_customer_sk=T.INT64, ss_ticket_number=T.INT64,
+                             ss_store_sk=T.INT64, ss_quantity=T.INT32,
+                             ss_net_profit=T.DECIMAL(2)),
+    "store_returns": Schema.of(sr_returned_date_sk=T.INT64,
+                               sr_item_sk=T.INT64, sr_customer_sk=T.INT64,
+                               sr_ticket_number=T.INT64,
+                               sr_return_quantity=T.INT32,
+                               sr_net_loss=T.DECIMAL(2)),
+    "catalog_sales": Schema.of(cs_sold_date_sk=T.INT64, cs_item_sk=T.INT64,
+                               cs_bill_customer_sk=T.INT64,
+                               cs_quantity=T.INT32,
+                               cs_net_profit=T.DECIMAL(2)),
+}
+
+DIST_KEYS = {
+    "date_dim": None, "item": None, "store": None,      # replicated dims
+    "customer": ("c_customer_sk",),
+    "store_sales": ("ss_ticket_number",),
+    "store_returns": ("sr_ticket_number",),
+    "catalog_sales": ("cs_bill_customer_sk",),
+}
+
+_STATES = ["TN", "CA", "TX", "WA", "NY", "GA", "OH", "MI"]
+_WORDS = ["bright", "quiet", "amber", "rustic", "mellow", "crisp", "vivid",
+          "plain", "brass", "linen"]
+
+
+def generate(scale: float = 1.0, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    n_dates = 365 * 4                       # 1998-01-01 .. 2001-12-30
+    n_item = max(int(500 * scale), 50)
+    n_store = 12
+    n_cust = max(int(2_000 * scale), 100)
+    n_ss = max(int(30_000 * scale), 1_000)
+    n_cs = max(int(20_000 * scale), 800)
+
+    data: dict[str, dict[str, np.ndarray]] = {}
+
+    base = date_to_days("1998-01-01")
+    days = np.arange(n_dates, dtype=np.int64)
+    dates = base + days
+    years = 1998 + days // 365
+    moy = (days % 365) // 31 + 1
+    moy = np.clip(moy, 1, 12)
+    data["date_dim"] = {
+        "d_date_sk": days + 1,
+        "d_date": dates,
+        "d_year": years.astype(np.int32),
+        "d_moy": moy.astype(np.int32),
+        "d_quarter_name": np.asarray(
+            [f"{y}Q{(m - 1) // 3 + 1}" for y, m in zip(years, moy)],
+            dtype=object),
+    }
+
+    ik = np.arange(1, n_item + 1, dtype=np.int64)
+    w = np.asarray(_WORDS, dtype=object)
+    data["item"] = {
+        "i_item_sk": ik,
+        "i_item_id": np.asarray([f"ITEM{i:08d}" for i in ik], dtype=object),
+        "i_item_desc": (w[rng.integers(0, 10, n_item)] + " "
+                        + w[rng.integers(0, 10, n_item)]),
+        "i_current_price": rng.integers(100, 10_000, n_item) / 100.0,
+    }
+
+    sk = np.arange(1, n_store + 1, dtype=np.int64)
+    data["store"] = {
+        "s_store_sk": sk,
+        "s_store_id": np.asarray([f"ST{i:06d}" for i in sk], dtype=object),
+        "s_store_name": np.asarray([f"Store {i}" for i in sk], dtype=object),
+        "s_state": np.asarray(_STATES, dtype=object)[
+            rng.integers(0, len(_STATES), n_store)],
+    }
+
+    data["customer"] = {
+        "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64)}
+
+    ss_date = rng.integers(1, n_dates + 1, n_ss)
+    data["store_sales"] = {
+        "ss_sold_date_sk": ss_date.astype(np.int64),
+        "ss_item_sk": rng.integers(1, n_item + 1, n_ss).astype(np.int64),
+        "ss_customer_sk": rng.integers(1, n_cust + 1, n_ss).astype(np.int64),
+        "ss_ticket_number": np.arange(1, n_ss + 1, dtype=np.int64),
+        "ss_store_sk": rng.integers(1, n_store + 1, n_ss).astype(np.int64),
+        "ss_quantity": rng.integers(1, 100, n_ss).astype(np.int32),
+        "ss_net_profit": rng.integers(-5_000, 20_000, n_ss) / 100.0,
+    }
+
+    # ~35% of sales get returned within ~180 days
+    ret_idx = np.sort(rng.choice(n_ss, size=int(n_ss * 0.35), replace=False))
+    n_sr = len(ret_idx)
+    sr_date = np.minimum(ss_date[ret_idx] + rng.integers(1, 180, n_sr),
+                         n_dates)
+    data["store_returns"] = {
+        "sr_returned_date_sk": sr_date.astype(np.int64),
+        "sr_item_sk": data["store_sales"]["ss_item_sk"][ret_idx],
+        "sr_customer_sk": data["store_sales"]["ss_customer_sk"][ret_idx],
+        "sr_ticket_number": data["store_sales"]["ss_ticket_number"][ret_idx],
+        "sr_return_quantity": rng.integers(1, 50, n_sr).astype(np.int32),
+        "sr_net_loss": rng.integers(50, 10_000, n_sr) / 100.0,
+    }
+
+    data["catalog_sales"] = {
+        "cs_sold_date_sk": rng.integers(1, n_dates + 1, n_cs).astype(np.int64),
+        "cs_item_sk": rng.integers(1, n_item + 1, n_cs).astype(np.int64),
+        "cs_bill_customer_sk": rng.integers(1, n_cust + 1, n_cs)
+        .astype(np.int64),
+        "cs_quantity": rng.integers(1, 100, n_cs).astype(np.int32),
+        "cs_net_profit": rng.integers(-5_000, 20_000, n_cs) / 100.0,
+    }
+    return data
+
+
+def load_tpcds(session, scale: float = 1.0, seed: int = 0) -> None:
+    from tools.tpchgen import load_tables
+
+    load_tables(session, SCHEMAS, DIST_KEYS, generate(scale, seed))

@@ -267,17 +267,16 @@ def generate(sf: float = 0.01, seed: int = 0) -> dict[str, dict[str, np.ndarray]
     return data
 
 
-def load_tpch(session, sf: float = 0.01, seed: int = 0,
-              tables: list[str] | None = None) -> None:
-    """Create + populate TPC-H tables in a session's catalog."""
+def load_tables(session, schemas, dist_keys, raw,
+                only: list[str] | None = None) -> None:
+    """Create + populate benchmark tables (shared by tpch/tpcds loaders)."""
     from cloudberry_tpu.catalog.catalog import DistributionPolicy
     from cloudberry_tpu.columnar.batch import encode_column
 
-    raw = generate(sf, seed)
-    for name, schema in SCHEMAS.items():
-        if tables is not None and name not in tables:
+    for name, schema in schemas.items():
+        if only is not None and name not in only:
             continue
-        keys = DIST_KEYS[name]
+        keys = dist_keys[name]
         policy = (DistributionPolicy.replicated() if keys is None
                   else DistributionPolicy.hashed(*keys))
         t = session.catalog.create_table(name, schema, policy)
@@ -285,3 +284,9 @@ def load_tpch(session, sf: float = 0.01, seed: int = 0,
         for f in schema.fields:
             encoded[f.name] = encode_column(raw[name][f.name], f, t.dicts)
         t.set_data(encoded, t.dicts)
+
+
+def load_tpch(session, sf: float = 0.01, seed: int = 0,
+              tables: list[str] | None = None) -> None:
+    """Create + populate TPC-H tables in a session's catalog."""
+    load_tables(session, SCHEMAS, DIST_KEYS, generate(sf, seed), tables)
