@@ -147,6 +147,15 @@ _VERSION_COUNTER = itertools.count(1)
 class Catalog:
     def __init__(self):
         self.tables: dict[str, Table] = {}
+        # name -> unbound query AST (views re-bind per statement, so they
+        # track base-table changes like the reference's rewriter)
+        self.views: dict[str, object] = {}
+        # bumped on any DDL that can change name resolution (view create/
+        # drop, table create/drop) — statement caches key on it
+        self.ddl_version: int = 0
+
+    def bump_ddl(self) -> None:
+        self.ddl_version += 1
 
     def create_table(self, name: str, schema: Schema,
                      policy: DistributionPolicy | None = None,
@@ -162,6 +171,7 @@ class Catalog:
                   for f in schema.fields}
         t._version = next(_VERSION_COUNTER)
         self.tables[name] = t
+        self.bump_ddl()
         return t
 
     def drop_table(self, name: str, if_exists: bool = False) -> None:
@@ -169,6 +179,7 @@ class Catalog:
         if name not in self.tables and if_exists:
             return
         del self.tables[name]
+        self.bump_ddl()
 
     def table(self, name: str) -> Table:
         t = self.tables.get(name.lower())

@@ -39,12 +39,13 @@ class Executable:
 
 
 def execute(plan: N.PlanNode, session) -> ColumnBatch:
-    if session.config.n_segments > 1:
+    seg = getattr(plan, "_direct_segment", None)
+    if session.config.n_segments > 1 and seg is None:
         from cloudberry_tpu.exec.dist_executor import execute_distributed
 
         return execute_distributed(plan, session)
     exe = compile_plan(plan, session)
-    tables = prepare_tables(exe.table_names, session)
+    tables = prepare_tables(exe.table_names, session, segment=seg)
     return run_executable(exe, tables)
 
 
@@ -63,11 +64,19 @@ def compile_plan(plan: N.PlanNode, session,
     return Executable(plan, jax.jit(run), table_names)
 
 
-def prepare_tables(table_names: list[str], session) -> dict:
+def prepare_tables(table_names: list[str], session,
+                   segment: int | None = None) -> dict:
+    """segment=None: whole tables (single-segment mode); otherwise ONE
+    segment's shard (direct dispatch — cdbtargeteddispatch analog)."""
     tables = {}
     for name in table_names:
         t = session.catalog.table(name)
-        tables[name] = {c: jnp.asarray(v) for c, v in t.data.items()}
+        if segment is None or t.policy.kind == "replicated":
+            tables[name] = {c: jnp.asarray(v) for c, v in t.data.items()}
+        else:
+            st = session.sharded_table(name)
+            tables[name] = {c: jnp.asarray(v[segment])
+                            for c, v in st.columns.items()}
     return tables
 
 

@@ -380,13 +380,19 @@ class Binder:
     def bind_table_ref(self, ref: ast.TableRefNode, scope: Scope,
                        post_filters: list[ast.ExprNode]) -> tuple[str, N.PlanNode]:
         if isinstance(ref, ast.TableName):
+            view = self.catalog.views.get(ref.name.lower())
+            if view is not None:
+                # view expansion: re-bind the stored query as a derived table
+                return self.bind_table_ref(
+                    ast.DerivedTable(view, ref.alias or ref.name),
+                    scope, post_filters)
             table = self._lookup_table(ref.name)
             alias = ref.alias or ref.name
             plan = _scan_node(table, alias)
             scope.entries.append(RangeEntry(alias, plan))
             return alias, plan
         if isinstance(ref, ast.DerivedTable):
-            sub = self.bind_select(ref.select)
+            sub = self.bind_query(ref.select)
             alias = ref.alias
             # re-qualify output names under the derived alias
             proj = N.PProject(sub, [(f"{alias}.{f.name.split('.')[-1]}",

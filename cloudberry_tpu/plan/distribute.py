@@ -30,6 +30,97 @@ from cloudberry_tpu.plan.sharding import Sharding
 from cloudberry_tpu.types import DType, FLOAT64, INT64
 
 
+def direct_dispatch_segment(plan: N.PlanNode, session):
+    """The cdbtargeteddispatch.c analog: if every partitioned scan is
+    filtered by equality literals covering its FULL distribution key set and
+    all scans route to the same segment, the statement can run on that one
+    segment with no collectives at all. Returns the segment id or None."""
+    import numpy as np
+
+    from cloudberry_tpu.utils import hashing
+
+    nseg = session.config.n_segments
+    segs: set[int] = set()
+
+    def conjuncts(e: ex.Expr):
+        if isinstance(e, ex.BinOp) and e.op == "and":
+            yield from conjuncts(e.left)
+            yield from conjuncts(e.right)
+        else:
+            yield e
+
+    def visit(node: N.PlanNode, preds: tuple) -> bool:
+        if isinstance(node, N.PFilter):
+            return visit(node.child, preds + (node.predicate,))
+        if isinstance(node, N.PScan):
+            if node.table_name == "$dual":
+                return True
+            table = session.catalog.table(node.table_name)
+            if table.policy.kind == "replicated":
+                return True
+            if table.policy.kind != "hashed":
+                return False
+            eq: dict[str, ex.Literal] = {}
+            for p in preds:
+                for c in conjuncts(p):
+                    if isinstance(c, ex.BinOp) and c.op == "=":
+                        l, r = c.left, c.right
+                        if isinstance(r, ex.ColumnRef) and \
+                                isinstance(l, ex.Literal):
+                            l, r = r, l
+                        if isinstance(l, ex.ColumnRef) and \
+                                isinstance(r, ex.Literal):
+                            eq[l.name] = r
+            try:
+                key_names = [node.column_map[k] for k in table.policy.keys]
+            except KeyError:
+                return False
+            if not all(k in eq for k in key_names):
+                return False
+            cols = []
+            for k, phys in zip(key_names, table.policy.keys):
+                dt = table.schema.field(phys).type.np_dtype
+                cols.append(np.asarray([eq[k].value], dtype=dt))
+            h = hashing.hash_columns_np(cols)
+            segs.add(int(hashing.jump_consistent_hash_np(h, nseg)[0]))
+            return True
+        return all(visit(c, ()) for c in node.children())
+
+    if not visit(plan, ()):
+        return None
+    for e in _all_exprs(plan):
+        for sub in ex.walk(e):
+            if isinstance(sub, ex.SubqueryScalar):
+                return None  # subquery plans may scan other segments
+    if len(segs) != 1:
+        return None
+    return next(iter(segs))
+
+
+def _all_exprs(plan: N.PlanNode):
+    yield from _node_exprs(plan)
+    for c in plan.children():
+        yield from _all_exprs(c)
+
+
+def apply_direct_dispatch(plan: N.PlanNode, session, seg: int) -> N.PlanNode:
+    """Rewrite scans for single-shard execution (capacities become the
+    shard's) and tag the plan; the executor feeds segment ``seg``'s arrays."""
+    def rewrite(node: N.PlanNode):
+        if isinstance(node, N.PScan) and node.table_name != "$dual":
+            table = session.catalog.table(node.table_name)
+            if table.policy.kind != "replicated":
+                st = session.sharded_table(node.table_name)
+                node.capacity = st.capacity
+                node.num_rows = int(st.counts[seg])
+        for c in node.children():
+            rewrite(c)
+
+    rewrite(plan)
+    plan._direct_segment = seg
+    return plan
+
+
 def distribute_plan(plan: N.PlanNode, session) -> N.PlanNode:
     d = Distributor(session)
     plan, cap = d.walk(plan)

@@ -53,8 +53,13 @@ class PlanNode:
         return type(self).__name__.removeprefix("P")
 
     def explain(self, indent: int = 0) -> str:
-        lines = [" " * indent + "-> " + self.title()
-                 + (f"  [{self.sharding}]" if self.sharding else "")]
+        lines = []
+        seg = getattr(self, "_direct_segment", None)
+        if seg is not None and indent == 0:
+            lines.append(f"Direct dispatch: segment {seg} "
+                         "(point predicate on distribution key)")
+        lines.append(" " * indent + "-> " + self.title()
+                     + (f"  [{self.sharding}]" if self.sharding else ""))
         for c in self.children():
             lines.append(c.explain(indent + 3))
         return "\n".join(lines)

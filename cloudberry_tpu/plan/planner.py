@@ -32,6 +32,8 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
     catalog = session.catalog
 
     if isinstance(stmt, ast.CreateTable):
+        if stmt.name.lower() in catalog.views:
+            raise BindError(f"{stmt.name!r} already exists as a view")
         fields = []
         for c in stmt.columns:
             t = T.SQL_TYPE_MAP.get(c.type_name)
@@ -48,6 +50,25 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
         catalog.create_table(stmt.name, Schema(tuple(fields)), policy,
                              if_not_exists=stmt.if_not_exists)
         return PlanResult(is_ddl=True, ddl_result=f"CREATE TABLE {stmt.name}")
+
+    if isinstance(stmt, ast.CreateView):
+        if stmt.name.lower() in catalog.tables:
+            raise BindError(f"{stmt.name!r} already exists as a table")
+        if stmt.name.lower() in catalog.views:
+            raise BindError(f"view {stmt.name!r} already exists "
+                            "(no OR REPLACE yet)")
+        catalog.views[stmt.name.lower()] = stmt.query
+        catalog.bump_ddl()
+        return PlanResult(is_ddl=True, ddl_result=f"CREATE VIEW {stmt.name}")
+
+    if isinstance(stmt, ast.DropView):
+        if stmt.name.lower() not in catalog.views:
+            if stmt.if_exists:
+                return PlanResult(is_ddl=True, ddl_result="DROP VIEW")
+            raise BindError(f"unknown view {stmt.name!r}")
+        del catalog.views[stmt.name.lower()]
+        catalog.bump_ddl()
+        return PlanResult(is_ddl=True, ddl_result=f"DROP VIEW {stmt.name}")
 
     if isinstance(stmt, ast.DropTable):
         catalog.drop_table(stmt.name, if_exists=stmt.if_exists)
@@ -207,6 +228,14 @@ def _optimize(plan: N.PlanNode, session) -> N.PlanNode:
     from cloudberry_tpu.plan.prune import prune_plan
 
     plan = prune_plan(plan)
+    if session.config.n_segments > 1 \
+            and session.config.planner.enable_direct_dispatch:
+        from cloudberry_tpu.plan.distribute import (apply_direct_dispatch,
+                                                    direct_dispatch_segment)
+
+        seg = direct_dispatch_segment(plan, session)
+        if seg is not None:
+            return apply_direct_dispatch(plan, session, seg)
     return _distribute(plan, session)
 
 

@@ -86,8 +86,9 @@ class Session:
         entry = self._stmt_cache.get(query)
         if entry is None:
             return None
-        names, versions, nseg, runner = entry
-        stale = nseg != self.config.n_segments
+        names, versions, nseg, ddlv, runner = entry
+        stale = (nseg != self.config.n_segments
+                 or ddlv != self.catalog.ddl_version)
         if not stale:
             try:
                 stale = self._table_versions(names) != versions
@@ -102,7 +103,12 @@ class Session:
         from cloudberry_tpu.exec import executor as X
 
         names = sorted({s.table_name for s in X.scans_of(plan)})
-        if self.config.n_segments > 1:
+        seg = getattr(plan, "_direct_segment", None)
+        if seg is not None:
+            exe = X.compile_plan(plan, self)
+            runner = lambda: X.run_executable(
+                exe, X.prepare_tables(exe.table_names, self, segment=seg))
+        elif self.config.n_segments > 1:
             from cloudberry_tpu.exec.dist_executor import (
                 compile_distributed, execute_distributed)
 
@@ -118,7 +124,7 @@ class Session:
             self._stmt_cache.pop(next(iter(self._stmt_cache)))
         self._stmt_cache[query] = (
             names, self._table_versions(names),
-            self.config.n_segments, runner)
+            self.config.n_segments, self.catalog.ddl_version, runner)
         return runner()
 
     def explain(self, query: str) -> str:

@@ -254,6 +254,18 @@ class CreateTable(Node):
 
 
 @dataclass
+class CreateView(Node):
+    name: str
+    query: Node  # Select or SetOp
+
+
+@dataclass
+class DropView(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class DropTable(Node):
     name: str
     if_exists: bool = False

@@ -93,12 +93,17 @@ class Parser:
             return self.parse_create_table()
         if self.at_kw("drop"):
             self.advance()
-            self.expect_kw("table")
+            is_view = bool(self.accept_kw("view"))
+            if not is_view:
+                self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):
                 self.expect_kw("exists")
                 if_exists = True
-            return ast.DropTable(self.expect_ident(), if_exists)
+            name = self.expect_ident()
+            if is_view:
+                return ast.DropView(name, if_exists)
+            return ast.DropTable(name, if_exists)
         if self.at_kw("insert"):
             return self.parse_insert()
         if self.at_kw("update"):
@@ -111,8 +116,12 @@ class Parser:
             return ast.Delete(table, where)
         raise ParseError(f"unsupported statement start {self.cur.text!r}")
 
-    def parse_create_table(self) -> ast.CreateTable:
+    def parse_create_table(self):
         self.expect_kw("create")
+        if self.accept_kw("view"):
+            name = self.expect_ident()
+            self.expect_kw("as")
+            return ast.CreateView(name, self.parse_query())
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):

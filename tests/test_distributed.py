@@ -94,3 +94,29 @@ def test_left_join_replicated_probe_partitioned_build():
     exp = run(1)
     assert got["x"].tolist() == exp["x"].tolist() == [1, 2, 3, 4, 5]
     assert got["v"].tolist() == exp["v"].tolist()
+
+
+def test_direct_dispatch_point_query():
+    s = cb.Session(Config(n_segments=8))
+    s.sql("create table pk_t (id bigint, payload decimal(10,2)) distributed by (id)")
+    s.sql("insert into pk_t values " + ",".join(f"({i}, {i}.25)" for i in range(200)))
+    # point query on the distribution key: no motions, single-shard exec
+    text = s.explain("select payload from pk_t where id = 42")
+    assert "Direct dispatch: segment" in text
+    assert "Motion" not in text
+    df = s.sql("select payload from pk_t where id = 42").to_pandas()
+    assert df["payload"].tolist() == [42.25]
+    # every key routes correctly (exercises all segments)
+    for k in [0, 7, 63, 199]:
+        got = s.sql(f"select payload from pk_t where id = {k}").to_pandas()
+        assert got["payload"].tolist() == [k + 0.25]
+    # non-point query still distributes
+    text2 = s.explain("select sum(payload) from pk_t where id > 5")
+    assert "Direct dispatch" not in text2 and "Motion" in text2
+    # disabled by config -> no direct dispatch
+    s2 = cb.Session(Config(n_segments=8).with_overrides(
+        **{"planner.enable_direct_dispatch": False}))
+    s2.sql("create table pk_t (id bigint, payload decimal(10,2)) distributed by (id)")
+    s2.sql("insert into pk_t values (1, 1.0)")
+    assert "Direct dispatch" not in s2.explain(
+        "select payload from pk_t where id = 1")

@@ -233,13 +233,13 @@ def test_statement_cache_reuse_and_invalidation(sess):
     sess.sql("insert into sc values (1),(2),(3)")
     q = "select sum(k) as s from sc"
     assert sess.sql(q).to_pandas()["s"][0] == 6
-    runner1 = sess._stmt_cache[q][3]
+    runner1 = sess._stmt_cache[q][4]
     assert sess.sql(q).to_pandas()["s"][0] == 6
-    assert sess._stmt_cache[q][3] is runner1  # reused, not rebuilt
+    assert sess._stmt_cache[q][4] is runner1  # reused, not rebuilt
     # DML bumps the table version -> cache invalidated, result fresh
     sess.sql("insert into sc values (10)")
     assert sess.sql(q).to_pandas()["s"][0] == 16
-    assert sess._stmt_cache[q][3] is not runner1
+    assert sess._stmt_cache[q][4] is not runner1
 
 
 def test_statement_cache_drop_recreate_not_stale(sess):
@@ -252,3 +252,39 @@ def test_statement_cache_drop_recreate_not_stale(sess):
     sess.sql("insert into scd values ('b'),('z'),('z')")
     # recreated table: dictionary codes differ; cache must NOT replay
     assert int(sess.sql(q).to_pandas()["n"][0]) == 1
+
+
+def test_views(sess):
+    sess.sql("create table vt (k int, v decimal(10,2))")
+    sess.sql("insert into vt values (1,10.0),(2,20.0),(1,5.0)")
+    sess.sql("create view vsum as select k, sum(v) as total from vt group by k")
+    df = sess.sql("select k, total from vsum where total > 12 order by k").to_pandas()
+    assert list(zip(df.k, df.total)) == [(1, 15.0), (2, 20.0)]
+    # views track base-table changes (re-bound per statement)
+    sess.sql("insert into vt values (2, 1.0)")
+    df = sess.sql("select total from vsum where k = 2").to_pandas()
+    assert df["total"].tolist() == [21.0]
+    # view joins a table
+    df = sess.sql("""select a.k from vsum a, vt b
+                     where a.k = b.k and b.v = 5.0""").to_pandas()
+    assert df["k"].tolist() == [1]
+    sess.sql("drop view vsum")
+    with pytest.raises(Exception):
+        sess.sql("select * from vsum")
+
+
+def test_view_ddl_invalidates_cache(sess):
+    sess.sql("create table vb1 (x int)"); sess.sql("insert into vb1 values (1)")
+    sess.sql("create table vb2 (x int)"); sess.sql("insert into vb2 values (2)")
+    sess.sql("create view vv as select x from vb1")
+    q = "select x from vv"
+    assert sess.sql(q).to_pandas()["x"].tolist() == [1]
+    sess.sql("drop view vv")
+    sess.sql("create view vv as select x from vb2")
+    assert sess.sql(q).to_pandas()["x"].tolist() == [2]  # not the stale plan
+    with pytest.raises(BindError):
+        sess.sql("create view vv as select 1")  # no OR REPLACE
+    with pytest.raises(BindError):
+        sess.sql("drop view no_such_view")
+    with pytest.raises(BindError):
+        sess.sql("create table vv (y int)")  # view shadow guard

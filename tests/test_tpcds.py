@@ -103,11 +103,17 @@ def test_tpcds_query(ds_session, qname):
     assert_frames_match(got, exp, qname)
 
 
-@pytest.mark.parametrize("qname", sorted(DS_QUERIES))
-def test_tpcds_distributed(qname):
+@pytest.fixture(scope="module")
+def ds_dist_session():
     s = cb.Session(Config(n_segments=8))
     load_tpcds(s, scale=0.5, seed=11)
     tables = {n: t.to_pandas() for n, t in s.catalog.tables.items()}
+    return s, tables
+
+
+@pytest.mark.parametrize("qname", sorted(DS_QUERIES))
+def test_tpcds_distributed(ds_dist_session, qname):
+    s, tables = ds_dist_session
     got = s.sql(DS_QUERIES[qname]).to_pandas()
     exp = ORACLES[qname](tables)
     assert_frames_match(got, exp, qname)
