@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from cloudberry_tpu.columnar.batch import ColumnBatch
 from cloudberry_tpu.exec import executor as X
+from cloudberry_tpu.exec import kernels as K
 from cloudberry_tpu.exec.expr_compile import compile_expr
 from cloudberry_tpu.parallel.mesh import SEG_AXIS, segment_mesh
 from cloudberry_tpu.plan import nodes as N
@@ -128,6 +129,12 @@ class DistLowerer(X.Lowerer):
 
     def motion(self, node: N.PMotion):
         cols, sel = self.lower(node.child)
+        if node.pre_compact:
+            cols, sel, n = K.compact(cols, sel, node.pre_compact)
+            self.checks[
+                f"pre-gather compaction truncated rows (node {id(node)}): "
+                "local top-N emitted more than its limit"] = \
+                n > node.pre_compact
         if node.kind in ("gather", "broadcast"):
             out = {n: jax.lax.all_gather(c, SEG_AXIS, axis=0, tiled=True)
                    for n, c in cols.items()}

@@ -583,28 +583,8 @@ def _pallas_pad(a, tile):
 
 
 def _substitute_subqueries(e: ex.Expr, mapping: dict[int, str]) -> ex.Expr:
-    """Rebuild an expression tree with SubqueryScalar nodes replaced by
-    ColumnRefs into the augmented column dict."""
-    if isinstance(e, ex.SubqueryScalar):
-        return ex.ColumnRef(mapping[id(e)], e.dtype)
-    if isinstance(e, ex.BinOp):
-        return ex.BinOp(e.op, _substitute_subqueries(e.left, mapping),
-                        _substitute_subqueries(e.right, mapping), e.dtype)
-    if isinstance(e, ex.UnaryOp):
-        return ex.UnaryOp(e.op, _substitute_subqueries(e.operand, mapping),
-                          e.dtype)
-    if isinstance(e, ex.Cast):
-        return ex.Cast(_substitute_subqueries(e.operand, mapping), e.dtype)
-    if isinstance(e, ex.Func):
-        return ex.Func(e.name, tuple(_substitute_subqueries(a, mapping)
-                                     for a in e.args), e.dtype)
-    if isinstance(e, ex.CaseWhen):
-        return ex.CaseWhen(
-            tuple((_substitute_subqueries(c, mapping),
-                   _substitute_subqueries(v, mapping)) for c, v in e.whens),
-            _substitute_subqueries(e.otherwise, mapping)
-            if e.otherwise is not None else None, e.dtype)
-    if isinstance(e, ex.DictLookup):
-        return ex.DictLookup(_substitute_subqueries(e.column, mapping),
-                             e.table, e.dtype)
-    return e
+    """Replace SubqueryScalar nodes with ColumnRefs into the augmented
+    column dict (generic rewriter: new node types flow through)."""
+    return ex.rewrite(
+        e, lambda n: ex.ColumnRef(mapping[id(n)], n.dtype)
+        if isinstance(n, ex.SubqueryScalar) else None)

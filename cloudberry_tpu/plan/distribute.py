@@ -163,6 +163,35 @@ class Distributor:
             node.sharding = child.sharding
             return node, cap
         if isinstance(node, N.PLimit):
+            k = node.limit + node.offset
+            if isinstance(node.child, N.PSort) and 0 < k <= (1 << 20):
+                self._walk_subqueries(node.child)  # sort keys' subqueries
+                # top-N pushdown (the merge-sorted-receive analog,
+                # execMotionSortedReceiver): each segment sorts and keeps its
+                # own top k, compacts to k rows, THEN gathers — the
+                # coordinator merges k·nseg rows instead of whole shards
+                srt = node.child
+                inner, icap = self.walk(srt.child)
+                if inner.sharding.is_partitioned and k < icap:
+                    local_sort = N.PSort(inner, list(srt.keys))
+                    local_sort.fields = list(inner.fields)
+                    local_sort.sharding = inner.sharding
+                    local_top = N.PLimit(local_sort, k)
+                    local_top.fields = list(inner.fields)
+                    local_top.sharding = inner.sharding
+                    m, _ = self.gather(local_top, k)
+                    m.pre_compact = k
+                    srt.child = m
+                    srt.sharding = m.sharding
+                    node.sharding = m.sharding
+                    return node, m.out_capacity
+                # fall through: finish as a plain gathered sort+limit
+                if inner.sharding.is_partitioned:
+                    inner, icap = self.gather(inner, icap)
+                srt.child = inner
+                srt.sharding = inner.sharding
+                node.sharding = inner.sharding
+                return node, icap
             child, cap = self.walk(node.child)
             if child.sharding.is_partitioned:
                 child, cap = self.gather(child, cap)

@@ -156,6 +156,37 @@ class AggCall:
         return self.arg.dtype
 
 
+def rewrite(e: Expr, fn) -> Expr:
+    """Top-down structural rewrite: ``fn(node)`` returns a replacement or
+    None to recurse. THE one place that knows how to rebuild each node —
+    substitution passes must use this instead of hand-rolled per-class
+    copies (which silently skip newly added node types)."""
+    out = fn(e)
+    if out is not None:
+        return out
+    if isinstance(e, BinOp):
+        return BinOp(e.op, rewrite(e.left, fn), rewrite(e.right, fn), e.dtype)
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, rewrite(e.operand, fn), e.dtype)
+    if isinstance(e, Cast):
+        return Cast(rewrite(e.operand, fn), e.dtype)
+    if isinstance(e, Func):
+        return Func(e.name, tuple(rewrite(a, fn) for a in e.args), e.dtype)
+    if isinstance(e, CaseWhen):
+        return CaseWhen(
+            tuple((rewrite(c, fn), rewrite(v, fn)) for c, v in e.whens),
+            rewrite(e.otherwise, fn) if e.otherwise is not None else None,
+            e.dtype)
+    if isinstance(e, DictLookup):
+        out = DictLookup(rewrite(e.column, fn), e.table, e.dtype)
+        d = getattr(e, "_out_dict", None)
+        if d is not None:
+            object.__setattr__(out, "_out_dict", d)
+        return out
+    # leaves (ColumnRef, Literal, IsValid, SubqueryScalar) pass through
+    return e
+
+
 def walk(e: Expr):
     yield e
     for c in e.children():

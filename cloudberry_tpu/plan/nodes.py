@@ -214,6 +214,9 @@ class PMotion(PlanNode):
     # set by the distribution pass:
     out_capacity: int = 0   # receive-side array capacity
     bucket_cap: int = 0     # per-destination bucket capacity (redistribute)
+    # compact selected rows to this capacity BEFORE the collective (top-N
+    # pushdown: gather k·nseg rows instead of whole shards); 0 = off
+    pre_compact: int = 0
 
     def children(self):
         return [self.child]

@@ -51,6 +51,9 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
                              if_not_exists=stmt.if_not_exists)
         return PlanResult(is_ddl=True, ddl_result=f"CREATE TABLE {stmt.name}")
 
+    if isinstance(stmt, ast.CreateTableAs):
+        return PlanResult(is_ddl=True, ddl_result=_ctas(session, stmt))
+
     if isinstance(stmt, ast.CreateView):
         if stmt.name.lower() in catalog.tables:
             raise BindError(f"{stmt.name!r} already exists as a table")
@@ -195,6 +198,36 @@ def _update(session, stmt: ast.Update) -> str:
         new_data[f.name] = arr.astype(f.type.np_dtype)
     table.set_data(new_data, dicts)
     return f"UPDATE {n_upd}"
+
+
+def _ctas(session, stmt: ast.CreateTableAs) -> str:
+    """CREATE TABLE AS: materialize the query, derive the schema from its
+    output fields, place per the DISTRIBUTED clause."""
+    if stmt.name.lower() in session.catalog.views:
+        raise BindError(f"{stmt.name!r} already exists as a view")
+    if stmt.name.lower() in session.catalog.tables:
+        if stmt.if_not_exists:
+            return f"CREATE TABLE {stmt.name} (exists, skipped)"
+        raise BindError(f"table {stmt.name!r} already exists")
+    batch = _run_internal(session, stmt.query)
+    policy = {
+        "hash": DistributionPolicy.hashed(*stmt.dist_keys),
+        "replicated": DistributionPolicy.replicated(),
+        "random": DistributionPolicy.random(),
+    }[stmt.distribution]
+    if stmt.distribution == "hash":
+        missing = set(stmt.dist_keys) - set(batch.schema.names)
+        if missing:
+            raise BindError(f"distribution key(s) {sorted(missing)} not in "
+                            "the query output")
+    t = session.catalog.create_table(stmt.name, batch.schema, policy)
+    sel = np.asarray(batch.sel)
+    data = {}
+    for f in batch.schema.fields:
+        data[f.name] = np.asarray(batch.columns[f.name])[sel] \
+            .astype(f.type.np_dtype)
+    t.set_data(data, dict(batch.dicts))
+    return f"SELECT {int(sel.sum())}"
 
 
 def _insert_select(session, stmt: ast.InsertSelect) -> str:

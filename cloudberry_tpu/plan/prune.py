@@ -1,10 +1,14 @@
-"""Column pruning — the targetlist-narrowing the reference's planner does
-(and PAX's column projection exploits, SURVEY §2.5): each node keeps only
-the columns its ancestors actually use. On TPU this directly cuts HBM
-traffic — every pruned column is one less array scanned, gathered through
-joins, permuted by sorts, and shuffled by motions.
+"""Plan rewrites that run before distribution:
 
-Run BEFORE the distribution pass so motions move only live columns.
+- predicate pushdown through projections (qual pushdown): a filter whose
+  columns are simple renames in the projection below moves under it —
+  filters reach scans, which unlocks direct dispatch through views and
+  shrinks every downstream intermediate;
+- column pruning — the targetlist-narrowing the reference's planner does
+  (and PAX's column projection exploits, SURVEY §2.5): each node keeps only
+  the columns its ancestors actually use. On TPU this directly cuts HBM
+  traffic — every pruned column is one less array scanned, gathered through
+  joins, permuted by sorts, and shuffled by motions.
 """
 
 from __future__ import annotations
@@ -14,8 +18,43 @@ from cloudberry_tpu.plan import nodes as N
 
 
 def prune_plan(plan: N.PlanNode) -> N.PlanNode:
+    plan = _pushdown(plan)
     _prune(plan, set(plan.names))
     return plan
+
+
+def _pushdown(node: N.PlanNode) -> N.PlanNode:
+    """Move PFilter under PProject when every referenced column is a plain
+    rename (ColumnRef) in the projection."""
+    # rewrite children first
+    if isinstance(node, N.PFilter):
+        node.child = _pushdown(node.child)
+        child = node.child
+        if isinstance(child, N.PProject):
+            renames = {n: e for n, e in child.exprs
+                       if isinstance(e, ex.ColumnRef)}
+            used = ex.columns_used(node.predicate)
+            if used <= set(renames):
+                new_pred = _substitute_cols(
+                    node.predicate, {n: renames[n] for n in used})
+                inner = N.PFilter(child.child, new_pred)
+                inner.fields = list(child.child.fields)
+                child.child = _pushdown(inner)
+                return child
+        return node
+    for attr in ("child", "build", "probe"):
+        c = getattr(node, attr, None)
+        if c is not None:
+            setattr(node, attr, _pushdown(c))
+    if isinstance(node, N.PConcat):
+        node.inputs = [_pushdown(c) for c in node.inputs]
+    return node
+
+
+def _substitute_cols(e: ex.Expr, mapping: dict[str, ex.Expr]) -> ex.Expr:
+    return ex.rewrite(
+        e, lambda n: mapping.get(n.name)
+        if isinstance(n, ex.ColumnRef) else None)
 
 
 def _expr_cols(e: ex.Expr) -> set[str]:

@@ -254,6 +254,15 @@ class CreateTable(Node):
 
 
 @dataclass
+class CreateTableAs(Node):
+    name: str
+    query: Node
+    distribution: str = "random"
+    dist_keys: tuple[str, ...] = ()
+    if_not_exists: bool = False
+
+
+@dataclass
 class CreateView(Node):
     name: str
     query: Node  # Select or SetOp

@@ -129,6 +129,15 @@ class Parser:
             self.expect_kw("exists")
             if_not_exists = True
         name = self.expect_ident()
+        if self.at_kw("as") or self.at_kw("distributed"):
+            # CREATE TABLE name [DISTRIBUTED ...] AS query  /  name AS query
+            distribution, keys = self._parse_distribution()
+            self.expect_kw("as")
+            q = self.parse_query()
+            if distribution is None:
+                distribution, keys = self._parse_distribution()
+            return ast.CreateTableAs(name, q, distribution or "random",
+                                     keys or (), if_not_exists)
         self.expect_op("(")
         cols = []
         while True:
@@ -149,22 +158,25 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        distribution, keys = "random", ()
-        if self.accept_kw("distributed"):
-            if self.accept_kw("by"):
-                self.expect_op("(")
-                ks = [self.expect_ident()]
-                while self.accept_op(","):
-                    ks.append(self.expect_ident())
-                self.expect_op(")")
-                distribution, keys = "hash", tuple(ks)
-            elif self.accept_kw("replicated"):
-                distribution = "replicated"
-            elif self.accept_kw("randomly"):
-                distribution = "random"
-            else:
-                raise ParseError("expected BY/REPLICATED/RANDOMLY after DISTRIBUTED")
-        return ast.CreateTable(name, cols, distribution, keys, if_not_exists)
+        distribution, keys = self._parse_distribution()
+        return ast.CreateTable(name, cols, distribution or "random",
+                               keys or (), if_not_exists)
+
+    def _parse_distribution(self):
+        if not self.accept_kw("distributed"):
+            return None, None
+        if self.accept_kw("by"):
+            self.expect_op("(")
+            ks = [self.expect_ident()]
+            while self.accept_op(","):
+                ks.append(self.expect_ident())
+            self.expect_op(")")
+            return "hash", tuple(ks)
+        if self.accept_kw("replicated"):
+            return "replicated", ()
+        if self.accept_kw("randomly"):
+            return "random", ()
+        raise ParseError("expected BY/REPLICATED/RANDOMLY after DISTRIBUTED")
 
     def parse_insert(self):
         self.expect_kw("insert")
@@ -304,7 +316,7 @@ class Parser:
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()
-        elif self.cur.kind == "ident" and not self.at_kw(*_CLAUSE_KWS):
+        elif self.cur.kind == "ident" and self.cur.text not in _RESERVED:
             alias = self.advance().text
         return ast.SelectItem(e, alias)
 
@@ -357,9 +369,7 @@ class Parser:
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()
-        elif (self.cur.kind == "ident"
-              and not self.at_kw(*_CLAUSE_KWS, "inner", "left", "right",
-                                 "full", "cross", "join", "on")):
+        elif self.cur.kind == "ident" and self.cur.text not in _RESERVED:
             alias = self.advance().text
         return ast.TableName(name, alias)
 

@@ -120,3 +120,20 @@ def test_direct_dispatch_point_query():
     s2.sql("insert into pk_t values (1, 1.0)")
     assert "Direct dispatch" not in s2.explain(
         "select payload from pk_t where id = 1")
+
+
+def test_topn_pushdown():
+    s = cb.Session(Config(n_segments=8))
+    s.sql("create table tn (k bigint, v bigint) distributed by (k)")
+    s.sql("insert into tn values " + ",".join(f"({i},{(i*37)%1000})" for i in range(400)))
+    text = s.explain("select k, v from tn order by v desc limit 5")
+    # local Sort+Limit below the gather; final sort above it
+    gather_idx = text.index("Motion gather")
+    assert "Limit 5" in text[gather_idx:], text
+    got = s.sql("select k, v from tn order by v desc, k limit 5").to_pandas()
+    s1 = cb.Session()
+    s1.sql("create table tn (k bigint, v bigint) distributed by (k)")
+    s1.sql("insert into tn values " + ",".join(f"({i},{(i*37)%1000})" for i in range(400)))
+    exp = s1.sql("select k, v from tn order by v desc, k limit 5").to_pandas()
+    assert got["k"].tolist() == exp["k"].tolist()
+    assert got["v"].tolist() == exp["v"].tolist()

@@ -288,3 +288,30 @@ def test_view_ddl_invalidates_cache(sess):
         sess.sql("drop view no_such_view")
     with pytest.raises(BindError):
         sess.sql("create table vv (y int)")  # view shadow guard
+
+
+def test_create_table_as_select(sess):
+    sess.sql("create table base (k int, s text, v decimal(10,2))")
+    sess.sql("insert into base values (1,'a',10.0),(2,'b',20.0),(3,'a',5.0)")
+    out = sess.sql("""create table summary distributed by (s) as
+                      select s, sum(v) as total, count(*) as n
+                      from base group by s""")
+    assert out == "SELECT 2"
+    df = sess.sql("select s, total, n from summary order by s").to_pandas()
+    assert list(zip(df.s, df.total, df.n)) == [("a", 15.0, 2), ("b", 20.0, 1)]
+    from cloudberry_tpu.catalog.catalog import DistributionPolicy
+    assert sess.catalog.table("summary").policy == DistributionPolicy.hashed("s")
+    with pytest.raises(BindError):
+        sess.sql("create table bad distributed by (nope) as select s from base")
+
+
+def test_ctas_trailing_distributed_and_if_not_exists(sess):
+    sess.sql("create table cb2 (k int)"); sess.sql("insert into cb2 values (1),(2)")
+    # canonical trailing DISTRIBUTED BY form (query ends in a table name)
+    sess.sql("create table c2 as select k from cb2 distributed by (k)")
+    assert len(sess.sql("select k from c2").to_pandas()) == 2
+    # IF NOT EXISTS no-ops on rerun
+    out = sess.sql("create table if not exists c2 as select k from cb2")
+    assert "skipped" in out
+    with pytest.raises(BindError):
+        sess.sql("create table c2 as select k from cb2")
