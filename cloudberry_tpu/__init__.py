@@ -21,7 +21,7 @@ flow, static shapes with selection masks, ``lax`` control flow only.
 import jax
 
 # 64-bit support: analytical SQL needs int64 keys and f64 aggregates.
-# On TPU, f64 is emulated — hot kernels downcast per Config.exec.compute_dtype.
+# On TPU f64 is emulated, so hot paths stay on int64 fixed-point / f32.
 jax.config.update("jax_enable_x64", True)
 
 from cloudberry_tpu.config import Config, get_config, set_config  # noqa: E402

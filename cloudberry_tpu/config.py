@@ -21,39 +21,23 @@ class InterconnectConfig:
     """Motion transport knobs (reference: gp_interconnect_* GUCs,
     contrib/interconnect/ic_modules.c:26-160 vtable selection)."""
 
-    # 'ici'      — XLA collectives inside shard_map (the default transport)
-    # 'loopback' — single-device host loopback used by tests (MotionIPCLayer seam)
-    backend: str = "ici"
     # Per-destination bucket capacity for hash redistribute, as a multiple of
     # fair share (local_rows / n_segments). The moral equivalent of the UDP
     # interconnect's capacity-based flow control (ic_udpifc.c:3018-3040):
     # rows over capacity are detected and reported, not silently dropped.
     capacity_factor: float = 2.0
-    # Use ragged_all_to_all when available instead of padded all_to_all.
-    ragged: bool = False
 
 
 @dataclass(frozen=True)
 class ExecConfig:
-    """Executor shape/dtype discipline (XLA: static shapes only)."""
+    """Executor shape/dtype discipline (XLA: static shapes only).
 
-    # Default tile capacity for intermediate results when not inferable.
-    batch_capacity: int = 1 << 20
-    # Group-by output capacity when the planner cannot bound cardinality.
-    # (None → same as input capacity: always correct, more memory.)
-    agg_capacity: int | None = None
-    # Float compute dtype on device. f64 is emulated on TPU; money columns
-    # keep exactness via int64-cent accumulation regardless of this setting.
-    compute_dtype: str = "float64"
-    # Sum aggregates over decimal columns accumulate in int64 fixed-point.
-    exact_decimal_agg: bool = True
-    # Runtime bloom-style filters pushed from join build to probe scan
-    # (reference: nodeRuntimeFilter.c).
-    enable_runtime_filters: bool = True
+    Planned-but-unwired knobs live in docs/DESIGN.md's gap list, not here —
+    every field below is read by the engine."""
+
     # Fused Pallas dense-aggregation kernel (exec/pallas_kernels.py):
-    # float32 MXU accumulation — pair with compute_dtype='float32'; off by
-    # default until re-measured on hardware (exact int64 money sums need
-    # the XLA path).
+    # float32 MXU accumulation; off by default until re-measured on hardware
+    # (exact int64 money sums need the XLA path).
     use_pallas: bool = False
 
 
@@ -68,8 +52,6 @@ class PlannerConfig:
     # Prune dispatch to a single segment for point predicates on the
     # distribution key (reference: cdbtargeteddispatch.c).
     enable_direct_dispatch: bool = True
-    # Two/three-stage aggregation (reference: cdbgroupingpaths.c).
-    enable_multistage_agg: bool = True
 
 
 @dataclass(frozen=True)
@@ -93,7 +75,7 @@ class Config:
 
     def with_overrides(self, **kv: Any) -> "Config":
         """Return a copy with dotted-path overrides, e.g.
-        ``cfg.with_overrides(**{"exec.compute_dtype": "float32"})``."""
+        ``cfg.with_overrides(**{"exec.use_pallas": True})``."""
         out = self
         for path, value in kv.items():
             parts = path.split(".")

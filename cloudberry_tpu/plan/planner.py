@@ -93,6 +93,12 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
         plan = _optimize(plan, session)
         return PlanResult(plan=plan)
 
+    if isinstance(stmt, ast.CopyFrom):
+        return PlanResult(is_ddl=True, ddl_result=_copy_from(session, stmt))
+
+    if isinstance(stmt, ast.CopyTo):
+        return PlanResult(is_ddl=True, ddl_result=_copy_to(session, stmt))
+
     if isinstance(stmt, ast.Delete):
         return PlanResult(is_ddl=True, ddl_result=_delete(session, stmt))
 
@@ -117,6 +123,126 @@ def _run_internal(session, query: ast.Node):
     check_admission(plan, session)
     with session._gate:
         return execute(plan, session)
+
+
+def _copy_from(session, stmt: ast.CopyFrom) -> str:
+    """Delimited-file ingest (the COPY / gpfdist load path): numeric and
+    decimal columns parse through the native C++ codec
+    (cloudberry_tpu.native), strings/dates through the host splitter."""
+    from cloudberry_tpu import native
+    from cloudberry_tpu.columnar.batch import encode_column
+
+    table = session.catalog.table(stmt.table)
+    with open(stmt.path, "rb") as fh:
+        buf = fh.read()
+    if stmt.header:
+        nl = buf.find(b"\n")
+        buf = buf[nl + 1:] if nl >= 0 else b""
+    d = stmt.delimiter
+    fields = table.schema.fields
+    text_cols: dict[int, list] = {}
+    need_text = [i for i, f in enumerate(fields)
+                 if f.dtype in (T.DType.STRING, T.DType.DATE,
+                                T.DType.BOOL, T.DType.FLOAT64)]
+    if need_text:
+        db = d.encode()
+        rows = [ln.split(db) for ln in buf.splitlines() if ln]
+        for i in need_text:
+            try:
+                text_cols[i] = [r[i].decode() for r in rows]
+            except IndexError:
+                raise BindError(
+                    f"COPY: a line has fewer than {i + 1} columns")
+    parsed: dict[str, np.ndarray] = {}
+    n_rows = None
+    for i, f in enumerate(fields):
+        if f.dtype in (T.DType.INT32, T.DType.INT64):
+            arr = native.parse_int64_column(buf, i, d).astype(f.type.np_dtype)
+        elif f.dtype == T.DType.DECIMAL:
+            # already int64 fixed-point at the field's scale (physical form)
+            arr = native.parse_decimal_column(buf, i, f.type.scale, d)
+        elif f.dtype == T.DType.FLOAT64:
+            try:
+                arr = np.asarray([float(v) for v in text_cols[i]])
+            except ValueError:
+                raise BindError(
+                    f"COPY: malformed double in column {f.name!r}")
+        elif f.dtype == T.DType.BOOL:
+            vals = []
+            for v in text_cols[i]:
+                lv = v.lower()
+                if lv in ("t", "true", "1"):
+                    vals.append(True)
+                elif lv in ("f", "false", "0"):
+                    vals.append(False)
+                else:
+                    raise BindError(
+                        f"COPY: malformed boolean {v!r} in column "
+                        f"{f.name!r}")
+            arr = np.asarray(vals)
+        else:  # STRING / DATE encode via the shared column encoder
+            arr = encode_column(np.asarray(text_cols[i], dtype=object),
+                                f, table.dicts)
+        if n_rows is None:
+            n_rows = len(arr)
+        elif len(arr) != n_rows:
+            raise BindError(
+                f"COPY: column {f.name!r} parsed {len(arr)} rows, "
+                f"expected {n_rows} (malformed file?)")
+        old = table.data.get(f.name)
+        parsed[f.name] = arr if old is None or len(old) == 0 \
+            else np.concatenate([old, arr])
+    table.set_data(parsed, table.dicts)
+    return f"COPY {n_rows or 0}"
+
+
+def _copy_to(session, stmt: ast.CopyTo) -> str:
+    """Delimited-file unload (COPY TO / writable-external analog).
+    Decimals format from their raw int64 fixed-point (never through float,
+    which would round past 2^53); values containing the delimiter or a
+    newline are rejected rather than silently corrupting the file."""
+    from cloudberry_tpu.types import days_to_date
+
+    table = session.catalog.table(stmt.table)
+    n = table.num_rows
+    d = stmt.delimiter
+    cols = []
+    for f in table.schema.fields:
+        arr = table.data[f.name]
+        if f.dtype == T.DType.DECIMAL:
+            cols.append([_fmt_decimal(int(v), f.type.scale) for v in arr])
+        elif f.dtype == T.DType.DATE:
+            cols.append([str(days_to_date(int(v))) for v in arr])
+        elif f.dtype == T.DType.STRING:
+            values = table.dicts[f.name].values if f.name in table.dicts \
+                else []
+            out = []
+            for code in arr:
+                v = values[code]
+                if d in v or "\n" in v:
+                    raise BindError(
+                        f"COPY TO: value in column {f.name!r} contains the "
+                        "delimiter or a newline; choose another DELIMITER")
+                out.append(v)
+            cols.append(out)
+        elif f.dtype == T.DType.FLOAT64:
+            cols.append([repr(float(v)) for v in arr])
+        else:
+            cols.append([str(v) for v in arr])
+    with open(stmt.path, "w") as fh:
+        if stmt.header:
+            fh.write(d.join(table.schema.names) + "\n")
+        for i in range(n):
+            fh.write(d.join(c[i] for c in cols) + "\n")
+    return f"COPY {n}"
+
+
+def _fmt_decimal(raw: int, scale: int) -> str:
+    if scale == 0:
+        return str(raw)
+    sign = "-" if raw < 0 else ""
+    raw = abs(raw)
+    return f"{sign}{raw // 10 ** scale}.{raw % 10 ** scale:0{scale}d}"
 
 
 def _delete(session, stmt: ast.Delete) -> str:
@@ -295,8 +421,14 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
             by_col[c].append(_literal_value(v))
     new_data = {}
     for f in table.schema.fields:
-        vals = np.asarray(by_col[f.name])
-        arr = encode_column(vals, f, table.dicts)
+        raw = by_col[f.name]
+        if f.dtype == T.DType.DECIMAL:
+            # exact fixed-point from the literal TEXT — a float round-trip
+            # loses precision beyond 2^53 (e.g. decimal(18,2) near 9e13)
+            arr = np.asarray([_exact_decimal(v, f.type.scale) for v in raw],
+                             dtype=np.int64)
+        else:
+            arr = encode_column(np.asarray(raw), f, table.dicts)
         old = table.data.get(f.name)
         new_data[f.name] = arr if old is None or len(old) == 0 \
             else np.concatenate([old, arr])
@@ -304,10 +436,25 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
     return f"INSERT {len(stmt.rows)}"
 
 
+def _exact_decimal(v, scale: int) -> int:
+    """Literal text/int → int64 fixed-point, digit-exact."""
+    text = str(v)
+    neg = text.startswith("-")
+    if neg:
+        text = text[1:]
+    if "e" in text.lower():
+        raise BindError("scientific notation not supported for DECIMAL "
+                        "literals (write the digits out)")
+    whole, _, frac = text.partition(".")
+    frac = (frac + "0" * scale)[:scale]
+    out = int(whole or "0") * 10 ** scale + (int(frac) if frac else 0)
+    return -out if neg else out
+
+
 def _literal_value(e: ast.ExprNode):
     if isinstance(e, ast.NumberLit):
-        return float(e.text) if "." in e.text or "e" in e.text.lower() \
-            else int(e.text)
+        # keep numeric literal TEXT so decimal targets stay digit-exact
+        return e.text
     if isinstance(e, ast.StringLit):
         return e.value
     if isinstance(e, ast.DateLit):
@@ -315,5 +462,6 @@ def _literal_value(e: ast.ExprNode):
     if isinstance(e, ast.BoolLit):
         return e.value
     if isinstance(e, ast.UnaryOp) and e.op == "-":
-        return -_literal_value(e.operand)
+        inner = _literal_value(e.operand)
+        return f"-{inner}" if isinstance(inner, str) else -inner
     raise BindError("INSERT VALUES must be literals")

@@ -308,6 +308,22 @@ class Delete(Node):
 
 
 @dataclass
+class CopyFrom(Node):
+    table: str
+    path: str
+    delimiter: str = "|"
+    header: bool = False
+
+
+@dataclass
+class CopyTo(Node):
+    table: str
+    path: str
+    delimiter: str = "|"
+    header: bool = False
+
+
+@dataclass
 class Explain(Node):
     stmt: Select
     analyze: bool = False

@@ -106,6 +106,8 @@ class Parser:
             return ast.DropTable(name, if_exists)
         if self.at_kw("insert"):
             return self.parse_insert()
+        if self.at_kw("copy"):
+            return self.parse_copy()
         if self.at_kw("update"):
             return self.parse_update()
         if self.at_kw("delete"):
@@ -202,6 +204,29 @@ class Parser:
             if not self.accept_op(","):
                 break
         return ast.InsertValues(table, columns, rows)
+
+    def parse_copy(self):
+        self.expect_kw("copy")
+        table = self.expect_ident()
+        direction = self.accept_kw("from", "to")
+        if direction is None:
+            raise ParseError("expected FROM or TO after COPY <table>")
+        if self.cur.kind != "string":
+            raise ParseError("COPY path must be a string literal")
+        path = self.advance().text
+        delim, header = "|", False
+        self.accept_kw("with")
+        while True:
+            if self.accept_kw("delimiter"):
+                if self.cur.kind != "string" or len(self.cur.text) != 1:
+                    raise ParseError("DELIMITER must be a 1-char string")
+                delim = self.advance().text
+            elif self.accept_kw("header"):
+                header = True
+            else:
+                break
+        cls = ast.CopyFrom if direction == "from" else ast.CopyTo
+        return cls(table, path, delim, header)
 
     def parse_update(self) -> ast.Update:
         self.expect_kw("update")

@@ -315,3 +315,51 @@ def test_ctas_trailing_distributed_and_if_not_exists(sess):
     assert "skipped" in out
     with pytest.raises(BindError):
         sess.sql("create table c2 as select k from cb2")
+
+
+def test_copy_from_and_to(sess, tmp_path):
+    p = tmp_path / "in.tbl"
+    p.write_text("1|9.99|apple|2024-01-05\n"
+                 "2|12.50|pear|2024-02-01\n"
+                 "3|0.07|fig|2024-01-20\n")
+    sess.sql("create table cp (id bigint, price decimal(10,2), name text, d date)")
+    out = sess.sql(f"copy cp from '{p}'")
+    assert out == "COPY 3"
+    df = sess.sql("select id, price, name from cp order by id").to_pandas()
+    assert df["price"].tolist() == [9.99, 12.50, 0.07]
+    assert df["name"].tolist() == ["apple", "pear", "fig"]
+    # append semantics + header + custom delimiter
+    p2 = tmp_path / "in2.csv"
+    p2.write_text("id,price,name,d\n4,1.25,kiwi,2024-03-01\n")
+    assert sess.sql(f"copy cp from '{p2}' with delimiter ',' header") == "COPY 1"
+    assert len(sess.sql("select id from cp").to_pandas()) == 4
+    # unload round-trip
+    p3 = tmp_path / "out.tbl"
+    assert sess.sql(f"copy cp to '{p3}'") == "COPY 4"
+    sess.sql("create table cp2 (id bigint, price decimal(10,2), name text, d date)")
+    assert sess.sql(f"copy cp2 from '{p3}'") == "COPY 4"
+    a = sess.sql("select sum(price) as s from cp").to_pandas()["s"][0]
+    b = sess.sql("select sum(price) as s from cp2").to_pandas()["s"][0]
+    assert a == b
+
+
+def test_copy_edge_cases(sess, tmp_path):
+    sess.sql("create table ce (b boolean, f double, s text)")
+    bad = tmp_path / "b.tbl"
+    bad.write_text("maybe|1.5|x\n")
+    with pytest.raises(BindError):
+        sess.sql(f"copy ce from '{bad}'")  # bad boolean rejected
+    bad2 = tmp_path / "b2.tbl"
+    bad2.write_text("true|oops|x\n")
+    with pytest.raises(BindError):
+        sess.sql(f"copy ce from '{bad2}'")  # bad double rejected
+    # delimiter inside a string value refuses to unload corruptly
+    sess.sql("insert into ce values (true, 1.0, 'a|b')")
+    with pytest.raises(BindError):
+        sess.sql(f"copy ce to '{tmp_path / 'o.tbl'}'")
+    # big exact decimal round-trips through COPY TO text
+    sess.sql("create table bd (v decimal(18,2))")
+    sess.sql("insert into bd values (90071992547409.93)")
+    out = tmp_path / "bd.tbl"
+    sess.sql(f"copy bd to '{out}'")
+    assert out.read_text().strip() == "90071992547409.93"
