@@ -23,12 +23,16 @@ from cloudberry_tpu.types import DType, Field, Schema, date_to_days
 
 @dataclass
 class ColumnBatch:
-    """Host-facing container; executors work on the raw ``columns``/``sel``."""
+    """Host-facing container; executors work on the raw ``columns``/``sel``.
+
+    ``validity``: per-column bool arrays for nullable (outer-join) columns —
+    False rows render as NULL."""
 
     schema: Schema
     columns: dict[str, Any]          # name -> (capacity,) array (np or jax)
     sel: Any                         # (capacity,) bool array
     dicts: dict[str, StringDictionary] = field(default_factory=dict)
+    validity: dict[str, Any] = field(default_factory=dict)
 
     @property
     def capacity(self) -> int:
@@ -76,7 +80,14 @@ class ColumnBatch:
         out = {}
         for f in self.schema.fields:
             arr = np.asarray(self.columns[f.name])[sel]
-            out[f.name] = decode_column(arr, f, self.dicts)
+            col = decode_column(arr, f, self.dicts)
+            vm = self.validity.get(f.name)
+            if vm is not None:
+                invalid = ~np.asarray(vm)[sel]
+                if invalid.any():
+                    col = np.asarray(col, dtype=object)
+                    col[invalid] = None
+            out[f.name] = col
         return pd.DataFrame(out)
 
 

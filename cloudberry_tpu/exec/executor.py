@@ -93,11 +93,17 @@ def raise_checks(checks: dict) -> None:
 
 
 def make_batch(plan: N.PlanNode, cols, sel) -> ColumnBatch:
-    fields = tuple(Field(f.name, f.type) for f in plan.fields)
-    dicts = {f.name: f.sdict for f in plan.fields if f.sdict is not None}
+    shown = [f for f in plan.fields if not f.name.startswith("$vm")]
+    fields = tuple(Field(f.name, f.type) for f in shown)
+    dicts = {f.name: f.sdict for f in shown if f.sdict is not None}
+    validity = {}
+    for f in shown:
+        nm = f.null_mask
+        if nm and nm != "$lost" and nm in cols:
+            validity[f.name] = np.asarray(cols[nm])
     return ColumnBatch(Schema(fields),
-                       {k: np.asarray(v) for k, v in cols.items()},
-                       np.asarray(sel), dicts)
+                       {f.name: np.asarray(cols[f.name]) for f in shown},
+                       np.asarray(sel), dicts, validity=validity)
 
 
 def scans_of(plan: N.PlanNode):
@@ -368,23 +374,40 @@ class Lowerer:
     def _join_expand(self, node: N.PJoin, bcols, bsel, bkeys,
                      pcols, psel, pkeys):
         """Many-to-many expansion: one output row per match pair; LEFT joins
-        append unmatched (preserved) probe rows after the pairs."""
+        append unmatched (preserved) probe rows after the pairs; FULL joins
+        append unmatched rows from BOTH sides."""
         cap = node.out_capacity
         pi, bi, osel, matched, total = K.join_expand(
             bkeys, bsel, pkeys, psel, cap)
         need = total
         is_pair = osel
-        if node.kind == "left":
+        j = jnp.arange(cap, dtype=total.dtype)
+        probe_valid = osel  # rows whose probe columns are real
+        if node.kind in ("left", "full"):
             um = psel & ~matched
             um_rank = jnp.cumsum(um.astype(total.dtype)) - 1
             n_um = jnp.sum(um.astype(total.dtype))
             slot = jnp.where(um, total + um_rank, cap)
             pi = pi.at[slot].set(jnp.arange(um.shape[0], dtype=pi.dtype),
                                  mode="drop")
-            j = jnp.arange(cap, dtype=total.dtype)
             osel = j < (total + n_um)
             is_pair = j < total
+            probe_valid = osel
             need = total + n_um
+            if node.kind == "full":
+                bmatched = jnp.zeros(bsel.shape, dtype=jnp.bool_)
+                bmatched = bmatched.at[bi].max(is_pair, mode="drop")
+                um_b = bsel & ~bmatched
+                umb_rank = jnp.cumsum(um_b.astype(total.dtype)) - 1
+                n_umb = jnp.sum(um_b.astype(total.dtype))
+                slot_b = jnp.where(um_b, total + n_um + umb_rank, cap)
+                bi = bi.at[slot_b].set(
+                    jnp.arange(um_b.shape[0], dtype=bi.dtype), mode="drop")
+                osel = j < (total + n_um + n_umb)
+                # build columns are real for pairs AND the build-only region
+                is_pair = (j < total) | (j >= total + n_um)
+                probe_valid = j < (total + n_um)
+                need = total + n_um + n_umb
         elif node.kind != "inner":
             raise ExecError(f"expansion join does not support {node.kind}")
         self.checks[
@@ -393,13 +416,20 @@ class Lowerer:
 
         cols = {}
         for name, c in pcols.items():
-            cols[name] = jnp.take(c, pi, axis=0)
+            g = jnp.take(c, pi, axis=0)
+            if node.kind == "full":
+                # zero the build-only region; other kinds exclude those
+                # rows via the selection mask already
+                g = jnp.where(probe_valid, g, jnp.zeros((), dtype=g.dtype))
+            cols[name] = g
         for name in node.build_payload:
             g = jnp.take(bcols[name], bi, axis=0)
             cols[name] = jnp.where(is_pair, g,
                                    jnp.zeros((), dtype=g.dtype))
         if node.match_name:
             cols[node.match_name] = is_pair
+        if node.probe_match_name:
+            cols[node.probe_match_name] = probe_valid
         return cols, osel
 
     def agg(self, node: N.PAgg):

@@ -341,7 +341,7 @@ class Binder:
                            for f in child.fields]
 
         # -------- ORDER BY / LIMIT
-        visible = list(plan.fields)
+        visible = list(plan.fields)  # includes hidden $vm validity columns
         if sel.order_by:
             keys = []
             for oi in sel.order_by:
@@ -398,9 +398,14 @@ class Binder:
             proj = N.PProject(sub, [(f"{alias}.{f.name.split('.')[-1]}",
                                      ex.ColumnRef(f.name, f.type))
                                     for f in sub.fields])
+            def _remap_mask(nm):
+                if nm in (None, "$lost"):
+                    return nm
+                return f"{alias}.{nm.split('.')[-1]}"
+
             proj.fields = [N.PlanField(f"{alias}.{f.name.split('.')[-1]}",
                                        f.type, f.sdict,
-                                       null_mask=f.null_mask)
+                                       null_mask=_remap_mask(f.null_mask))
                            for f in sub.fields]
             scope.entries.append(RangeEntry(alias, proj))
             return alias, proj
@@ -432,6 +437,9 @@ class Binder:
             residual.append(c)
         if not lkeys:
             raise BindError("JOIN requires at least one equi-condition")
+        if ref.kind == "full" and residual:
+            raise BindError("FULL JOIN with non-equi ON conditions is not "
+                            "supported yet")
         if ref.kind in ("left", "right"):
             # ON-clause extras must filter the NON-preserved side BEFORE the
             # join (post-join filtering would drop preserved rows)
@@ -468,6 +476,11 @@ class Binder:
             plan = self._make_join("left", rplan, lplan, rkeys, lkeys)
         elif ref.kind == "right":
             plan = self._make_join("left", lplan, rplan, lkeys, rkeys)
+        elif ref.kind == "full":
+            if _plan_capacity(lplan) <= _plan_capacity(rplan):
+                plan = self._make_join("full", lplan, rplan, lkeys, rkeys)
+            else:
+                plan = self._make_join("full", rplan, lplan, rkeys, lkeys)
         else:
             raise BindError(f"{ref.kind} join not supported yet")
         for c in residual:
@@ -600,20 +613,45 @@ class Binder:
                    ) -> N.PJoin:
         # semi/anti only filter the probe side: no build columns in output
         payload = [f.name for f in build.fields] \
-            if kind in ("inner", "left") else []
+            if kind in ("inner", "left", "full") else []
         match_name = self.gensym("match")
         j = N.PJoin(kind, build, probe, build_keys, probe_keys,
                     payload, match_name)
         # semi/anti joins only test membership — build duplicates are fine;
-        # inner/left joins with a non-unique build need pair expansion
-        if kind in ("inner", "left") \
-                and not _build_is_unique(build, build_keys, self.catalog):
+        # inner/left joins with a non-unique build need pair expansion;
+        # FULL joins always expand (both-side unmatched regions)
+        if kind == "full" or (kind in ("inner", "left")
+                              and not _build_is_unique(build, build_keys,
+                                                       self.catalog)):
             j.unique_build = False
             j.out_capacity = _plan_capacity(build) + _plan_capacity(probe)
-        nm = match_name if kind == "left" else None
-        j.fields = list(probe.fields) + [
-            N.PlanField(f.name, f.type, f.sdict, null_mask=nm)
-            for f in build.fields if kind in ("inner", "left")]
+        nm = match_name if kind in ("left", "full") else None
+        pm = self.gensym("pmatch") if kind == "full" else None
+        j.probe_match_name = pm
+
+        def _merge_mask(new_mask, old_mask):
+            # nullable through BOTH this join and an earlier one would need
+            # a combined mask column — mark provenance lost (honest error /
+            # NULL-render skip) rather than pick one arbitrarily
+            if new_mask is None:
+                return old_mask
+            if old_mask is None:
+                return new_mask
+            return "$lost"
+
+        j.fields = [
+            N.PlanField(f.name, f.type, f.sdict,
+                        null_mask=_merge_mask(pm, f.null_mask))
+            for f in probe.fields] + [
+            N.PlanField(f.name, f.type, f.sdict,
+                        null_mask=_merge_mask(nm, f.null_mask))
+            for f in build.fields if kind in ("inner", "left", "full")]
+        # expose the validity masks as (hidden, $-prefixed) columns so
+        # downstream projections can carry them to the result surface
+        if nm is not None:
+            j.fields.append(N.PlanField(nm, T.BOOL, None))
+        if pm is not None:
+            j.fields.append(N.PlanField(pm, T.BOOL, None))
         return j
 
     def _filter(self, child: N.PlanNode, pred: ex.Expr) -> N.PFilter:
@@ -761,8 +799,8 @@ class Binder:
                     if item.expr.table and e.alias != item.expr.table:
                         continue
                     for f in e.plan.fields:
-                        if f.name in seen_sources:
-                            continue  # entries rebound to one merged plan
+                        if f.name in seen_sources or f.name.startswith("$"):
+                            continue  # merged-plan dupes / internal masks
                         seen_sources.add(f.name)
                         name = _uniquify(f.name.split(".")[-1], taken)
                         exprs.append((name, _colref(f)))
@@ -775,8 +813,13 @@ class Binder:
             name = _uniquify(name, taken)
             exprs.append((name, bound))
             nm = getattr(bound, "_null_mask", None)
+            if nm is None and getattr(bound, "_null_expr", None) is not None:
+                nm = "$expr"
             fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound),
-                                      null_mask="$lost" if nm else None))
+                                      null_mask=nm))
+        # nullable outputs: project their validity masks as hidden columns
+        # ("$vm..."), so NULLs render correctly at the result surface
+        exprs, fields = _attach_validity_outputs(self, exprs, fields, scope)
         proj = N.PProject(plan, exprs)
         proj.fields = fields
         self._rewritten_order = {}
@@ -1002,6 +1045,8 @@ class Binder:
             return self._bind_uncorrelated_scalar(node)
 
         if isinstance(node, ast.FuncCall):
+            if node.name == "coalesce":
+                return self._bind_coalesce(node, scope)
             if node.name == "sqrt":
                 arg = self._coerce(b(node.args[0]), T.FLOAT64)
                 return ex.Func("sqrt", (arg,), T.FLOAT64)
@@ -1322,6 +1367,47 @@ class Binder:
             cmp = ex.UnaryOp("not", cmp, T.BOOL)
         out = self._filter(j, cmp)
         out.fields = list(plan.fields)  # drop subplan columns from output
+        return out
+
+    def _bind_coalesce(self, node: ast.FuncCall, scope: Scope) -> ex.Expr:
+        """COALESCE over nullable (outer-join) operands: first VALID value
+        wins, validity read from the operands' masks. Operands without a
+        mask are never null, so anything after the first such operand is
+        dead."""
+        if not node.args:
+            raise BindError("coalesce() requires at least one argument")
+        bound = [self.bind_scalar(a, scope) for a in node.args]
+        rtype = _common_type([b.dtype for b in bound])
+        coerced = []
+        for b in bound:
+            mask = getattr(b, "_null_mask", None)
+            if mask == "$lost":
+                raise BindError("coalesce over a column whose null "
+                                "provenance was lost (derived table) is "
+                                "not supported yet")
+            c = self._coerce(b, rtype) if b.dtype != rtype else b
+            if mask is not None and c is not b:
+                object.__setattr__(c, "_null_mask", mask)  # survive casts
+            coerced.append(c)
+        out = None
+        all_masked = True
+        masks = []
+        for b in reversed(coerced):
+            mask = getattr(b, "_null_mask", None)
+            if mask is None:
+                all_masked = False
+                out = b  # never-null operand: later fallbacks are dead
+                continue
+            masks.append(mask)
+            out = b if out is None else \
+                ex.CaseWhen(((ex.IsValid(mask), b),), out, rtype)
+        if all_masked and masks:
+            # result is NULL only when EVERY operand is: validity = OR of
+            # the operand masks, carried as an expression for the output
+            valid: ex.Expr = ex.IsValid(masks[0])
+            for m in masks[1:]:
+                valid = ex.BinOp("or", valid, ex.IsValid(m), T.BOOL)
+            object.__setattr__(out, "_null_expr", valid)
         return out
 
     def _bind_substring(self, node: ast.SubstringExpr, scope: Scope) -> ex.Expr:
@@ -1685,6 +1771,41 @@ def _ast_key(node: ast.Node) -> str:
         else:
             parts.append(f"{k}={v!r}")
     return "(" + " ".join(parts) + ")"
+
+
+def _attach_validity_outputs(binder, exprs, fields, scope):
+    """For output fields whose source is nullable (outer-join column or a
+    COALESCE over only-nullable operands), add the validity as a hidden bool
+    output ("$vm…") and point the field at it."""
+    mask_out: dict[str, str] = {}
+    new_fields = []
+    for (name, bound), f in zip(list(exprs), fields):
+        nm = f.null_mask
+        if nm is None or nm == "$lost":
+            new_fields.append(f)
+            continue
+        if nm == "$expr":
+            hidden = binder.gensym("vm")  # "$vm<n>", deterministic
+            exprs.append((hidden, getattr(bound, "_null_expr")))
+            new_fields.append(N.PlanField(f.name, f.type, f.sdict,
+                                          null_mask=hidden))
+            mask_out[hidden] = hidden
+            continue
+        if nm not in mask_out:
+            hidden = binder.gensym("vm")
+            try:
+                mref = binder.bind_scalar(ast.Name((nm,)), scope)
+            except BindError:
+                new_fields.append(N.PlanField(f.name, f.type, f.sdict,
+                                              null_mask="$lost"))
+                continue
+            exprs.append((hidden, mref))
+            mask_out[nm] = hidden
+        new_fields.append(N.PlanField(f.name, f.type, f.sdict,
+                                      null_mask=mask_out[nm]))
+    for hidden in dict.fromkeys(mask_out.values()):
+        new_fields.append(N.PlanField(hidden, T.BOOL, None))
+    return exprs, new_fields
 
 
 def _uniquify(name: str, taken: set[str]) -> str:

@@ -306,6 +306,25 @@ class Distributor:
         probe, pcap = self.walk(node.probe)
         bsh, psh = build.sharding, probe.sharding
 
+        if node.kind == "full":
+            # FULL join emits unmatched rows from BOTH sides exactly once:
+            # broadcast/replicated inputs would duplicate them per segment,
+            # so require key colocation or gather both sides
+            if not (bsh.is_partitioned and psh.is_partitioned
+                    and _join_colocated(node, bsh, psh)):
+                if bsh.is_partitioned:
+                    build, bcap = self.gather(build, bcap)
+                if psh.is_partitioned:
+                    probe, pcap = self.gather(probe, pcap)
+                node.build = build
+                node.probe = probe
+                node.sharding = Sharding.singleton()
+                return node, _join_out_cap(node, bcap, pcap)
+            node.build = build
+            node.probe = probe
+            node.sharding = psh
+            return node, _join_out_cap(node, bcap, pcap)
+
         b_part = bsh.is_partitioned
         p_part = psh.is_partitioned
 

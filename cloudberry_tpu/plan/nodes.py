@@ -103,7 +103,7 @@ class PJoin(PlanNode):
     - unique_build=False: many-to-many expansion (one output row per match
       pair) at ``out_capacity`` with overflow detection."""
 
-    kind: str  # 'inner' | 'left' | 'semi' | 'anti'
+    kind: str  # 'inner' | 'left' | 'full' | 'semi' | 'anti'
     build: PlanNode
     probe: PlanNode
     build_keys: list[ex.Expr]
@@ -112,6 +112,9 @@ class PJoin(PlanNode):
     build_payload: list[str] = dc_field(default_factory=list)
     # name of the bool match-mask output column (left join null tests)
     match_name: Optional[str] = None
+    # FULL joins: validity mask for the probe side (rows synthesized from
+    # unmatched build rows have NULL probe columns)
+    probe_match_name: Optional[str] = None
     unique_build: bool = True
     out_capacity: int = 0  # expansion joins only
     # semi/anti residual predicate over (probe cols + build cols) — the

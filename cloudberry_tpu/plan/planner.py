@@ -422,13 +422,23 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
     new_data = {}
     for f in table.schema.fields:
         raw = by_col[f.name]
-        if f.dtype == T.DType.DECIMAL:
-            # exact fixed-point from the literal TEXT — a float round-trip
-            # loses precision beyond 2^53 (e.g. decimal(18,2) near 9e13)
-            arr = np.asarray([_exact_decimal(v, f.type.scale) for v in raw],
-                             dtype=np.int64)
-        else:
-            arr = encode_column(np.asarray(raw), f, table.dicts)
+        try:
+            if f.dtype == T.DType.DECIMAL:
+                # exact fixed-point from the literal TEXT — a float
+                # round-trip loses precision beyond 2^53
+                arr = np.asarray(
+                    [_exact_decimal(v, f.type.scale) for v in raw],
+                    dtype=np.int64)
+            elif f.dtype in (T.DType.INT32, T.DType.INT64):
+                arr = np.asarray([int(round(float(v))) for v in raw]) \
+                    .astype(f.type.np_dtype)
+            elif f.dtype == T.DType.FLOAT64:
+                arr = np.asarray([float(v) for v in raw])
+            else:
+                arr = encode_column(np.asarray(raw), f, table.dicts)
+        except (ValueError, TypeError) as e2:
+            raise BindError(
+                f"INSERT: bad literal for column {f.name!r}: {e2}")
         old = table.data.get(f.name)
         new_data[f.name] = arr if old is None or len(old) == 0 \
             else np.concatenate([old, arr])
@@ -446,8 +456,11 @@ def _exact_decimal(v, scale: int) -> int:
         raise BindError("scientific notation not supported for DECIMAL "
                         "literals (write the digits out)")
     whole, _, frac = text.partition(".")
-    frac = (frac + "0" * scale)[:scale]
-    out = int(whole or "0") * 10 ** scale + (int(frac) if frac else 0)
+    frac_digits = frac + "0" * (scale + 1)
+    kept, next_digit = frac_digits[:scale], frac_digits[scale]
+    out = int(whole or "0") * 10 ** scale + (int(kept) if kept else 0)
+    if next_digit >= "5":
+        out += 1  # round half up, matching PostgreSQL numeric
     return -out if neg else out
 
 

@@ -363,3 +363,95 @@ def test_copy_edge_cases(sess, tmp_path):
     out = tmp_path / "bd.tbl"
     sess.sql(f"copy bd to '{out}'")
     assert out.read_text().strip() == "90071992547409.93"
+
+
+def test_full_outer_join(sess):
+    sess.sql("create table fa (k int, a int)")
+    sess.sql("insert into fa values (1,10),(2,20),(3,30)")
+    sess.sql("create table fb (k int, b int)")
+    sess.sql("insert into fb values (2,200),(3,300),(4,400),(2,201)")
+    df = sess.sql("""select fa.k, a, b from fa full join fb on fa.k = fb.k
+                     order by a, b""").to_pandas()
+    # pairs: (2,20,200),(2,20,201),(3,30,300); probe-only (1,10,-);
+    # build-only (-,-,400) — zeros stand in for NULL values, masks track
+    assert len(df) == 5
+    # IS NULL works on both sides
+    df2 = sess.sql("""select a from fa full join fb on fa.k = fb.k
+                      where b is null""").to_pandas()
+    assert df2["a"].tolist() == [10]
+    df3 = sess.sql("""select b from fa full join fb on fa.k = fb.k
+                      where a is null""").to_pandas()
+    assert df3["b"].tolist() == [400]
+    # counts are null-aware on both sides
+    df4 = sess.sql("""select count(a) as ca, count(b) as cb, count(*) as n
+                      from fa full join fb on fa.k = fb.k""").to_pandas()
+    assert (int(df4.ca[0]), int(df4.cb[0]), int(df4.n[0])) == (4, 4, 5)
+
+
+def test_full_outer_join_distributed():
+    s = cb.Session(cb.Config(n_segments=4))
+    s.sql("create table fa (k bigint, a bigint) distributed by (k)")
+    s.sql("insert into fa values " + ",".join(f"({i},{i})" for i in range(0, 30, 2)))
+    s.sql("create table fb (k bigint, b bigint) distributed by (k)")
+    s.sql("insert into fb values " + ",".join(f"({i},{i*10})" for i in range(0, 30, 3)))
+    got = s.sql("""select count(*) as n, count(a) as ca, count(b) as cb
+                   from fa full join fb on fa.k = fb.k""").to_pandas()
+    # evens 15, multiples-of-3 10, both (mult of 6) 5 -> union 20 rows
+    assert int(got.n[0]) == 20
+    assert int(got.ca[0]) == 15 and int(got.cb[0]) == 10
+
+
+def test_full_join_null_rendering_and_coalesce(sess):
+    sess.sql("create table jl (k int, a text)")
+    sess.sql("insert into jl values (1,'x'),(2,'y')")
+    sess.sql("create table jr (k int, b text)")
+    sess.sql("insert into jr values (2,'p'),(3,'q')")
+    df = sess.sql("""select coalesce(jl.k, jr.k) as k, a, b
+                     from jl full join jr on jl.k = jr.k
+                     order by k""").to_pandas()
+    def norm(vals):
+        return [None if v is None or (isinstance(v, float) and v != v)
+                else v for v in vals]
+
+    assert df["k"].tolist() == [1, 2, 3]
+    assert norm(df["a"]) == ["x", "y", None]
+    assert norm(df["b"]) == [None, "p", "q"]
+    # left join renders NULL for unmatched build columns
+    df2 = sess.sql("select a, b from jl left join jr on jl.k = jr.k "
+                   "order by a").to_pandas()
+    assert norm(df2["b"]) == [None, "p"]
+
+
+def test_coalesce_chains_and_insert_literals(sess):
+    sess.sql("create table cbase (k int)")
+    sess.sql("insert into cbase values (1),(2),(3)")
+    sess.sql("create table cr1 (k int, x bigint)")
+    sess.sql("insert into cr1 values (1, 10)")
+    sess.sql("create table cr2 (k int, y bigint)")
+    sess.sql("insert into cr2 values (3, 300)")
+    df = sess.sql("""select cbase.k, coalesce(x, y) as v
+                     from cbase left join cr1 on cbase.k = cr1.k
+                                left join cr2 on cbase.k = cr2.k
+                     order by cbase.k""").to_pandas()
+    vals = [None if v is None or (isinstance(v, float) and v != v) else int(v)
+            for v in df["v"]]
+    assert vals == [10, None, 300]  # all-null row renders NULL, not 0
+    # mixed-width coalesce keeps masks through coercion
+    sess.sql("create table cw (k int, small integer)")
+    sess.sql("insert into cw values (2, 7)")
+    df2 = sess.sql("""select coalesce(small, x) as v
+                      from cbase left join cw on cbase.k = cw.k
+                                 left join cr1 on cbase.k = cr1.k
+                      order by cbase.k""").to_pandas()
+    v2 = [None if v is None or (isinstance(v, float) and v != v) else int(v)
+          for v in df2["v"]]
+    assert v2 == [10, 7, None]
+    # INSERT literal coercions: rounding + clean errors
+    sess.sql("create table ints (x int)")
+    sess.sql("insert into ints values (2.5), (1e2)")
+    assert sorted(sess.sql("select x from ints").to_pandas().x) == [2, 100]
+    sess.sql("create table decs (v decimal(10,2))")
+    sess.sql("insert into decs values (1.999)")
+    assert sess.sql("select v from decs").to_pandas().v[0] == 2.0
+    with pytest.raises(BindError):
+        sess.sql("insert into ints values ('nope')")
