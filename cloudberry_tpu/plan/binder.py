@@ -806,7 +806,7 @@ class Binder:
                         exprs.append((name, _colref(f)))
                         fields.append(N.PlanField(
                             name, f.type, f.sdict,
-                            null_mask="$lost" if f.null_mask else None))
+                            null_mask=f.null_mask))
                 continue
             bound = self.bind_scalar(item.expr, scope)
             name = item.alias or _default_name(item.expr) or self.gensym("col")
@@ -1389,25 +1389,34 @@ class Binder:
             if mask is not None and c is not b:
                 object.__setattr__(c, "_null_mask", mask)  # survive casts
             coerced.append(c)
+        def validity_of(b):
+            mask = getattr(b, "_null_mask", None)
+            if mask is not None:
+                return ex.IsValid(mask)
+            return getattr(b, "_null_expr", None)  # nested coalesce etc.
+
         out = None
         all_masked = True
-        masks = []
+        vexprs = []
         for b in reversed(coerced):
-            mask = getattr(b, "_null_mask", None)
-            if mask is None:
+            v = validity_of(b)
+            if v is None:
                 all_masked = False
                 out = b  # never-null operand: later fallbacks are dead
                 continue
-            masks.append(mask)
+            vexprs.append(v)
             out = b if out is None else \
-                ex.CaseWhen(((ex.IsValid(mask), b),), out, rtype)
-        if all_masked and masks:
+                ex.CaseWhen(((v, b),), out, rtype)
+        if all_masked and vexprs:
             # result is NULL only when EVERY operand is: validity = OR of
-            # the operand masks, carried as an expression for the output
-            valid: ex.Expr = ex.IsValid(masks[0])
-            for m in masks[1:]:
-                valid = ex.BinOp("or", valid, ex.IsValid(m), T.BOOL)
-            object.__setattr__(out, "_null_expr", valid)
+            # the operand validities, carried for the output surface
+            valid = vexprs[0]
+            for v in vexprs[1:]:
+                valid = ex.BinOp("or", valid, v, T.BOOL)
+            out2 = ex.CaseWhen(tuple(), out, rtype) if isinstance(
+                out, (ex.ColumnRef, ex.Literal)) else out
+            object.__setattr__(out2, "_null_expr", valid)
+            out = out2
         return out
 
     def _bind_substring(self, node: ast.SubstringExpr, scope: Scope) -> ex.Expr:

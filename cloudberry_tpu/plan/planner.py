@@ -93,6 +93,10 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
         plan = _optimize(plan, session)
         return PlanResult(plan=plan)
 
+    if isinstance(stmt, ast.TxnStmt):
+        return PlanResult(is_ddl=True,
+                          ddl_result=session.txn(stmt.kind))
+
     if isinstance(stmt, ast.CopyFrom):
         return PlanResult(is_ddl=True, ddl_result=_copy_from(session, stmt))
 
@@ -430,7 +434,7 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
                     [_exact_decimal(v, f.type.scale) for v in raw],
                     dtype=np.int64)
             elif f.dtype in (T.DType.INT32, T.DType.INT64):
-                arr = np.asarray([int(round(float(v))) for v in raw]) \
+                arr = np.asarray([_int_literal(v) for v in raw]) \
                     .astype(f.type.np_dtype)
             elif f.dtype == T.DType.FLOAT64:
                 arr = np.asarray([float(v) for v in raw])
@@ -462,6 +466,24 @@ def _exact_decimal(v, scale: int) -> int:
     if next_digit >= "5":
         out += 1  # round half up, matching PostgreSQL numeric
     return -out if neg else out
+
+
+def _int_literal(v) -> int:
+    """Literal → int: digit-exact for plain integers (no float round-trip:
+    2^53-adjacent bigints must survive), half-away-from-zero rounding for
+    fractional text, float only for exponent forms."""
+    text = str(v)
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    if "e" in text.lower():
+        import math
+
+        x = float(text)
+        return int(math.floor(x + 0.5)) if x >= 0 else \
+            int(math.ceil(x - 0.5))
+    return _exact_decimal(text, 0)  # digit-exact, rounds half up
 
 
 def _literal_value(e: ast.ExprNode):

@@ -70,6 +70,48 @@ class Session:
         with self._gate:
             return self._execute_and_cache(query, result.plan)
 
+    # ----------------------------------------------------- transactions
+    # Single-session transactions over the in-memory catalog: BEGIN
+    # snapshots every table's (immutable-once-set) data dict plus deep
+    # copies of the mutable string dictionaries and the view registry;
+    # ROLLBACK restores and bumps versions so statement caches invalidate.
+    # The durable-store analog is TableStore's snapshot manifests (atomic
+    # CURRENT commit); this is the session-surface counterpart.
+
+    def txn(self, kind: str) -> str:
+        from cloudberry_tpu.columnar.dictionary import StringDictionary
+        from cloudberry_tpu.plan.binder import BindError
+
+        snap = getattr(self, "_txn_snapshot", None)
+        if kind == "begin":
+            if snap is not None:
+                raise BindError("already in a transaction")
+            self._txn_snapshot = {
+                "tables": {
+                    name: (t, t.data,
+                           {c: StringDictionary(d.values)
+                            for c, d in t.dicts.items()},
+                           t.policy)
+                    for name, t in self.catalog.tables.items()},
+                "views": dict(self.catalog.views),
+            }
+            return "BEGIN"
+        if snap is None:
+            raise BindError(f"{kind.upper()}: no transaction in progress")
+        if kind == "commit":
+            self._txn_snapshot = None
+            return "COMMIT"
+        # rollback
+        self.catalog.tables = {}
+        for name, (t, data, dicts, policy) in snap["tables"].items():
+            t.policy = policy
+            t.set_data(data, dicts)  # bumps version → caches invalidate
+            self.catalog.tables[name] = t
+        self.catalog.views = snap["views"]
+        self.catalog.bump_ddl()
+        self._txn_snapshot = None
+        return "ROLLBACK"
+
     # ------------------------------------------------- statement cache
     # The prepared-statement / plan-cache analog: a repeated query string
     # reuses its compiled XLA program as long as every referenced table's

@@ -324,6 +324,11 @@ class CopyTo(Node):
 
 
 @dataclass
+class TxnStmt(Node):
+    kind: str  # 'begin' | 'commit' | 'rollback'
+
+
+@dataclass
 class Explain(Node):
     stmt: Select
     analyze: bool = False

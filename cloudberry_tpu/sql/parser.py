@@ -106,6 +106,15 @@ class Parser:
             return ast.DropTable(name, if_exists)
         if self.at_kw("insert"):
             return self.parse_insert()
+        if self.at_kw("begin", "commit", "rollback", "abort", "start", "end"):
+            w = self.advance().text
+            if w == "start":
+                self.expect_kw("transaction")
+                w = "begin"
+            else:
+                self.accept_kw("transaction", "work")
+                w = {"abort": "rollback", "end": "commit"}.get(w, w)
+            return ast.TxnStmt(w)
         if self.at_kw("copy"):
             return self.parse_copy()
         if self.at_kw("update"):
