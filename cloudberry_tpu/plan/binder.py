@@ -1378,6 +1378,37 @@ class Binder:
             raise BindError("coalesce() requires at least one argument")
         bound = [self.bind_scalar(a, scope) for a in node.args]
         rtype = _common_type([b.dtype for b in bound])
+        out_dict = None
+        if any(b.dtype.base == DType.STRING for b in bound):
+            if not all(b.dtype.base == DType.STRING for b in bound):
+                raise BindError("coalesce mixes string and non-string "
+                                "operands")
+            rtype = T.STRING
+            # reconcile dictionaries: codes re-based onto one output dict
+            base = next((_expr_dict(b) for b in bound
+                         if _expr_dict(b) is not None), None)
+            out_dict = StringDictionary(base.values if base else ())
+            rebased = []
+            for b in bound:
+                mask = getattr(b, "_null_mask", None)
+                if isinstance(b, ex.Literal) and isinstance(b.value, str):
+                    b2: ex.Expr = ex.Literal(out_dict.add(b.value), T.STRING)
+                else:
+                    d = _expr_dict(b)
+                    if d is None:
+                        raise BindError("string coalesce operand has no "
+                                        "dictionary")
+                    if d.values == out_dict.values[:len(d)]:
+                        b2 = b  # prefix-compatible: codes already valid
+                    else:
+                        xlat = np.fromiter((out_dict.add(v)
+                                            for v in d.values),
+                                           dtype=np.int32, count=len(d))
+                        b2 = ex.DictLookup(b, xlat, T.STRING)
+                    if mask is not None:
+                        object.__setattr__(b2, "_null_mask", mask)
+                rebased.append(b2)
+            bound = rebased
         coerced = []
         for b in bound:
             mask = getattr(b, "_null_mask", None)
@@ -1417,6 +1448,11 @@ class Binder:
                 out, (ex.ColumnRef, ex.Literal)) else out
             object.__setattr__(out2, "_null_expr", valid)
             out = out2
+        if out_dict is not None:
+            out3 = out if not isinstance(out, (ex.ColumnRef, ex.Literal)) \
+                else ex.CaseWhen(tuple(), out, rtype)
+            object.__setattr__(out3, "_out_dict", out_dict)
+            out = out3
         return out
 
     def _bind_substring(self, node: ast.SubstringExpr, scope: Scope) -> ex.Expr:

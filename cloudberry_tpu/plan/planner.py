@@ -440,7 +440,7 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
                 arr = np.asarray([float(v) for v in raw])
             else:
                 arr = encode_column(np.asarray(raw), f, table.dicts)
-        except (ValueError, TypeError) as e2:
+        except (ValueError, TypeError, OverflowError) as e2:
             raise BindError(
                 f"INSERT: bad literal for column {f.name!r}: {e2}")
         old = table.data.get(f.name)

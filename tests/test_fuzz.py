@@ -1,0 +1,106 @@
+"""Deterministic query fuzzing: random-but-seeded simple queries compared
+against pandas (the gptorment.pl stress analog, aimed at planner/executor
+seams rather than load). Every case is reproducible from its index."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+
+N_CASES = 40
+
+
+def _make_session(nseg):
+    s = cb.Session(Config(n_segments=nseg)) if nseg > 1 else cb.Session()
+    rng = np.random.default_rng(99)
+    n = 500
+    k = rng.integers(0, 20, n)
+    g = rng.choice(["aa", "bb", "cc", "dd"], n)
+    v = rng.integers(-1000, 1000, n)
+    d = rng.integers(0, 50, n)
+    s.sql("create table f (k bigint, g text, v bigint, d bigint) "
+          "distributed by (k)")
+    rows = ",".join(f"({a},'{b}',{c},{e})" for a, b, c, e in zip(k, g, v, d))
+    s.sql(f"insert into f values {rows}")
+    df = pd.DataFrame({"k": k, "g": g, "v": v, "d": d})
+    return s, df
+
+
+@pytest.fixture(scope="module")
+def fuzz_single():
+    return _make_session(1)
+
+
+@pytest.fixture(scope="module")
+def fuzz_dist():
+    return _make_session(8)
+
+
+def _gen_case(i):
+    rng = np.random.default_rng(1000 + i)
+    cmp_col = rng.choice(["k", "v", "d"])
+    cmp_op = rng.choice(["<", "<=", ">", ">=", "=", "<>"])
+    cmp_val = int(rng.integers(-500, 500))
+    g_lit = rng.choice(["aa", "bb", "cc", "zz"])
+    conj = rng.choice(["and", "or"])
+    where = (f"({cmp_col} {cmp_op} {cmp_val} {conj} g = '{g_lit}')")
+    mode = rng.choice(["agg", "group", "plain"])
+    if mode == "agg":
+        sql = (f"select count(*) as n, sum(v) as sv, min(d) as md "
+               f"from f where {where}")
+    elif mode == "group":
+        sql = (f"select g, count(*) as n, sum(v) as sv from f "
+               f"where {where} group by g order by g")
+    else:
+        sql = (f"select k, g, v from f where {where} "
+               f"order by k, g, v, d limit 20")
+    pandas_where = where.replace("=", "==").replace("<>", "!=") \
+        .replace("<==", "<=").replace(">==", ">=")
+    return sql, pandas_where, mode
+
+
+def _expect(df, pandas_where, mode):
+    m = df.query(pandas_where)
+    if mode == "agg":
+        return pd.DataFrame({
+            "n": [len(m)], "sv": [m.v.sum() if len(m) else 0],
+            "md": [m.d.min() if len(m) else None]})
+    if mode == "group":
+        out = m.groupby("g", as_index=False).agg(n=("v", "size"),
+                                                 sv=("v", "sum"))
+        return out.sort_values("g").reset_index(drop=True)
+    out = m[["k", "g", "v"]].sort_values(
+        ["k", "g", "v"], kind="stable").head(20)
+    return out.reset_index(drop=True)
+
+
+@pytest.mark.parametrize("i", range(N_CASES))
+def test_fuzz_single(fuzz_single, i):
+    _run_case(fuzz_single, i)
+
+
+@pytest.mark.parametrize("i", range(0, N_CASES, 4))
+def test_fuzz_distributed(fuzz_dist, i):
+    _run_case(fuzz_dist, i)
+
+
+def _run_case(fixture, i):
+    s, df = fixture
+    sql, pw, mode = _gen_case(i)
+    got = s.sql(sql).to_pandas()
+    exp = _expect(df, pw, mode)
+    assert len(got) == len(exp), f"case {i}: {sql}"
+    if mode == "agg":
+        assert int(got.n[0]) == int(exp.n[0]), f"case {i}: {sql}"
+        assert int(got.sv[0]) == int(exp.sv[0]), f"case {i}: {sql}"
+        if int(exp.n[0]) > 0:
+            assert int(got.md[0]) == int(exp.md[0]), f"case {i}: {sql}"
+    elif mode == "group":
+        assert got.g.tolist() == exp.g.tolist(), f"case {i}: {sql}"
+        assert got.n.tolist() == exp.n.tolist(), f"case {i}: {sql}"
+        assert got.sv.tolist() == exp.sv.tolist(), f"case {i}: {sql}"
+    else:
+        for c in ("k", "g", "v"):
+            assert got[c].tolist() == exp[c].tolist(), f"case {i}: {sql}"

@@ -518,3 +518,22 @@ def test_review_fixes_star_nested_coalesce_bigint(sess):
     # long transaction spellings
     sess.sql("begin work"); sess.sql("commit work")
     sess.sql("begin"); sess.sql("rollback transaction")
+
+
+def test_string_coalesce_cross_dict(sess):
+    sess.sql("create table sc_a (k int, a text)")
+    sess.sql("insert into sc_a values (1,'x')")
+    sess.sql("create table sc_b (k int, b text)")
+    sess.sql("insert into sc_b values (2,'q')")
+    df = sess.sql("""select coalesce(a, b) as v
+                     from sc_a full join sc_b on sc_a.k = sc_b.k
+                     order by v""").to_pandas()
+    assert sorted(df.v.tolist()) == ["q", "x"]  # codes re-based, not aliased
+    df2 = sess.sql("""select coalesce(a, 'none') as v
+                      from sc_a full join sc_b on sc_a.k = sc_b.k
+                      order by v""").to_pandas()
+    assert sorted(df2.v.tolist()) == ["none", "x"]
+    # huge int literal -> clean BindError, not OverflowError
+    sess.sql("create table ovf (v bigint)")
+    with pytest.raises(BindError):
+        sess.sql("insert into ovf values (99999999999999999999)")
