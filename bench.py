@@ -205,8 +205,9 @@ def main() -> None:
     # honest zero so a real regression can never masquerade as the stale
     # last-good number.
     if proc.returncode != 0:
+        sf = os.environ.get("BENCH_SF", "1")
         emit({
-            "metric": "tpch_sf1_q1_speedup_vs_cpu_executor",
+            "metric": f"tpch_sf{float(sf):g}_q1_speedup_vs_cpu_executor",
             "value": 0.0,
             "unit": (f"x (ENGINE FAILURE rc={proc.returncode} — "
                      f"see stderr; not an environment problem)"),

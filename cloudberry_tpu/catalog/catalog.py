@@ -58,25 +58,42 @@ class Table:
     data: dict[str, np.ndarray] = field(default_factory=dict)   # host columns
     dicts: dict[str, StringDictionary] = field(default_factory=dict)
     stats: TableStats = field(default_factory=TableStats)
+    # per-column validity (True = value present); absent column = no NULLs.
+    # Invariant: data values are canonicalized to 0 at invalid lanes, so
+    # hashing/placement/grouping see a stable representative.
+    validity: dict[str, np.ndarray] = field(default_factory=dict)
 
     @property
     def num_rows(self) -> int:
         return self.stats.row_count
 
     def set_data(self, data: dict[str, np.ndarray],
-                 dicts: dict[str, StringDictionary] | None = None) -> None:
+                 dicts: dict[str, StringDictionary] | None = None,
+                 validity: dict[str, np.ndarray] | None = None) -> None:
         self.data = data
         self.dicts = dicts or {}
         n = len(next(iter(data.values()))) if data else 0
         self.stats.row_count = n
         self.stats.unique = {}
+        self.validity = {}
+        for c, v in (validity or {}).items():
+            v = np.asarray(v, dtype=np.bool_)
+            if c in data and not v.all():
+                self.validity[c] = v
+                # canonical zero at NULL lanes (placement/grouping stability)
+                data[c] = np.where(v, data[c],
+                                   np.zeros((), dtype=data[c].dtype))
         # globally-unique version: a DROP+CREATE+INSERT sequence must never
         # reproduce an old version (statement caches key on it)
         self._version = next(_VERSION_COUNTER)
         for f in self.schema.fields:
             arr = data.get(f.name)
             if arr is not None and arr.dtype.kind in "if" and n:
-                self.stats.min_max[f.name] = (float(arr.min()), float(arr.max()))
+                vm = self.validity.get(f.name)
+                vals = arr[vm] if vm is not None else arr
+                if len(vals):
+                    self.stats.min_max[f.name] = (float(vals.min()),
+                                                  float(vals.max()))
 
     def is_unique(self, col: str) -> bool:
         """Whether a column's values are distinct (PK detection; the planner
@@ -85,8 +102,9 @@ class Table:
         cached = self.stats.unique.get(col)
         if cached is None:
             arr = self.data.get(col)
-            if arr is None or arr.dtype.kind not in "iuf":
-                cached = False
+            if arr is None or arr.dtype.kind not in "iuf" \
+                    or col in self.validity:
+                cached = False  # nullable columns never count as PKs
             else:
                 cached = bool(len(np.unique(arr)) == len(arr))
             self.stats.unique[col] = cached
@@ -99,7 +117,8 @@ class Table:
         cached = self.stats.unique.get(key)
         if cached is None:
             arrs = [self.data.get(c) for c in cols]
-            if any(a is None or a.dtype.kind not in "iuf" for a in arrs):
+            if any(a is None or a.dtype.kind not in "iuf" for a in arrs) \
+                    or any(c in self.validity for c in cols):
                 cached = False
             elif self.stats.row_count == 0:
                 cached = True
@@ -114,15 +133,21 @@ class Table:
         return cached
 
     def to_pandas(self):
-        """Decode the (already physically-encoded) table data to pandas."""
+        """Decode the (already physically-encoded) table data to pandas;
+        NULL lanes render as None."""
         import pandas as pd
 
         from cloudberry_tpu.columnar.batch import decode_column
 
-        return pd.DataFrame({
-            f.name: decode_column(np.asarray(self.data[f.name]), f, self.dicts)
-            for f in self.schema.fields
-        })
+        out = {}
+        for f in self.schema.fields:
+            col = decode_column(np.asarray(self.data[f.name]), f, self.dicts)
+            vm = self.validity.get(f.name)
+            if vm is not None:
+                col = np.asarray(col, dtype=object)
+                col[~vm] = None
+            out[f.name] = col
+        return pd.DataFrame(out)
 
     def shard_assignment(self, n_segments: int) -> Optional[np.ndarray]:
         """Segment id per row (None for replicated tables).

@@ -80,13 +80,22 @@ class ColumnBatch:
         out = {}
         for f in self.schema.fields:
             arr = np.asarray(self.columns[f.name])[sel]
-            col = decode_column(arr, f, self.dicts)
             vm = self.validity.get(f.name)
+            invalid = None
             if vm is not None:
-                invalid = ~np.asarray(vm)[sel]
-                if invalid.any():
-                    col = np.asarray(col, dtype=object)
-                    col[invalid] = None
+                invalid = ~np.asarray(vm).astype(bool)[sel]
+                if f.dtype == DType.STRING and invalid.any():
+                    # NULL string lanes may hold out-of-dictionary codes
+                    # (e.g. -1 from CASE NULL branches): clamp before decode
+                    arr = np.where(invalid, 0, arr)
+                    d = self.dicts.get(f.name)
+                    if d is not None and len(d) == 0:
+                        out[f.name] = np.full(len(arr), None, dtype=object)
+                        continue
+            col = decode_column(arr, f, self.dicts)
+            if invalid is not None and invalid.any():
+                col = np.asarray(col, dtype=object)
+                col[invalid] = None
             out[f.name] = col
         return pd.DataFrame(out)
 

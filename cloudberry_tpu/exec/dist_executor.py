@@ -116,7 +116,8 @@ class DistLowerer(X.Lowerer):
             return {}, jnp.ones((1,), dtype=jnp.bool_)
         t = self.tables[node.table_name]
         cols = {}
-        for phys, out in node.column_map.items():
+        for phys, out in list(node.column_map.items()) + [
+                (f"$nn:{p}", o) for p, o in node.mask_map.items()]:
             arr = t["$cols"][phys]
             if arr.ndim == 2:      # partitioned: (1, cap) block inside smap
                 arr = arr[0]
@@ -126,6 +127,10 @@ class DistLowerer(X.Lowerer):
         n = t["$nrows"].reshape(())
         sel = jnp.arange(node.capacity) < n
         return cols, sel
+
+    def global_any(self, x):
+        local = jnp.any(x).astype(jnp.int32)
+        return jax.lax.psum(local, SEG_AXIS) > 0
 
     def motion(self, node: N.PMotion):
         cols, sel = self.lower(node.child)

@@ -73,6 +73,9 @@ def prepare_tables(table_names: list[str], session,
         t = session.catalog.table(name)
         if segment is None or t.policy.kind == "replicated":
             tables[name] = {c: jnp.asarray(v) for c, v in t.data.items()}
+            for c, vm in t.validity.items():
+                tables[name][f"$nn:{c}"] = jnp.asarray(
+                    np.asarray(vm, dtype=np.bool_))
         else:
             st = session.sharded_table(name)
             tables[name] = {c: jnp.asarray(v[segment])
@@ -98,9 +101,12 @@ def make_batch(plan: N.PlanNode, cols, sel) -> ColumnBatch:
     dicts = {f.name: f.sdict for f in shown if f.sdict is not None}
     validity = {}
     for f in shown:
-        nm = f.null_mask
-        if nm and nm != "$lost" and nm in cols:
-            validity[f.name] = np.asarray(cols[nm])
+        ms = f.masks
+        if ms and all(m in cols for m in ms):
+            v = np.asarray(cols[ms[0]]).astype(bool)
+            for m in ms[1:]:
+                v = v & np.asarray(cols[m]).astype(bool)
+            validity[f.name] = v
     return ColumnBatch(Schema(fields),
                        {f.name: np.asarray(cols[f.name]) for f in shown},
                        np.asarray(sel), dicts, validity=validity)
@@ -187,6 +193,11 @@ class Lowerer:
             if arr.shape[0] < node.capacity:  # empty table: 0 rows, cap 1
                 arr = jnp.zeros((node.capacity,), dtype=arr.dtype)
             cols[out] = arr
+        for phys, out in node.mask_map.items():
+            arr = data[f"$nn:{phys}"]
+            if arr.shape[0] < node.capacity:
+                arr = jnp.zeros((node.capacity,), dtype=jnp.bool_)
+            cols[out] = arr
         n = node.num_rows if node.num_rows >= 0 else node.capacity
         sel = jnp.arange(node.capacity) < n
         return cols, sel
@@ -195,6 +206,11 @@ class Lowerer:
         # single-program mode: loopback motion is the identity (the
         # MotionIPCLayer seam's test backend)
         return self.lower(node.child)
+
+    def global_any(self, x) -> jnp.ndarray:
+        """Any() across ALL data — the distributed lowerer reduces over the
+        segment axis too (null-aware NOT IN needs a cluster-wide answer)."""
+        return jnp.any(x)
 
     # ----------------------------------------------------------- expressions
 
@@ -231,14 +247,24 @@ class Lowerer:
         bkeys = [self.expr(k, bcols) for k in node.build_keys]
         pkeys = [self.expr(k, pcols) for k in node.probe_keys]
 
-        if node.kind in ("semi", "anti") and node.residual is not None:
-            return self._join_semi_residual(node, bcols, bsel, bkeys,
-                                            pcols, psel, pkeys)
-        if not node.unique_build:
-            return self._join_expand(node, bcols, bsel, bkeys,
-                                     pcols, psel, pkeys)
+        # SQL NULL-key semantics: a NULL key matches nothing. NULL-key build
+        # rows leave the build set; NULL-key probe rows become unmatched
+        # (they still flow through left/full/anti via the ORIGINAL psel).
+        bkv = self.expr(node.build_key_valid, bcols) \
+            if node.build_key_valid is not None else None
+        pkv = self.expr(node.probe_key_valid, pcols) \
+            if node.probe_key_valid is not None else None
+        bselm = bsel & bkv if bkv is not None else bsel
+        pselm = psel & pkv if pkv is not None else psel
 
-        idx, matched, has_dup = K.join_lookup(bkeys, bsel, pkeys, psel)
+        if node.kind in ("semi", "anti") and node.residual is not None:
+            return self._join_semi_residual(node, bcols, bselm, bkeys,
+                                            pcols, psel, pselm, pkeys)
+        if not node.unique_build:
+            return self._join_expand(node, bcols, bsel, bselm, bkeys,
+                                     pcols, psel, pselm, pkeys)
+
+        idx, matched, has_dup = K.join_lookup(bkeys, bselm, pkeys, pselm)
         if node.kind in ("inner", "left"):
             # semi/anti only test membership; inner/left rely on the
             # planner's uniqueness proof — verify it at runtime (free:
@@ -257,6 +283,14 @@ class Lowerer:
             sel = psel
         elif node.kind == "anti":
             sel = psel & ~matched
+            if node.null_aware:
+                # x NOT IN (...): never TRUE if x is NULL or ANY subquery
+                # key is NULL — the build-side test must be GLOBAL across
+                # segments (the NULL row may live on another shard)
+                if pkv is not None:
+                    sel = sel & pkv
+                if bkv is not None:
+                    sel = sel & ~self.global_any(bsel & ~bkv)
         else:
             raise ExecError(f"join kind {node.kind}")
         return cols, sel
@@ -351,14 +385,14 @@ class Lowerer:
             out_cols[name] = o[inv]  # back to the child's row order
         return out_cols, sel
 
-    def _join_semi_residual(self, node: N.PJoin, bcols, bsel, bkeys,
-                            pcols, psel, pkeys):
+    def _join_semi_residual(self, node: N.PJoin, bcols, bselm, bkeys,
+                            pcols, psel, pselm, pkeys):
         """Correlated EXISTS with extra non-equi conditions (Q21 shape):
         expand equi-match pairs, evaluate the residual per pair, then
         OR-reduce back onto probe rows."""
         cap = node.out_capacity
         pi, bi, osel, _matched, total = K.join_expand(
-            bkeys, bsel, pkeys, psel, cap)
+            bkeys, bselm, pkeys, pselm, cap)
         self.checks[
             f"semi-join expansion overflow: match pairs exceed capacity "
             f"{cap} (node {id(node)})"] = total > cap
@@ -371,14 +405,16 @@ class Lowerer:
         sel = psel & hit if node.kind == "semi" else psel & ~hit
         return dict(pcols), sel
 
-    def _join_expand(self, node: N.PJoin, bcols, bsel, bkeys,
-                     pcols, psel, pkeys):
+    def _join_expand(self, node: N.PJoin, bcols, bsel, bselm, bkeys,
+                     pcols, psel, pselm, pkeys):
         """Many-to-many expansion: one output row per match pair; LEFT joins
         append unmatched (preserved) probe rows after the pairs; FULL joins
-        append unmatched rows from BOTH sides."""
+        append unmatched rows from BOTH sides (NULL-key rows of either side
+        are unmatched by construction — bselm/pselm exclude them from
+        matching, bsel/psel keep them in the preserved regions)."""
         cap = node.out_capacity
         pi, bi, osel, matched, total = K.join_expand(
-            bkeys, bsel, pkeys, psel, cap)
+            bkeys, bselm, pkeys, pselm, cap)
         need = total
         is_pair = osel
         j = jnp.arange(cap, dtype=total.dtype)
@@ -438,33 +474,11 @@ class Lowerer:
         agg_values: dict[str, Any] = {}
         post_scale: dict[str, float] = {}
         for name, call in node.aggs:
+            # NULL semantics are compiled away by the binder: nullable args
+            # arrive identity-filled with companion valid-count aggregates
+            # (Binder._mask_nullable_aggs), so only standard funcs remain.
             func = call.func
-            nmask = getattr(call.arg, "_null_mask", None) \
-                if call.arg is not None else None
-            if nmask == "$lost":
-                raise ExecError(
-                    f"aggregate {func}() over a nullable column exported "
-                    "through a derived table is not supported yet")
-            if func == "count" and call.arg is None:
-                agg_values[name] = None
-            elif func == "count" and nmask is not None:
-                # COUNT(col) over an outer join's nullable side counts only
-                # matched rows
-                func = "count_nn"
-                agg_values[name] = cols[nmask]
-            elif func in ("sum", "min", "max") and nmask is not None:
-                # null rows contribute the aggregate's identity; a group of
-                # ONLY null rows yields the identity rather than SQL NULL
-                # (documented limitation until null-valued outputs exist)
-                v = self.expr(call.arg, cols)
-                ident = {"sum": jnp.zeros((), dtype=v.dtype),
-                         "min": K._dtype_max(v.dtype),
-                         "max": K._dtype_min(v.dtype)}[func]
-                agg_values[name] = jnp.where(cols[nmask], v, ident)
-            elif func == "avg" and nmask is not None:
-                raise ExecError("avg() over an outer join's nullable side "
-                                "is not supported yet")
-            elif func in ("sum", "min", "max", "avg", "count"):
+            if func in ("sum", "min", "max", "avg", "count"):
                 agg_values[name] = self.expr(call.arg, cols) \
                     if call.arg is not None else None
             else:

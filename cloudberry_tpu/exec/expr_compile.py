@@ -96,10 +96,16 @@ def compile_expr(e: ex.Expr) -> Callable[[Columns], jnp.ndarray]:
         return lookup
 
     if isinstance(e, ex.IsValid):
-        name, neg = e.mask_name, e.negate
-        if neg:
-            return lambda cols: jnp.logical_not(cols[name])
-        return lambda cols: cols[name]
+        names, neg = e.mask_names, e.negate
+
+        def valid(cols):
+            # mask columns may be bool or 0/1 ints (agg companions)
+            v = cols[names[0]].astype(jnp.bool_)
+            for n in names[1:]:
+                v = jnp.logical_and(v, cols[n].astype(jnp.bool_))
+            return jnp.logical_not(v) if neg else v
+
+        return valid
 
     raise NotImplementedError(type(e).__name__)
 

@@ -179,11 +179,14 @@ class Binder:
         to common types/dictionaries, then Append(+distinct) / semi / anti."""
         left = self.bind_query(node.left)
         right = self.bind_query(node.right)
-        if len(left.fields) != len(right.fields):
+        lvis = _user_fields(left)
+        rvis = _user_fields(right)
+        if len(lvis) != len(rvis):
             raise BindError(
-                f"set operation arity mismatch: {len(left.fields)} vs "
-                f"{len(right.fields)} columns")
-        left, right, out_fields = self._align_setop_sides(left, right)
+                f"set operation arity mismatch: {len(lvis)} vs "
+                f"{len(rvis)} columns")
+        left, right, out_fields = self._align_setop_sides(
+            left, right, lvis, rvis)
 
         if node.op == "union":
             plan: N.PlanNode = N.PConcat([left, right])
@@ -195,11 +198,13 @@ class Binder:
                 raise BindError(
                     f"{node.op.upper()} ALL is not supported yet "
                     "(bag semantics need per-row multiplicity)")
-            # distinct(left) filtered by membership in right
+            # distinct(left) filtered by membership in right; set ops treat
+            # NULLs as equal ("not distinct"), so keys are canonical-zero
+            # values plus the mask columns — no key-validity exclusion
             probe = self._distinct_on_all(left)
             kind = "semi" if node.op == "intersect" else "anti"
-            keys_b = [_colref(f) for f in right.fields]
-            keys_p = [_colref(f) for f in probe.fields]
+            keys_b = [_canonical_ref(f) for f in right.fields]
+            keys_p = [_canonical_ref(f) for f in probe.fields]
             j = N.PJoin(kind, right, probe, keys_b, keys_p, [],
                         self.gensym("match"))
             j.fields = list(probe.fields)
@@ -211,8 +216,8 @@ class Binder:
             keys = []
             out_scope = Scope([RangeEntry("$set", plan)])
             for oi in node.order_by:
-                keys.append((self.bind_scalar(oi.expr, out_scope),
-                             oi.ascending))
+                _append_sort_key(keys, self.bind_scalar(oi.expr, out_scope),
+                                 oi.ascending)
             srt = N.PSort(plan, keys)
             srt.fields = list(plan.fields)
             plan = srt
@@ -224,18 +229,28 @@ class Binder:
         return plan
 
     def _distinct_on_all(self, plan: N.PlanNode) -> N.PAgg:
-        agg = N.PAgg(plan, [(f.name, _colref(f)) for f in plan.fields], [],
+        # Nullable columns group by (canonical-zero value, validity mask):
+        # mask columns are among plan.fields, so they participate as keys —
+        # SQL DISTINCT treats NULLs as equal, which this reproduces exactly.
+        agg = N.PAgg(plan,
+                     [(f.name, _canonical_ref(f)) for f in plan.fields], [],
                      capacity=_plan_capacity(plan))
-        agg.fields = [N.PlanField(f.name, f.type, f.sdict)
+        agg.fields = [N.PlanField(f.name, f.type, f.sdict,
+                                  null_mask=f.null_mask)
                       for f in plan.fields]
         return agg
 
-    def _align_setop_sides(self, left: N.PlanNode, right: N.PlanNode):
+    def _align_setop_sides(self, left: N.PlanNode, right: N.PlanNode,
+                           lvis=None, rvis=None):
         """Project both sides to common types under the LEFT side's column
-        names; string columns re-code into the left dictionary (extended)."""
+        names; string columns re-code into the left dictionary (extended).
+        Only user-visible fields align; hidden validity columns re-emerge
+        as SHARED "$vmu<i>" mask columns on both sides."""
+        lvis = _user_fields(left) if lvis is None else lvis
+        rvis = _user_fields(right) if rvis is None else rvis
         lex, rex, lfields, rfields = [], [], [], []
         changed_l = changed_r = False
-        for lf, rf in zip(left.fields, right.fields):
+        for lf, rf in zip(lvis, rvis):
             le: ex.Expr = _colref(lf)
             re_: ex.Expr = _colref(rf)
             if lf.type.base == DType.STRING or rf.type.base == DType.STRING:
@@ -273,7 +288,28 @@ class Binder:
             rex.append((lf.name, re_))
             lfields.append(N.PlanField(lf.name, out_t, sdict))
             rfields.append(N.PlanField(lf.name, out_t, sdict))
-        if changed_l or [n for n, _ in lex] != left.names:
+        # nullable columns: materialize a SHARED hidden validity column on
+        # both sides (same name → PConcat aligns them; set-op joins and
+        # DISTINCT then treat NULLs as equal via the mask key)
+        n_vis = len(lvis)
+        for i, (lf, rf) in enumerate(zip(lvis, rvis)):
+            lm, rm = lf.masks, rf.masks
+            if not lm and not rm:
+                continue
+            hidden = f"$vmu{i}"
+            true_lit = ex.Literal(True, T.BOOL)
+            lex.append((hidden, ex.IsValid(lm) if lm else true_lit))
+            rex.append((hidden, ex.IsValid(rm) if rm else true_lit))
+            f0 = lfields[i]
+            lfields[i] = N.PlanField(f0.name, f0.type, f0.sdict,
+                                     null_mask=(hidden,))
+            changed_l = changed_r = True
+        lfields = lfields + [N.PlanField(n, T.BOOL, None)
+                             for n, _ in lex[n_vis:]]
+        rfields = [N.PlanField(f.name, f.type, f.sdict, null_mask=f.null_mask)
+                   for f in lfields]
+        if changed_l or [n for n, _ in lex] != [f.name for f in lvis] \
+                or len(lvis) != len(left.fields):
             p = N.PProject(left, lex)
             p.fields = lfields
             left = p
@@ -334,11 +370,7 @@ class Binder:
 
         # -------- DISTINCT
         if sel.distinct:
-            child = plan
-            plan = N.PAgg(child, [(f.name, _colref(f)) for f in child.fields],
-                          [], capacity=_plan_capacity(child))
-            plan.fields = [N.PlanField(f.name, f.type, f.sdict)
-                           for f in child.fields]
+            plan = self._distinct_on_all(plan)
 
         # -------- ORDER BY / LIMIT
         visible = list(plan.fields)  # includes hidden $vm validity columns
@@ -351,16 +383,26 @@ class Binder:
                     # ORDER BY references non-output columns: carry them as a
                     # hidden sort column through the projection, drop after
                     if isinstance(plan, N.PProject):
+                        nm = None
+                        v = _valid_of(bound)
+                        if v is not None:
+                            # carry the validity too, or NULL ordering breaks
+                            vmname = self.gensym("vm")
+                            plan.exprs.append((vmname, v))
+                            plan.fields.append(
+                                N.PlanField(vmname, T.BOOL, None))
+                            nm = (vmname,)
                         name = self.gensym("sort")
                         plan.exprs.append((name, bound))
-                        f = N.PlanField(name, bound.dtype, _expr_dict(bound))
+                        f = N.PlanField(name, bound.dtype, _expr_dict(bound),
+                                        null_mask=nm)
                         plan.fields.append(f)
                         bound = _colref(f)
                     else:
                         raise BindError(
                             "ORDER BY expression references columns outside "
                             "the select list")
-                keys.append((bound, oi.ascending))
+                _append_sort_key(keys, bound, oi.ascending)
             s = N.PSort(plan, keys)
             s.fields = list(plan.fields)
             plan = s
@@ -399,9 +441,10 @@ class Binder:
                                      ex.ColumnRef(f.name, f.type))
                                     for f in sub.fields])
             def _remap_mask(nm):
-                if nm in (None, "$lost"):
-                    return nm
-                return f"{alias}.{nm.split('.')[-1]}"
+                if nm is None:
+                    return None
+                masks = (nm,) if isinstance(nm, str) else nm
+                return tuple(f"{alias}.{m.split('.')[-1]}" for m in masks)
 
             proj.fields = [N.PlanField(f"{alias}.{f.name.split('.')[-1]}",
                                        f.type, f.sdict,
@@ -629,22 +672,18 @@ class Binder:
         pm = self.gensym("pmatch") if kind == "full" else None
         j.probe_match_name = pm
 
-        def _merge_mask(new_mask, old_mask):
-            # nullable through BOTH this join and an earlier one would need
-            # a combined mask column — mark provenance lost (honest error /
-            # NULL-render skip) rather than pick one arbitrarily
-            if new_mask is None:
-                return old_mask
-            if old_mask is None:
-                return new_mask
-            return "$lost"
+        def _merge_mask(new_mask, f):
+            # a column nullable through BOTH this join and an earlier source
+            # simply carries both mask names (validity = their conjunction)
+            masks = ((new_mask,) if new_mask else ()) + f.masks
+            return masks or None
 
         j.fields = [
             N.PlanField(f.name, f.type, f.sdict,
-                        null_mask=_merge_mask(pm, f.null_mask))
+                        null_mask=_merge_mask(pm, f))
             for f in probe.fields] + [
             N.PlanField(f.name, f.type, f.sdict,
-                        null_mask=_merge_mask(nm, f.null_mask))
+                        null_mask=_merge_mask(nm, f))
             for f in build.fields if kind in ("inner", "left", "full")]
         # expose the validity masks as (hidden, $-prefixed) columns so
         # downstream projections can carry them to the result surface
@@ -652,6 +691,7 @@ class Binder:
             j.fields.append(N.PlanField(nm, T.BOOL, None))
         if pm is not None:
             j.fields.append(N.PlanField(pm, T.BOOL, None))
+        _attach_key_validity(j)
         return j
 
     def _filter(self, child: N.PlanNode, pred: ex.Expr) -> N.PFilter:
@@ -664,6 +704,7 @@ class Binder:
     def _bind_agg(self, sel: ast.Select, plan: N.PlanNode, scope: Scope
                   ) -> tuple[N.PlanNode, Scope]:
         group_keys: list[tuple[str, ex.Expr]] = []
+        key_mask: dict[str, str] = {}   # key output name -> validity key name
         key_name_by_ast: dict[str, str] = {}
         alias_map = {i.alias: i.expr for i in sel.items if i.alias}
         for g in sel.group_by:
@@ -673,7 +714,18 @@ class Binder:
             bound = self.bind_scalar(g, scope)
             name = (bound.name if isinstance(bound, ex.ColumnRef)
                     else self.gensym("k"))
-            group_keys.append((name, bound))
+            v = _valid_of(bound)
+            if v is not None:
+                # NULL group keys: group by (canonical-zero value, validity)
+                # — all NULLs form ONE group, distinct from any real value
+                # (SQL GROUP BY treats NULLs as equal)
+                kv = self.gensym("vmk")
+                bound = _masked_key(bound, v)
+                group_keys.append((name, bound))
+                group_keys.append((kv, ex.Cast(v, T.INT32)))
+                key_mask[name] = kv
+            else:
+                group_keys.append((name, bound))
             key_name_by_ast[_ast_key(g)] = name
 
         aggs: list[tuple[str, ex.AggCall]] = []
@@ -720,15 +772,31 @@ class Binder:
                 if key not in agg_names:
                     if node.star:
                         call = ex.AggCall("count", None)
+                        agg_names[key] = self.gensym("agg")
+                        aggs.append((agg_names[key], call))
                     else:
                         arg = self.bind_scalar(node.args[0], scope)
                         func = node.name
                         if func == "count" and node.distinct:
                             func = "count_distinct"
-                        call = ex.AggCall(func, arg, distinct=node.distinct)
-                    agg_names[key] = self.gensym("agg")
-                    aggs.append((agg_names[key], call))
-                return ast.Name((agg_names[key],))
+                        if func == "avg" and _valid_of(arg) is not None:
+                            # avg over a nullable arg: sum(valid)/count(valid)
+                            # — NULL when no valid rows (mask rides on the
+                            # sum's companion)
+                            s = self.gensym("agg")
+                            c2 = self.gensym("agg")
+                            aggs.append((s, ex.AggCall("sum", arg)))
+                            aggs.append((c2, ex.AggCall("count", arg)))
+                            agg_names[key] = ("avg2", s, c2)
+                        else:
+                            agg_names[key] = self.gensym("agg")
+                            aggs.append((agg_names[key], ex.AggCall(
+                                func, arg, distinct=node.distinct)))
+                entry = agg_names[key]
+                if isinstance(entry, tuple) and entry[0] == "avg2":
+                    return ast.BinOp("/", ast.Name((entry[1],)),
+                                     ast.Name((entry[2],)))
+                return ast.Name((entry,))
             if _ast_key(node) in key_name_by_ast:
                 return ast.Name((key_name_by_ast[_ast_key(node)],))
             out = node.__class__(**vars(node))
@@ -752,12 +820,18 @@ class Binder:
             plan, group_keys, aggs = self._rewrite_count_distinct(
                 plan, group_keys, aggs)
 
+        aggs, agg_masks = self._mask_nullable_aggs(
+            aggs, global_agg=not group_keys)
         agg = N.PAgg(plan, group_keys, aggs,
                      capacity=_agg_capacity(plan, group_keys))
         agg.fields = [
-            N.PlanField(n, e.dtype,
-                        _expr_dict(e)) for n, e in group_keys
-        ] + [N.PlanField(n, c.dtype, None) for n, c in aggs]
+            N.PlanField(n, e.dtype, _expr_dict(e),
+                        null_mask=((key_mask[n],) if n in key_mask else None))
+            for n, e in group_keys
+        ] + [N.PlanField(n, c.dtype, None,
+                         null_mask=((agg_masks[n],)
+                                    if n in agg_masks else None))
+             for n, c in aggs]
         plan = agg
 
         agg_scope = Scope([RangeEntry("$agg", agg)])
@@ -775,6 +849,7 @@ class Binder:
             name = _uniquify(name, taken)
             exprs.append((name, bound))
             fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound)))
+        exprs, fields = _attach_validity_outputs(self, exprs, fields)
         proj = N.PProject(plan, exprs)
         proj.fields = fields
         # stash rewritten order-by for _bind_output_expr
@@ -799,8 +874,10 @@ class Binder:
                     if item.expr.table and e.alias != item.expr.table:
                         continue
                     for f in e.plan.fields:
-                        if f.name in seen_sources or f.name.startswith("$"):
-                            continue  # merged-plan dupes / internal masks
+                        if f.name in seen_sources \
+                                or f.name.split(".")[-1].startswith("$"):
+                            # merged-plan dupes / masks / internal columns
+                            continue
                         seen_sources.add(f.name)
                         name = _uniquify(f.name.split(".")[-1], taken)
                         exprs.append((name, _colref(f)))
@@ -812,14 +889,10 @@ class Binder:
             name = item.alias or _default_name(item.expr) or self.gensym("col")
             name = _uniquify(name, taken)
             exprs.append((name, bound))
-            nm = getattr(bound, "_null_mask", None)
-            if nm is None and getattr(bound, "_null_expr", None) is not None:
-                nm = "$expr"
-            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound),
-                                      null_mask=nm))
+            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound)))
         # nullable outputs: project their validity masks as hidden columns
         # ("$vm..."), so NULLs render correctly at the result surface
-        exprs, fields = _attach_validity_outputs(self, exprs, fields, scope)
+        exprs, fields = _attach_validity_outputs(self, exprs, fields)
         proj = N.PProject(plan, exprs)
         proj.fields = fields
         self._rewritten_order = {}
@@ -867,13 +940,48 @@ class Binder:
         new_items = [ast.SelectItem(replace(i.expr), i.alias)
                      for i in sel.items]
         for part_asts, order_asts, calls in specs.values():
-            pk = [self.bind_scalar(a, scope) for a in part_asts]
-            okeys = [(self.bind_scalar(o.expr, scope), o.ascending)
-                     for o in order_asts]
+            pk = []
+            for a in part_asts:
+                bound = self.bind_scalar(a, scope)
+                v = _valid_of(bound)
+                if v is not None:
+                    # NULL partition keys form ONE partition, distinct from
+                    # any real value: (canonical-zero value, validity) pair
+                    # — same discipline as GROUP BY (_masked_key)
+                    pk.append(_masked_key(bound, v))
+                    pk.append(ex.Cast(v, T.INT32))
+                else:
+                    pk.append(bound)
+            okeys = []
+            for o in order_asts:
+                bound = self.bind_scalar(o.expr, scope)
+                v = _valid_of(bound)
+                if v is not None:
+                    # NULLs order as largest (same rule as PSort keys)
+                    okeys.append((ex.Cast(ex.UnaryOp("not", v, T.BOOL),
+                                          T.INT32), o.ascending))
+                    okeys.append((_masked_key(bound, v), o.ascending))
+                else:
+                    okeys.append((bound, o.ascending))
             bound_calls = []
             new_fields = []
             for name, func, arg_ast in calls:
                 arg = self.bind_scalar(arg_ast, scope)                     if arg_ast is not None else None
+                if arg is not None and _valid_of(arg) is not None:
+                    v = _valid_of(arg)
+                    if func == "sum":
+                        z = 0.0 if arg.dtype.base == DType.FLOAT64 else 0
+                        arg = ex.CaseWhen(((v, arg),),
+                                          ex.Literal(z, arg.dtype), arg.dtype)
+                    elif func in ("min", "max"):
+                        ident = _dtype_extreme(arg.dtype, func == "min")
+                        arg = ex.CaseWhen(((v, arg),),
+                                          ex.Literal(ident, arg.dtype),
+                                          arg.dtype)
+                    else:
+                        raise BindError(
+                            f"window {func}() over a nullable argument is "
+                            "not supported yet")
                 if func in ("row_number", "rank", "dense_rank", "count"):
                     t = T.INT64
                 elif func == "avg":
@@ -907,7 +1015,7 @@ class Binder:
         if isinstance(e, ast.Name) and len(e.parts) == 1:
             for f in plan.fields:
                 if f.name == e.parts[0]:
-                    return ex.ColumnRef(f.name, f.type)
+                    return _colref(f)  # keeps dictionary + null mask
         rw = getattr(self, "_rewritten_order", {}).get(id(e))
         if rw is not None and self._agg_scope is not None:
             try:
@@ -947,15 +1055,20 @@ class Binder:
         if isinstance(node, ast.IntervalLit):
             raise BindError("interval literal only valid in date arithmetic")
 
+        if isinstance(node, ast.NullLit):
+            return _null_literal(T.INT64)
+
         if isinstance(node, ast.UnaryOp):
             if node.op == "not":
-                return ex.UnaryOp("not", b(node.operand), T.BOOL)
+                return self._not_expr(b(node.operand))
             operand = b(node.operand)
             if node.op == "+":
                 return operand
             if isinstance(operand, ex.Literal):
-                return ex.Literal(-operand.value, operand.dtype)
-            return ex.UnaryOp("-", operand, operand.dtype)
+                out: ex.Expr = ex.Literal(-operand.value, operand.dtype)
+            else:
+                out = ex.UnaryOp("-", operand, operand.dtype)
+            return _set_valid(out, _valid_of(operand))
 
         if isinstance(node, ast.BinOp):
             return self._bind_binop(node, scope)
@@ -966,7 +1079,7 @@ class Binder:
             both = ast.BinOp("and", lo, hi)
             out = self.bind_scalar(both, scope)
             if node.negated:
-                return ex.UnaryOp("not", out, T.BOOL)
+                return self._not_expr(out)
             return out
 
         if isinstance(node, ast.InList):
@@ -977,57 +1090,50 @@ class Binder:
                 values = {it.value for it in node.items}
                 table = sdict.predicate_table(lambda v: v in values)
                 out: ex.Expr = ex.DictLookup(e, table)
+                v = _valid_of(e)
+                if v is not None:
+                    out = _set_valid(ex.BinOp("and", out, v, T.BOOL), v)
             else:
                 cmps = [self._bind_binop(ast.BinOp("=", node.expr, it), scope)
                         for it in node.items]
                 out = cmps[0]
                 for c in cmps[1:]:
-                    out = ex.BinOp("or", out, c, T.BOOL)
+                    out = self._logic("or", out, c)
             if node.negated:
-                return ex.UnaryOp("not", out, T.BOOL)
+                return self._not_expr(out)
             return out
 
         if isinstance(node, ast.Like):
             e = b(node.expr)
             sdict = _require_dict(e)
             out = ex.DictLookup(e, sdict.like_table(node.pattern))
+            v = _valid_of(e)
+            if v is not None:
+                out = _set_valid(ex.BinOp("and", out, v, T.BOOL), v)
             if node.negated:
-                return ex.UnaryOp("not", out, T.BOOL)
+                return self._not_expr(out)
             return out
 
         if isinstance(node, ast.IsNull):
             e = b(node.operand)
-            if isinstance(e, ex.IsValid):
-                return ex.IsValid(e.mask_name, negate=not node.negated)
-            mask = getattr(e, "_null_mask", None)
-            if mask == "$lost":
-                raise BindError(
-                    "IS NULL on a nullable column exported through a "
-                    "derived table is not supported yet (null provenance "
-                    "is lost at the projection)")
-            if mask is not None:
-                # column from an outer join's nullable side: NULL ⇔ unmatched
-                return ex.IsValid(mask, negate=not node.negated)
-            # non-nullable columns: IS NULL is constant false
-            return ex.Literal(bool(node.negated), T.BOOL)
+            v = _valid_of(e)
+            if v is None:
+                # provably non-null: IS NULL is constant false
+                return ex.Literal(bool(node.negated), T.BOOL)
+            # v itself is never NULL, so no is-true wrapping needed
+            return v if node.negated else ex.UnaryOp("not", v, T.BOOL)
 
         if isinstance(node, ast.CaseExpr):
             whens = [(b(c), b(v)) for c, v in node.whens]
             otherwise = b(node.otherwise) if node.otherwise else None
-            result_exprs = [v for _, v in whens] + (
-                [otherwise] if otherwise is not None else [])
-            if any(e.dtype.base == DType.STRING for e in result_exprs):
-                return self._bind_string_case(whens, otherwise, result_exprs)
-            rtype = _common_type([e.dtype for e in result_exprs])
-            whens = tuple((c, self._coerce(v, rtype)) for c, v in whens)
-            otherwise = self._coerce(otherwise, rtype) if otherwise is not None else None
-            return ex.CaseWhen(whens, otherwise, rtype)
+            return self._bind_case(whens, otherwise)
 
         if isinstance(node, ast.ExtractExpr):
             e = b(node.operand)
             if e.dtype.base != DType.DATE:
                 raise BindError("EXTRACT requires a date operand")
-            return ex.Func(f"extract_{node.part}", (e,), T.INT32)
+            return _set_valid(ex.Func(f"extract_{node.part}", (e,), T.INT32),
+                              _valid_of(e))
 
         if isinstance(node, ast.CastExpr):
             e = b(node.operand)
@@ -1036,7 +1142,9 @@ class Binder:
                 raise BindError(f"unknown type {node.type_name!r}")
             if t.base == DType.DECIMAL and node.scale is not None:
                 t = T.DECIMAL(node.scale)
-            return ex.Cast(e, t)
+            if _is_null_literal(e):
+                return _null_literal(t)
+            return _set_valid(ex.Cast(e, t), _valid_of(e))
 
         if isinstance(node, ast.SubstringExpr):
             return self._bind_substring(node, scope)
@@ -1049,12 +1157,81 @@ class Binder:
                 return self._bind_coalesce(node, scope)
             if node.name == "sqrt":
                 arg = self._coerce(b(node.args[0]), T.FLOAT64)
-                return ex.Func("sqrt", (arg,), T.FLOAT64)
+                return _set_valid(ex.Func("sqrt", (arg,), T.FLOAT64),
+                                  _valid_of(arg))
             if node.name in AGG_FUNCS:
                 raise BindError(f"aggregate {node.name}() not allowed here")
             raise BindError(f"unknown function {node.name!r}")
 
         raise BindError(f"unsupported expression {type(node).__name__}")
+
+    def _not_expr(self, e: ex.Expr) -> ex.Expr:
+        """NOT under 3VL, is-true normalized: NOT x is TRUE iff x is valid
+        and false; NULL stays NULL (excluded by filters)."""
+        v = _valid_of(e)
+        out: ex.Expr = ex.UnaryOp("not", e, T.BOOL)
+        if v is not None:
+            out = ex.BinOp("and", out, v, T.BOOL)
+        return _set_valid(out, v)
+
+    def _logic(self, op: str, l: ex.Expr, r: ex.Expr) -> ex.Expr:
+        """AND/OR under Kleene 3VL over is-true normalized operands: the
+        plain BinOp value is already the correct is-TRUE; validity records
+        when the 3VL result is non-NULL (e.g. FALSE AND NULL is known)."""
+        out: ex.Expr = ex.BinOp(op, l, r, T.BOOL)
+        vl, vr = _valid_of(l), _valid_of(r)
+        if vl is None and vr is None:
+            return out
+        both = _and_valid(vl, vr) or ex.Literal(True, T.BOOL)
+        if op == "and":
+            def known_false(x, vx):
+                nx = ex.UnaryOp("not", x, T.BOOL)
+                return nx if vx is None else ex.BinOp("and", vx, nx, T.BOOL)
+
+            valid = ex.BinOp(
+                "or", ex.BinOp("or", both, known_false(l, vl), T.BOOL),
+                known_false(r, vr), T.BOOL)
+        else:
+            # OR known if both sides known, or either is TRUE (is-true
+            # normalized values already imply validity)
+            valid = ex.BinOp("or", ex.BinOp("or", both, l, T.BOOL), r,
+                             T.BOOL)
+        return _set_valid(out, valid)
+
+    def _bind_case(self, whens, otherwise) -> ex.Expr:
+        """CASE under 3VL: NULL conditions fall through (automatic with
+        is-true normalized conditions); a missing ELSE is an implicit NULL;
+        result validity mirrors the CASE over branch validities."""
+        result_exprs = [v for _, v in whens] + (
+            [otherwise] if otherwise is not None else [])
+        non_null = [e for e in result_exprs if not _is_null_literal(e)]
+        if any(e.dtype.base == DType.STRING for e in non_null):
+            out = self._bind_string_case(whens, otherwise, non_null)
+        else:
+            rtype = _common_type([e.dtype for e in non_null]) if non_null \
+                else T.INT64
+            cw = tuple(
+                (c, _null_literal(rtype) if _is_null_literal(v)
+                 else self._coerce(v, rtype)) for c, v in whens)
+            other = None if otherwise is None else (
+                _null_literal(rtype) if _is_null_literal(otherwise)
+                else self._coerce(otherwise, rtype))
+            out = ex.CaseWhen(cw, other, rtype)
+        branch_vs = [_valid_of(v) for _, v in out.whens]
+        vo = _valid_of(out.otherwise) if out.otherwise is not None else None
+        if out.otherwise is not None and not getattr(
+                out, "_implicit_null_else", False) \
+                and vo is None and all(v is None for v in branch_vs):
+            return out  # no branch can produce NULL
+        true_lit = ex.Literal(True, T.BOOL)
+        vwhens = tuple((c, v if v is not None else true_lit)
+                       for (c, _), v in zip(out.whens, branch_vs))
+        if out.otherwise is None or getattr(out, "_implicit_null_else",
+                                            False):
+            votherwise: ex.Expr = ex.Literal(False, T.BOOL)
+        else:
+            votherwise = vo if vo is not None else true_lit
+        return _set_valid(out, ex.CaseWhen(vwhens, votherwise, T.BOOL))
 
     def _bind_string_case(self, whens, otherwise, result_exprs) -> ex.Expr:
         """CASE yielding strings: literal results get codes in an output
@@ -1075,38 +1252,110 @@ class Binder:
         out_dict = StringDictionary(base.values if base else ())
 
         def enc(e):
+            if _is_null_literal(e):
+                lit = ex.Literal(-1, T.STRING)  # code -1: masked at render
+                object.__setattr__(lit, "_is_null_lit", True)
+                object.__setattr__(lit, "_null_expr",
+                                   ex.Literal(False, T.BOOL))
+                return lit
             if isinstance(e, ex.Literal):
                 return ex.Literal(out_dict.add(e.value), T.STRING)
             return e  # column codes valid: out_dict extends its dictionary
 
         whens = tuple((c, enc(v)) for c, v in whens)
-        otherwise = enc(otherwise) if otherwise is not None else \
+        implicit_null = otherwise is None
+        otherwise_e = enc(otherwise) if otherwise is not None else \
             ex.Literal(-1, T.STRING)
-        out = ex.CaseWhen(whens, otherwise, T.STRING)
+        out = ex.CaseWhen(whens, otherwise_e, T.STRING)
         object.__setattr__(out, "_out_dict", out_dict)
+        if implicit_null:
+            object.__setattr__(out, "_implicit_null_else", True)
         return out
+
+    def _mask_nullable_aggs(self, aggs, global_agg: bool):
+        """Make aggregates NULL-correct:
+        - count(x) over a nullable x counts only valid rows (sum of 0/1);
+        - sum/min/max over a nullable x aggregate identity-filled values and
+          gain a hidden companion counting valid rows — zero valid rows
+          means the SQL result is NULL (the companion is the output's mask);
+        - with no GROUP BY, sum/min/max/avg over an EMPTY input are NULL,
+          so they gain a row-count companion even for non-null args.
+        Only standard funcs come out, so the distributed partial/final agg
+        split (plan/distribute.py) needs no NULL knowledge at all."""
+        out: list[tuple[str, ex.AggCall]] = []
+        masks: dict[str, str] = {}
+        one = ex.Literal(1, T.INT64)
+        zero = ex.Literal(0, T.INT64)
+        for name, call in aggs:
+            v = _valid_of(call.arg) if call.arg is not None else None
+            if call.func == "count" and call.arg is not None \
+                    and v is not None:
+                out.append((name, ex.AggCall(
+                    "sum", ex.CaseWhen(((v, one),), zero, T.INT64))))
+                continue
+            if call.func in ("sum", "min", "max") \
+                    and (v is not None or global_agg):
+                arg = call.arg
+                if v is not None:
+                    if call.func == "sum":
+                        ident = 0.0 if arg.dtype.base == DType.FLOAT64 else 0
+                    else:
+                        ident = _dtype_extreme(arg.dtype,
+                                               want_max=(call.func == "min"))
+                    arg = ex.CaseWhen(((v, arg),),
+                                      ex.Literal(ident, arg.dtype), arg.dtype)
+                out.append((name, ex.AggCall(call.func, arg)))
+                comp = self.gensym("vma")
+                if v is not None:
+                    out.append((comp, ex.AggCall(
+                        "sum", ex.CaseWhen(((v, one),), zero, T.INT64))))
+                else:
+                    out.append((comp, ex.AggCall("count", None)))
+                masks[name] = comp
+                continue
+            if call.func == "avg" and global_agg and v is None:
+                comp = self.gensym("vma")
+                out.append((name, call))
+                out.append((comp, ex.AggCall("count", None)))
+                masks[name] = comp
+                continue
+            out.append((name, call))
+        return out, masks
 
     def _rewrite_count_distinct(self, plan, group_keys, aggs):
         """DQA split (cdbgroupingpaths.c / TupleSplit analog): rewrite
         count(distinct x) group by k as a distinct-on-(k,x) inner aggregation
-        followed by count per k."""
+        followed by count per k. A nullable x becomes (canonical value,
+        validity) key pair; the outer count then skips the NULL group."""
         if not all(c.func == "count_distinct" for _, c in aggs):
             raise BindError("count(distinct) mixed with other aggregates "
                             "is not supported yet")
         inner_keys = list(group_keys)
-        arg_of: list[tuple[str, str]] = []
+        arg_of: list[tuple[str, str, Optional[tuple]]] = []
         for name, call in aggs:
             assert call.arg is not None
             aname = self.gensym("darg")
-            inner_keys.append((aname, call.arg))
-            arg_of.append((name, aname))
+            v = _valid_of(call.arg)
+            if v is None:
+                inner_keys.append((aname, call.arg))
+                arg_of.append((name, aname, None))
+            else:
+                avname = self.gensym("vmk")
+                inner_keys.append((aname, _masked_key(call.arg, v)))
+                inner_keys.append((avname, ex.Cast(v, T.INT32)))
+                arg_of.append((name, aname, (avname,)))
         inner = N.PAgg(plan, inner_keys, [],
                        capacity=_agg_capacity(plan, inner_keys))
         inner.fields = [N.PlanField(n, e.dtype, _expr_dict(e))
                         for n, e in inner_keys]
+        mask_of = {aname: m for _, aname, m in arg_of}
+        inner.fields = [
+            N.PlanField(f.name, f.type, f.sdict,
+                        null_mask=mask_of.get(f.name))
+            for f in inner.fields]
         new_group = [(n, _colref(inner.field(n))) for n, _ in group_keys]
         new_aggs = [(name, ex.AggCall("count", _colref(inner.field(aname))))
-                    for name, aname in arg_of]
+                    for name, aname, _ in arg_of]
         return inner, new_group, new_aggs
 
     # -------------------------------------------------- subquery predicates
@@ -1139,9 +1388,10 @@ class Binder:
         sub = Binder(self.catalog)
         sub._counter = self._counter + 1000
         plan = sub.bind_select(node.select)
-        if len(plan.fields) != 1:
+        ufs = _user_fields(plan)  # hidden $vm mask outputs don't count
+        if len(ufs) != 1:
             raise BindError("scalar subquery must return one column")
-        f = plan.fields[0]
+        f = ufs[0]
         e = ex.SubqueryScalar(plan, f.type)
         if f.sdict is not None:
             object.__setattr__(e, "_sdict", f.sdict)
@@ -1281,6 +1531,7 @@ class Binder:
         j = N.PJoin(kind, subplan, plan, build_keys, probe_keys, [],
                     self.gensym("match"))
         j.fields = list(plan.fields)
+        _attach_key_validity(j)
         if res_rw:
             # residual references outer names + mangled subplan names
             combined = Scope(list(scope.entries)
@@ -1320,6 +1571,10 @@ class Binder:
         j = N.PJoin(kind, subplan, plan, build_keys, probe_keys, [],
                     self.gensym("match"))
         j.fields = list(plan.fields)
+        _attach_key_validity(j)
+        # x NOT IN (subquery): if the subquery yields ANY NULL key, the
+        # predicate is never TRUE — null-aware anti join
+        j.null_aware = negated
         return j
 
     def _apply_scalar_comparison(self, node: ast.BinOp, plan: N.PlanNode,
@@ -1359,6 +1614,7 @@ class Binder:
                     [f.name for f in subplan.fields], self.gensym("match"))
         j.fields = list(plan.fields) + [
             N.PlanField(f.name, f.type, f.sdict) for f in subplan.fields]
+        _attach_key_validity(j)
         cmp_scope = Scope(list(scope.entries) + [RangeEntry("$sq", j)])
         cmp = self._bind_comparison(
             op, self.bind_scalar(lhs, scope),
@@ -1370,29 +1626,32 @@ class Binder:
         return out
 
     def _bind_coalesce(self, node: ast.FuncCall, scope: Scope) -> ex.Expr:
-        """COALESCE over nullable (outer-join) operands: first VALID value
-        wins, validity read from the operands' masks. Operands without a
-        mask are never null, so anything after the first such operand is
-        dead."""
+        """COALESCE: first non-NULL value wins; result is NULL only when
+        every operand is. Operands without validity are never null, so
+        anything after the first such operand is dead."""
         if not node.args:
             raise BindError("coalesce() requires at least one argument")
         bound = [self.bind_scalar(a, scope) for a in node.args]
-        rtype = _common_type([b.dtype for b in bound])
+        non_null = [b for b in bound if not _is_null_literal(b)]
+        if not non_null:
+            return _null_literal(T.INT64)
+        rtype = _common_type([b.dtype for b in non_null])
         out_dict = None
-        if any(b.dtype.base == DType.STRING for b in bound):
-            if not all(b.dtype.base == DType.STRING for b in bound):
+        if any(b.dtype.base == DType.STRING for b in non_null):
+            if not all(b.dtype.base == DType.STRING for b in non_null):
                 raise BindError("coalesce mixes string and non-string "
                                 "operands")
             rtype = T.STRING
             # reconcile dictionaries: codes re-based onto one output dict
-            base = next((_expr_dict(b) for b in bound
+            base = next((_expr_dict(b) for b in non_null
                          if _expr_dict(b) is not None), None)
             out_dict = StringDictionary(base.values if base else ())
             rebased = []
             for b in bound:
-                mask = getattr(b, "_null_mask", None)
-                if isinstance(b, ex.Literal) and isinstance(b.value, str):
-                    b2: ex.Expr = ex.Literal(out_dict.add(b.value), T.STRING)
+                if _is_null_literal(b):
+                    b2: ex.Expr = _null_literal(T.STRING)
+                elif isinstance(b, ex.Literal) and isinstance(b.value, str):
+                    b2 = ex.Literal(out_dict.add(b.value), T.STRING)
                 else:
                     d = _expr_dict(b)
                     if d is None:
@@ -1405,32 +1664,20 @@ class Binder:
                                             for v in d.values),
                                            dtype=np.int32, count=len(d))
                         b2 = ex.DictLookup(b, xlat, T.STRING)
-                    if mask is not None:
-                        object.__setattr__(b2, "_null_mask", mask)
+                        _set_valid(b2, _valid_of(b))
                 rebased.append(b2)
-            bound = rebased
-        coerced = []
-        for b in bound:
-            mask = getattr(b, "_null_mask", None)
-            if mask == "$lost":
-                raise BindError("coalesce over a column whose null "
-                                "provenance was lost (derived table) is "
-                                "not supported yet")
-            c = self._coerce(b, rtype) if b.dtype != rtype else b
-            if mask is not None and c is not b:
-                object.__setattr__(c, "_null_mask", mask)  # survive casts
-            coerced.append(c)
-        def validity_of(b):
-            mask = getattr(b, "_null_mask", None)
-            if mask is not None:
-                return ex.IsValid(mask)
-            return getattr(b, "_null_expr", None)  # nested coalesce etc.
+            coerced = rebased
+        else:
+            coerced = [
+                _null_literal(rtype) if _is_null_literal(b)
+                else (self._coerce(b, rtype) if b.dtype != rtype else b)
+                for b in bound]
 
         out = None
         all_masked = True
         vexprs = []
         for b in reversed(coerced):
-            v = validity_of(b)
+            v = _valid_of(b)
             if v is None:
                 all_masked = False
                 out = b  # never-null operand: later fallbacks are dead
@@ -1446,11 +1693,12 @@ class Binder:
                 valid = ex.BinOp("or", valid, v, T.BOOL)
             out2 = ex.CaseWhen(tuple(), out, rtype) if isinstance(
                 out, (ex.ColumnRef, ex.Literal)) else out
-            object.__setattr__(out2, "_null_expr", valid)
+            _set_valid(out2, valid)
             out = out2
         if out_dict is not None:
             out3 = out if not isinstance(out, (ex.ColumnRef, ex.Literal)) \
-                else ex.CaseWhen(tuple(), out, rtype)
+                else _set_valid(ex.CaseWhen(tuple(), out, rtype),
+                                _valid_of(out))
             object.__setattr__(out3, "_out_dict", out_dict)
             out = out3
         return out
@@ -1471,13 +1719,13 @@ class Binder:
             table[code] = out_dict.add(sub)
         col = ex.DictLookup(e, table, T.STRING)
         object.__setattr__(col, "_out_dict", out_dict)
-        return col
+        return _set_valid(col, _valid_of(e))
 
     def _bind_binop(self, node: ast.BinOp, scope: Scope) -> ex.Expr:
         op = node.op
         if op in ("and", "or"):
-            return ex.BinOp(op, self.bind_scalar(node.left, scope),
-                            self.bind_scalar(node.right, scope), T.BOOL)
+            return self._logic(op, self.bind_scalar(node.left, scope),
+                               self.bind_scalar(node.right, scope))
 
         # date ± interval folding (literal side only, TPC-H style)
         if op in ("+", "-"):
@@ -1489,9 +1737,19 @@ class Binder:
         right = self.bind_scalar(node.right, scope)
 
         if op in ("=", "<>", "<", "<=", ">", ">="):
-            return self._bind_comparison(op, left, right)
+            if _is_null_literal(left) or _is_null_literal(right):
+                return _null_bool()  # cmp with NULL is NULL (never TRUE)
+            v = _and_valid(_valid_of(left), _valid_of(right))
+            out = self._bind_comparison(op, left, right)
+            if v is not None:
+                out = ex.BinOp("and", out, v, T.BOOL)  # is-true normalize
+            return _set_valid(out, v)
 
-        # arithmetic
+        # arithmetic — strict: NULL in, NULL out
+        v = _and_valid(_valid_of(left), _valid_of(right))
+        return _set_valid(self._bind_arith(op, left, right), v)
+
+    def _bind_arith(self, op: str, left: ex.Expr, right: ex.Expr) -> ex.Expr:
         lt, rt = left.dtype, right.dtype
         if lt.base == DType.DATE or rt.base == DType.DATE:
             if op == "-" and lt.base == DType.DATE and rt.base == DType.DATE:
@@ -1627,9 +1885,11 @@ class Binder:
     def _coerce(self, e: ex.Expr, t: SqlType) -> ex.Expr:
         if e.dtype == t:
             return e
-        if isinstance(e, ex.Literal):
-            return _literal_cast(e, t)
-        return ex.Cast(e, t)
+        out = _literal_cast(e, t) if isinstance(e, ex.Literal) else ex.Cast(e, t)
+        _set_valid(out, _valid_of(e))  # casts are validity-preserving
+        if _is_null_literal(e):
+            object.__setattr__(out, "_is_null_lit", True)
+        return out
 
 
 # ------------------------------------------------------------------ helpers
@@ -1637,29 +1897,145 @@ class Binder:
 
 def _colref(f: N.PlanField) -> ex.ColumnRef:
     """ColumnRef carrying the field's dictionary (string ops need it) and
-    its outer-join null mask."""
+    its validity (NULL) mask."""
     c = ex.ColumnRef(f.name, f.type)
     if f.sdict is not None:
         object.__setattr__(c, "_sdict", f.sdict)
     if f.null_mask is not None:
-        object.__setattr__(c, "_null_mask", f.null_mask)
+        object.__setattr__(c, "_null_expr", ex.IsValid(f.masks))
     return c
+
+
+# ----------------------------------------------- validity (NULL) propagation
+# The binder tracks, for every bound expression, a bool "validity" expression
+# (True = value present, False = SQL NULL) via the ``_null_expr`` attribute;
+# None means provably non-null. Boolean expressions are kept "is-TRUE
+# normalized": their compiled VALUE is the three-valued-logic is-TRUE (NULL
+# evaluates as False), which makes WHERE/join/HAVING filtering correct with
+# no executor knowledge of 3VL; the validity expr rides alongside for IS
+# NULL, COALESCE, and NULL rendering. At plan boundaries (projections, agg
+# outputs, scans) validity is materialized as hidden bool columns and
+# recorded in PlanField.null_mask — ordinary columns that flow through
+# motions/joins like any other. The reference gets all of this from
+# per-datum null flags in every Datum slot; here it is compiled structure.
+
+
+def _valid_of(e: ex.Expr):
+    """Validity expr of a bound expression (None = never NULL)."""
+    return getattr(e, "_null_expr", None)
+
+
+def _set_valid(e: ex.Expr, v) -> ex.Expr:
+    if v is not None:
+        object.__setattr__(e, "_null_expr", v)
+    return e
+
+
+def _and_valid(*vs):
+    out = None
+    for v in vs:
+        if v is None:
+            continue
+        out = v if out is None else ex.BinOp("and", out, v, T.BOOL)
+    return out
+
+
+def _is_null_literal(e: ex.Expr) -> bool:
+    return bool(getattr(e, "_is_null_lit", False))
+
+
+def _null_literal(t: SqlType) -> ex.Expr:
+    """A typed NULL: zero value + always-False validity."""
+    z = 0.0 if t.base == DType.FLOAT64 else \
+        (False if t.base == DType.BOOL else 0)
+    lit = ex.Literal(z, t)
+    object.__setattr__(lit, "_is_null_lit", True)
+    object.__setattr__(lit, "_null_expr", ex.Literal(False, T.BOOL))
+    return lit
+
+
+def _null_bool() -> ex.Expr:
+    """The NULL boolean, is-TRUE normalized: value False, validity False."""
+    return _null_literal(T.BOOL)
+
+
+_HIDDEN_PREFIXES = ("$vm", "$nn:", "$match", "$pmatch")
+
+
+def _is_hidden_name(name: str) -> bool:
+    return name.split(".")[-1].startswith(_HIDDEN_PREFIXES)
+
+
+def _user_fields(plan: N.PlanNode) -> list[N.PlanField]:
+    return [f for f in plan.fields if not _is_hidden_name(f.name)]
+
+
+def _canonical_ref(f: N.PlanField) -> ex.Expr:
+    """Reference a field with NULL lanes canonicalized to zero — safe as a
+    grouping/set-op key where the validity mask rides as its own column.
+    Deliberately carries NO validity (the mask column is the key's partner)."""
+    c = ex.ColumnRef(f.name, f.type)
+    if f.sdict is not None:
+        object.__setattr__(c, "_sdict", f.sdict)
+    if not f.masks:
+        return c
+    z = 0.0 if f.type.base == DType.FLOAT64 else \
+        (False if f.type.base == DType.BOOL else 0)
+    out = ex.CaseWhen(((ex.IsValid(f.masks), c),),
+                      ex.Literal(z, f.type), f.type)
+    if f.sdict is not None:
+        object.__setattr__(out, "_out_dict", f.sdict)
+    return out
+
+
+def _attach_key_validity(j: N.PJoin) -> None:
+    """SQL equi-join NULL semantics: a NULL key matches nothing. The
+    executor ANDs these into the build/probe selection for matching."""
+    j.build_key_valid = _and_valid(*[_valid_of(k) for k in j.build_keys])
+    j.probe_key_valid = _and_valid(*[_valid_of(k) for k in j.probe_keys])
+
+
+def _dtype_extreme(t: SqlType, want_max: bool):
+    if t.base == DType.FLOAT64:
+        return float("inf") if want_max else float("-inf")
+    bits = 31 if t.np_dtype == np.int32 else 63
+    return (1 << bits) - 1 if want_max else -(1 << bits)
 
 
 def _scan_node(table: Table, alias: str) -> N.PScan:
     cmap = {f.name: f"{alias}.{f.name}" for f in table.schema.fields}
+    validity = getattr(table, "validity", {})
+    # mask output names keep the "<alias>.$..." shape so the hidden-column
+    # convention (last dotted component starts with "$") holds
+    mask_map = {f.name: f"{alias}.$nn:{f.name}"
+                for f in table.schema.fields if f.name in validity}
     scan = N.PScan(table.name, cmap, capacity=max(table.num_rows, 1),
-                   num_rows=table.num_rows)
-    scan.fields = [N.PlanField(f"{alias}.{f.name}", f.type,
-                               table.dicts.get(f.name))
-                   for f in table.schema.fields]
+                   num_rows=table.num_rows, mask_map=mask_map)
+    scan.fields = [
+        N.PlanField(f"{alias}.{f.name}", f.type, table.dicts.get(f.name),
+                    null_mask=((mask_map[f.name],)
+                               if f.name in mask_map else None))
+        for f in table.schema.fields
+    ] + [N.PlanField(m, T.BOOL, None) for m in mask_map.values()]
     return scan
 
 
 def _fields_only_plan(fields: list[N.PlanField]) -> N.PlanNode:
     p = N.PlanNode()
-    p.fields = [N.PlanField(f.name, f.type, f.sdict) for f in fields]
+    p.fields = [N.PlanField(f.name, f.type, f.sdict, null_mask=f.null_mask)
+                for f in fields]
     return p
+
+
+def _append_sort_key(keys: list, bound: ex.Expr, ascending: bool) -> None:
+    """ORDER BY with SQL NULL ordering: NULLs sort as larger than every
+    value (NULLS LAST when ascending, FIRST when descending) — an is-null
+    flag becomes the preceding sort key with the same direction."""
+    v = _valid_of(bound)
+    if v is not None:
+        keys.append((ex.Cast(ex.UnaryOp("not", v, T.BOOL), T.INT32),
+                     ascending))
+    keys.append((bound, ascending))
 
 
 def _const_row() -> N.PlanNode:
@@ -1818,37 +2194,40 @@ def _ast_key(node: ast.Node) -> str:
     return "(" + " ".join(parts) + ")"
 
 
-def _attach_validity_outputs(binder, exprs, fields, scope):
-    """For output fields whose source is nullable (outer-join column or a
-    COALESCE over only-nullable operands), add the validity as a hidden bool
-    output ("$vm…") and point the field at it."""
-    mask_out: dict[str, str] = {}
+def _masked_key(bound: ex.Expr, v: ex.Expr) -> ex.Expr:
+    """Canonicalize a nullable grouping key's NULL lanes to zero (its
+    validity rides as a separate key column)."""
+    z = 0.0 if bound.dtype.base == DType.FLOAT64 else \
+        (False if bound.dtype.base == DType.BOOL else 0)
+    masked = ex.CaseWhen(((v, bound),), ex.Literal(z, bound.dtype),
+                         bound.dtype)
+    d = _expr_dict(bound)
+    if d is not None:
+        object.__setattr__(masked, "_out_dict", d)
+    return masked
+
+
+def _attach_validity_outputs(binder, exprs, fields):
+    """For output exprs that can be NULL, materialize the validity as a
+    hidden bool output ("$vm…") and point the field's null_mask at it —
+    the plan-boundary form of expression-level validity."""
+    mask_out: dict = {}   # dedup key -> hidden column name
     new_fields = []
     for (name, bound), f in zip(list(exprs), fields):
-        nm = f.null_mask
-        if nm is None or nm == "$lost":
-            new_fields.append(f)
+        v = _valid_of(bound)
+        if v is None:
+            new_fields.append(N.PlanField(f.name, f.type, f.sdict))
             continue
-        if nm == "$expr":
-            hidden = binder.gensym("vm")  # "$vm<n>", deterministic
-            exprs.append((hidden, getattr(bound, "_null_expr")))
-            new_fields.append(N.PlanField(f.name, f.type, f.sdict,
-                                          null_mask=hidden))
-            mask_out[hidden] = hidden
-            continue
-        if nm not in mask_out:
+        key = (("iv", v.mask_names, v.negate)
+               if isinstance(v, ex.IsValid) else id(v))
+        hidden = mask_out.get(key)
+        if hidden is None:
             hidden = binder.gensym("vm")
-            try:
-                mref = binder.bind_scalar(ast.Name((nm,)), scope)
-            except BindError:
-                new_fields.append(N.PlanField(f.name, f.type, f.sdict,
-                                              null_mask="$lost"))
-                continue
-            exprs.append((hidden, mref))
-            mask_out[nm] = hidden
+            mask_out[key] = hidden
+            exprs.append((hidden, v))
         new_fields.append(N.PlanField(f.name, f.type, f.sdict,
-                                      null_mask=mask_out[nm]))
-    for hidden in dict.fromkeys(mask_out.values()):
+                                      null_mask=(hidden,)))
+    for hidden in mask_out.values():
         new_fields.append(N.PlanField(hidden, T.BOOL, None))
     return exprs, new_fields
 

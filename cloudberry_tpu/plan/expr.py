@@ -127,10 +127,16 @@ class SubqueryScalar(Expr):
 
 @dataclass(frozen=True)
 class IsValid(Expr):
-    """True where an outer-join matched (IS NOT NULL on nullable side)."""
-    mask_name: str
+    """True where every named validity column is True (a column is valid /
+    IS NOT NULL where the conjunction of its mask columns holds; a column
+    nullable through several outer joins carries one mask name per join)."""
+    mask_names: tuple[str, ...]
     negate: bool = False
     dtype: SqlType = BOOL
+
+    def __post_init__(self):
+        if isinstance(self.mask_names, str):  # tolerate single-name callers
+            object.__setattr__(self, "mask_names", (self.mask_names,))
 
 
 @dataclass(frozen=True)
@@ -199,5 +205,5 @@ def columns_used(e: Expr) -> set[str]:
         if isinstance(node, ColumnRef):
             out.add(node.name)
         if isinstance(node, IsValid):
-            out.add(node.mask_name)
+            out.update(node.mask_names)
     return out

@@ -27,8 +27,18 @@ class PlanField:
     name: str
     type: SqlType
     sdict: Optional[StringDictionary] = None  # for STRING columns
-    # bool column name indicating validity (outer-join nullable side)
-    null_mask: Optional[str] = None
+    # validity mask column name(s): the column is valid (NOT NULL) where ALL
+    # named bool columns are True. A column nullable through several outer
+    # joins / a nullable base column carries one name per source.
+    null_mask: Optional[str | tuple[str, ...]] = None
+
+    @property
+    def masks(self) -> tuple[str, ...]:
+        if self.null_mask is None:
+            return ()
+        if isinstance(self.null_mask, str):
+            return (self.null_mask,)
+        return self.null_mask
 
 
 @dataclass
@@ -72,6 +82,9 @@ class PScan(PlanNode):
     column_map: dict[str, str]
     capacity: int          # static array capacity (≥1 even when empty)
     num_rows: int = -1     # actual rows; -1 means == capacity
+    # physical column name → output validity-mask field name, for base
+    # columns that contain NULLs (storage keys them "$nn:<phys>")
+    mask_map: dict[str, str] = dc_field(default_factory=dict)
 
     def title(self):
         return f"Scan {self.table_name} [{self.capacity}]"
@@ -121,6 +134,14 @@ class PJoin(PlanNode):
     # correlated-EXISTS extra conditions (e.g. Q21's l2.l_suppkey <>
     # l1.l_suppkey); forces pair-expansion evaluation
     residual: Optional[ex.Expr] = None
+    # SQL NULL join-key semantics: a NULL key matches nothing. These bool
+    # exprs (over build/probe columns) are True where every key is valid;
+    # None = keys provably non-null.
+    build_key_valid: Optional[ex.Expr] = None
+    probe_key_valid: Optional[ex.Expr] = None
+    # NOT IN (subquery) null-awareness: if ANY build key is NULL, the anti
+    # join yields no rows at all (x NOT IN (..., NULL) is never TRUE)
+    null_aware: bool = False
 
     def children(self):
         return [self.build, self.probe]

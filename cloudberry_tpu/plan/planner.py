@@ -143,6 +143,13 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
         nl = buf.find(b"\n")
         buf = buf[nl + 1:] if nl >= 0 else b""
     d = stmt.delimiter
+    db = d.encode()
+    # NULLs in the file (\N, or an empty field for non-string columns) need
+    # per-row masks: take the host text path. The conservative byte probe
+    # keeps the native fast path for files that can't contain NULLs.
+    if (b"\\N" in buf or db + db in buf or buf.startswith(db)
+            or b"\n" + db in buf or db + b"\n" in buf or buf.endswith(db)):
+        return _copy_from_text(table, buf, db)
     fields = table.schema.fields
     text_cols: dict[int, list] = {}
     need_text = [i for i, f in enumerate(fields)
@@ -200,6 +207,73 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
     return f"COPY {n_rows or 0}"
 
 
+def _copy_from_text(table, buf: bytes, db: bytes) -> str:
+    """COPY FROM host text path with NULL support: \\N is NULL everywhere;
+    an empty field is NULL for non-string columns (empty string is a value
+    for strings, matching PostgreSQL text-format COPY)."""
+    from cloudberry_tpu.columnar.batch import encode_column
+
+    fields = table.schema.fields
+    rows = [ln.split(db) for ln in buf.splitlines() if ln]
+    n_rows = len(rows)
+    parsed = {}
+    new_valid = {}
+    for i, f in enumerate(fields):
+        try:
+            toks = [r[i] for r in rows]
+        except IndexError:
+            raise BindError(f"COPY: a line has fewer than {i + 1} columns")
+        if f.dtype == T.DType.STRING:
+            isnull = np.asarray([t == b"\\N" for t in toks], dtype=np.bool_)
+        else:
+            isnull = np.asarray([t in (b"", b"\\N") for t in toks],
+                                dtype=np.bool_)
+        if isnull.any() and not f.nullable:
+            raise BindError(f"COPY: NULL in NOT NULL column {f.name!r}")
+        vals = [_NULL_FILL[f.dtype] if m else t.decode()
+                for t, m in zip(toks, isnull)]
+        try:
+            if f.dtype in (T.DType.INT32, T.DType.INT64):
+                arr = np.asarray([int(v) for v in vals]) \
+                    .astype(f.type.np_dtype)
+            elif f.dtype == T.DType.DECIMAL:
+                arr = np.asarray(
+                    [_exact_decimal(v, f.type.scale) for v in vals],
+                    dtype=np.int64)
+            elif f.dtype == T.DType.FLOAT64:
+                arr = np.asarray([float(v) for v in vals])
+            elif f.dtype == T.DType.BOOL:
+                outv = []
+                for v in vals:
+                    lv = str(v).lower()
+                    if lv in ("t", "true", "1"):
+                        outv.append(True)
+                    elif lv in ("f", "false", "0"):
+                        outv.append(False)
+                    else:
+                        raise BindError(
+                            f"COPY: malformed boolean {v!r} in column "
+                            f"{f.name!r}")
+                arr = np.asarray(outv)
+            else:
+                arr = encode_column(np.asarray(vals, dtype=object), f,
+                                    table.dicts)
+        except ValueError as e2:
+            raise BindError(
+                f"COPY: malformed value in column {f.name!r}: {e2}")
+        old = table.data.get(f.name)
+        n_old = len(old) if old is not None else 0
+        parsed[f.name] = arr if n_old == 0 else np.concatenate([old, arr])
+        old_v = table.validity.get(f.name)
+        if isnull.any() or old_v is not None:
+            if old_v is None:
+                old_v = np.ones(n_old, dtype=np.bool_)
+            new_valid[f.name] = np.concatenate([old_v, ~isnull]) \
+                if n_old else ~isnull
+    table.set_data(parsed, table.dicts, validity=new_valid)
+    return f"COPY {n_rows}"
+
+
 def _copy_to(session, stmt: ast.CopyTo) -> str:
     """Delimited-file unload (COPY TO / writable-external analog).
     Decimals format from their raw int64 fixed-point (never through float,
@@ -233,6 +307,12 @@ def _copy_to(session, stmt: ast.CopyTo) -> str:
             cols.append([repr(float(v)) for v in arr])
         else:
             cols.append([str(v) for v in arr])
+    for idx, f in enumerate(table.schema.fields):
+        vm = table.validity.get(f.name)
+        if vm is not None:
+            col = cols[idx]
+            for i in np.nonzero(~np.asarray(vm))[0]:
+                col[i] = "\\N"
     with open(stmt.path, "w") as fh:
         if stmt.header:
             fh.write(d.join(table.schema.names) + "\n")
@@ -258,16 +338,22 @@ def _delete(session, stmt: ast.Delete) -> str:
         table.set_data({f.name: np.zeros(0, dtype=f.type.np_dtype)
                         for f in table.schema.fields}, table.dicts)
         return f"DELETE {before}"
+    # DELETE removes rows where the predicate is TRUE; a NULL predicate
+    # KEEPS the row (3VL) — so keep NOT pred OR pred IS NULL
     keep = ast.Select(
         items=[ast.SelectItem(ast.Name((f.name,)), f.name)
                for f in table.schema.fields],
         from_refs=[ast.TableName(stmt.table)],
-        where=ast.UnaryOp("not", stmt.where))
+        where=ast.BinOp("or", ast.UnaryOp("not", stmt.where),
+                        ast.IsNull(stmt.where, False)))
     batch = _run_internal(session, keep)
     sel = np.asarray(batch.sel)
     new_data = {f.name: np.asarray(batch.columns[f.name])[sel]
                 for f in table.schema.fields}
-    table.set_data(new_data, table.dicts)
+    new_valid = {f.name: np.asarray(batch.validity[f.name])
+                 .astype(np.bool_)[sel]
+                 for f in table.schema.fields if f.name in batch.validity}
+    table.set_data(new_data, table.dicts, validity=new_valid)
     return f"DELETE {before - int(sel.sum())}"
 
 
@@ -314,6 +400,7 @@ def _update(session, stmt: ast.Update) -> str:
     n_upd = int(np.asarray(batch.columns["$updated"])[sel].sum()) \
         if stmt.where is not None else int(sel.sum())
     new_data = {}
+    new_valid = {}
     dicts = dict(table.dicts)
     for f in table.schema.fields:
         arr = np.asarray(batch.columns[f.name])[sel]
@@ -326,7 +413,10 @@ def _update(session, stmt: ast.Update) -> str:
             if nd is not None:
                 dicts[f.name] = nd
         new_data[f.name] = arr.astype(f.type.np_dtype)
-    table.set_data(new_data, dicts)
+        vm = batch.validity.get(f.name)
+        if vm is not None:
+            new_valid[f.name] = np.asarray(vm).astype(np.bool_)[sel]
+    table.set_data(new_data, dicts, validity=new_valid)
     return f"UPDATE {n_upd}"
 
 
@@ -352,11 +442,14 @@ def _ctas(session, stmt: ast.CreateTableAs) -> str:
                             "the query output")
     t = session.catalog.create_table(stmt.name, batch.schema, policy)
     sel = np.asarray(batch.sel)
-    data = {}
+    data, validity = {}, {}
     for f in batch.schema.fields:
         data[f.name] = np.asarray(batch.columns[f.name])[sel] \
             .astype(f.type.np_dtype)
-    t.set_data(data, dict(batch.dicts))
+        vm = batch.validity.get(f.name)
+        if vm is not None:
+            validity[f.name] = np.asarray(vm).astype(np.bool_)[sel]
+    t.set_data(data, dict(batch.dicts), validity=validity)
     return f"SELECT {int(sel.sum())}"
 
 
@@ -377,13 +470,36 @@ def _insert_select(session, stmt: ast.InsertSelect) -> str:
     df = batch.to_pandas()  # decode, then re-encode into the table's dicts
     new_rows = len(df)
     new_data = {}
+    new_valid = {}
     for f, qname in zip(table.schema.fields, df.columns):
-        vals = df[qname].to_numpy()
-        arr = encode_column(vals, f, table.dicts)
+        vals = df[qname]
+        isna = vals.isna().to_numpy()
+        if isna.any():
+            if not f.nullable:
+                raise BindError(
+                    f"INSERT: NULL in NOT NULL column {f.name!r}")
+            fill = _NULL_FILL[f.dtype]
+            if f.dtype == T.DType.DATE:
+                fill = np.datetime64(0, "D")
+            elif f.dtype in (T.DType.INT32, T.DType.INT64,
+                             T.DType.DECIMAL, T.DType.FLOAT64):
+                fill = 0
+            vals_np = np.asarray(
+                [fill if m else v for v, m in zip(vals.to_numpy(), isna)])
+        else:
+            vals_np = vals.to_numpy()
+        arr = encode_column(vals_np, f, table.dicts)
         old = table.data.get(f.name)
-        new_data[f.name] = arr if old is None or len(old) == 0 \
+        n_old = len(old) if old is not None else 0
+        new_data[f.name] = arr if n_old == 0 \
             else np.concatenate([old, arr])
-    table.set_data(new_data, table.dicts)
+        old_v = table.validity.get(f.name)
+        if isna.any() or old_v is not None:
+            if old_v is None:
+                old_v = np.ones(n_old, dtype=np.bool_)
+            new_valid[f.name] = np.concatenate([old_v, ~isna]) \
+                if n_old else ~isna
+    table.set_data(new_data, table.dicts, validity=new_valid)
     return f"INSERT {new_rows}"
 
 
@@ -410,6 +526,13 @@ def _distribute(plan: N.PlanNode, session) -> N.PlanNode:
     return plan
 
 
+_NULL = object()   # sentinel for a NULL literal in VALUES
+
+_NULL_FILL = {T.DType.BOOL: False, T.DType.INT32: "0", T.DType.INT64: "0",
+              T.DType.FLOAT64: "0", T.DType.DECIMAL: "0",
+              T.DType.DATE: "1970-01-01", T.DType.STRING: ""}
+
+
 def _insert_values(catalog, stmt: ast.InsertValues) -> str:
     from cloudberry_tpu.columnar.batch import encode_column
 
@@ -424,8 +547,15 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
         for c, v in zip(cols, row):
             by_col[c].append(_literal_value(v))
     new_data = {}
+    new_valid = {}
     for f in table.schema.fields:
         raw = by_col[f.name]
+        isnull = np.asarray([v is _NULL for v in raw], dtype=np.bool_)
+        if isnull.any():
+            if not f.nullable:
+                raise BindError(
+                    f"INSERT: NULL in NOT NULL column {f.name!r}")
+            raw = [_NULL_FILL[f.dtype] if v is _NULL else v for v in raw]
         try:
             if f.dtype == T.DType.DECIMAL:
                 # exact fixed-point from the literal TEXT — a float
@@ -444,9 +574,16 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
             raise BindError(
                 f"INSERT: bad literal for column {f.name!r}: {e2}")
         old = table.data.get(f.name)
-        new_data[f.name] = arr if old is None or len(old) == 0 \
+        n_old = len(old) if old is not None else 0
+        new_data[f.name] = arr if n_old == 0 \
             else np.concatenate([old, arr])
-    table.set_data(new_data, table.dicts)
+        old_v = table.validity.get(f.name)
+        if isnull.any() or old_v is not None:
+            if old_v is None:
+                old_v = np.ones(n_old, dtype=np.bool_)
+            new_valid[f.name] = np.concatenate([old_v, ~isnull]) \
+                if n_old else ~isnull
+    table.set_data(new_data, table.dicts, validity=new_valid)
     return f"INSERT {len(stmt.rows)}"
 
 
@@ -496,6 +633,8 @@ def _literal_value(e: ast.ExprNode):
         return e.value
     if isinstance(e, ast.BoolLit):
         return e.value
+    if isinstance(e, ast.NullLit):
+        return _NULL
     if isinstance(e, ast.UnaryOp) and e.op == "-":
         inner = _literal_value(e.operand)
         return f"-{inner}" if isinstance(inner, str) else -inner

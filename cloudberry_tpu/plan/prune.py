@@ -52,27 +52,50 @@ def _pushdown(node: N.PlanNode) -> N.PlanNode:
 
 
 def _substitute_cols(e: ex.Expr, mapping: dict[str, ex.Expr]) -> ex.Expr:
-    return ex.rewrite(
-        e, lambda n: mapping.get(n.name)
-        if isinstance(n, ex.ColumnRef) else None)
+    def fn(n):
+        if isinstance(n, ex.ColumnRef):
+            return mapping.get(n.name)
+        if isinstance(n, ex.IsValid):
+            # mask references rewrite with the projection's renames too
+            new = []
+            for m in n.mask_names:
+                t = mapping.get(m)
+                if not isinstance(t, ex.ColumnRef):
+                    return None
+                new.append(t.name)
+            return ex.IsValid(tuple(new), n.negate)
+        return None
+
+    return ex.rewrite(e, fn)
 
 
 def _expr_cols(e: ex.Expr) -> set[str]:
     out = ex.columns_used(e)
     for node in ex.walk(e):
-        mask = getattr(node, "_null_mask", None)
-        if mask and mask != "$lost":
-            out.add(mask)
+        v = getattr(node, "_null_expr", None)
+        if v is not None:
+            out |= ex.columns_used(v)
         if isinstance(node, ex.SubqueryScalar):
             _prune(node.plan, set(node.plan.names))
     return out
 
 
+def _with_field_masks(node: N.PlanNode, req: set[str]) -> set[str]:
+    """A required field drags its validity mask columns along."""
+    out = set(req)
+    for f in node.fields:
+        if f.name in out:
+            out.update(f.masks)
+    return out
+
+
 def _prune(node: N.PlanNode, req: set[str]) -> None:
     if isinstance(node, N.PScan):
-        keep = {phys: out for phys, out in node.column_map.items()
-                if out in req}
-        node.column_map = keep
+        req = _with_field_masks(node, req)
+        node.column_map = {phys: out for phys, out in node.column_map.items()
+                           if out in req}
+        node.mask_map = {phys: out for phys, out in node.mask_map.items()
+                         if out in req}
         node.fields = [f for f in node.fields if f.name in req]
         return
 
@@ -81,6 +104,7 @@ def _prune(node: N.PlanNode, req: set[str]) -> None:
         return
 
     if isinstance(node, N.PProject):
+        req = _with_field_masks(node, req)
         node.exprs = [(n, e) for n, e in node.exprs if n in req]
         node.fields = [f for f in node.fields if f.name in req]
         child_req = set()
@@ -90,12 +114,17 @@ def _prune(node: N.PlanNode, req: set[str]) -> None:
         return
 
     if isinstance(node, N.PJoin):
+        req = _with_field_masks(node, req)
         build_req = set()
         probe_req = set()
         for k in node.build_keys:
             build_req |= _expr_cols(k)
         for k in node.probe_keys:
             probe_req |= _expr_cols(k)
+        if node.build_key_valid is not None:
+            build_req |= _expr_cols(node.build_key_valid)
+        if node.probe_key_valid is not None:
+            probe_req |= _expr_cols(node.probe_key_valid)
         if node.residual is not None:
             rcols = _expr_cols(node.residual)
             build_names = set(node.build.names)
@@ -136,7 +165,7 @@ def _prune(node: N.PlanNode, req: set[str]) -> None:
         return
 
     if isinstance(node, N.PMotion):
-        child_req = set(req)
+        child_req = _with_field_masks(node, set(req))
         for e in node.hash_keys:
             child_req |= _expr_cols(e)
         _prune(node.child, child_req)

@@ -91,7 +91,7 @@ class Session:
                     name: (t, t.data,
                            {c: StringDictionary(d.values)
                             for c, d in t.dicts.items()},
-                           t.policy)
+                           t.policy, dict(t.validity))
                     for name, t in self.catalog.tables.items()},
                 "views": dict(self.catalog.views),
             }
@@ -103,9 +103,10 @@ class Session:
             return "COMMIT"
         # rollback
         self.catalog.tables = {}
-        for name, (t, data, dicts, policy) in snap["tables"].items():
+        for name, (t, data, dicts, policy, validity) in \
+                snap["tables"].items():
             t.policy = policy
-            t.set_data(data, dicts)  # bumps version → caches invalidate
+            t.set_data(data, dicts, validity=validity)  # bumps version
             self.catalog.tables[name] = t
         self.catalog.views = snap["views"]
         self.catalog.bump_ddl()
@@ -209,8 +210,13 @@ class Session:
         if cached is not None and cached.version == version:
             return cached
 
+        # validity masks ride as ordinary "$nn:<col>" bool columns so the
+        # distributed input plumbing shards them like any other column
+        phys_cols = dict(t.data)
+        for cname, vm in t.validity.items():
+            phys_cols[f"$nn:{cname}"] = np.asarray(vm, dtype=np.bool_)
         if t.policy.kind == "replicated":
-            st = ShardedTable(dict(t.data),
+            st = ShardedTable(phys_cols,
                               np.full(nseg, t.num_rows, dtype=np.int64),
                               max(t.num_rows, 1), True, version)
         else:
@@ -221,7 +227,7 @@ class Session:
             cols = {}
             order = np.argsort(assign, kind="stable") if len(assign) else assign
             starts = np.concatenate([[0], np.cumsum(counts)])
-            for cname, arr in t.data.items():
+            for cname, arr in phys_cols.items():
                 buf = np.zeros((nseg, cap), dtype=arr.dtype)
                 sorted_arr = arr[order]
                 for s in range(nseg):

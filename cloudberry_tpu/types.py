@@ -94,7 +94,8 @@ def DECIMAL(scale: int = 2) -> SqlType:
 class Field:
     name: str
     type: SqlType
-    nullable: bool = False
+    # SQL default: columns are nullable unless declared NOT NULL
+    nullable: bool = True
 
     @property
     def dtype(self) -> DType:

@@ -1,0 +1,326 @@
+"""General NULL semantics — the full-SQL three-valued-logic surface.
+
+The reference inherits NULL handling from PostgreSQL (per-datum null flags);
+here validity is compiled structure: expression-level validity exprs in the
+binder, hidden "$vm"/"$nn:" bool columns at plan boundaries, identity-filled
+aggregate args with valid-count companions (plan/binder.py). These tests pin
+the observable semantics against PostgreSQL behavior.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+
+
+def _mk(nseg=1):
+    s = cb.Session(Config(n_segments=nseg)) if nseg > 1 else cb.Session()
+    s.sql("create table t (a int, b int, c text, f double) "
+          "distributed by (a)")
+    s.sql("insert into t values "
+          "(1, 10, 'x', 1.5), (2, null, 'y', null), "
+          "(3, 30, null, 3.5), (4, null, null, null), (5, 0, 'x', 0.0)")
+    s.sql("create table u (a int, d int) distributed by (a)")
+    s.sql("insert into u values (1, 100), (3, 300), (6, null)")
+    return s
+
+
+@pytest.fixture(scope="module", params=[1, 8], ids=["single", "dist8"])
+def s(request):
+    return _mk(request.param)
+
+
+def _norm(vals):
+    """pandas renders string/float NULLs as NaN, object NULLs as None —
+    normalize both to None for comparison."""
+    return [None if (v is None or (isinstance(v, float) and np.isnan(v))
+                     or v is pd.NA) else v for v in vals]
+
+
+def rows(s, q):
+    return [_norm(r) for r in s.sql(q).to_pandas().values.tolist()]
+
+
+def col(s, q, name=None):
+    df = s.sql(q).to_pandas()
+    return _norm(df[name if name else df.columns[0]].tolist())
+
+
+# ------------------------------------------------------------- predicates
+
+
+def test_where_null_excluded(s):
+    # b > 5 is NULL for NULL b: those rows are excluded, not errors
+    assert col(s, "select a from t where b > 5 order by a") == [1, 3]
+
+
+def test_where_not_null_excluded(s):
+    # NOT (NULL) is still NULL -> excluded (3VL, not two-valued negation)
+    assert col(s, "select a from t where not (b > 5) order by a") == [5]
+
+
+def test_is_null_and_not_null(s):
+    assert col(s, "select a from t where b is null order by a") == [2, 4]
+    assert col(s, "select a from t where b is not null order by a") \
+        == [1, 3, 5]
+
+
+def test_3vl_or_and(s):
+    # (b > 5 OR a = 2): NULL OR TRUE = TRUE keeps row 2
+    assert col(s, "select a from t where b > 5 or a = 2 order by a") \
+        == [1, 2, 3]
+    # (b > 5 AND a < 10): NULL AND TRUE = NULL -> excluded
+    assert col(s, "select a from t where b > 5 and a < 10 order by a") \
+        == [1, 3]
+
+
+def test_null_literal_comparison(s):
+    assert col(s, "select a from t where b = null") == []
+    assert col(s, "select a from t where null = null") == []
+
+
+def test_in_list_with_null_value(s):
+    assert col(s, "select a from t where b in (10, 30) order by a") == [1, 3]
+    # NOT IN over a nullable column: NULL b is excluded
+    assert col(s, "select a from t where b not in (10) order by a") == [3, 5]
+
+
+# ------------------------------------------------------------ expressions
+
+
+def test_arithmetic_propagates_null(s):
+    out = col(s, "select b + 1 from t order by a")
+    assert out == [11, None, 31, None, 1]
+    out = col(s, "select b * 2 - a from t order by a")
+    assert out == [19, None, 57, None, -5]
+
+
+def test_coalesce(s):
+    assert col(s, "select coalesce(b, -1) from t order by a") \
+        == [10, -1, 30, -1, 0]
+    assert col(s, "select coalesce(b, a) from t order by a") \
+        == [10, 2, 30, 4, 0]
+    assert col(s, "select coalesce(c, 'missing') from t order by a") \
+        == ["x", "y", "missing", "missing", "x"]
+
+
+def test_case_implicit_null(s):
+    out = col(s, "select case when b > 15 then 'big' end from t order by a")
+    assert out == [None, None, "big", None, None]
+    out = col(s, "select case when b > 15 then b else null end "
+                 "from t order by a")
+    assert out == [None, None, 30, None, None]
+
+
+def test_case_null_condition_falls_through(s):
+    # b > 5 NULL for rows 2/4 -> fall to ELSE
+    out = col(s, "select case when b > 5 then 1 else 0 end "
+                 "from t order by a")
+    assert out == [1, 0, 1, 0, 0]
+
+
+# ------------------------------------------------------------- aggregates
+
+
+def test_aggregates_skip_nulls(s):
+    df = s.sql("select count(*) as n, count(b) as nb, sum(b) as sb, "
+               "avg(b) as ab, min(b) as mb, max(b) as xb from t").to_pandas()
+    assert df.n[0] == 5 and df.nb[0] == 3
+    assert df.sb[0] == 40 and df.mb[0] == 0 and df.xb[0] == 30
+    assert abs(df.ab[0] - 40 / 3) < 1e-9
+
+
+def test_empty_aggregates_are_null(s):
+    df = s.sql("select sum(b) as sb, min(b) as mb, avg(b) as ab, "
+               "count(b) as nb from t where a > 100").to_pandas()
+    assert df.sb[0] is None and df.mb[0] is None and df.ab[0] is None
+    assert df.nb[0] == 0
+
+
+def test_all_null_group_aggregate(s):
+    # group c=NULL has b values {30, NULL}; group 'y' has only NULL b
+    out = rows(s, "select c, sum(b), count(b) from t group by c order by c")
+    assert out == [["x", 10, 2], ["y", None, 0], [None, 30, 1]]
+
+
+def test_group_by_nullable_key(s):
+    # NULLs form ONE group, distinct from real values (incl. 0-adjacent)
+    out = rows(s, "select b, count(*) from t group by b order by b")
+    assert out == [[0, 1], [10, 1], [30, 1], [None, 2]]
+
+
+def test_count_distinct_skips_nulls(s):
+    assert col(s, "select count(distinct c) from t") == [2]
+    assert col(s, "select count(distinct b) from t") == [3]
+
+
+def test_avg_nullable_distributed_split(s):
+    out = rows(s, "select c, avg(b) from t group by c order by c")
+    assert out[0][0] == "x" and abs(out[0][1] - 5.0) < 1e-9
+    assert out[1][0] == "y" and out[1][1] is None
+    assert out[2][0] is None and abs(out[2][1] - 30.0) < 1e-9
+
+
+# ------------------------------------------------------------------ joins
+
+
+def test_null_keys_never_match(s):
+    # u has a NULL d; t row 5 has b=0 — NULL keys must not pair up
+    out = rows(s, "select t.a, u.a from t join u on t.b = u.d")
+    assert out == []
+
+
+def test_left_join_nullable_payload(s):
+    out = rows(s, "select t.a, u.d from t left join u on t.a = u.a "
+                  "order by t.a")
+    assert out == [[1, 100], [2, None], [3, 300], [4, None], [5, None]]
+
+
+def test_null_provenance_through_derived_table(s):
+    # the round-1 "$lost" case: nullable column re-exported by a subquery
+    q = ("select * from (select t.a as a, u.d as d from t "
+         "left join u on t.a = u.a) v where d is null order by a")
+    assert col(s, q) == [2, 4, 5]
+    q2 = ("select count(d) from (select t.a as a, u.d as d from t "
+          "left join u on t.a = u.a) v")
+    assert col(s, q2) == [2]
+    q3 = ("select avg(d) from (select t.a as a, u.d as d from t "
+          "left join u on t.a = u.a) v")
+    assert abs(col(s, q3)[0] - 200.0) < 1e-9
+
+
+def test_double_nullable_masks_conjoin(s):
+    # nullable through TWO outer joins: validity is the mask conjunction
+    q = ("select t.a, w.d2 from t "
+         "left join (select u.a as a2, u.d as d2 from u) w on t.a = w.a2 "
+         "order by t.a")
+    assert rows(s, q) == [[1, 100], [2, None], [3, 300], [4, None],
+                          [5, None]]
+
+
+def test_not_in_null_aware(s):
+    # u.d contains NULL -> x NOT IN (select d from u) is never TRUE
+    assert col(s, "select a from t where a not in (select d from u)") == []
+    # without the NULL, normal anti semantics
+    assert col(s, "select a from t where a not in "
+                  "(select d from u where d is not null) order by a") \
+        == [1, 2, 3, 4, 5]
+
+
+# ------------------------------------------------------- sort / distinct
+
+
+def test_null_sort_order(s):
+    # ascending: NULLS LAST; descending: NULLS FIRST (PostgreSQL default)
+    assert col(s, "select b from t order by b") == [0, 10, 30, None, None]
+    assert col(s, "select b from t order by b desc, a") \
+        == [None, None, 30, 10, 0]
+
+
+def test_distinct_groups_nulls(s):
+    assert col(s, "select distinct b from t order by b") \
+        == [0, 10, 30, None]
+    assert col(s, "select distinct c from t order by c") == ["x", "y", None]
+
+
+def test_union_intersect_except_with_nulls(s):
+    assert col(s, "select b from t union select d from u order by b") \
+        == [0, 10, 30, 100, 300, None]
+    # INTERSECT: NULL equals NULL for set ops
+    assert col(s, "select b from t intersect select d from u "
+                  "order by b") == [None]
+    assert col(s, "select b from t except select b from t where b is null "
+                  "order by b") == [0, 10, 30]
+
+
+# --------------------------------------------------------------- DML / IO
+
+
+def test_update_set_null_and_delete_3vl():
+    s2 = _mk(1)
+    s2.sql("update t set b = null where a = 1")
+    assert col(s2, "select a from t where b is null order by a") == [1, 2, 4]
+    # DELETE where b > 5: NULL predicate rows must be KEPT
+    s2.sql("delete from t where b > 5")
+    assert col(s2, "select a from t order by a") == [1, 2, 4, 5]
+
+
+def test_ctas_preserves_validity():
+    s2 = _mk(1)
+    s2.sql("create table t2 as select a, b from t distributed by (a)")
+    assert col(s2, "select a from t2 where b is null order by a") == [2, 4]
+
+
+def test_insert_select_preserves_validity():
+    s2 = _mk(1)
+    s2.sql("create table t3 (a int, b int) distributed by (a)")
+    s2.sql("insert into t3 select a, b from t")
+    assert col(s2, "select a from t3 where b is null order by a") == [2, 4]
+
+
+def test_copy_null_roundtrip(tmp_path):
+    s2 = _mk(1)
+    p = tmp_path / "t.csv"
+    s2.sql(f"copy t to '{p}'")
+    text = p.read_text()
+    assert "\\N" in text
+    s2.sql("create table tc (a int, b int, c text, f double) "
+           "distributed by (a)")
+    s2.sql(f"copy tc from '{p}'")
+    a = s2.sql("select a, b, c from tc order by a").to_pandas()
+    b = s2.sql("select a, b, c from t order by a").to_pandas()
+    pd.testing.assert_frame_equal(a, b)
+
+
+def test_not_null_constraint_rejected():
+    s2 = cb.Session()
+    s2.sql("create table nn (a int not null, b int) distributed by (a)")
+    with pytest.raises(Exception, match="NOT NULL"):
+        s2.sql("insert into nn values (null, 1)")
+
+
+def test_having_on_nullable_agg():
+    s2 = _mk(1)
+    out = rows(s2, "select c, sum(b) as sb from t group by c "
+                   "having sum(b) > 5 order by c")
+    # 'y' group's sum is NULL -> HAVING NULL excludes that group
+    assert out == [["x", 10], [None, 30]]
+
+
+def test_not_in_null_aware_cross_segment():
+    """The NULL build row may live on a DIFFERENT segment than the probe
+    rows: the has-NULL test must reduce across the whole mesh (psum)."""
+    s8 = cb.Session(Config(n_segments=2))
+    s8.sql("create table tt (a int, b int) distributed by (b)")
+    s8.sql("insert into tt values (1, 1), (2, 2), (3, 3), (4, 4)")
+    s8.sql("create table uu (x int) distributed by (x)")
+    s8.sql("insert into uu values (10), (null)")
+    assert rows(s8, "select a from tt where b not in (select x from uu)") \
+        == []
+
+
+def test_window_partition_by_nullable_key():
+    s2 = _mk(1)
+    # c has values x,y,NULL,NULL,x — the NULL partition must be its own,
+    # distinct from any canonical value
+    out = rows(s2, "select a, count(*) over (partition by c) as n "
+                   "from t order by a")
+    assert out == [[1, 2], [2, 1], [3, 2], [4, 2], [5, 2]]
+
+
+def test_order_by_hidden_sort_column_null_order():
+    s2 = _mk(1)
+    # ORDER BY a non-output nullable column goes through the hidden
+    # sort-column path; NULLS LAST must still hold
+    out = col(s2, "select a from t order by b, a")
+    assert out == [5, 1, 3, 2, 4]
+
+
+def test_null_flows_through_motions():
+    """Redistribute a nullable column across 8 segments: masks ride the
+    all_to_all like any other column."""
+    s8 = _mk(8)
+    out = rows(s8, "select b, count(*) as n from t group by b order by b")
+    assert out == [[0, 1], [10, 1], [30, 1], [None, 2]]

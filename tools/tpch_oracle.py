@@ -291,7 +291,9 @@ def q17(t):
     avg_q = li.groupby("l_partkey")["l_quantity"].mean() * 0.2
     j = li.merge(p, left_on="l_partkey", right_on="p_partkey")
     j = j[j.l_quantity < j.l_partkey.map(avg_q)]
-    return pd.DataFrame({"avg_yearly": [j.l_extendedprice.sum() / 7.0]})
+    # SQL: sum() over zero rows is NULL, not 0 (pandas' .sum() default)
+    total = j.l_extendedprice.sum() / 7.0 if len(j) else float("nan")
+    return pd.DataFrame({"avg_yearly": [total]})
 
 
 def q18(t):
