@@ -115,6 +115,14 @@ def make_batch(plan: N.PlanNode, cols, sel) -> ColumnBatch:
 def scans_of(plan: N.PlanNode):
     if isinstance(plan, N.PScan) and plan.table_name != "$dual":
         yield plan
+    # scalar subqueries ride inside expressions, not children — their scans
+    # need table inputs too (a FROM-less outer SELECT may still scan)
+    from cloudberry_tpu.plan.distribute import _node_exprs
+
+    for e in _node_exprs(plan):
+        for sub in ex.walk(e):
+            if isinstance(sub, ex.SubqueryScalar):
+                yield from scans_of(sub.plan)
     for c in plan.children():
         yield from scans_of(c)
 
@@ -131,6 +139,8 @@ class Lowerer:
         self.tables = tables
         self.checks: dict[str, jnp.ndarray] = {}
         self._subcache: dict[int, jnp.ndarray] = {}
+        # shared-subplan (PShare) results, keyed by child object identity
+        self._sharecache: dict[int, tuple] = {}
         # scatter (segment ops) lower well on CPU; TPU serializes large
         # scatters, so it gets unrolled masked reductions instead
         platform = platform or jax.default_backend()
@@ -173,6 +183,11 @@ class Lowerer:
             return self.motion(node)
         if isinstance(node, N.PWindow):
             return self.window(node)
+        if isinstance(node, N.PShare):
+            key = id(node.child)
+            if key not in self._sharecache:
+                self._sharecache[key] = self.lower(node.child)
+            return self._sharecache[key]
         if isinstance(node, N.PConcat):
             outs = [self.lower(c) for c in node.inputs]
             cols = {f.name: jnp.concatenate([o[0][f.name] for o in outs])

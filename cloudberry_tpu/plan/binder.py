@@ -162,6 +162,8 @@ class Binder:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
         self._counter = 0
+        # CTE name -> bound plan; references share the plan via PShare
+        self._ctes: dict[str, N.PlanNode] = {}
 
     def gensym(self, prefix: str) -> str:
         self._counter += 1
@@ -170,6 +172,15 @@ class Binder:
     # ------------------------------------------------------------ statements
 
     def bind_query(self, node: ast.Node) -> N.PlanNode:
+        if isinstance(node, ast.WithQuery):
+            saved = dict(self._ctes)
+            try:
+                for name, q in node.ctes:
+                    # earlier CTEs are visible to later ones (non-recursive)
+                    self._ctes[name.lower()] = self.bind_query(q)
+                return self.bind_query(node.query)
+            finally:
+                self._ctes = saved
         if isinstance(node, ast.SetOp):
             return self.bind_setop(node)
         return self.bind_select(node)
@@ -422,12 +433,29 @@ class Binder:
     def bind_table_ref(self, ref: ast.TableRefNode, scope: Scope,
                        post_filters: list[ast.ExprNode]) -> tuple[str, N.PlanNode]:
         if isinstance(ref, ast.TableName):
+            cte = self._ctes.get(ref.name.lower())
+            if cte is not None:
+                # CTE reference: every reference shares the SAME bound plan
+                # (materialize-once, the ShareInputScan analog)
+                share = N.PShare(cte)
+                share.fields = list(cte.fields)
+                alias = ref.alias or ref.name
+                proj = self._requalify(share, alias)
+                scope.entries.append(RangeEntry(alias, proj))
+                return alias, proj
             view = self.catalog.views.get(ref.name.lower())
             if view is not None:
-                # view expansion: re-bind the stored query as a derived table
-                return self.bind_table_ref(
-                    ast.DerivedTable(view, ref.alias or ref.name),
-                    scope, post_filters)
+                # view expansion: re-bind the stored query as a derived
+                # table — with the caller's CTEs HIDDEN (a view's references
+                # are fixed at creation; PostgreSQL semantics)
+                saved = self._ctes
+                self._ctes = {}
+                try:
+                    return self.bind_table_ref(
+                        ast.DerivedTable(view, ref.alias or ref.name),
+                        scope, post_filters)
+                finally:
+                    self._ctes = saved
             table = self._lookup_table(ref.name)
             alias = ref.alias or ref.name
             plan = _scan_node(table, alias)
@@ -435,26 +463,31 @@ class Binder:
             return alias, plan
         if isinstance(ref, ast.DerivedTable):
             sub = self.bind_query(ref.select)
-            alias = ref.alias
-            # re-qualify output names under the derived alias
-            proj = N.PProject(sub, [(f"{alias}.{f.name.split('.')[-1]}",
-                                     ex.ColumnRef(f.name, f.type))
-                                    for f in sub.fields])
-            def _remap_mask(nm):
-                if nm is None:
-                    return None
-                masks = (nm,) if isinstance(nm, str) else nm
-                return tuple(f"{alias}.{m.split('.')[-1]}" for m in masks)
-
-            proj.fields = [N.PlanField(f"{alias}.{f.name.split('.')[-1]}",
-                                       f.type, f.sdict,
-                                       null_mask=_remap_mask(f.null_mask))
-                           for f in sub.fields]
-            scope.entries.append(RangeEntry(alias, proj))
-            return alias, proj
+            proj = self._requalify(sub, ref.alias)
+            scope.entries.append(RangeEntry(ref.alias, proj))
+            return ref.alias, proj
         if isinstance(ref, ast.JoinRef):
             return self._bind_join_ref(ref, scope, post_filters)
         raise BindError(f"unsupported FROM item {type(ref).__name__}")
+
+    def _requalify(self, sub: N.PlanNode, alias: str) -> N.PProject:
+        """Re-qualify a subplan's output names under a derived/CTE alias
+        (mask column references remap with their fields)."""
+        proj = N.PProject(sub, [(f"{alias}.{f.name.split('.')[-1]}",
+                                 ex.ColumnRef(f.name, f.type))
+                                for f in sub.fields])
+
+        def _remap_mask(nm):
+            if nm is None:
+                return None
+            masks = (nm,) if isinstance(nm, str) else nm
+            return tuple(f"{alias}.{m.split('.')[-1]}" for m in masks)
+
+        proj.fields = [N.PlanField(f"{alias}.{f.name.split('.')[-1]}",
+                                   f.type, f.sdict,
+                                   null_mask=_remap_mask(f.null_mask))
+                       for f in sub.fields]
+        return proj
 
     def _bind_join_ref(self, ref: ast.JoinRef, scope: Scope,
                        post_filters: list[ast.ExprNode]) -> tuple[str, N.PlanNode]:
@@ -1387,20 +1420,39 @@ class Binder:
     def _bind_uncorrelated_scalar(self, node: ast.ScalarSubquery) -> ex.Expr:
         sub = Binder(self.catalog)
         sub._counter = self._counter + 1000
+        sub._ctes = self._ctes
         plan = sub.bind_select(node.select)
         ufs = _user_fields(plan)  # hidden $vm mask outputs don't count
         if len(ufs) != 1:
             raise BindError("scalar subquery must return one column")
         f = ufs[0]
-        e = ex.SubqueryScalar(plan, f.type)
+        if not f.masks:
+            e = ex.SubqueryScalar(plan, f.type)
+            if f.sdict is not None:
+                object.__setattr__(e, "_sdict", f.sdict)
+            return e
+        # nullable scalar: the value and its validity are TWO scalar
+        # subqueries over ONE shared subplan (PShare → computed once);
+        # validity then composes like any other expression's
+        share_v = N.PShare(plan)
+        share_v.fields = list(plan.fields)
+        vproj = N.PProject(share_v, [(f.name, ex.ColumnRef(f.name, f.type))])
+        vproj.fields = [N.PlanField(f.name, f.type, f.sdict)]
+        e = ex.SubqueryScalar(vproj, f.type)
         if f.sdict is not None:
             object.__setattr__(e, "_sdict", f.sdict)
-        return e
+        share_m = N.PShare(plan)
+        share_m.fields = list(plan.fields)
+        mname = self.gensym("sqv")
+        mproj = N.PProject(share_m, [(mname, ex.IsValid(f.masks))])
+        mproj.fields = [N.PlanField(mname, T.BOOL, None)]
+        return _set_valid(e, ex.SubqueryScalar(mproj, T.BOOL))
 
     def _scratch_inner_scope(self, sub: ast.Select) -> Scope:
         inner = Scope()
         sb = Binder(self.catalog)
         sb._counter = self._counter + 2000
+        sb._ctes = self._ctes
         dump: list = []
         for ref in sub.from_refs:
             sb.bind_table_ref(ref, inner, dump)

@@ -215,6 +215,20 @@ class Distributor:
             node.child = child
             node.sharding = child.sharding
             return node, cap
+        if isinstance(node, N.PShare):
+            # distribute the shared subplan ONCE; every reference sees the
+            # same (possibly motion-wrapped) result — consumers add their
+            # own motions above if they need a different distribution
+            cached = getattr(node.child, "_dist_out", None)
+            if cached is None:
+                child, cap = self.walk(node.child)
+                cached = (child, cap)
+                node.child._dist_out = cached
+                child._dist_out = cached
+            child, cap = cached
+            node.child = child
+            node.sharding = child.sharding
+            return node, cap
         if isinstance(node, N.PConcat):
             total = 0
             new_inputs = []

@@ -211,6 +211,25 @@ class PWindow(PlanNode):
 
 
 @dataclass
+class PShare(PlanNode):
+    """Materialize-once reference to a shared subplan — the ShareInputScan
+    analog (nodeShareInputScan.c:31-45). Every reference to one CTE holds
+    the SAME child object; pushdown, pruning, distribution and lowering all
+    memoize on that object's identity, so the subplan computes once per
+    statement (here: once per XLA program — XLA CSE would usually do this
+    anyway, but the memoization guarantees it and keeps plan rewrites from
+    mutating the shared subtree twice)."""
+
+    child: PlanNode
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        return "ShareInputScan"
+
+
+@dataclass
 class PConcat(PlanNode):
     """Append inputs (UNION ALL / the setop flow's Append, cdbsetop.c
     analog); output capacity = Σ child capacities."""

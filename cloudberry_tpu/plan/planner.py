@@ -87,7 +87,7 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
         plan = _optimize(plan, session)
         return PlanResult(is_ddl=True, ddl_result=plan.explain())
 
-    if isinstance(stmt, (ast.Select, ast.SetOp)):
+    if isinstance(stmt, (ast.Select, ast.SetOp, ast.WithQuery)):
         binder = Binder(catalog)
         plan = binder.bind_query(stmt)
         plan = _optimize(plan, session)
@@ -134,7 +134,6 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
     decimal columns parse through the native C++ codec
     (cloudberry_tpu.native), strings/dates through the host splitter."""
     from cloudberry_tpu import native
-    from cloudberry_tpu.columnar.batch import encode_column
 
     table = session.catalog.table(stmt.table)
     with open(stmt.path, "rb") as fh:
@@ -172,28 +171,8 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
         elif f.dtype == T.DType.DECIMAL:
             # already int64 fixed-point at the field's scale (physical form)
             arr = native.parse_decimal_column(buf, i, f.type.scale, d)
-        elif f.dtype == T.DType.FLOAT64:
-            try:
-                arr = np.asarray([float(v) for v in text_cols[i]])
-            except ValueError:
-                raise BindError(
-                    f"COPY: malformed double in column {f.name!r}")
-        elif f.dtype == T.DType.BOOL:
-            vals = []
-            for v in text_cols[i]:
-                lv = v.lower()
-                if lv in ("t", "true", "1"):
-                    vals.append(True)
-                elif lv in ("f", "false", "0"):
-                    vals.append(False)
-                else:
-                    raise BindError(
-                        f"COPY: malformed boolean {v!r} in column "
-                        f"{f.name!r}")
-            arr = np.asarray(vals)
-        else:  # STRING / DATE encode via the shared column encoder
-            arr = encode_column(np.asarray(text_cols[i], dtype=object),
-                                f, table.dicts)
+        else:  # FLOAT/BOOL/STRING/DATE through the shared text parser
+            arr = _parse_text_column(text_cols[i], f, table)
         if n_rows is None:
             n_rows = len(arr)
         elif len(arr) != n_rows:
@@ -203,16 +182,51 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
         old = table.data.get(f.name)
         parsed[f.name] = arr if old is None or len(old) == 0 \
             else np.concatenate([old, arr])
-    table.set_data(parsed, table.dicts)
+    # the file itself carries no NULLs on this path, but appended rows must
+    # EXTEND any existing validity masks, not erase them
+    new_valid = {c: np.concatenate([v, np.ones(n_rows or 0, dtype=np.bool_)])
+                 for c, v in table.validity.items()}
+    table.set_data(parsed, table.dicts, validity=new_valid)
     return f"COPY {n_rows or 0}"
+
+
+def _parse_text_column(vals, f, table) -> np.ndarray:
+    """One COPY column from text values — shared by the native fast path
+    (float/bool/string/date columns) and the NULL-bearing text path."""
+    from cloudberry_tpu.columnar.batch import encode_column
+
+    try:
+        if f.dtype in (T.DType.INT32, T.DType.INT64):
+            return np.asarray([int(v) for v in vals]) \
+                .astype(f.type.np_dtype)
+        if f.dtype == T.DType.DECIMAL:
+            return np.asarray([_exact_decimal(v, f.type.scale)
+                               for v in vals], dtype=np.int64)
+        if f.dtype == T.DType.FLOAT64:
+            return np.asarray([float(v) for v in vals])
+        if f.dtype == T.DType.BOOL:
+            out = []
+            for v in vals:
+                lv = str(v).lower()
+                if lv in ("t", "true", "1"):
+                    out.append(True)
+                elif lv in ("f", "false", "0"):
+                    out.append(False)
+                else:
+                    raise BindError(
+                        f"COPY: malformed boolean {v!r} in column "
+                        f"{f.name!r}")
+            return np.asarray(out)
+        return encode_column(np.asarray(vals, dtype=object), f, table.dicts)
+    except ValueError as e2:
+        raise BindError(
+            f"COPY: malformed value in column {f.name!r}: {e2}")
 
 
 def _copy_from_text(table, buf: bytes, db: bytes) -> str:
     """COPY FROM host text path with NULL support: \\N is NULL everywhere;
     an empty field is NULL for non-string columns (empty string is a value
     for strings, matching PostgreSQL text-format COPY)."""
-    from cloudberry_tpu.columnar.batch import encode_column
-
     fields = table.schema.fields
     rows = [ln.split(db) for ln in buf.splitlines() if ln]
     n_rows = len(rows)
@@ -232,35 +246,7 @@ def _copy_from_text(table, buf: bytes, db: bytes) -> str:
             raise BindError(f"COPY: NULL in NOT NULL column {f.name!r}")
         vals = [_NULL_FILL[f.dtype] if m else t.decode()
                 for t, m in zip(toks, isnull)]
-        try:
-            if f.dtype in (T.DType.INT32, T.DType.INT64):
-                arr = np.asarray([int(v) for v in vals]) \
-                    .astype(f.type.np_dtype)
-            elif f.dtype == T.DType.DECIMAL:
-                arr = np.asarray(
-                    [_exact_decimal(v, f.type.scale) for v in vals],
-                    dtype=np.int64)
-            elif f.dtype == T.DType.FLOAT64:
-                arr = np.asarray([float(v) for v in vals])
-            elif f.dtype == T.DType.BOOL:
-                outv = []
-                for v in vals:
-                    lv = str(v).lower()
-                    if lv in ("t", "true", "1"):
-                        outv.append(True)
-                    elif lv in ("f", "false", "0"):
-                        outv.append(False)
-                    else:
-                        raise BindError(
-                            f"COPY: malformed boolean {v!r} in column "
-                            f"{f.name!r}")
-                arr = np.asarray(outv)
-            else:
-                arr = encode_column(np.asarray(vals, dtype=object), f,
-                                    table.dicts)
-        except ValueError as e2:
-            raise BindError(
-                f"COPY: malformed value in column {f.name!r}: {e2}")
+        arr = _parse_text_column(vals, f, table)
         old = table.data.get(f.name)
         n_old = len(old) if old is not None else 0
         parsed[f.name] = arr if n_old == 0 else np.concatenate([old, arr])

@@ -26,6 +26,17 @@ def prune_plan(plan: N.PlanNode) -> N.PlanNode:
 def _pushdown(node: N.PlanNode) -> N.PlanNode:
     """Move PFilter under PProject when every referenced column is a plain
     rename (ColumnRef) in the projection."""
+    if isinstance(node, N.PShare):
+        # shared subtree: rewrite ONCE (every PShare holds the same child);
+        # filters above a PShare never push into it — other consumers see
+        # the same materialization
+        done = getattr(node.child, "_pushdown_done", None)
+        if done is None:
+            done = _pushdown(node.child)
+            node.child._pushdown_done = done
+            done._pushdown_done = done
+        node.child = done
+        return node
     # rewrite children first
     if isinstance(node, N.PFilter):
         node.child = _pushdown(node.child)
@@ -97,6 +108,14 @@ def _prune(node: N.PlanNode, req: set[str]) -> None:
         node.mask_map = {phys: out for phys, out in node.mask_map.items()
                          if out in req}
         node.fields = [f for f in node.fields if f.name in req]
+        return
+
+    if isinstance(node, N.PShare):
+        # consumers may need different column subsets of the shared
+        # subplan: keep its full output (materialize-once trade-off)
+        if not getattr(node.child, "_share_pruned", False):
+            node.child._share_pruned = True
+            _prune(node.child, set(node.child.names))
         return
 
     if isinstance(node, N.PFilter):

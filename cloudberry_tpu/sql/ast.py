@@ -225,6 +225,14 @@ class Select(Node):
 
 
 @dataclass
+class WithQuery(Node):
+    """WITH name AS (query), ... body — non-recursive CTEs; each name is
+    bound once and shared across references (ShareInputScan analog)."""
+    ctes: list[tuple[str, Node]]   # (name, Select | SetOp | WithQuery)
+    query: Node                    # Select | SetOp
+
+
+@dataclass
 class SetOp(Node):
     """UNION/INTERSECT/EXCEPT chain; ORDER BY/LIMIT apply to the whole."""
     op: str                      # 'union' | 'intersect' | 'except'

@@ -83,7 +83,7 @@ class Parser:
     # ----------------------------------------------------------- statements
 
     def parse_statement(self) -> ast.Node:
-        if self.at_kw("select") or self.at_op("("):
+        if self.at_kw("select", "with") or self.at_op("("):
             return self.parse_query()
         if self.at_kw("explain"):
             self.advance()
@@ -254,8 +254,23 @@ class Parser:
     # --------------------------------------------------------------- SELECT
 
     def parse_query(self) -> ast.Node:
-        """select-core (UNION|INTERSECT|EXCEPT select-core)* [ORDER BY]
-        [LIMIT]; set operations own the trailing ORDER BY/LIMIT."""
+        """[WITH ctes] select-core (UNION|INTERSECT|EXCEPT select-core)*
+        [ORDER BY] [LIMIT]; set operations own the trailing ORDER BY/LIMIT."""
+        if self.at_kw("with"):
+            self.advance()
+            if self.accept_kw("recursive"):
+                raise ParseError("WITH RECURSIVE is not supported yet")
+            ctes = []
+            while True:
+                name = self.expect_ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                ctes.append((name, q))
+                if not self.accept_op(","):
+                    break
+            return ast.WithQuery(ctes, self.parse_query())
         node: ast.Node = self._parse_intersect_chain()
         while self.at_kw("union", "except"):
             op = self.advance().text
@@ -641,5 +656,5 @@ _CLAUSE_KWS = ("from", "where", "group", "having", "order", "limit", "offset",
 _RESERVED = frozenset(_CLAUSE_KWS) | {
     "select", "by", "on", "join", "inner", "left", "right", "full", "cross",
     "distinct", "exists", "create", "drop", "insert", "into", "values",
-    "table", "distributed",
+    "table", "distributed", "with",
 }

@@ -1,0 +1,133 @@
+"""WITH (common table expressions) + materialize-once sharing.
+
+The reference evaluates a multiply-referenced CTE once and shares the
+tuplestore across slices via ShareInputScan (nodeShareInputScan.c:31-45).
+Here every reference to a CTE holds the SAME bound subplan behind a PShare
+node; plan rewrites and lowering memoize on its identity, so the subplan is
+traced once per XLA program.
+"""
+
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.plan import nodes as N
+from tools.tpchgen import load_tpch
+
+
+@pytest.fixture(scope="module", params=[1, 8], ids=["single", "dist8"])
+def s(request):
+    sess = cb.Session(Config(n_segments=request.param)) \
+        if request.param > 1 else cb.Session()
+    load_tpch(sess, sf=0.01, seed=7)
+    return sess
+
+
+def test_basic_cte(s):
+    q = ("with big as (select l_orderkey, sum(l_quantity) as q "
+         "from lineitem group by l_orderkey) "
+         "select count(*) as n from big where q > 100")
+    direct = ("select count(*) as n from (select l_orderkey, "
+              "sum(l_quantity) as q from lineitem group by l_orderkey) v "
+              "where q > 100")
+    assert s.sql(q).to_pandas().n[0] == s.sql(direct).to_pandas().n[0]
+
+
+def test_chained_ctes(s):
+    q = ("with a as (select l_orderkey as k, l_quantity as q from lineitem "
+         "where l_quantity > 30), "
+         "b as (select k, count(*) as n from a group by k) "
+         "select count(*) as n from b where n >= 2")
+    assert s.sql(q).to_pandas().n[0] > 0
+
+
+def test_shared_cte_self_join(s):
+    # both references must see the SAME materialization: equal keys imply
+    # equal revenues, so the strict inequality self-join is empty
+    q = ("with r as (select l_suppkey as sk, sum(l_extendedprice) as rev "
+         "from lineitem group by l_suppkey) "
+         "select count(*) as n from r a, r b "
+         "where a.rev > b.rev and a.sk = b.sk")
+    assert s.sql(q).to_pandas().n[0] == 0
+
+
+def test_share_is_one_object(s):
+    from cloudberry_tpu.plan.binder import Binder
+    from cloudberry_tpu.sql.parser import parse_sql
+
+    q = ("with r as (select l_suppkey as sk, count(*) as n from lineitem "
+         "group by l_suppkey) "
+         "select a.sk from r a, r b where a.sk = b.sk")
+    plan = Binder(s.catalog).bind_query(parse_sql(q))
+    shares = []
+
+    def walk(n):
+        if isinstance(n, N.PShare):
+            shares.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    assert len(shares) == 2
+    assert shares[0].child is shares[1].child  # materialize-once contract
+
+
+def test_cte_visible_in_subquery(s):
+    q = ("with r as (select l_suppkey as sk, sum(l_quantity) as q "
+         "from lineitem group by l_suppkey) "
+         "select count(*) as n from r "
+         "where q = (select max(q) from r)")
+    assert s.sql(q).to_pandas().n[0] >= 1
+
+
+def test_q15_as_cte(s):
+    """TPC-H Q15 spelled with WITH instead of repeated derived tables."""
+    from tools.tpch_queries import QUERIES
+
+    q15_with = """
+    with revenue as (
+        select l_suppkey as supplier_no,
+               sum(l_extendedprice * (1 - l_discount)) as total_revenue
+        from lineitem
+        where l_shipdate >= date '1996-01-01'
+            and l_shipdate < date '1996-01-01' + interval '3' month
+        group by l_suppkey
+    )
+    select s_suppkey, s_name, s_address, s_phone, total_revenue
+    from supplier, revenue
+    where s_suppkey = supplier_no
+      and total_revenue = (select max(total_revenue) from revenue)
+    order by s_suppkey
+    """
+    a = s.sql(q15_with).to_pandas()
+    b = s.sql(QUERIES["q15"]).to_pandas()
+    assert a.values.tolist() == b.values.tolist()
+
+
+def test_cte_in_ctas():
+    s2 = cb.Session()
+    s2.sql("create table t (a int, b int) distributed by (a)")
+    s2.sql("insert into t values (1, 10), (2, 20)")
+    s2.sql("create table t2 as with d as (select a, b * 2 as b2 from t) "
+           "select * from d distributed by (a)")
+    assert s2.sql("select b2 from t2 order by b2").to_pandas() \
+        .b2.tolist() == [20, 40]
+
+
+def test_cte_with_nulls():
+    s2 = cb.Session()
+    s2.sql("create table t (a int, b int) distributed by (a)")
+    s2.sql("insert into t values (1, 10), (2, null), (3, 30)")
+    q = ("with d as (select a, b from t) "
+         "select count(*) as n from d x, d y "
+         "where x.a = y.a and x.b is null")
+    assert s2.sql(q).to_pandas().n[0] == 1
+
+
+def test_cte_name_shadows_table():
+    s2 = cb.Session()
+    s2.sql("create table t (a int) distributed by (a)")
+    s2.sql("insert into t values (1), (2), (3)")
+    out = s2.sql("with t as (select a from t where a > 1) "
+                 "select count(*) as n from t").to_pandas()
+    assert out.n[0] == 2

@@ -274,6 +274,44 @@ def test_copy_null_roundtrip(tmp_path):
     pd.testing.assert_frame_equal(a, b)
 
 
+def test_copy_fast_path_preserves_existing_validity(tmp_path):
+    """A NULL-free COPY file takes the native fast path — it must EXTEND
+    existing validity masks, not erase the table's stored NULLs."""
+    s2 = cb.Session()
+    s2.sql("create table t5 (a int, b int) distributed by (a)")
+    s2.sql("insert into t5 values (1, null), (2, 20)")
+    p = tmp_path / "clean.csv"
+    p.write_text("3|30\n4|40\n")
+    s2.sql(f"copy t5 from '{p}'")
+    assert col(s2, "select a from t5 where b is null") == [1]
+    assert col(s2, "select a from t5 where b is not null order by a") \
+        == [2, 3, 4]
+
+
+def test_scalar_subquery_null_result():
+    """A scalar subquery whose single row is NULL yields NULL, not a
+    sentinel value (the value and validity share one subplan)."""
+    s2 = _mk(1)
+    out = col(s2, "select (select max(b) from t where a > 100)")
+    assert out == [None]
+    # and in a comparison: NULL never matches
+    assert col(s2, "select a from t where b = "
+                   "(select max(b) from t where a > 100)") == []
+    # non-null scalar still works
+    assert col(s2, "select (select max(b) from t)") == [30]
+
+
+def test_cte_does_not_leak_into_view():
+    """A view's internal table references are fixed at creation and must
+    not resolve to the caller's same-named CTE (PostgreSQL semantics)."""
+    s2 = cb.Session()
+    s2.sql("create table base (x int) distributed by (x)")
+    s2.sql("insert into base values (10), (20)")
+    s2.sql("create view vsum as select sum(x) as s from base")
+    out = col(s2, "with base as (select 1 as x) select s from vsum", "s")
+    assert out == [30]
+
+
 def test_not_null_constraint_rejected():
     s2 = cb.Session()
     s2.sql("create table nn (a int not null, b int) distributed by (a)")
