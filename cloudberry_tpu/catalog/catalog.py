@@ -62,14 +62,36 @@ class Table:
     # Invariant: data values are canonicalized to 0 at invalid lanes, so
     # hashing/placement/grouping see a stable representative.
     validity: dict[str, np.ndarray] = field(default_factory=dict)
+    # durable storage binding (storage/table_store.py). cold=True means the
+    # data lives ONLY in micro-partition files: scans read pruned partitions
+    # per query; ensure_loaded() materializes for paths that need RAM arrays
+    backing: object = None
+    cold: bool = False
 
     @property
     def num_rows(self) -> int:
         return self.stats.row_count
 
+    def ensure_loaded(self) -> None:
+        """Materialize a cold stored table into RAM (DML paths and
+        distributed placement need whole arrays)."""
+        if not self.cold or self.backing is None:
+            return
+        cols, _, dicts = self.backing.scan(self.name)
+        validity = {k[4:]: v for k, v in cols.items()
+                    if k.startswith("$nn:")}
+        data = {k: v for k, v in cols.items() if not k.startswith("$nn:")}
+        self._loading = True
+        try:
+            self.set_data(data, dicts, validity=validity)
+        finally:
+            self._loading = False
+        self.cold = False
+
     def set_data(self, data: dict[str, np.ndarray],
                  dicts: dict[str, StringDictionary] | None = None,
-                 validity: dict[str, np.ndarray] | None = None) -> None:
+                 validity: dict[str, np.ndarray] | None = None,
+                 appended: int | None = None) -> None:
         self.data = data
         self.dicts = dicts or {}
         n = len(next(iter(data.values()))) if data else 0
@@ -94,11 +116,37 @@ class Table:
                 if len(vals):
                     self.stats.min_max[f.name] = (float(vals.min()),
                                                   float(vals.max()))
+        # durable tables: every data change is a new atomic snapshot; an
+        # append-only change persists just the new tail partitions. Inside
+        # a transaction, writes defer to COMMIT (store.begin_txn).
+        if self.backing is not None and not getattr(self, "_loading", False):
+            if not getattr(self.backing, "autocommit", True):
+                self.backing._txn_dirty[self.name] = self
+            elif appended is not None and appended < n:
+                k = appended
+                self.backing.append(
+                    self.name, {c: v[-k:] for c, v in data.items()},
+                    self.schema, self.dicts,
+                    validity={c: v[-k:] for c, v in self.validity.items()},
+                    unique={c: bool(self.is_unique(c))
+                            for c in self.schema.names
+                            if data.get(c) is not None
+                            and data[c].dtype.kind in "iu"},
+                    policy=self.policy,
+                    rows_per_partition=self.backing.rows_per_partition)
+            else:
+                self.backing.save_table(
+                    self, getattr(self.backing, "rows_per_partition",
+                                  1 << 20))
+            self.cold = False
 
     def is_unique(self, col: str) -> bool:
         """Whether a column's values are distinct (PK detection; the planner
         uses this the way nodeHash.c trusts unique-ified hash sides). Lazy +
         cached; recomputed when data changes (set_data clears the cache)."""
+        if self.cold:
+            # data not in RAM: only manifest-recorded uniqueness counts
+            return bool(self.stats.unique.get(col, False))
         cached = self.stats.unique.get(col)
         if cached is None:
             arr = self.data.get(col)
@@ -113,6 +161,9 @@ class Table:
     def is_unique_cols(self, cols: tuple[str, ...]) -> bool:
         """Exact multi-column uniqueness (composite PK detection, e.g.
         partsupp's (ps_partkey, ps_suppkey)) — lexsort + adjacent compare."""
+        if self.cold:
+            # conservative without RAM data (single-column manifests only)
+            return any(bool(self.stats.unique.get(c, False)) for c in cols)
         key = "|".join(sorted(cols))
         cached = self.stats.unique.get(key)
         if cached is None:
@@ -172,6 +223,9 @@ _VERSION_COUNTER = itertools.count(1)
 class Catalog:
     def __init__(self):
         self.tables: dict[str, Table] = {}
+        # durable store (storage/table_store.py) when the session is
+        # storage-backed; new tables bind to it at CREATE
+        self.store = None
         # name -> unbound query AST (views re-bind per statement, so they
         # track base-table changes like the reference's rewriter)
         self.views: dict[str, object] = {}
@@ -195,6 +249,12 @@ class Catalog:
         t.data = {f.name: np.zeros(0, dtype=f.type.np_dtype)
                   for f in schema.fields}
         t._version = next(_VERSION_COUNTER)
+        if self.store is not None:
+            t.backing = self.store
+            if self.store.autocommit:
+                self.store.save_table(t)  # durable schema from CREATE on
+            else:
+                self.store._txn_dirty[name] = t
         self.tables[name] = t
         self.bump_ddl()
         return t
@@ -203,6 +263,13 @@ class Catalog:
         name = name.lower()
         if name not in self.tables and if_exists:
             return
+        t = self.tables[name]
+        if t.backing is not None:
+            if t.backing.autocommit:
+                t.backing.drop_table(name)
+            else:
+                t.backing._txn_drops.append(name)
+                t.backing._txn_dirty.pop(name, None)
         del self.tables[name]
         self.bump_ddl()
 

@@ -66,12 +66,28 @@ class ResourceConfig:
 
 
 @dataclass(frozen=True)
+class StorageConfig:
+    """Durable storage (PAX/AOCS analog, storage/table_store.py).
+
+    With ``root`` set, the session's tables live in micro-partition files:
+    DDL/DML persist through snapshot manifests, scans read only referenced
+    columns from partitions that survive footer-stats pruning, and a fresh
+    session on the same root sees every committed table."""
+
+    root: str | None = None
+    # Rows per micro-partition file — smaller means finer pruning
+    # granularity, more files (the AO blocksize / PAX partition-size knob).
+    rows_per_partition: int = 1 << 20
+
+
+@dataclass(frozen=True)
 class Config:
     n_segments: int = 1
     interconnect: InterconnectConfig = field(default_factory=InterconnectConfig)
     exec: ExecConfig = field(default_factory=ExecConfig)
     planner: PlannerConfig = field(default_factory=PlannerConfig)
     resource: ResourceConfig = field(default_factory=ResourceConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":
         """Return a copy with dotted-path overrides, e.g.

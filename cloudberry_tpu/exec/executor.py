@@ -36,6 +36,9 @@ class Executable:
     plan: N.PlanNode
     fn: Callable  # (tables pytree) -> (cols dict, sel, checks dict)
     table_names: list[str]
+    # scans bound to pruned micro-partition reads (plan/scanprune.py);
+    # their inputs key by scan identity, not table name
+    store_scans: list = None  # type: ignore[assignment]
 
 
 def execute(plan: N.PlanNode, session) -> ColumnBatch:
@@ -45,13 +48,15 @@ def execute(plan: N.PlanNode, session) -> ColumnBatch:
 
         return execute_distributed(plan, session)
     exe = compile_plan(plan, session)
-    tables = prepare_tables(exe.table_names, session, segment=seg)
-    return run_executable(exe, tables)
+    return run_executable(exe, prepare_inputs(exe, session, segment=seg))
 
 
 def compile_plan(plan: N.PlanNode, session,
                  platform: str | None = None) -> Executable:
-    table_names = sorted({s.table_name for s in scans_of(plan)})
+    scans = list(scans_of(plan))
+    store_scans = [s for s in scans if hasattr(s, "_store_parts")]
+    table_names = sorted({s.table_name for s in scans
+                          if not hasattr(s, "_store_parts")})
     platform = platform or jax.default_backend()
     use_pallas = session.config.exec.use_pallas
 
@@ -61,7 +66,7 @@ def compile_plan(plan: N.PlanNode, session,
         out = {f.name: cols[f.name] for f in plan.fields}
         return out, sel, low.checks
 
-    return Executable(plan, jax.jit(run), table_names)
+    return Executable(plan, jax.jit(run), table_names, store_scans)
 
 
 def prepare_tables(table_names: list[str], session,
@@ -71,6 +76,7 @@ def prepare_tables(table_names: list[str], session,
     tables = {}
     for name in table_names:
         t = session.catalog.table(name)
+        t.ensure_loaded()  # safety: a cold table on the RAM path loads whole
         if segment is None or t.policy.kind == "replicated":
             tables[name] = {c: jnp.asarray(v) for c, v in t.data.items()}
             for c, vm in t.validity.items():
@@ -81,6 +87,42 @@ def prepare_tables(table_names: list[str], session,
             tables[name] = {c: jnp.asarray(v[segment])
                             for c, v in st.columns.items()}
     return tables
+
+
+def prepare_inputs(exe: Executable, session,
+                   segment: int | None = None) -> dict:
+    """All inputs for one executable: RAM tables by name plus pruned
+    store reads keyed by scan identity."""
+    tables = prepare_tables(exe.table_names, session, segment=segment)
+    for s in (exe.store_scans or ()):
+        tables[s._input_key] = _load_store_scan(s, session)
+    return tables
+
+
+_STORE_SCAN_CACHE_MAX = 16
+
+
+def _load_store_scan(scan: N.PScan, session) -> dict:
+    """Read a pruned scan's columns from micro-partitions (column
+    projection: ONLY column_map + mask_map physical columns are read),
+    cached per (table, version, partitions, columns)."""
+    store = session.catalog.store
+    key = (scan.table_name, store.current_version(scan.table_name),
+           tuple(p["file"] for p in scan._store_parts),
+           tuple(sorted(scan.column_map)), tuple(sorted(scan.mask_map)))
+    cache = session._store_scan_cache
+    hit = cache.get(key)
+    if hit is None:
+        cols, validity = store.read_partitions(
+            scan.table_name, scan._store_parts,
+            sorted(set(scan.column_map) | set(scan.mask_map)))
+        hit = {c: jnp.asarray(v) for c, v in cols.items()}
+        for c, v in validity.items():
+            hit[f"$nn:{c}"] = jnp.asarray(np.asarray(v, dtype=np.bool_))
+        if len(cache) >= _STORE_SCAN_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        cache[key] = hit
+    return hit
 
 
 def run_executable(exe: Executable, tables: dict) -> ColumnBatch:
@@ -201,7 +243,7 @@ class Lowerer:
     def scan(self, node: N.PScan):
         if node.table_name == "$dual":
             return {}, jnp.ones((1,), dtype=jnp.bool_)
-        data = self.tables[node.table_name]
+        data = self.tables[getattr(node, "_input_key", node.table_name)]
         cols = {}
         for phys, out in node.column_map.items():
             arr = data[phys]

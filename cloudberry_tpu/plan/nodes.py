@@ -87,7 +87,16 @@ class PScan(PlanNode):
     mask_map: dict[str, str] = dc_field(default_factory=dict)
 
     def title(self):
-        return f"Scan {self.table_name} [{self.capacity}]"
+        base = f"Scan {self.table_name} [{self.capacity}]"
+        rep = getattr(self, "_prune_report", None)
+        if rep is not None:
+            kept = len(getattr(self, "_store_parts", ()))
+            base += f" parts {kept}/{rep['candidates']}"
+            skips = rep["skipped_minmax"] + rep["skipped_bloom"]
+            if skips:
+                base += (f" (minmax-skip {rep['skipped_minmax']}, "
+                         f"bloom-skip {rep['skipped_bloom']})")
+        return base
 
 
 @dataclass

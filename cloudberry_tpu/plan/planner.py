@@ -136,6 +136,7 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
     from cloudberry_tpu import native
 
     table = session.catalog.table(stmt.table)
+    table.ensure_loaded()
     with open(stmt.path, "rb") as fh:
         buf = fh.read()
     if stmt.header:
@@ -186,7 +187,8 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
     # EXTEND any existing validity masks, not erase them
     new_valid = {c: np.concatenate([v, np.ones(n_rows or 0, dtype=np.bool_)])
                  for c, v in table.validity.items()}
-    table.set_data(parsed, table.dicts, validity=new_valid)
+    table.set_data(parsed, table.dicts, validity=new_valid,
+                   appended=n_rows or 0)
     return f"COPY {n_rows or 0}"
 
 
@@ -256,7 +258,8 @@ def _copy_from_text(table, buf: bytes, db: bytes) -> str:
                 old_v = np.ones(n_old, dtype=np.bool_)
             new_valid[f.name] = np.concatenate([old_v, ~isnull]) \
                 if n_old else ~isnull
-    table.set_data(parsed, table.dicts, validity=new_valid)
+    table.set_data(parsed, table.dicts, validity=new_valid,
+                   appended=n_rows)
     return f"COPY {n_rows}"
 
 
@@ -268,6 +271,7 @@ def _copy_to(session, stmt: ast.CopyTo) -> str:
     from cloudberry_tpu.types import days_to_date
 
     table = session.catalog.table(stmt.table)
+    table.ensure_loaded()
     n = table.num_rows
     d = stmt.delimiter
     cols = []
@@ -447,6 +451,7 @@ def _insert_select(session, stmt: ast.InsertSelect) -> str:
     if list(cols) != list(table.schema.names):
         raise BindError("INSERT ... SELECT must target all columns in "
                         "schema order (no defaults yet)")
+    table.ensure_loaded()
     batch = _run_internal(session, stmt.query)
     if len(batch.schema.fields) != len(table.schema.fields):
         raise BindError(
@@ -485,14 +490,17 @@ def _insert_select(session, stmt: ast.InsertSelect) -> str:
                 old_v = np.ones(n_old, dtype=np.bool_)
             new_valid[f.name] = np.concatenate([old_v, ~isna]) \
                 if n_old else ~isna
-    table.set_data(new_data, table.dicts, validity=new_valid)
+    table.set_data(new_data, table.dicts, validity=new_valid,
+                   appended=new_rows)
     return f"INSERT {new_rows}"
 
 
 def _optimize(plan: N.PlanNode, session) -> N.PlanNode:
     from cloudberry_tpu.plan.prune import prune_plan
+    from cloudberry_tpu.plan.scanprune import apply_storage_scans
 
     plan = prune_plan(plan)
+    apply_storage_scans(plan, session)
     if session.config.n_segments > 1 \
             and session.config.planner.enable_direct_dispatch:
         from cloudberry_tpu.plan.distribute import (apply_direct_dispatch,
@@ -523,6 +531,7 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
     from cloudberry_tpu.columnar.batch import encode_column
 
     table = catalog.table(stmt.table)
+    table.ensure_loaded()  # appends need the existing rows in RAM
     cols = stmt.columns or table.schema.names
     if set(cols) != set(table.schema.names):
         raise BindError("INSERT must target all columns (no defaults yet)")
@@ -569,7 +578,8 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
                 old_v = np.ones(n_old, dtype=np.bool_)
             new_valid[f.name] = np.concatenate([old_v, ~isnull]) \
                 if n_old else ~isnull
-    table.set_data(new_data, table.dicts, validity=new_valid)
+    table.set_data(new_data, table.dicts, validity=new_valid,
+                   appended=len(stmt.rows))
     return f"INSERT {len(stmt.rows)}"
 
 

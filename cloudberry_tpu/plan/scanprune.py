@@ -1,0 +1,113 @@
+"""Storage scan binding: cold-table scans → pruned micro-partition reads.
+
+The planner move PAX makes with sparse filters (contrib/pax_storage
+micro_partition_stats.cc) and the executor makes with PartitionSelector
+(nodePartitionSelector.c): predicate ranges and equality literals reach the
+storage layer BEFORE any column bytes move, so whole files are skipped by
+manifest min/max (no IO) and footer bloom filters (footer-only IO), and only
+the scan's referenced columns are ever read host-side — then only the
+surviving rows transfer to the device.
+
+Runs after predicate pushdown + column pruning (plan/prune.py), so filters
+sit directly on scans and column_map is already narrowed.
+"""
+
+from __future__ import annotations
+
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.types import DType
+
+_RANGE_TYPES = (DType.INT32, DType.INT64, DType.DECIMAL, DType.DATE,
+                DType.FLOAT64)
+
+
+def apply_storage_scans(plan: N.PlanNode, session) -> None:
+    """Bind every cold-table scan to its pruned partition list (single-
+    segment execution; distributed placement materializes via
+    Session.sharded_table instead)."""
+    store = getattr(session.catalog, "store", None)
+    if store is None or session.config.n_segments > 1:
+        return
+    _walk(plan, (), session, store)
+
+
+def _walk(node: N.PlanNode, preds: tuple, session, store) -> None:
+    if isinstance(node, N.PFilter):
+        _walk(node.child, preds + (node.predicate,), session, store)
+        return
+    if isinstance(node, N.PScan):
+        if node.table_name == "$dual" or hasattr(node, "_store_parts"):
+            return
+        t = session.catalog.table(node.table_name)
+        if t.cold:
+            _bind_scan(node, preds, t, store)
+        return
+    for e in _exprs_of(node):
+        for sub in ex.walk(e):
+            if isinstance(sub, ex.SubqueryScalar):
+                _walk(sub.plan, (), session, store)
+    for c in node.children():
+        _walk(c, (), session, store)
+
+
+def _exprs_of(node: N.PlanNode):
+    from cloudberry_tpu.plan.distribute import _node_exprs
+
+    yield from _node_exprs(node)
+
+
+def _bind_scan(node: N.PScan, preds: tuple, t, store) -> None:
+    rev = {out: phys for phys, out in node.column_map.items()}
+    ranges: dict[str, tuple] = {}
+    eqs: dict[str, object] = {}
+    for p in preds:
+        for c in _conjuncts(p):
+            got = _simple_cmp(c, rev)
+            if got is None:
+                continue
+            col, op, val = got
+            if op == "=":
+                eqs[col] = val
+                continue
+            lo, hi = ranges.get(col, (None, None))
+            if op in (">", ">="):
+                lo = val if lo is None else max(lo, val)
+            else:  # < / <=  (strictness ignored — bounds stay conservative)
+                hi = val if hi is None else min(hi, val)
+            ranges[col] = (lo, hi)
+    parts, report = store.select_partitions(t.name, ranges, eqs)
+    rows = sum(p["num_rows"] - len(p["deleted"]) for p in parts)
+    node._store_parts = parts
+    node._prune_report = report
+    node._input_key = f"{node.table_name}#{id(node)}"
+    node.capacity = max(rows, 1)
+    node.num_rows = rows
+
+
+def _conjuncts(e: ex.Expr):
+    if isinstance(e, ex.BinOp) and e.op == "and":
+        yield from _conjuncts(e.left)
+        yield from _conjuncts(e.right)
+    else:
+        yield e
+
+
+def _simple_cmp(e: ex.Expr, rev: dict):
+    """column <op> literal over a range-comparable physical type, in either
+    orientation; returns (phys_col, op, value) or None."""
+    if not isinstance(e, ex.BinOp) or e.op not in ("=", "<", "<=", ">", ">="):
+        return None
+    l, r = e.left, e.right
+    op = e.op
+    if isinstance(r, ex.ColumnRef) and isinstance(l, ex.Literal):
+        l, r = r, l
+        op = {"=": "=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}[op]
+    if not (isinstance(l, ex.ColumnRef) and isinstance(r, ex.Literal)):
+        return None
+    phys = rev.get(l.name)
+    if phys is None or l.dtype.base not in _RANGE_TYPES:
+        return None
+    if not isinstance(r.value, (int, float)):
+        return None
+    return phys, op, r.value

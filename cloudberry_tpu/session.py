@@ -38,6 +38,21 @@ class Session:
 
         self.config = config or get_config()
         self.catalog = Catalog()
+        # durable storage: register stored tables cold (schema/stats only),
+        # then bind the catalog so new tables persist (order matters: the
+        # registration itself must not write empty snapshots)
+        self.store = None
+        if self.config.storage.root:
+            from cloudberry_tpu.storage.table_store import TableStore
+
+            self.store = TableStore(self.config.storage.root)
+            self.store.rows_per_partition = \
+                self.config.storage.rows_per_partition
+            for name in self.store.table_names():
+                self.store.register_cold(self.catalog, name)
+            self.catalog.store = self.store
+        # per-query pruned store reads, keyed (table, version, parts, cols)
+        self._store_scan_cache: dict = {}
         self._shard_cache: dict[str, ShardedTable] = {}
         # query_info_collect_hook analog: callables receiving QueryMetrics
         self.metrics_hooks: list = []
@@ -91,22 +106,36 @@ class Session:
                     name: (t, t.data,
                            {c: StringDictionary(d.values)
                             for c, d in t.dicts.items()},
-                           t.policy, dict(t.validity))
+                           t.policy, dict(t.validity), t.cold)
                     for name, t in self.catalog.tables.items()},
                 "views": dict(self.catalog.views),
             }
+            if self.store is not None:
+                # durable writes defer to COMMIT; ROLLBACK never touches disk
+                self.store.begin_txn()
             return "BEGIN"
         if snap is None:
             raise BindError(f"{kind.upper()}: no transaction in progress")
         if kind == "commit":
             self._txn_snapshot = None
+            if self.store is not None:
+                self.store.commit_txn()
             return "COMMIT"
-        # rollback
+        # rollback: restore RAM state WITHOUT persisting (the store never
+        # saw the transaction's writes); cold tables restore to cold —
+        # their placeholder arrays must never overwrite stored data
+        if self.store is not None:
+            self.store.abort_txn()
         self.catalog.tables = {}
-        for name, (t, data, dicts, policy, validity) in \
+        for name, (t, data, dicts, policy, validity, cold) in \
                 snap["tables"].items():
             t.policy = policy
-            t.set_data(data, dicts, validity=validity)  # bumps version
+            t._loading = True
+            try:
+                t.set_data(data, dicts, validity=validity)  # bumps version
+            finally:
+                t._loading = False
+            t.cold = cold
             self.catalog.tables[name] = t
         self.catalog.views = snap["views"]
         self.catalog.bump_ddl()
@@ -150,7 +179,7 @@ class Session:
         if seg is not None:
             exe = X.compile_plan(plan, self)
             runner = lambda: X.run_executable(
-                exe, X.prepare_tables(exe.table_names, self, segment=seg))
+                exe, X.prepare_inputs(exe, self, segment=seg))
         elif self.config.n_segments > 1:
             from cloudberry_tpu.exec.dist_executor import (
                 compile_distributed, execute_distributed)
@@ -160,7 +189,7 @@ class Session:
         else:
             exe = X.compile_plan(plan, self)
             runner = lambda: X.run_executable(
-                exe, X.prepare_tables(exe.table_names, self))
+                exe, X.prepare_inputs(exe, self))
         if len(self._stmt_cache) >= self._STMT_CACHE_MAX:
             # FIFO eviction keeps the cache (and its pinned XLA programs)
             # bounded under literal-inlining workloads
@@ -203,6 +232,7 @@ class Session:
 
     def sharded_table(self, name: str) -> ShardedTable:
         t = self.catalog.table(name)
+        t.ensure_loaded()  # distributed placement needs whole arrays
         nseg = self.config.n_segments
         key = f"{name}@{nseg}"
         cached = self._shard_cache.get(key)

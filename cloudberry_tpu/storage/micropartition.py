@@ -118,6 +118,11 @@ def write_micropartition(path: str, data: dict[str, np.ndarray],
         if f.dtype != DType.STRING and n and arr.dtype.kind in "iuf":
             enc["min"] = _json_num(arr.min())
             enc["max"] = _json_num(arr.max())
+            if arr.dtype.kind in "iu":
+                # bloom filter for equality pruning (PAX
+                # micro_partition_stats.cc bloom move): point predicates
+                # skip files min/max can't exclude
+                enc["bloom"] = _bloom_build(arr)
         if f.dtype == DType.STRING and f.name in dicts:
             enc["dictionary"] = dicts[f.name].values
         offset += len(blob)
@@ -182,6 +187,47 @@ def read_columns(path: str, names: Iterable[str] | None = None,
                 out[name] = np.frombuffer(raw, dtype=dt,
                                           count=footer["num_rows"]).copy()
     return out
+
+
+_BLOOM_BITS = 2048
+_BLOOM_K = 3
+
+
+def _bloom_hashes(vals: np.ndarray) -> list[np.ndarray]:
+    """k bit positions per value via two mixed 64-bit hashes (Kirsch-
+    Mitzenmacher double hashing)."""
+    x = vals.astype(np.uint64, copy=True)
+    x ^= x >> np.uint64(33)
+    x *= np.uint64(0xFF51AFD7ED558CCD)
+    x ^= x >> np.uint64(33)
+    h2 = x * np.uint64(0xC4CEB9FE1A85EC53) ^ (x >> np.uint64(29))
+    m = np.uint64(_BLOOM_BITS)
+    return [((x + np.uint64(i) * h2) % m).astype(np.int64)
+            for i in range(_BLOOM_K)]
+
+
+def _bloom_build(arr: np.ndarray) -> str:
+    import base64
+
+    bits = np.zeros(_BLOOM_BITS, dtype=bool)
+    for pos in _bloom_hashes(arr):
+        bits[pos] = True
+    return base64.b64encode(np.packbits(bits).tobytes()).decode()
+
+
+def bloom_may_contain(enc: dict, value) -> bool:
+    """False means the partition provably lacks ``value`` in this column."""
+    b64 = enc.get("bloom")
+    if b64 is None:
+        return True
+    import base64
+
+    bits = np.unpackbits(
+        np.frombuffer(base64.b64decode(b64), dtype=np.uint8))
+    for pos in _bloom_hashes(np.asarray([value], dtype=np.int64)):
+        if not bits[int(pos[0])]:
+            return False
+    return True
 
 
 def prune_by_stats(footer: dict, column: str, lo=None, hi=None) -> bool:

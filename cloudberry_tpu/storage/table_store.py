@@ -48,6 +48,33 @@ class TableStore:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        # session-transaction write deferral: inside BEGIN..COMMIT, data
+        # changes collect in _txn_dirty (and drops in _txn_drops) and hit
+        # disk only at COMMIT; ROLLBACK discards them — the store never
+        # sees uncommitted state (single-coordinator commit discipline)
+        self.autocommit = True
+        self._txn_dirty: dict[str, object] = {}
+        self._txn_drops: list[str] = []
+        self.rows_per_partition = 1 << 20
+
+    # ------------------------------------------------- session transactions
+
+    def begin_txn(self) -> None:
+        self.autocommit = False
+        self._txn_dirty = {}
+        self._txn_drops = []
+
+    def commit_txn(self) -> None:
+        for name in self._txn_drops:
+            self.drop_table(name)
+        for t in self._txn_dirty.values():
+            self.save_table(t, self.rows_per_partition)
+        self.abort_txn()
+
+    def abort_txn(self) -> None:
+        self.autocommit = True
+        self._txn_dirty = {}
+        self._txn_drops = []
 
     # ----------------------------------------------------------- manifests
 
@@ -101,10 +128,13 @@ class TableStore:
     def append(self, table: str, data: dict[str, np.ndarray], schema: Schema,
                dicts: dict[str, StringDictionary] | None = None,
                rows_per_partition: int = 1 << 20,
-               replace: bool = False, policy=None) -> int:
+               replace: bool = False, policy=None,
+               validity: dict[str, np.ndarray] | None = None,
+               unique: dict[str, bool] | None = None) -> int:
         """Append rows as new micro-partitions (``replace=True``: the new
         snapshot contains ONLY these rows — still one atomic commit, so a
         crash mid-write never publishes an empty intermediate).
+        ``validity`` masks persist as extra "$nn:<col>" bool columns.
         Returns the new snapshot version."""
         tdir = os.path.join(self.root, table)
         os.makedirs(tdir, exist_ok=True)
@@ -112,15 +142,26 @@ class TableStore:
         if replace:
             man["partitions"] = []
         n = len(next(iter(data.values()))) if data else 0
+        phys_schema = schema
+        phys_data = data
+        if validity:
+            from cloudberry_tpu.types import BOOL, Field as TField
+
+            phys_data = dict(data)
+            extra = []
+            for c, v in validity.items():
+                phys_data[f"$nn:{c}"] = np.asarray(v, dtype=np.bool_)
+                extra.append(TField(f"$nn:{c}", BOOL))
+            phys_schema = Schema(tuple(schema.fields) + tuple(extra))
         new_parts = []
         for lo in range(0, max(n, 1), rows_per_partition):
             hi = min(lo + rows_per_partition, n)
             if hi <= lo:
                 break
-            chunk = {k: v[lo:hi] for k, v in data.items()}
+            chunk = {k: v[lo:hi] for k, v in phys_data.items()}
             fname = f"part-{uuid.uuid4().hex}.cbmp"
             footer = mp.write_micropartition(
-                os.path.join(tdir, fname), chunk, schema, dicts)
+                os.path.join(tdir, fname), chunk, phys_schema, dicts)
             stats = {c["name"]: [c["min"], c["max"]]
                      for c in footer["columns"] if "min" in c}
             new_parts.append({"file": fname, "num_rows": hi - lo,
@@ -130,6 +171,14 @@ class TableStore:
         # decoding correctly); anything else is a caller error, not silent
         # corruption.
         man["schema"] = [mp._field_json(f) for f in schema.fields]
+        man["not_null"] = [f.name for f in schema.fields if not f.nullable]
+        if replace:
+            man["nullable"] = sorted(validity or [])
+        elif validity:
+            man["nullable"] = sorted(set(man.get("nullable", []))
+                                     | set(validity))
+        if unique is not None:
+            man["unique"] = unique
         if policy is not None:
             man["policy"] = {"kind": policy.kind, "keys": list(policy.keys)}
         old_dicts = man.get("dicts", {}) if not replace else {}
@@ -163,47 +212,180 @@ class TableStore:
 
     # --------------------------------------------------------------- reads
 
+    def select_partitions(self, table: str, ranges: dict | None = None,
+                          eqs: dict | None = None,
+                          version: Optional[int] = None
+                          ) -> tuple[list[dict], dict]:
+        """Pick the partitions a predicate can touch, without reading any
+        column data. ``ranges``: {col: (lo, hi)}; ``eqs``: {col: value}.
+        Manifest min/max prunes first (no file IO); equality predicates then
+        check footer bloom filters (footer-only IO). Returns (surviving
+        partition entries, report) — the report counts candidates and
+        skips per mechanism (for EXPLAIN and the file-skip tests)."""
+        man = self.read_manifest(table, version)
+        tdir = os.path.join(self.root, table)
+        report = {"candidates": len(man["partitions"]),
+                  "skipped_minmax": 0, "skipped_bloom": 0}
+        ranges = dict(ranges or {})
+        for c, v in (eqs or {}).items():
+            lo, hi = ranges.get(c, (None, None))
+            lo = v if lo is None else max(lo, v)
+            hi = v if hi is None else min(hi, v)
+            ranges[c] = (lo, hi)
+        out = []
+        for part in man["partitions"]:
+            if ranges and not all(_part_may_match(part, c, lo, hi)
+                                  for c, (lo, hi) in ranges.items()):
+                report["skipped_minmax"] += 1
+                continue
+            if eqs:
+                footer = mp.read_footer(os.path.join(tdir, part["file"]))
+                encs = {c["name"]: c for c in footer["columns"]}
+                if any(c in encs
+                       and not mp.bloom_may_contain(encs[c], v)
+                       for c, v in eqs.items()):
+                    report["skipped_bloom"] += 1
+                    continue
+            out.append(part)
+        return out, report
+
+    def read_partitions(self, table: str, parts: list[dict],
+                        columns: list[str] | None = None,
+                        version: Optional[int] = None
+                        ) -> tuple[dict, dict]:
+        """Read (selected columns of) the given partitions; "$nn:" validity
+        columns split out. Returns (columns dict, validity dict)."""
+        man = self.read_manifest(table, version)
+        schema = Schema(tuple(mp._field_from_json(j) for j in man["schema"]))
+        nullable = set(man.get("nullable", []))
+        tdir = os.path.join(self.root, table)
+        names = list(columns) if columns is not None else list(schema.names)
+        want = names + [f"$nn:{c}" for c in names if c in nullable]
+        chunks: list[dict[str, np.ndarray]] = []
+        for part in parts:
+            cols = mp.read_columns(os.path.join(tdir, part["file"]), want)
+            if part["deleted"]:
+                keep = np.ones(part["num_rows"], dtype=bool)
+                keep[np.asarray(part["deleted"], dtype=np.int64)] = False
+                cols = {k: v[keep] for k, v in cols.items()}
+                cols["$n"] = int(keep.sum())
+            else:
+                cols["$n"] = part["num_rows"]
+            chunks.append(cols)
+        out, validity = {}, {}
+        for name in want:
+            arrs = []
+            for c in chunks:
+                a = c.get(name)
+                if a is None:
+                    # older partition without the validity column: all valid
+                    a = np.ones(c["$n"], dtype=np.bool_)
+                arrs.append(a)
+            base = name[4:] if name.startswith("$nn:") else None
+            f_dt = (np.bool_ if base is not None
+                    else schema.field(name).type.np_dtype)
+            col = (np.concatenate(arrs) if arrs
+                   else np.zeros(0, dtype=f_dt))
+            if base is not None:
+                validity[base] = col
+            else:
+                out[name] = col
+        return out, validity
+
     def scan(self, table: str, columns: list[str] | None = None,
              version: Optional[int] = None,
              prune: dict | None = None) -> tuple[dict, Schema, dict]:
         """Snapshot read. ``prune``: {col: (lo, hi)} ranges — partitions
         provably outside are skipped via footer stats.
 
-        Returns (columns dict, schema, dicts)."""
+        Returns (columns dict, schema, dicts); validity columns under
+        their "$nn:<col>" names when present."""
         man = self.read_manifest(table, version)
         if man["schema"] is None:
             raise KeyError(f"table {table!r} has no data in store")
         schema = Schema(tuple(mp._field_from_json(j) for j in man["schema"]))
-        tdir = os.path.join(self.root, table)
-        chunks: list[dict[str, np.ndarray]] = []
-        for part in man["partitions"]:
-            if prune and not all(
-                    _part_may_match(part, c, lo, hi)
-                    for c, (lo, hi) in prune.items()):
-                continue
-            cols = mp.read_columns(os.path.join(tdir, part["file"]), columns)
-            if part["deleted"]:
-                keep = np.ones(part["num_rows"], dtype=bool)
-                keep[np.asarray(part["deleted"], dtype=np.int64)] = False
-                cols = {k: v[keep] for k, v in cols.items()}
-            chunks.append(cols)
-        names = columns or schema.names
-        out = {}
-        for name in names:
-            arrs = [c[name] for c in chunks]
-            f = schema.field(name)
-            out[name] = (np.concatenate(arrs) if arrs
-                         else np.zeros(0, dtype=f.type.np_dtype))
+        parts, _ = self.select_partitions(table, prune, version=version)
+        cols, validity = self.read_partitions(table, parts, columns,
+                                              version=version)
+        for c, v in validity.items():
+            cols[f"$nn:{c}"] = v
         dicts = {k: StringDictionary(v) for k, v in man["dicts"].items()}
-        return out, schema, dicts
+        return cols, schema, dicts
 
     # ------------------------------------------------------ session bridge
 
-    def save_table(self, t) -> int:
+    def save_table(self, t, rows_per_partition: int = 1 << 20) -> int:
         """Persist a catalog Table's current data as a fresh snapshot
-        (one atomic commit)."""
+        (one atomic commit). Records per-column uniqueness so cold
+        registration can plan PK joins without loading data."""
+        unique = {c: bool(t.is_unique(c)) for c in t.schema.names
+                  if t.data.get(c) is not None
+                  and t.data[c].dtype.kind in "iu"}
         return self.append(t.name, t.data, t.schema, t.dicts, replace=True,
-                           policy=t.policy)
+                           policy=t.policy, validity=t.validity,
+                           unique=unique,
+                           rows_per_partition=rows_per_partition)
+
+    def drop_table(self, name: str) -> None:
+        import shutil
+
+        tdir = os.path.join(self.root, name)
+        if os.path.isdir(tdir):
+            shutil.rmtree(tdir)
+
+    def table_names(self) -> list[str]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if os.path.isfile(os.path.join(self._mdir(name), "CURRENT")):
+                out.append(name)
+        return out
+
+    def register_cold(self, catalog, name: str):
+        """Register a stored table WITHOUT loading data: schema, policy,
+        dictionaries, nullability, row count, per-column min/max and
+        uniqueness all come from the manifest, so the planner can bind and
+        prune scans against the cold table (the reference analog: catalog
+        entries + pg_statistic exist without touching segment files)."""
+        from cloudberry_tpu.catalog.catalog import DistributionPolicy
+        from cloudberry_tpu.types import Field as TField
+
+        man = self.read_manifest(name)
+        if man["schema"] is None:
+            return None
+        nullable = set(man.get("nullable", []))
+        not_null = set(man.get("not_null", []))
+        fields = tuple(
+            TField(j["name"],
+                   mp._field_from_json(j).type,
+                   nullable=j["name"] not in not_null)
+            for j in man["schema"])
+        pol = man.get("policy")
+        policy = (DistributionPolicy(pol["kind"], tuple(pol["keys"]))
+                  if pol else DistributionPolicy.random())
+        t = catalog.create_table(name, Schema(fields), policy)
+        t.backing = self
+        t.cold = True
+        t.dicts = {k: StringDictionary(v) for k, v in man["dicts"].items()}
+        # placeholder keys: the binder only needs to know WHICH columns are
+        # nullable to emit scan mask fields; arrays load with the data
+        t.validity = {c: np.zeros(0, dtype=np.bool_) for c in nullable}
+        rows = 0
+        mm: dict[str, tuple] = {}
+        for p in man["partitions"]:
+            rows += p["num_rows"] - len(p["deleted"])
+            for c, (lo, hi) in p.get("stats", {}).items():
+                if c.startswith("$nn:"):
+                    continue
+                old = mm.get(c)
+                mm[c] = ((lo, hi) if old is None
+                         else (min(old[0], lo), max(old[1], hi)))
+        t.stats.row_count = rows
+        t.stats.min_max = {c: (float(lo), float(hi))
+                           for c, (lo, hi) in mm.items()}
+        # uniqueness survives deletion (a subset of unique stays unique)
+        t.stats.unique = {c: bool(u)
+                          for c, u in man.get("unique", {}).items()}
+        return t
 
     def load_table(self, catalog, name: str,
                    version: Optional[int] = None):
@@ -211,6 +393,9 @@ class TableStore:
         from cloudberry_tpu.catalog.catalog import DistributionPolicy
 
         data, schema, dicts = self.scan(name, version=version)
+        validity = {k[4:]: v for k, v in data.items()
+                    if k.startswith("$nn:")}
+        data = {k: v for k, v in data.items() if not k.startswith("$nn:")}
         pol = self.read_manifest(name, version).get("policy")
         policy = (DistributionPolicy(pol["kind"], tuple(pol["keys"]))
                   if pol else DistributionPolicy.random())
@@ -220,7 +405,7 @@ class TableStore:
         else:
             t = catalog.create_table(name, schema, policy)
         t.dicts = dicts
-        t.set_data(data, dicts)
+        t.set_data(data, dicts, validity=validity)
         return t
 
 

@@ -1,0 +1,247 @@
+"""Storage on the query scan path (VERDICT #4).
+
+Cold tables live only in micro-partition files; scans bind to pruned
+partition lists at plan time (plan/scanprune.py), read ONLY referenced
+columns host-side, and skip files via manifest min/max (no IO) and footer
+bloom filters (footer-only IO) — the PAX sparse-filter / PartitionSelector
+moves (contrib/pax_storage micro_partition_stats.cc,
+nodePartitionSelector.c).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.storage import micropartition as mp
+
+
+def _cfg(tmp_path, nseg=1, rpp=50):
+    return Config(n_segments=nseg).with_overrides(**{
+        "storage.root": str(tmp_path / "store"),
+        "storage.rows_per_partition": rpp,
+    })
+
+
+def _mk_store(tmp_path, nseg=1, rpp=50):
+    s = cb.Session(_cfg(tmp_path, nseg, rpp))
+    s.sql("create table t (a bigint, b bigint, c text, d double) "
+          "distributed by (a)")
+    rows = ",".join(f"({i}, {i * 10}, '{'xyz'[i % 3]}', {i}.5)"
+                    for i in range(200))
+    s.sql(f"insert into t values {rows}")
+    return s
+
+
+def _scan_of(session, sql):
+    from cloudberry_tpu.plan.binder import Binder
+    from cloudberry_tpu.plan.planner import _optimize
+    from cloudberry_tpu.sql.parser import parse_sql
+
+    plan = _optimize(Binder(session.catalog).bind_query(parse_sql(sql)),
+                     session)
+    scans = []
+
+    def walk(n):
+        if isinstance(n, N.PScan):
+            scans.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    assert len(scans) == 1
+    return scans[0]
+
+
+def test_durability_across_sessions(tmp_path):
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    t = s2.catalog.table("t")
+    assert t.cold and t.num_rows == 200
+    assert s2.sql("select count(*) as n from t").to_pandas().n[0] == 200
+    assert t.cold  # queries never forced materialization
+
+
+def test_minmax_file_skip_counts(tmp_path):
+    _mk_store(tmp_path)  # 200 rows / 50 per part = 4 partitions
+    s2 = cb.Session(_cfg(tmp_path))
+    scan = _scan_of(s2, "select b from t where a >= 150")
+    rep = scan._prune_report
+    assert rep["candidates"] == 4
+    assert rep["skipped_minmax"] == 3
+    assert len(scan._store_parts) == 1
+    assert scan.capacity == 50
+    out = s2.sql("select b from t where a >= 150 order by b").to_pandas()
+    assert out.b.tolist() == [i * 10 for i in range(150, 200)]
+
+
+def test_bloom_file_skip(tmp_path):
+    # interleaved values: every partition's [min,max] covers the range, so
+    # only the bloom can exclude files for a point predicate
+    s = cb.Session(_cfg(tmp_path))
+    s.sql("create table t (a bigint, b bigint) distributed by (a)")
+    vals = list(range(0, 1000, 7)) + list(range(3, 1000, 11))
+    s.sql("insert into t values " +
+          ",".join(f"({v}, {v * 2})" for v in vals))
+    s2 = cb.Session(_cfg(tmp_path))
+    scan = _scan_of(s2, "select b from t where a = 700")
+    rep = scan._prune_report
+    assert rep["skipped_bloom"] >= 1
+    assert s2.sql("select b from t where a = 700").to_pandas() \
+        .b.tolist() == [1400]
+
+
+def test_column_projection_never_reads_unreferenced(tmp_path, monkeypatch):
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    read_log = []
+    orig = mp.read_columns
+
+    def spy(path, names=None, footer=None):
+        read_log.append(sorted(names) if names is not None else None)
+        return orig(path, names, footer)
+
+    monkeypatch.setattr(mp, "read_columns", spy)
+    out = s2.sql("select b from t where a >= 150 order by b").to_pandas()
+    assert len(out) == 50
+    assert read_log, "expected store reads"
+    for names in read_log:
+        assert names == ["a", "b"], \
+            f"unreferenced columns were read: {names}"
+
+
+def test_cold_dml_append_and_update(tmp_path):
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    s2.sql("insert into t values (500, 5000, 'w', 0.5)")
+    s2.sql("update t set b = -1 where a = 0")
+    s2.sql("delete from t where a = 1")
+    s3 = cb.Session(_cfg(tmp_path))
+    df = s3.sql("select count(*) as n, min(b) as mb from t").to_pandas()
+    assert df.n[0] == 200 and df.mb[0] == -1
+
+
+def test_nulls_roundtrip_cold_scan(tmp_path):
+    s = cb.Session(_cfg(tmp_path))
+    s.sql("create table t (a int, b int) distributed by (a)")
+    s.sql("insert into t values (1, 10), (2, null), (3, 30)")
+    s2 = cb.Session(_cfg(tmp_path))
+    assert s2.catalog.table("t").cold
+    out = s2.sql("select a from t where b is null").to_pandas()
+    assert out.a.tolist() == [2]
+    df = s2.sql("select sum(b) as s, count(b) as c from t").to_pandas()
+    assert df.s[0] == 40 and df.c[0] == 2
+
+
+def test_distributed_mode_on_stored_tables(tmp_path):
+    _mk_store(tmp_path)
+    s8 = cb.Session(_cfg(tmp_path, nseg=8))
+    df = s8.sql("select c, count(*) as n, sum(b) as sb from t "
+                "group by c order by c").to_pandas()
+    s1 = cb.Session(_cfg(tmp_path))
+    df1 = s1.sql("select c, count(*) as n, sum(b) as sb from t "
+                 "group by c order by c").to_pandas()
+    assert df.values.tolist() == df1.values.tolist()
+
+
+def test_drop_table_removes_files(tmp_path):
+    s = _mk_store(tmp_path)
+    root = s.config.storage.root
+    assert os.path.isdir(os.path.join(root, "t"))
+    s.sql("drop table t")
+    assert not os.path.isdir(os.path.join(root, "t"))
+    s2 = cb.Session(_cfg(tmp_path))
+    with pytest.raises(Exception):
+        s2.sql("select * from t")
+
+
+def test_unique_stats_survive_cold_registration(tmp_path):
+    """PK detection (lookup-join planning) must work without loading
+    data: uniqueness flags persist in the manifest."""
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    t = s2.catalog.table("t")
+    assert t.cold
+    assert t.is_unique("a") is True
+    assert t.is_unique("c") is False
+
+
+def test_rollback_never_truncates_cold_table(tmp_path):
+    """BEGIN..ROLLBACK around a cold table must not persist its placeholder
+    (empty) arrays — the round-2 review's data-loss finding."""
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    assert s2.catalog.table("t").cold
+    s2.sql("begin")
+    s2.sql("insert into t values (999, 1, 'x', 0.1)")
+    s2.sql("rollback")
+    assert s2.sql("select count(*) as n from t").to_pandas().n[0] == 200
+    s3 = cb.Session(_cfg(tmp_path))
+    assert s3.sql("select count(*) as n from t").to_pandas().n[0] == 200
+
+
+def test_rolled_back_ddl_not_durable(tmp_path):
+    """CREATE+INSERT inside a rolled-back transaction must not persist."""
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    s2.sql("begin")
+    s2.sql("create table x (a int) distributed by (a)")
+    s2.sql("insert into x values (1)")
+    s2.sql("rollback")
+    s3 = cb.Session(_cfg(tmp_path))
+    assert "x" not in s3.catalog.tables
+
+
+def test_txn_commit_persists(tmp_path):
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    s2.sql("begin")
+    s2.sql("insert into t values (999, 1, 'x', 0.1)")
+    s2.sql("commit")
+    s3 = cb.Session(_cfg(tmp_path))
+    assert s3.sql("select count(*) as n from t").to_pandas().n[0] == 201
+
+
+def test_copy_to_from_cold_table(tmp_path):
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    out = tmp_path / "out.csv"
+    s2.sql(f"copy t to '{out}'")
+    assert len(out.read_text().splitlines()) == 200
+
+
+def test_not_null_survives_cold_registration(tmp_path):
+    s = cb.Session(_cfg(tmp_path))
+    s.sql("create table nn (a bigint not null, b bigint) "
+          "distributed by (a)")
+    s.sql("insert into nn values (1, 2)")
+    s2 = cb.Session(_cfg(tmp_path))
+    with pytest.raises(Exception, match="NOT NULL"):
+        s2.sql("insert into nn values (null, 3)")
+
+
+def test_insert_appends_incrementally(tmp_path):
+    """A single-row INSERT into a durable table writes one new partition,
+    not a full rewrite of every file."""
+    s = _mk_store(tmp_path, rpp=50)
+    tdir = os.path.join(s.config.storage.root, "t")
+    before = {f for f in os.listdir(tdir) if f.endswith(".cbmp")}
+    s.sql("insert into t values (1000, 1, 'x', 0.1)")
+    man = s.store.read_manifest("t")
+    files_now = [p["file"] for p in man["partitions"]]
+    # all previous manifest files still referenced, exactly one new
+    assert len([f for f in files_now if f not in before]) == 1
+    assert len(files_now) == len(before) + 1
+    s2 = cb.Session(_cfg(tmp_path))
+    assert s2.sql("select count(*) as n from t").to_pandas().n[0] == 201
+
+
+def test_ctas_persists(tmp_path):
+    s = _mk_store(tmp_path)
+    s.sql("create table t2 as select a, b from t where a < 10 "
+          "distributed by (a)")
+    s2 = cb.Session(_cfg(tmp_path))
+    assert s2.sql("select count(*) as n from t2").to_pandas().n[0] == 10
