@@ -48,6 +48,9 @@ class TableStats:
     min_max: dict[str, tuple[float, float]] = field(default_factory=dict)
     # lazily-computed per-column uniqueness (PK detection for join planning)
     unique: dict[str, bool] = field(default_factory=dict)
+    # number of distinct values per column (the pg_statistic n_distinct
+    # analog) — computed lazily or by ANALYZE; drives join/group costing
+    ndv: dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -97,7 +100,10 @@ class Table:
         n = len(next(iter(data.values()))) if data else 0
         self.stats.row_count = n
         self.stats.unique = {}
+        self.stats.ndv = {}
         self.validity = {}
+        if appended == 0:
+            appended = None  # nothing new: a full (replace) snapshot is safe
         for c, v in (validity or {}).items():
             v = np.asarray(v, dtype=np.bool_)
             if c in data and not v.all():
@@ -124,14 +130,26 @@ class Table:
                 self.backing._txn_dirty[self.name] = self
             elif appended is not None and appended < n:
                 k = appended
+                # refresh persisted uniqueness incrementally: a previously
+                # unique column stays unique iff the appended tail has no
+                # internal dups and no overlap with the head (O(N) isin,
+                # not a full O(N log N) re-sort per statement)
+                prev = self.backing.read_manifest(self.name) \
+                    .get("unique", {})
+                unique = dict(prev)
+                for c, flag in prev.items():
+                    arr = data.get(c)
+                    if arr is None or not flag:
+                        continue
+                    tail, head = arr[n - k:], arr[:n - k]
+                    unique[c] = bool(
+                        len(np.unique(tail)) == len(tail)
+                        and not np.isin(tail, head).any())
                 self.backing.append(
                     self.name, {c: v[-k:] for c, v in data.items()},
                     self.schema, self.dicts,
                     validity={c: v[-k:] for c, v in self.validity.items()},
-                    unique={c: bool(self.is_unique(c))
-                            for c in self.schema.names
-                            if data.get(c) is not None
-                            and data[c].dtype.kind in "iu"},
+                    unique=unique,
                     policy=self.policy,
                     rows_per_partition=self.backing.rows_per_partition)
             else:
@@ -139,6 +157,37 @@ class Table:
                     self, getattr(self.backing, "rows_per_partition",
                                   1 << 20))
             self.cold = False
+
+    def ndv(self, col: str) -> Optional[int]:
+        """Distinct-value count for costing (exact; computed lazily and
+        cached — the auto-ANALYZE stance, autostats.c:283). Cold tables
+        only report manifest-persisted values (ANALYZE writes them)."""
+        cached = self.stats.ndv.get(col)
+        if cached is not None:
+            return cached
+        if self.cold:
+            return None
+        arr = self.data.get(col)
+        if arr is None or arr.dtype.kind not in "iufb" \
+            or self.stats.row_count == 0:
+            return None
+        n = int(len(np.unique(arr)))
+        self.stats.ndv[col] = n
+        return n
+
+    def analyze(self) -> dict[str, int]:
+        """Collect NDV for every column (the distributed-ANALYZE analog,
+        analyze.c:31 — strings count distinct dictionary codes) and persist
+        into the manifest if durable."""
+        self.ensure_loaded()
+        for f in self.schema.fields:
+            arr = self.data.get(f.name)
+            if arr is not None and arr.dtype.kind in "iufb" \
+                    and self.stats.row_count:
+                self.stats.ndv[f.name] = int(len(np.unique(arr)))
+        if self.backing is not None:
+            self.backing.save_stats(self.name, self.stats.ndv)
+        return dict(self.stats.ndv)
 
     def is_unique(self, col: str) -> bool:
         """Whether a column's values are distinct (PK detection; the planner

@@ -93,8 +93,24 @@ def prepare_inputs(exe: Executable, session,
                    segment: int | None = None) -> dict:
     """All inputs for one executable: RAM tables by name plus pruned
     store reads keyed by scan identity."""
-    tables = prepare_tables(exe.table_names, session, segment=segment)
-    for s in (exe.store_scans or ()):
+    return _assemble_inputs(exe.table_names, exe.store_scans or (),
+                            session, segment)
+
+
+def prepare_plan_inputs(plan: N.PlanNode, session,
+                        segment: int | None = None) -> dict:
+    """Same input assembly from a bare plan (instrumented execution)."""
+    scans = list(scans_of(plan))
+    return _assemble_inputs(
+        sorted({s.table_name for s in scans
+                if not hasattr(s, "_store_parts")}),
+        [s for s in scans if hasattr(s, "_store_parts")],
+        session, segment)
+
+
+def _assemble_inputs(table_names, store_scans, session, segment) -> dict:
+    tables = prepare_tables(table_names, session, segment=segment)
+    for s in store_scans:
         tables[s._input_key] = _load_store_scan(s, session)
     return tables
 

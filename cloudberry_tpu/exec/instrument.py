@@ -102,13 +102,7 @@ def run_instrumented(plan: N.PlanNode, session, query: str = ""):
         return out, sel, low.checks, low.node_counts
 
     fn = jax.jit(run)
-    scans = list(X.scans_of(plan))
-    tables = X.prepare_tables(
-        sorted({s.table_name for s in scans
-                if not hasattr(s, "_store_parts")}), session)
-    for s in scans:
-        if hasattr(s, "_store_parts"):
-            tables[s._input_key] = X._load_store_scan(s, session)
+    tables = X.prepare_plan_inputs(plan, session)
     t0 = time.time()
     result = fn(tables)
     jax.block_until_ready(result)

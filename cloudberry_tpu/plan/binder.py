@@ -121,10 +121,16 @@ def _unique_sets(plan: N.PlanNode, catalog: Catalog) -> list[frozenset[str]]:
         for phys, name in plan.column_map.items():
             if t.is_unique(phys):
                 out.append(frozenset([name]))
-    elif isinstance(plan, (N.PFilter, N.PSort, N.PLimit, N.PMotion)):
+    elif isinstance(plan, (N.PFilter, N.PSort, N.PLimit, N.PMotion,
+                           N.PShare)):
         out = _unique_sets(plan.children()[0], catalog)
     elif isinstance(plan, N.PJoin):
-        out = _unique_sets(plan.probe, catalog)
+        # probe uniqueness survives ONLY when each probe row emits at most
+        # one output row: semi/anti always; inner/left with a unique build.
+        # Expansion (many-to-many) and full joins duplicate probe rows.
+        if plan.kind in ("semi", "anti") or (
+                plan.unique_build and plan.kind in ("inner", "left")):
+            out = _unique_sets(plan.probe, catalog)
     elif isinstance(plan, N.PAgg):
         if plan.group_keys:
             out = [frozenset(n for n, _ in plan.group_keys)]
@@ -611,14 +617,121 @@ class Binder:
 
     def _join_tree(self, plans: dict[str, N.PlanNode], edges, scope: Scope
                    ) -> N.PlanNode:
-        if len(plans) == 1:
-            return next(iter(plans.values()))
         # group aliases by current plan object (explicit joins may share)
         groups: dict[int, set[str]] = {}
         plan_of: dict[int, N.PlanNode] = {}
         for a, p in plans.items():
             groups.setdefault(id(p), set()).add(a)
             plan_of[id(p)] = p
+        # aliases buried inside explicit JOIN trees resolve through scope
+        # entries — they belong to the group containing their plan
+        for se in scope.entries:
+            for gid, p in plan_of.items():
+                if p is se.plan or _plan_contains(p, se.plan):
+                    groups[gid].add(se.alias)
+        # equi-conjuncts between aliases INSIDE one group are plain filters
+        # (their join already happened in the explicit JOIN tree) — they
+        # must never be dropped as unusable edges
+        alias_group = {a: gid for gid, aliases in groups.items()
+                       for a in aliases}
+        cross = []
+        for e in edges:
+            ga, gb = alias_group.get(e[0]), alias_group.get(e[2])
+            if ga is not None and ga == gb:
+                p = plan_of[ga]
+                pred = self.bind_scalar(ast.BinOp("=", e[1], e[3]), scope)
+                p2 = self._filter(p, pred)
+                plan_of[ga] = p2
+                for se in scope.entries:
+                    if se.alias in groups[ga]:
+                        se.plan = p2
+                for a2, p_old in list(plans.items()):
+                    if a2 in groups[ga]:
+                        plans[a2] = p2
+            else:
+                cross.append(e)
+        edges = cross
+        if len(plan_of) == 1:
+            return next(iter(plan_of.values()))
+        gids = list(plan_of)
+        if len(gids) <= 10:
+            return self._join_tree_dp(groups, plan_of, gids, edges, scope)
+        return self._join_tree_greedy(groups, plan_of, edges, scope)
+
+    def _join_tree_dp(self, groups, plan_of, gids, edges, scope: Scope
+                      ) -> N.PlanNode:
+        """Bushy dynamic-programming join-order search over connected
+        subsets (the CJoinOrderDP.cpp move): cost = Σ estimated intermediate
+        result sizes; per pair, build/probe orientation prefers a provably
+        unique (PK) build side, then the smaller estimate."""
+        from cloudberry_tpu.plan import cost as C
+
+        cat = self.catalog
+        base = [(1 << i, g) for i, g in enumerate(gids)]
+        best: dict[int, tuple[float, N.PlanNode, frozenset]] = {}
+        for bit, g in base:
+            p = plan_of[g]
+            best[bit] = (0.0, p, frozenset(groups[g]))
+        full = (1 << len(gids)) - 1
+        by_size: dict[int, list[int]] = {}
+        for m in range(1, full + 1):
+            by_size.setdefault(bin(m).count("1"), []).append(m)
+        for size in range(2, len(gids) + 1):
+            for m in by_size.get(size, ()):
+                s = (m - 1) & m
+                while s:
+                    o = m ^ s
+                    if s > o and s in best and o in best:
+                        cand = self._dp_join(best[s], best[o], edges,
+                                             scope, cat)
+                        if cand is not None and (
+                                m not in best or cand[0] < best[m][0]):
+                            best[m] = cand
+                    s = (s - 1) & m
+        if full not in best:
+            raise BindError("cross join between FROM items not supported "
+                            "(no join condition found)")
+        final = best[full][1]
+        for e in scope.entries:
+            if e.alias in alias_set_of(groups):
+                e.plan = final
+        return final
+
+    def _dp_join(self, left, right, edges, scope: Scope, cat):
+        cost_l, pl, al = left
+        cost_r, pr, ar = right
+        used = [e for e in edges
+                if (e[0] in al and e[2] in ar)
+                or (e[2] in al and e[0] in ar)]
+        if not used:
+            return None  # disconnected: no cross joins
+        from cloudberry_tpu.plan import cost as C
+
+        lkeys, rkeys = [], []
+        for (a, lx, b, rx) in used:
+            if a in al:
+                lkeys.append(self.bind_scalar(lx, scope))
+                rkeys.append(self.bind_scalar(rx, scope))
+            else:
+                lkeys.append(self.bind_scalar(rx, scope))
+                rkeys.append(self.bind_scalar(lx, scope))
+        l_uniq = _build_is_unique(pl, lkeys, cat)
+        r_uniq = _build_is_unique(pr, rkeys, cat)
+        el = C.estimate_rows(pl, cat)
+        er = C.estimate_rows(pr, cat)
+        if r_uniq and (not l_uniq or er <= el):
+            j = self._make_join("inner", pr, pl, rkeys, lkeys)
+        elif l_uniq:
+            j = self._make_join("inner", pl, pr, lkeys, rkeys)
+        elif er <= el:
+            j = self._make_join("inner", pr, pl, rkeys, lkeys)
+        else:
+            j = self._make_join("inner", pl, pr, lkeys, rkeys)
+        est = C.estimate_rows(j, cat)
+        return (cost_l + cost_r + est, j, al | ar)
+
+    def _join_tree_greedy(self, groups, plan_of, edges, scope: Scope
+                          ) -> N.PlanNode:
         # start from the largest capacity group (the fact side)
         order = sorted(plan_of, key=lambda i: _plan_capacity(plan_of[i]),
                        reverse=True)
@@ -2100,6 +2213,13 @@ def _rebind_scope(scope: Scope, alias: str, plan: N.PlanNode) -> None:
     for e in scope.entries:
         if e.alias == alias:
             e.plan = plan
+
+
+def alias_set_of(groups) -> set:
+    out: set = set()
+    for aliases in groups.values():
+        out |= aliases
+    return out
 
 
 def _plan_contains(root: N.PlanNode, target: N.PlanNode) -> bool:

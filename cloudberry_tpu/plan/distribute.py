@@ -316,6 +316,10 @@ class Distributor:
     # ----------------------------------------------------------------- join
 
     def _join(self, node: N.PJoin) -> tuple[N.PlanNode, int]:
+        from cloudberry_tpu.plan.cost import estimate_rows
+
+        # estimate BEFORE the walk mutates scan capacities to shard sizes
+        est_build_rows = estimate_rows(node.build, self.session.catalog)
         build, bcap = self.walk(node.build)
         probe, pcap = self.walk(node.probe)
         bsh, psh = build.sharding, probe.sharding
@@ -343,8 +347,9 @@ class Distributor:
         p_part = psh.is_partitioned
 
         if b_part and p_part and not _join_colocated(node, bsh, psh):
-            est_build_total = bcap * self.nseg
-            if est_build_total <= self.cfg.planner.broadcast_threshold:
+            # statistics-estimated build size (cost.py), not the worst-case
+            # capacity: broadcast genuinely small sides, redistribute the rest
+            if est_build_rows <= self.cfg.planner.broadcast_threshold:
                 build, bcap = self.broadcast(build, bcap)
             else:
                 bsub = _hashed_key_positions(bsh, node.build_keys)

@@ -93,6 +93,13 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
         plan = _optimize(plan, session)
         return PlanResult(plan=plan)
 
+    if isinstance(stmt, ast.Analyze):
+        t = catalog.table(stmt.table)
+        ndv = t.analyze()
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"ANALYZE {stmt.table} "
+                                     f"({len(ndv)} columns)")
+
     if isinstance(stmt, ast.TxnStmt):
         return PlanResult(is_ddl=True,
                           ddl_result=session.txn(stmt.kind))

@@ -34,6 +34,11 @@ def apply_storage_scans(plan: N.PlanNode, session) -> None:
 
 def _walk(node: N.PlanNode, preds: tuple, session, store) -> None:
     if isinstance(node, N.PFilter):
+        # WHERE predicates are where scalar subqueries usually live — their
+        # plans' cold scans need binding too
+        for sub in ex.walk(node.predicate):
+            if isinstance(sub, ex.SubqueryScalar):
+                _walk(sub.plan, (), session, store)
         _walk(node.child, preds + (node.predicate,), session, store)
         return
     if isinstance(node, N.PScan):

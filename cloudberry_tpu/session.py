@@ -101,12 +101,15 @@ class Session:
         if kind == "begin":
             if snap is not None:
                 raise BindError("already in a transaction")
+            import copy
+
             self._txn_snapshot = {
                 "tables": {
                     name: (t, t.data,
                            {c: StringDictionary(d.values)
                             for c, d in t.dicts.items()},
-                           t.policy, dict(t.validity), t.cold)
+                           t.policy, dict(t.validity), t.cold,
+                           copy.deepcopy(t.stats))
                     for name, t in self.catalog.tables.items()},
                 "views": dict(self.catalog.views),
             }
@@ -127,7 +130,7 @@ class Session:
         if self.store is not None:
             self.store.abort_txn()
         self.catalog.tables = {}
-        for name, (t, data, dicts, policy, validity, cold) in \
+        for name, (t, data, dicts, policy, validity, cold, stats) in \
                 snap["tables"].items():
             t.policy = policy
             t._loading = True
@@ -136,6 +139,7 @@ class Session:
             finally:
                 t._loading = False
             t.cold = cold
+            t.stats = stats  # manifest-derived stats survive (cold tables)
             self.catalog.tables[name] = t
         self.catalog.views = snap["views"]
         self.catalog.bump_ddl()

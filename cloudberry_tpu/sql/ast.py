@@ -340,3 +340,9 @@ class TxnStmt(Node):
 class Explain(Node):
     stmt: Select
     analyze: bool = False
+
+
+@dataclass
+class Analyze(Node):
+    """ANALYZE <table> — collect column statistics (NDV)."""
+    table: str

@@ -115,6 +115,9 @@ class Parser:
                 self.accept_kw("transaction", "work")
                 w = {"abort": "rollback", "end": "commit"}.get(w, w)
             return ast.TxnStmt(w)
+        if self.at_kw("analyze"):
+            self.advance()
+            return ast.Analyze(self.expect_ident())
         if self.at_kw("copy"):
             return self.parse_copy()
         if self.at_kw("update"):

@@ -340,6 +340,13 @@ class TableStore:
                 out.append(name)
         return out
 
+    def save_stats(self, name: str, ndv: dict[str, int]) -> int:
+        """Persist ANALYZE output as a new manifest version (stats change
+        is a catalog change — same atomic commit discipline)."""
+        man = self.read_manifest(name)
+        man["ndv"] = {k: int(v) for k, v in ndv.items()}
+        return self._commit(name, man)
+
     def register_cold(self, catalog, name: str):
         """Register a stored table WITHOUT loading data: schema, policy,
         dictionaries, nullability, row count, per-column min/max and
@@ -385,6 +392,7 @@ class TableStore:
         # uniqueness survives deletion (a subset of unique stays unique)
         t.stats.unique = {c: bool(u)
                           for c, u in man.get("unique", {}).items()}
+        t.stats.ndv = {c: int(v) for c, v in man.get("ndv", {}).items()}
         return t
 
     def load_table(self, catalog, name: str,

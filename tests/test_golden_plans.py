@@ -1,0 +1,38 @@
+"""Plan-shape regression tests (the ORCA minidump-replay analog).
+
+Every TPC-H query's optimized plan — join order, motion placement,
+capacities, share nodes — must match its committed snapshot in
+tests/golden/. A legitimate planner change regenerates them with
+`python -m tools.golden_plans` and the diff is reviewed like any code.
+"""
+
+import os
+
+import pytest
+
+from tools.golden_plans import (GOLDEN_DIR, make_session, plan_text,
+                                snapshot_name)
+from tools.tpch_queries import QUERIES
+
+_SESSIONS = {}
+
+
+def _session(nseg):
+    if nseg not in _SESSIONS:
+        _SESSIONS[nseg] = make_session(nseg)
+    return _SESSIONS[nseg]
+
+
+@pytest.mark.parametrize("nseg", [1, 8], ids=["single", "dist8"])
+@pytest.mark.parametrize("qname", sorted(QUERIES))
+def test_plan_shape(qname, nseg):
+    path = os.path.join(GOLDEN_DIR, snapshot_name(qname, nseg))
+    assert os.path.exists(path), \
+        f"missing golden plan {path}; run python -m tools.golden_plans"
+    with open(path) as fh:
+        expected = fh.read()
+    got = plan_text(_session(nseg), QUERIES[qname])
+    assert got == expected, (
+        f"plan shape changed for {qname} (nseg={nseg}).\n"
+        f"--- expected ---\n{expected}\n--- got ---\n{got}\n"
+        "If intentional, regenerate: python -m tools.golden_plans")

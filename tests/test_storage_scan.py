@@ -239,6 +239,43 @@ def test_insert_appends_incrementally(tmp_path):
     assert s2.sql("select count(*) as n from t").to_pandas().n[0] == 201
 
 
+def test_zero_row_append_is_not_a_duplication(tmp_path):
+    """Regression: appended=0 must not re-append the whole table."""
+    s = _mk_store(tmp_path)
+    s.sql("create table e (a bigint, b bigint) distributed by (a)")
+    s.sql("insert into e values (1, 2)")
+    empty = tmp_path / "empty.csv"
+    empty.write_text("")
+    s.sql(f"copy e from '{empty}'")
+    s2 = cb.Session(_cfg(tmp_path))
+    assert s2.sql("select count(*) as n from e").to_pandas().n[0] == 1
+
+
+def test_rollback_keeps_cold_stats(tmp_path):
+    """Regression: ROLLBACK must not wipe a cold table's manifest stats
+    (row counts / uniqueness drive the planner)."""
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    t = s2.catalog.table("t")
+    assert t.num_rows == 200 and t.is_unique("a")
+    s2.sql("begin")
+    s2.sql("create table scratch (x int) distributed by (x)")
+    s2.sql("rollback")
+    t = s2.catalog.table("t")
+    assert t.cold and t.num_rows == 200 and t.is_unique("a")
+
+
+def test_subquery_cold_scan_is_pruned(tmp_path):
+    """Scalar subqueries in WHERE bind their cold scans to pruned reads
+    instead of silently materializing the table."""
+    _mk_store(tmp_path)
+    s2 = cb.Session(_cfg(tmp_path))
+    out = s2.sql("select count(*) as n from t "
+                 "where b > (select max(b) from t where a < 50)").to_pandas()
+    assert out.n[0] == 150
+    assert s2.catalog.table("t").cold  # never materialized
+
+
 def test_ctas_persists(tmp_path):
     s = _mk_store(tmp_path)
     s.sql("create table t2 as select a, b from t where a < 10 "

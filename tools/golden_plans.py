@@ -1,0 +1,61 @@
+"""Golden plan-shape snapshots — the ORCA minidump-replay analog.
+
+`python -m tools.golden_plans` regenerates tests/golden/*.plan for every
+TPC-H query in single-segment and 8-segment modes; the committed files are
+the expected plans, and tests/test_golden_plans.py fails on any regression
+(capacity changes, motion placement, join order, share nodes...). Like the
+reference's 1,246 .mdp fixtures, this pins optimizer behavior with no
+cluster and no oracle run.
+"""
+
+from __future__ import annotations
+
+import os
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "golden")
+
+SF = 0.01
+SEED = 7
+
+
+def make_session(nseg: int):
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import Config
+    from tools.tpchgen import load_tpch
+
+    s = cb.Session(Config(n_segments=nseg)) if nseg > 1 else cb.Session()
+    load_tpch(s, sf=SF, seed=SEED)
+    return s
+
+
+def plan_text(session, sql: str) -> str:
+    return session.explain(sql).rstrip() + "\n"
+
+
+def snapshot_name(qname: str, nseg: int) -> str:
+    return f"{qname}_seg{nseg}.plan"
+
+
+def regenerate() -> list[str]:
+    from tools.tpch_queries import QUERIES
+
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    written = []
+    for nseg in (1, 8):
+        s = make_session(nseg)
+        for qname in sorted(QUERIES):
+            text = plan_text(s, QUERIES[qname])
+            path = os.path.join(GOLDEN_DIR, snapshot_name(qname, nseg))
+            with open(path, "w") as fh:
+                fh.write(text)
+            written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    for p in regenerate():
+        print(p)
