@@ -102,8 +102,9 @@ class Table:
         self.stats.unique = {}
         self.stats.ndv = {}
         self.validity = {}
-        if appended == 0:
-            appended = None  # nothing new: a full (replace) snapshot is safe
+        no_change = appended == 0  # zero-row append: skip persistence
+        if no_change:
+            appended = None
         for c, v in (validity or {}).items():
             v = np.asarray(v, dtype=np.bool_)
             if c in data and not v.all():
@@ -125,7 +126,8 @@ class Table:
         # durable tables: every data change is a new atomic snapshot; an
         # append-only change persists just the new tail partitions. Inside
         # a transaction, writes defer to COMMIT (store.begin_txn).
-        if self.backing is not None and not getattr(self, "_loading", False):
+        if self.backing is not None and not getattr(self, "_loading", False) \
+                and not no_change:
             if not getattr(self.backing, "autocommit", True):
                 self.backing._txn_dirty[self.name] = self
             elif appended is not None and appended < n:
@@ -186,7 +188,12 @@ class Table:
                     and self.stats.row_count:
                 self.stats.ndv[f.name] = int(len(np.unique(arr)))
         if self.backing is not None:
-            self.backing.save_stats(self.name, self.stats.ndv)
+            if getattr(self.backing, "autocommit", True):
+                self.backing.save_stats(self.name, self.stats.ndv)
+            else:
+                # inside a transaction: stats persist at COMMIT with the
+                # table (commit_txn re-saves stats), never on ROLLBACK
+                self.backing._txn_dirty[self.name] = self
         return dict(self.stats.ndv)
 
     def is_unique(self, col: str) -> bool:

@@ -52,6 +52,10 @@ class PlannerConfig:
     # Prune dispatch to a single segment for point predicates on the
     # distribution key (reference: cdbtargeteddispatch.c).
     enable_direct_dispatch: bool = True
+    # Push a semi-join runtime filter below the probe's redistribute when
+    # the estimated build side is at most this many rows (0 disables) —
+    # the nodeRuntimeFilter.c analog, exact rather than bloom.
+    runtime_filter_threshold: int = 1_000_000
 
 
 @dataclass(frozen=True)

@@ -132,8 +132,36 @@ class DistLowerer(X.Lowerer):
         local = jnp.any(x).astype(jnp.int32)
         return jax.lax.psum(local, SEG_AXIS) > 0
 
+    def runtime_filter(self, node):
+        """Exact semi-join pushdown (nodeRuntimeFilter.c analog): all-gather
+        the PACKED u64 build keys (keys only — the cheapest collective),
+        sorted-membership-test the probe rows, and AND into the selection
+        BEFORE the probe's redistribute. Packing ranges reduce globally so
+        every segment packs identically."""
+        pcols, psel = self.lower(node.child)
+        bcols, bsel = self.lower_shared(node.build)
+        bkeys = [self.expr(k, bcols) for k in node.build_keys]
+        pkeys = [self.expr(k, pcols) for k in node.probe_keys]
+        ranges = []
+        for k in bkeys:
+            u = K.sort_key_u64(k)
+            lo = jnp.min(jnp.where(bsel, u, K._U64_MAX))
+            hi = jnp.max(jnp.where(bsel, u, jnp.uint64(0)))
+            lo = jnp.min(jax.lax.all_gather(lo, SEG_AXIS))
+            hi = jnp.max(jax.lax.all_gather(hi, SEG_AXIS))
+            span = jnp.maximum(hi - lo, jnp.uint64(0)) + jnp.uint64(1)
+            ranges.append((lo, span))
+        kb = jnp.where(bsel, K.pack_with_ranges(bkeys, ranges), K._U64_MAX)
+        kp = K.pack_with_ranges(pkeys, ranges)
+        kb_all = jax.lax.all_gather(kb, SEG_AXIS, axis=0, tiled=True)
+        kb_sorted = jnp.sort(kb_all)
+        pos = jnp.clip(jnp.searchsorted(kb_sorted, kp), 0,
+                       kb_sorted.shape[0] - 1)
+        hit = (kb_sorted[pos] == kp) & (kp != K._U64_MAX)
+        return pcols, psel & hit
+
     def motion(self, node: N.PMotion):
-        cols, sel = self.lower(node.child)
+        cols, sel = self.lower_shared(node.child)
         if node.pre_compact:
             cols, sel, n = K.compact(cols, sel, node.pre_compact)
             self.checks[

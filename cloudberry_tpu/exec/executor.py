@@ -242,10 +242,9 @@ class Lowerer:
         if isinstance(node, N.PWindow):
             return self.window(node)
         if isinstance(node, N.PShare):
-            key = id(node.child)
-            if key not in self._sharecache:
-                self._sharecache[key] = self.lower(node.child)
-            return self._sharecache[key]
+            return self.lower_shared(node.child)
+        if isinstance(node, N.PRuntimeFilter):
+            return self.runtime_filter(node)
         if isinstance(node, N.PConcat):
             outs = [self.lower(c) for c in node.inputs]
             cols = {f.name: jnp.concatenate([o[0][f.name] for o in outs])
@@ -277,13 +276,27 @@ class Lowerer:
 
     def motion(self, node: N.PMotion):
         # single-program mode: loopback motion is the identity (the
-        # MotionIPCLayer seam's test backend)
-        return self.lower(node.child)
+        # MotionIPCLayer seam's test backend). lower_shared: a runtime
+        # filter may reference the motion's child (build side) too.
+        return self.lower_shared(node.child)
 
     def global_any(self, x) -> jnp.ndarray:
         """Any() across ALL data — the distributed lowerer reduces over the
         segment axis too (null-aware NOT IN needs a cluster-wide answer)."""
         return jnp.any(x)
+
+    def lower_shared(self, node: N.PlanNode):
+        """Lower a subtree at most once (PShare / runtime-filter build
+        sharing) — the materialize-once contract at trace level."""
+        key = id(node)
+        if key not in self._sharecache:
+            self._sharecache[key] = self.lower(node)
+        return self._sharecache[key]
+
+    def runtime_filter(self, node: N.PRuntimeFilter):
+        """Single-program mode: motions are loopback, so the filter would
+        only duplicate the join's own matching — pass through."""
+        return self.lower(node.child)
 
     # ----------------------------------------------------------- expressions
 
@@ -315,7 +328,9 @@ class Lowerer:
     # ------------------------------------------------------------ operators
 
     def join(self, node: N.PJoin):
-        bcols, bsel = self.lower(node.build)
+        # lower_shared: a runtime filter may reference the same build
+        # subtree — it must trace once
+        bcols, bsel = self.lower_shared(node.build)
         pcols, psel = self.lower(node.probe)
         bkeys = [self.expr(k, bcols) for k in node.build_keys]
         pkeys = [self.expr(k, pcols) for k in node.probe_keys]

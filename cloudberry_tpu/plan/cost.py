@@ -89,6 +89,14 @@ def _estimate_join(node: N.PJoin, catalog) -> float:
     return inner
 
 
+def semi_estimate(build: N.PlanNode, probe: N.PlanNode, build_keys,
+                  probe_keys, catalog) -> float:
+    """Rows of ``probe`` surviving a semi filter on the join keys (runtime-
+    filter sizing)."""
+    j = N.PJoin("semi", build, probe, list(build_keys), list(probe_keys), [])
+    return _estimate_join(j, catalog)
+
+
 def _keys_ndv(plan: N.PlanNode, keys, catalog) -> Optional[float]:
     """Combined NDV of a key tuple (product, capped by subtree rows)."""
     prod = 1.0

@@ -298,7 +298,9 @@ class Distributor:
         return m, m.out_capacity
 
     def redistribute(self, child: N.PlanNode, cap: int,
-                     keys: list[ex.Expr]) -> tuple[N.PlanNode, int]:
+                     keys: list[ex.Expr],
+                     est_rows: float | None = None
+                     ) -> tuple[N.PlanNode, int]:
         m = N.PMotion(child, "redistribute", hash_keys=list(keys))
         m.fields = list(child.fields)
         key_names = tuple(k.name for k in keys
@@ -310,8 +312,38 @@ class Distributor:
         # detected runtime error, never a silent drop
         factor = self.cfg.interconnect.capacity_factor
         m.bucket_cap = max(int(math.ceil(cap / self.nseg * factor)), 8)
+        if est_rows is not None:
+            # a runtime filter shrank the input: size buckets as if the
+            # worst source segment held min(cap, est) surviving rows —
+            # robust to source skew (all survivors on one shard) while
+            # still shrinking when the filter is selective; overflow stays
+            # a detected error pointing at capacity_factor
+            est_bucket = max(int(math.ceil(
+                min(est_rows, cap) / self.nseg * factor)), 64)
+            m.bucket_cap = min(m.bucket_cap, est_bucket)
         m.out_capacity = m.bucket_cap * self.nseg
         return m, m.out_capacity
+
+    def _maybe_runtime_filter(self, node: N.PJoin, build_src: N.PlanNode,
+                              probe: N.PlanNode, est_build_rows: float
+                              ) -> tuple[N.PlanNode, float | None]:
+        """Wrap the probe in a pre-motion runtime filter when profitable;
+        returns (probe', per-segment row estimate for bucket sizing)."""
+        from cloudberry_tpu.plan.cost import semi_estimate
+
+        thresh = self.cfg.planner.runtime_filter_threshold
+        if thresh <= 0 or node.kind not in ("inner", "semi") \
+                or est_build_rows > thresh:
+            return probe, None
+        rf = N.PRuntimeFilter(probe, build_src,
+                              list(node.build_keys), list(node.probe_keys))
+        rf.fields = list(probe.fields)
+        rf.sharding = probe.sharding
+        est = semi_estimate(node.build, node.probe,
+                            node.build_keys, node.probe_keys,
+                            self.session.catalog)
+        return rf, max(est, 1.0)  # TOTAL surviving rows (redistribute
+        #                           divides by nseg for the bucket size)
 
     # ----------------------------------------------------------------- join
 
@@ -347,24 +379,34 @@ class Distributor:
         p_part = psh.is_partitioned
 
         if b_part and p_part and not _join_colocated(node, bsh, psh):
-            # statistics-estimated build size (cost.py), not the worst-case
-            # capacity: broadcast genuinely small sides, redistribute the rest
-            if est_build_rows <= self.cfg.planner.broadcast_threshold:
+            # statistics-estimated build size (cost.py) decides, but the
+            # STATIC broadcast buffer is bcap·nseg rows regardless of actual
+            # data — cap it structurally so a misestimate can never allocate
+            # an unbounded replicated buffer
+            thr = self.cfg.planner.broadcast_threshold
+            if est_build_rows <= thr and bcap * self.nseg <= max(thr, 1) * 16:
                 build, bcap = self.broadcast(build, bcap)
             else:
                 bsub = _hashed_key_positions(bsh, node.build_keys)
                 psub = _hashed_key_positions(psh, node.probe_keys)
                 if bsub is not None:
+                    probe, est = self._maybe_runtime_filter(
+                        node, build, probe, est_build_rows)
                     probe, pcap = self.redistribute(
-                        probe, pcap, [node.probe_keys[i] for i in bsub])
+                        probe, pcap, [node.probe_keys[i] for i in bsub],
+                        est_rows=est)
                 elif psub is not None:
                     build, bcap = self.redistribute(
                         build, bcap, [node.build_keys[i] for i in psub])
                 else:
+                    build_src = build
                     build, bcap = self.redistribute(build, bcap,
                                                     list(node.build_keys))
+                    probe, est = self._maybe_runtime_filter(
+                        node, build_src, probe, est_build_rows)
                     probe, pcap = self.redistribute(probe, pcap,
-                                                    list(node.probe_keys))
+                                                    list(node.probe_keys),
+                                                    est_rows=est)
         elif b_part and not p_part:
             if node.kind in ("inner", "semi"):
                 # probe replicated/singleton, build partitioned: each segment

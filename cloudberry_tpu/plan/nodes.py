@@ -253,6 +253,28 @@ class PConcat(PlanNode):
 
 
 @dataclass
+class PRuntimeFilter(PlanNode):
+    """Semi-join pushdown before a probe-side motion (nodeRuntimeFilter.c
+    analog): drop probe rows whose join key provably has no build partner
+    BEFORE the shuffle. The build reference is the SAME object the join
+    lowers (memoized, traced once); the membership test all-gathers ONLY
+    the packed u64 build keys — the cheapest possible collective — and is
+    exact (sorted lookup), so unlike a bloom there are no false positives
+    and the planner may shrink downstream motion buffers on its estimate."""
+
+    child: PlanNode                  # probe subtree (pre-motion)
+    build: PlanNode                  # shared with the join's build input
+    build_keys: list[ex.Expr] = dc_field(default_factory=list)
+    probe_keys: list[ex.Expr] = dc_field(default_factory=list)
+
+    def children(self):
+        return [self.child]          # build is walked under the join
+
+    def title(self):
+        return "RuntimeFilter"
+
+
+@dataclass
 class PMotion(PlanNode):
     """The Motion node (nodeMotion.c analog). kind:
     'gather'       — all segments → singleton (GATHER_MOTION)

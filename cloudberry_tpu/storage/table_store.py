@@ -321,10 +321,14 @@ class TableStore:
         unique = {c: bool(t.is_unique(c)) for c in t.schema.names
                   if t.data.get(c) is not None
                   and t.data[c].dtype.kind in "iu"}
-        return self.append(t.name, t.data, t.schema, t.dicts, replace=True,
-                           policy=t.policy, validity=t.validity,
-                           unique=unique,
-                           rows_per_partition=rows_per_partition)
+        v = self.append(t.name, t.data, t.schema, t.dicts, replace=True,
+                        policy=t.policy, validity=t.validity,
+                        unique=unique,
+                        rows_per_partition=rows_per_partition)
+        if t.stats.ndv:
+            # ANALYZE output survives the snapshot (deferred-commit path)
+            v = self.save_stats(t.name, t.stats.ndv)
+        return v
 
     def drop_table(self, name: str) -> None:
         import shutil

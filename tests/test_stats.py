@@ -54,6 +54,27 @@ def test_analyze_persists_for_cold_tables(tmp_path):
     assert t.cold
 
 
+def test_analyze_in_rolled_back_txn_not_durable(tmp_path):
+    """Regression: ANALYZE inside BEGIN..ROLLBACK must not publish stats
+    computed from rolled-back rows."""
+    cfg = Config().with_overrides(**{"storage.root": str(tmp_path)})
+    s = cb.Session(cfg)
+    s.sql("create table t (a bigint, g bigint) distributed by (a)")
+    s.sql("insert into t values " +
+          ",".join(f"({i}, {i % 5})" for i in range(50)))
+    s.sql("begin")
+    s.sql("insert into t values " +
+          ",".join(f"({i + 100}, {i})" for i in range(50)))
+    s.sql("analyze t")
+    s.sql("rollback")
+    s2 = cb.Session(cfg)
+    assert s2.catalog.table("t").ndv("g") in (None, 5)
+    # and a committed ANALYZE does persist
+    s.sql("analyze t")
+    s3 = cb.Session(cfg)
+    assert s3.catalog.table("t").ndv("g") == 5
+
+
 def test_filter_selectivity_estimates(s):
     cat = s.catalog
     p = _plan(s, "select k from f where g = 3")
