@@ -191,9 +191,10 @@ class Table:
             if getattr(self.backing, "autocommit", True):
                 self.backing.save_stats(self.name, self.stats.ndv)
             else:
-                # inside a transaction: stats persist at COMMIT with the
-                # table (commit_txn re-saves stats), never on ROLLBACK
-                self.backing._txn_dirty[self.name] = self
+                # inside a transaction: a stats-only marker — COMMIT writes
+                # one manifest (save_stats), never a full data re-snapshot,
+                # and ROLLBACK discards it
+                self.backing._txn_stats[self.name] = self
         return dict(self.stats.ndv)
 
     def is_unique(self, col: str) -> bool:

@@ -56,6 +56,11 @@ class PlannerConfig:
     # the estimated build side is at most this many rows (0 disables) —
     # the nodeRuntimeFilter.c analog, exact rather than bloom.
     runtime_filter_threshold: int = 1_000_000
+    # Final grouped aggregation runs on ONE segment via gather when the
+    # group capacity is at most this (the GATHER_SINGLE motion analog,
+    # plannodes.h:1638): immune to hash-space skew across destinations,
+    # and cheaper than an all_to_all for small partials. 0 disables.
+    gather_single_threshold: int = 8192
 
 
 @dataclass(frozen=True)

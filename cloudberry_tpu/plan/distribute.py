@@ -307,10 +307,26 @@ class Distributor:
                           if isinstance(k, ex.ColumnRef))
         m.sharding = (Sharding.hashed(*key_names)
                       if len(key_names) == len(keys) else Sharding.strewn())
+        # skew-proof sizing: when the redistributed subtree is a (filtered)
+        # base-table scan with column keys, compute the TRUE per-(source,
+        # destination) row counts host-side — an exact upper bound that
+        # absorbs ANY key skew (the planner-level answer to the reference's
+        # skew handling; filters only shrink it further)
+        exact = self._exact_bucket_cap(child, keys)
+        factor = self.cfg.interconnect.capacity_factor
+        if exact is not None:
+            m.bucket_cap = max(exact, 8)
+            if est_rows is not None:
+                # a runtime filter below: the exact bound covers PRE-filter
+                # rows; the estimate may shrink further (overflow detected)
+                est_bucket = max(int(math.ceil(
+                    min(est_rows, cap) / self.nseg * factor)), 64)
+                m.bucket_cap = min(m.bucket_cap, est_bucket)
+            m.out_capacity = m.bucket_cap * self.nseg
+            return m, m.out_capacity
         # capacity-based flow control (the ic_udpifc.c:3018 analog): each
         # destination bucket holds factor × fair share; overflow is a
         # detected runtime error, never a silent drop
-        factor = self.cfg.interconnect.capacity_factor
         m.bucket_cap = max(int(math.ceil(cap / self.nseg * factor)), 8)
         if est_rows is not None:
             # a runtime filter shrank the input: size buckets as if the
@@ -324,34 +340,87 @@ class Distributor:
         m.out_capacity = m.bucket_cap * self.nseg
         return m, m.out_capacity
 
+    def _exact_bucket_cap(self, child: N.PlanNode, keys) -> Optional[int]:
+        """Exact max rows any (source, destination) bucket can receive,
+        from the base table's actual key values — None when the subtree
+        isn't a plain (possibly filtered/runtime-filtered) scan."""
+        import numpy as np
+
+        from cloudberry_tpu.utils import hashing
+
+        node = child
+        while isinstance(node, (N.PFilter, N.PRuntimeFilter)):
+            node = node.child
+        if not isinstance(node, N.PScan) or node.table_name == "$dual":
+            return None
+        try:
+            t = self.session.catalog.table(node.table_name)
+        except KeyError:
+            return None
+        if t.policy.kind == "replicated":
+            return None
+        rev = {out: phys for phys, out in node.column_map.items()}
+        phys = []
+        for k in keys:
+            p = rev.get(k.name) if isinstance(k, ex.ColumnRef) else None
+            if p is None:
+                return None
+            phys.append(p)
+        t.ensure_loaded()  # distributed scans materialize anyway
+        if t.num_rows == 0:
+            return None
+        cache = getattr(self.session, "_bucket_cap_cache", None)
+        if cache is None:
+            cache = self.session._bucket_cap_cache = {}
+        key = (node.table_name, getattr(t, "_version", 0),
+               tuple(phys), self.nseg)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        cols = [np.asarray(t.data[p]) for p in phys]
+        dst = hashing.jump_consistent_hash_np(
+            hashing.hash_columns_np(cols), self.nseg)
+        src = t.shard_assignment(self.nseg)
+        if src is None:
+            return None
+        counts = np.bincount(src.astype(np.int64) * self.nseg + dst,
+                             minlength=self.nseg * self.nseg)
+        out = int(counts.max())
+        if len(cache) >= 64:
+            cache.pop(next(iter(cache)))
+        cache[key] = out
+        return out
+
     def _maybe_runtime_filter(self, node: N.PJoin, build_src: N.PlanNode,
-                              probe: N.PlanNode, est_build_rows: float
+                              probe: N.PlanNode, est_build_rows: float,
+                              est_semi_rows: float | None
                               ) -> tuple[N.PlanNode, float | None]:
         """Wrap the probe in a pre-motion runtime filter when profitable;
-        returns (probe', per-segment row estimate for bucket sizing)."""
-        from cloudberry_tpu.plan.cost import semi_estimate
-
+        returns (probe', TOTAL surviving-row estimate for bucket sizing —
+        computed pre-walk by the caller so shard-mutated scans can't skew
+        it)."""
         thresh = self.cfg.planner.runtime_filter_threshold
         if thresh <= 0 or node.kind not in ("inner", "semi") \
-                or est_build_rows > thresh:
+                or est_build_rows > thresh or est_semi_rows is None:
             return probe, None
         rf = N.PRuntimeFilter(probe, build_src,
                               list(node.build_keys), list(node.probe_keys))
         rf.fields = list(probe.fields)
         rf.sharding = probe.sharding
-        est = semi_estimate(node.build, node.probe,
-                            node.build_keys, node.probe_keys,
-                            self.session.catalog)
-        return rf, max(est, 1.0)  # TOTAL surviving rows (redistribute
-        #                           divides by nseg for the bucket size)
+        return rf, max(est_semi_rows, 1.0)
 
     # ----------------------------------------------------------------- join
 
     def _join(self, node: N.PJoin) -> tuple[N.PlanNode, int]:
-        from cloudberry_tpu.plan.cost import estimate_rows
+        from cloudberry_tpu.plan.cost import estimate_rows, semi_estimate
 
         # estimate BEFORE the walk mutates scan capacities to shard sizes
+        # (both the build size and the runtime filter's survivor count)
         est_build_rows = estimate_rows(node.build, self.session.catalog)
+        est_semi_rows = semi_estimate(node.build, node.probe,
+                                      node.build_keys, node.probe_keys,
+                                      self.session.catalog) \
+            if node.kind in ("inner", "semi") else None
         build, bcap = self.walk(node.build)
         probe, pcap = self.walk(node.probe)
         bsh, psh = build.sharding, probe.sharding
@@ -391,7 +460,7 @@ class Distributor:
                 psub = _hashed_key_positions(psh, node.probe_keys)
                 if bsub is not None:
                     probe, est = self._maybe_runtime_filter(
-                        node, build, probe, est_build_rows)
+                        node, build, probe, est_build_rows, est_semi_rows)
                     probe, pcap = self.redistribute(
                         probe, pcap, [node.probe_keys[i] for i in bsub],
                         est_rows=est)
@@ -403,7 +472,8 @@ class Distributor:
                     build, bcap = self.redistribute(build, bcap,
                                                     list(node.build_keys))
                     probe, est = self._maybe_runtime_filter(
-                        node, build_src, probe, est_build_rows)
+                        node, build_src, probe, est_build_rows,
+                        est_semi_rows)
                     probe, pcap = self.redistribute(probe, pcap,
                                                     list(node.probe_keys),
                                                     est_rows=est)
@@ -472,8 +542,23 @@ class Distributor:
                           for n, c in partial_aggs]
         partial.sharding = child.sharding
 
-        key_refs = [_field_ref(partial, n) for n, _ in node.group_keys]
-        motion, mcap = self.redistribute(partial, partial.capacity, key_refs)
+        gst = self.cfg.planner.gather_single_threshold
+        if 0 < node.capacity <= gst:
+            # GATHER_SINGLE (plannodes.h:1638 analog): partials are small
+            # — gather them to one segment for the final merge. Immune to
+            # hash-space skew across destinations (a redistribute's
+            # per-bucket variance can overflow when many distinct keys
+            # land on one segment), and a cheaper collective besides.
+            motion, mcap = self.gather(partial, partial.capacity)
+            final_sharding = Sharding.singleton()
+        else:
+            key_refs = [_field_ref(partial, n) for n, _ in node.group_keys]
+            motion, mcap = self.redistribute(partial, partial.capacity,
+                                             key_refs)
+            final_sharding = _rename_sharding(
+                Sharding.hashed(*(k.name for k in key_refs
+                                  if isinstance(k, ex.ColumnRef))),
+                [(n, _field_ref(motion, n)) for n, _ in node.group_keys])
 
         final_keys = [(n, _field_ref(motion, n)) for n, _ in node.group_keys]
         final = N.PAgg(motion, final_keys, final_aggs,
@@ -481,10 +566,7 @@ class Distributor:
         final.fields = [N.PlanField(n, e.dtype, _f_dict(motion, e))
                         for n, e in final_keys] + \
                        [N.PlanField(n, c.dtype, None) for n, c in final_aggs]
-        final.sharding = _rename_sharding(
-            Sharding.hashed(*(k.name for k in key_refs
-                              if isinstance(k, ex.ColumnRef))),
-            final_keys)
+        final.sharding = final_sharding
 
         out = _finalize_project(final, node, finalize)
         out.sharding = final.sharding

@@ -62,6 +62,7 @@ class TableStore:
     def begin_txn(self) -> None:
         self.autocommit = False
         self._txn_dirty = {}
+        self._txn_stats: dict[str, object] = {}
         self._txn_drops = []
 
     def commit_txn(self) -> None:
@@ -69,11 +70,17 @@ class TableStore:
             self.drop_table(name)
         for t in self._txn_dirty.values():
             self.save_table(t, self.rows_per_partition)
+        # stats-only changes (ANALYZE with no DML): one manifest write,
+        # not a full data re-snapshot
+        for name, t in getattr(self, "_txn_stats", {}).items():
+            if name not in self._txn_dirty and t.stats.ndv:
+                self.save_stats(name, t.stats.ndv)
         self.abort_txn()
 
     def abort_txn(self) -> None:
         self.autocommit = True
         self._txn_dirty = {}
+        self._txn_stats = {}
         self._txn_drops = []
 
     # ----------------------------------------------------------- manifests
