@@ -130,7 +130,9 @@ class Table:
                 and not no_change:
             if not getattr(self.backing, "autocommit", True):
                 self.backing._txn_dirty[self.name] = self
-            elif appended is not None and appended < n:
+                self.cold = False
+                return
+            if appended is not None and appended < n:
                 k = appended
                 # refresh persisted uniqueness incrementally: a previously
                 # unique column stays unique iff the appended tail has no
@@ -147,7 +149,7 @@ class Table:
                     unique[c] = bool(
                         len(np.unique(tail)) == len(tail)
                         and not np.isin(tail, head).any())
-                self.backing.append(
+                self._store_version = self.backing.append(
                     self.name, {c: v[-k:] for c, v in data.items()},
                     self.schema, self.dicts,
                     validity={c: v[-k:] for c, v in self.validity.items()},
@@ -155,7 +157,7 @@ class Table:
                     policy=self.policy,
                     rows_per_partition=self.backing.rows_per_partition)
             else:
-                self.backing.save_table(
+                self._store_version = self.backing.save_table(
                     self, getattr(self.backing, "rows_per_partition",
                                   1 << 20))
             self.cold = False
@@ -189,7 +191,8 @@ class Table:
                 self.stats.ndv[f.name] = int(len(np.unique(arr)))
         if self.backing is not None:
             if getattr(self.backing, "autocommit", True):
-                self.backing.save_stats(self.name, self.stats.ndv)
+                self._store_version = \
+                    self.backing.save_stats(self.name, self.stats.ndv)
             else:
                 # inside a transaction: a stats-only marker — COMMIT writes
                 # one manifest (save_stats), never a full data re-snapshot,
@@ -293,6 +296,14 @@ class Catalog:
     def bump_ddl(self) -> None:
         self.ddl_version += 1
 
+    def adopt(self, t: "Table") -> "Table":
+        """Register an externally-constructed table (store registration)
+        without the CREATE-time persistence side effects."""
+        t._version = next(_VERSION_COUNTER)
+        self.tables[t.name] = t
+        self.bump_ddl()
+        return t
+
     def create_table(self, name: str, schema: Schema,
                      policy: DistributionPolicy | None = None,
                      if_not_exists: bool = False) -> Table:
@@ -309,7 +320,8 @@ class Catalog:
         if self.store is not None:
             t.backing = self.store
             if self.store.autocommit:
-                self.store.save_table(t)  # durable schema from CREATE on
+                # durable schema from CREATE on
+                t._store_version = self.store.save_table(t)
             else:
                 self.store._txn_dirty[name] = t
         self.tables[name] = t
@@ -327,6 +339,7 @@ class Catalog:
             else:
                 t.backing._txn_drops.append(name)
                 t.backing._txn_dirty.pop(name, None)
+                getattr(t.backing, "_txn_stats", {}).pop(name, None)
         del self.tables[name]
         self.bump_ddl()
 

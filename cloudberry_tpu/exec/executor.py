@@ -123,7 +123,7 @@ def _load_store_scan(scan: N.PScan, session) -> dict:
     projection: ONLY column_map + mask_map physical columns are read),
     cached per (table, version, partitions, columns)."""
     store = session.catalog.store
-    key = (scan.table_name, store.current_version(scan.table_name),
+    key = (scan.table_name, store.effective_version(scan.table_name),
            tuple(p["file"] for p in scan._store_parts),
            tuple(sorted(scan.column_map)), tuple(sorted(scan.mask_map)))
     cache = session._store_scan_cache

@@ -315,13 +315,11 @@ class Distributor:
         exact = self._exact_bucket_cap(child, keys)
         factor = self.cfg.interconnect.capacity_factor
         if exact is not None:
+            # the exact bound is authoritative: it absorbs ANY key skew,
+            # and a runtime filter below only removes rows — never grows a
+            # bucket past it. Estimates must not undercut it (a skewed hot
+            # key would trip the overflow check the exact count prevents).
             m.bucket_cap = max(exact, 8)
-            if est_rows is not None:
-                # a runtime filter below: the exact bound covers PRE-filter
-                # rows; the estimate may shrink further (overflow detected)
-                est_bucket = max(int(math.ceil(
-                    min(est_rows, cap) / self.nseg * factor)), 64)
-                m.bucket_cap = min(m.bucket_cap, est_bucket)
             m.out_capacity = m.bucket_cap * self.nseg
             return m, m.out_capacity
         # capacity-based flow control (the ic_udpifc.c:3018 analog): each

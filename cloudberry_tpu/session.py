@@ -21,6 +21,11 @@ import numpy as np
 from cloudberry_tpu.config import Config, get_config
 
 
+class SerializationError(RuntimeError):
+    """COMMIT lost the single-writer OCC race: another session committed a
+    conflicting table version after this transaction's BEGIN snapshot."""
+
+
 @dataclass
 class ShardedTable:
     """Host-side sharded layout: per-column (n_segments, capacity) arrays
@@ -68,6 +73,7 @@ class Session:
         from cloudberry_tpu.sql.parser import parse_sql
         from cloudberry_tpu.utils.faultinject import fault_point
 
+        self._sync_store()
         cached = self._cached_statement(query)
         if cached is not None:
             fault_point("dispatch_start")
@@ -84,6 +90,37 @@ class Session:
         fault_point("dispatch_start")
         with self._gate:
             return self._execute_and_cache(query, result.plan)
+
+    def _sync_store(self) -> None:
+        """Pick up OTHER sessions' committed changes at statement start
+        (outside transactions): any table whose store version moved
+        re-registers cold; new tables appear, dropped ones vanish. The
+        coordinator-catalog analog of the reference's shared catalog —
+        manifests ARE the catalog of record."""
+        if self.store is None \
+                or getattr(self, "_txn_snapshot", None) is not None:
+            return
+        # fast path: one epoch read; the per-table walk only runs when
+        # SOMETHING changed since this session last looked
+        epoch = self.store.epoch()
+        if epoch == getattr(self, "_seen_epoch", None):
+            return
+        self._seen_epoch = epoch
+        names = set(self.store.table_names())
+        for name in list(self.catalog.tables):
+            t = self.catalog.tables[name]
+            if t.backing is None:
+                continue
+            if name not in names:
+                del self.catalog.tables[name]
+                self.catalog.bump_ddl()
+                continue
+            v = self.store.current_version(name)
+            if v != getattr(t, "_store_version", None):
+                del self.catalog.tables[name]
+                self.store.register_cold(self.catalog, name)
+        for name in sorted(names - set(self.catalog.tables)):
+            self.store.register_cold(self.catalog, name)
 
     # ----------------------------------------------------- transactions
     # Single-session transactions over the in-memory catalog: BEGIN
@@ -114,21 +151,39 @@ class Session:
                 "views": dict(self.catalog.views),
             }
             if self.store is not None:
-                # durable writes defer to COMMIT; ROLLBACK never touches disk
+                # durable writes defer to COMMIT; ROLLBACK never touches
+                # disk. The BEGIN snapshot's versions are the OCC base.
                 self.store.begin_txn()
+                self._txn_base = dict(self.store.pinned)
             return "BEGIN"
         if snap is None:
             raise BindError(f"{kind.upper()}: no transaction in progress")
         if kind == "commit":
-            self._txn_snapshot = None
             if self.store is not None:
+                # single-writer OCC (the 2PC-role analog, cdbtm.c:883):
+                # first committer wins; a conflicting later COMMIT aborts
+                # and rolls back rather than overwriting
+                conflicts = self.store.conflicting_tables(
+                    getattr(self, "_txn_base", {}))
+                if conflicts:
+                    self.store.abort_txn()
+                    self._restore_snapshot(snap)
+                    raise SerializationError(
+                        "could not serialize access: table(s) "
+                        f"{', '.join(conflicts)} were modified by another "
+                        "session after this transaction began")
                 self.store.commit_txn()
+            self._txn_snapshot = None
             return "COMMIT"
         # rollback: restore RAM state WITHOUT persisting (the store never
         # saw the transaction's writes); cold tables restore to cold —
         # their placeholder arrays must never overwrite stored data
         if self.store is not None:
             self.store.abort_txn()
+        self._restore_snapshot(snap)
+        return "ROLLBACK"
+
+    def _restore_snapshot(self, snap) -> None:
         self.catalog.tables = {}
         for name, (t, data, dicts, policy, validity, cold, stats) in \
                 snap["tables"].items():
@@ -144,7 +199,6 @@ class Session:
         self.catalog.views = snap["views"]
         self.catalog.bump_ddl()
         self._txn_snapshot = None
-        return "ROLLBACK"
 
     # ------------------------------------------------- statement cache
     # The prepared-statement / plan-cache analog: a repeated query string
@@ -207,6 +261,7 @@ class Session:
         from cloudberry_tpu.sql.parser import parse_sql
         from cloudberry_tpu.plan.planner import plan_statement
 
+        self._sync_store()
         stmt = parse_sql(query)
         result = plan_statement(stmt, self, {})
         if result.is_ddl:
@@ -221,6 +276,7 @@ class Session:
         from cloudberry_tpu.plan.planner import plan_statement
         from cloudberry_tpu.sql.parser import parse_sql
 
+        self._sync_store()
         stmt = parse_sql(query)
         result = plan_statement(stmt, self, {})
         if result.is_ddl:

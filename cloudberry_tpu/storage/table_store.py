@@ -56,6 +56,11 @@ class TableStore:
         self._txn_dirty: dict[str, object] = {}
         self._txn_drops: list[str] = []
         self.rows_per_partition = 1 << 20
+        # snapshot pinning: while a session transaction is open, every read
+        # through read_manifest resolves to the version current at BEGIN —
+        # repeatable reads even while OTHER sessions commit (the
+        # distributed-snapshot discipline, cdbdistributedsnapshot.c)
+        self.pinned: dict[str, int] = {}
 
     # ------------------------------------------------- session transactions
 
@@ -64,17 +69,20 @@ class TableStore:
         self._txn_dirty = {}
         self._txn_stats: dict[str, object] = {}
         self._txn_drops = []
+        self.pinned = {name: self.current_version(name)
+                       for name in self.table_names()}
 
     def commit_txn(self) -> None:
+        self.pinned = {}  # commit writes against CURRENT, not the snapshot
         for name in self._txn_drops:
             self.drop_table(name)
         for t in self._txn_dirty.values():
-            self.save_table(t, self.rows_per_partition)
+            t._store_version = self.save_table(t, self.rows_per_partition)
         # stats-only changes (ANALYZE with no DML): one manifest write,
         # not a full data re-snapshot
         for name, t in getattr(self, "_txn_stats", {}).items():
             if name not in self._txn_dirty and t.stats.ndv:
-                self.save_stats(name, t.stats.ndv)
+                t._store_version = self.save_stats(name, t.stats.ndv)
         self.abort_txn()
 
     def abort_txn(self) -> None:
@@ -82,6 +90,20 @@ class TableStore:
         self._txn_dirty = {}
         self._txn_stats = {}
         self._txn_drops = []
+        self.pinned = {}
+
+    def effective_version(self, name: str) -> int:
+        v = self.pinned.get(name)
+        return v if v is not None else self.current_version(name)
+
+    def conflicting_tables(self, base: dict[str, int]) -> list[str]:
+        """Tables this transaction wrote whose store version moved past the
+        BEGIN snapshot — the single-writer OCC check (first committer
+        wins; the later COMMIT must fail, not overwrite)."""
+        written = set(self._txn_dirty) | set(self._txn_drops) \
+            | set(getattr(self, "_txn_stats", {}))
+        return sorted(n for n in written
+                      if self.current_version(n) != base.get(n, 0))
 
     # ----------------------------------------------------------- manifests
 
@@ -97,6 +119,8 @@ class TableStore:
 
     def read_manifest(self, table: str,
                       version: Optional[int] = None) -> dict:
+        if version is None:
+            version = self.pinned.get(table)
         v = self.current_version(table) if version is None else version
         if v == 0:
             return {"version": 0, "schema": None, "partitions": [],
@@ -128,7 +152,25 @@ class TableStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(mdir, "CURRENT"))
+        self._bump_epoch()
         return v
+
+    # store-wide change counter: one cheap read tells a session whether ANY
+    # table changed since it last looked (catalog-sync fast path)
+
+    def epoch(self) -> int:
+        try:
+            with open(os.path.join(self.root, "_EPOCH")) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def _bump_epoch(self) -> None:
+        v = self.epoch() + 1
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        with os.fdopen(fd, "w") as f:
+            f.write(str(v))
+        os.replace(tmp, os.path.join(self.root, "_EPOCH"))
 
     # -------------------------------------------------------------- writes
 
@@ -343,6 +385,7 @@ class TableStore:
         tdir = os.path.join(self.root, name)
         if os.path.isdir(tdir):
             shutil.rmtree(tdir)
+            self._bump_epoch()
 
     def table_names(self) -> list[str]:
         out = []
@@ -380,9 +423,15 @@ class TableStore:
         pol = man.get("policy")
         policy = (DistributionPolicy(pol["kind"], tuple(pol["keys"]))
                   if pol else DistributionPolicy.random())
-        t = catalog.create_table(name, Schema(fields), policy)
+        from cloudberry_tpu.catalog.catalog import Table
+
+        t = Table(name, Schema(fields), policy)
+        t.data = {f.name: np.zeros(0, dtype=f.type.np_dtype)
+                  for f in fields}
+        catalog.adopt(t)  # no create_table: must not write a new snapshot
         t.backing = self
         t.cold = True
+        t._store_version = man["version"]
         t.dicts = {k: StringDictionary(v) for k, v in man["dicts"].items()}
         # placeholder keys: the binder only needs to know WHICH columns are
         # nullable to emit scan mask fields; arrays load with the data

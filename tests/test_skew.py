@@ -85,6 +85,24 @@ def test_hot_key_join_redistribute_completes():
     assert out.sw[0] == 0 * 1500 + sum(range(1500, 2000))
 
 
+def test_hot_key_join_with_runtime_filter_default_config():
+    """Regression: the exact bucket bound must stay authoritative when a
+    runtime filter is present — an estimate must never undercut it."""
+    cfg = Config(n_segments=8).with_overrides(
+        **{"planner.broadcast_threshold": 0})  # runtime filter stays on
+    s = cb.Session(cfg)
+    s.sql("create table j1 (a bigint, key bigint) distributed by (a)")
+    s.sql("create table j2 (b bigint, key bigint, w bigint) "
+          "distributed by (b)")
+    s.sql("insert into j1 values " +
+          ",".join(f"({i}, {0 if i < 1500 else i})" for i in range(2000)))
+    s.sql("insert into j2 values " +
+          ",".join(f"({i}, {i}, {i})" for i in range(2000)))
+    out = s.sql("select sum(j2.w) as sw from j1, j2 "
+                "where j1.key = j2.key").to_pandas()
+    assert out.sw[0] == sum(range(1500, 2000))
+
+
 def test_skewed_window_partition():
     """Window partition redistribute on a skewed key completes (exact
     bucket sizing covers the scan-under-motion shape)."""
