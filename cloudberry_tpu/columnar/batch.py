@@ -73,9 +73,10 @@ class ColumnBatch:
         data = {f.name: df[f.name].to_numpy() for f in schema.fields}
         return ColumnBatch.from_arrays(data, schema, dicts, capacity)
 
-    def to_pandas(self):
-        import pandas as pd
-
+    def decoded_columns(self) -> dict[str, np.ndarray]:
+        """Selected rows as decoded host arrays (NULLs as None in object
+        arrays) — pandas-free, safe off the main thread (the arrow-backed
+        DataFrame constructor is not)."""
         sel = np.asarray(self.sel)
         out = {}
         for f in self.schema.fields:
@@ -97,7 +98,12 @@ class ColumnBatch:
                 col = np.asarray(col, dtype=object)
                 col[invalid] = None
             out[f.name] = col
-        return pd.DataFrame(out)
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.decoded_columns())
 
 
 def encode_column(arr: np.ndarray, f: Field,

@@ -90,11 +90,17 @@ def check_admission(plan: N.PlanNode, session) -> MemoryEstimate:
 
 
 class AdmissionGate:
-    """Slot-pool concurrency limit (ResGroupSlotData free list analog)."""
+    """Slot-pool concurrency limit (ResGroupSlotData free list analog).
+    Tracks active and peak occupancy so servers/tests can OBSERVE that
+    admission control actually bounded concurrency."""
 
     def __init__(self, max_concurrency: int):
         self._sem = threading.BoundedSemaphore(max_concurrency)
         self.max_concurrency = max_concurrency
+        self._lock = threading.Lock()
+        self.active = 0
+        self.peak = 0
+        self.total_admitted = 0
 
     def __enter__(self):
         acquired = self._sem.acquire(timeout=60.0)
@@ -102,8 +108,14 @@ class AdmissionGate:
             raise ResourceError(
                 "admission timeout: all "
                 f"{self.max_concurrency} statement slots busy for 60s")
+        with self._lock:
+            self.active += 1
+            self.peak = max(self.peak, self.active)
+            self.total_admitted += 1
         return self
 
     def __exit__(self, *exc):
+        with self._lock:
+            self.active -= 1
         self._sem.release()
         return False

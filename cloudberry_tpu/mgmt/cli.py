@@ -169,7 +169,39 @@ def cmd_check(args) -> int:
     return 0 if problems == 0 else 1
 
 
+def cmd_serve(args) -> int:
+    """Run the socket serving layer (the postmaster/tcop analog): one
+    process owns the session; clients connect over TCP."""
+    from cloudberry_tpu.config import Config
+    from cloudberry_tpu.serve import Server
+
+    cfg = load_cluster(args.store)
+    config = Config(n_segments=cfg["n_segments"]).with_overrides(
+        **{"storage.root": args.store})
+    srv = Server(config=config, host=args.host, port=args.port)
+    print(f"serving on {srv.host}:{srv.port} (store {args.store}, "
+          f"{cfg['n_segments']} segments)", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def cmd_sql(args) -> int:
+    if args.connect:
+        from cloudberry_tpu.serve import Client
+
+        host, _, port = args.connect.rpartition(":")
+        with Client(host or "127.0.0.1", int(port)) as c:
+            out = c.sql(args.query)
+        if "rows" in out:
+            print("\t".join(out["columns"]))
+            for row in out["rows"]:
+                print("\t".join(str(v) for v in row))
+        else:
+            print(out.get("status", ""))
+        return 0
     s, ts = _open_session(args.store)
     versions = {n: getattr(t, "_version", 0)
                 for n, t in s.catalog.tables.items()}
@@ -223,7 +255,14 @@ def main(argv=None) -> int:
     pq.add_argument("query")
     pq.add_argument("--save", action="store_true",
                     help="persist modified tables back to the store")
+    pq.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="send to a running server instead of in-process")
     pq.set_defaults(fn=cmd_sql)
+
+    pv = sub.add_parser("serve", help="run the socket server (tcop analog)")
+    pv.add_argument("--host", default="127.0.0.1")
+    pv.add_argument("--port", type=int, default=15432)
+    pv.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     return args.fn(args)

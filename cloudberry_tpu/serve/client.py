@@ -1,0 +1,49 @@
+"""Client for the JSON-over-TCP serving layer (the libpq analog)."""
+
+from __future__ import annotations
+
+import json
+import socket
+
+
+class ServerError(RuntimeError):
+    pass
+
+
+class Client:
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._r = self._sock.makefile("rb")
+        self._w = self._sock.makefile("wb")
+
+    def sql(self, query: str) -> dict:
+        """Execute one statement; returns {"columns", "rows", "rowcount"}
+        for queries or {"status": ...} for DDL/DML; raises ServerError on
+        engine errors."""
+        self._w.write(json.dumps({"sql": query}).encode() + b"\n")
+        self._w.flush()
+        line = self._r.readline()
+        if not line:
+            raise ServerError("server closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise ServerError(resp.get("error", "unknown server error"))
+        resp.pop("ok")
+        return resp
+
+    def rows(self, query: str) -> list[list]:
+        return self.sql(query)["rows"]
+
+    def close(self) -> None:
+        try:
+            self._r.close()
+            self._w.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

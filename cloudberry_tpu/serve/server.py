@@ -1,0 +1,192 @@
+"""Socket serving layer — the engine as a database, not a library.
+
+The reference's serving surface is the libpq wire protocol into a
+per-connection backend (exec_simple_query, src/backend/tcop/postgres.c:506,
+1655). Here one server process owns ONE Session (the QD); clients speak a
+newline-delimited JSON protocol:
+
+    → {"sql": "select ..."}
+    ← {"ok": true, "columns": [...], "rows": [[...]], "rowcount": N}
+    ← {"ok": true, "status": "CREATE TABLE t"}          (DDL/DML)
+    ← {"ok": false, "error": "..."}
+
+Read statements run concurrently under the session's admission gate (the
+resgroup slot pool); catalog-mutating statements serialize behind a write
+lock — the single-writer discipline the storage layer's OCC assumes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+import numpy as np
+
+_READ_STARTERS = ("select", "with", "explain", "show")
+_TXN_STARTERS = ("begin", "commit", "rollback", "abort", "start", "end")
+
+
+def _first_word(sql: str) -> str:
+    s = sql.lstrip()
+    if s.startswith("("):
+        return "("
+    head = s.split(None, 1)
+    return head[0].lower() if head else ""
+
+
+def _is_read(sql: str) -> bool:
+    w = _first_word(sql)
+    return w == "(" or w in _READ_STARTERS
+
+
+class _RWLock:
+    """Readers-writer lock: reads share, catalog mutations exclude — the
+    session's catalog/data swaps are only safe against concurrent readers
+    at statement granularity."""
+
+    def __init__(self):
+        self._readers = 0
+        self._r = threading.Lock()
+        self._w = threading.Lock()
+
+    def acquire_read(self):
+        with self._r:
+            self._readers += 1
+            if self._readers == 1:
+                self._w.acquire()
+
+    def release_read(self):
+        with self._r:
+            self._readers -= 1
+            if self._readers == 0:
+                self._w.release()
+
+    def acquire_write(self):
+        self._w.acquire()
+
+    def release_write(self):
+        self._w.release()
+
+
+def _json_safe(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating, float)):
+        f = float(v)
+        return None if f != f else f  # NaN (NULL rendering) → null
+    if isinstance(v, np.bool_):
+        return bool(v)
+    if isinstance(v, np.datetime64):
+        return str(v)
+    if hasattr(v, "isoformat"):
+        return v.isoformat()
+    return v
+
+
+class Server:
+    """One engine process serving many clients over TCP."""
+
+    def __init__(self, session=None, config=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        import cloudberry_tpu as cb
+
+        self.session = session if session is not None else cb.Session(config)
+        self._rw = _RWLock()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                        resp = outer._execute(req)
+                    except Exception as e:  # a bad client must not kill us
+                        resp = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                    self.wfile.flush()
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = TCP((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------------- control
+
+    def start(self) -> "Server":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------- execution
+
+    def _execute(self, req: dict) -> dict:
+        sql = req.get("sql")
+        if not isinstance(sql, str):
+            return {"ok": False, "error": "request must carry a 'sql' string"}
+        if _first_word(sql) in _TXN_STARTERS:
+            # all connections share ONE session: a wire-level BEGIN would
+            # absorb other clients' autocommit writes into its rollback
+            # scope — refuse rather than silently break their durability
+            return {"ok": False, "error":
+                    "transactions over the wire are not supported yet "
+                    "(connections share one session); use the in-process "
+                    "API for BEGIN/COMMIT/ROLLBACK"}
+        if _is_read(sql):
+            self._rw.acquire_read()
+            try:
+                result = self.session.sql(sql)
+            finally:
+                self._rw.release_read()
+        else:
+            # catalog mutation: exclusive — concurrent readers would race
+            # the data/stats swap (the OCC layer handles cross-PROCESS
+            # writers; this lock handles threads)
+            self._rw.acquire_write()
+            try:
+                result = self.session.sql(sql)
+            finally:
+                self._rw.release_write()
+        if hasattr(result, "decoded_columns"):
+            # pandas-free serialization: DataFrame construction with arrow
+            # string dtypes is not thread-safe, and handlers run threaded
+            cols = result.decoded_columns()
+            names = list(cols)
+            arrays = list(cols.values())
+            n = len(arrays[0]) if arrays else 0
+            return {
+                "ok": True,
+                "columns": names,
+                "rows": [[_json_safe(a[i]) for a in arrays]
+                         for i in range(n)],
+                "rowcount": n,
+            }
+        return {"ok": True, "status": str(result)}

@@ -58,6 +58,7 @@ class Session:
             self.catalog.store = self.store
         # per-query pruned store reads, keyed (table, version, parts, cols)
         self._store_scan_cache: dict = {}
+        self._sync_lock = __import__("threading").Lock()
         self._shard_cache: dict[str, ShardedTable] = {}
         # query_info_collect_hook analog: callables receiving QueryMetrics
         self.metrics_hooks: list = []
@@ -100,27 +101,28 @@ class Session:
         if self.store is None \
                 or getattr(self, "_txn_snapshot", None) is not None:
             return
-        # fast path: one epoch read; the per-table walk only runs when
-        # SOMETHING changed since this session last looked
-        epoch = self.store.epoch()
-        if epoch == getattr(self, "_seen_epoch", None):
-            return
-        self._seen_epoch = epoch
-        names = set(self.store.table_names())
-        for name in list(self.catalog.tables):
-            t = self.catalog.tables[name]
-            if t.backing is None:
-                continue
-            if name not in names:
-                del self.catalog.tables[name]
-                self.catalog.bump_ddl()
-                continue
-            v = self.store.current_version(name)
-            if v != getattr(t, "_store_version", None):
-                del self.catalog.tables[name]
+        with self._sync_lock:  # server handler threads share this session
+            # fast path: one epoch read; the per-table walk only runs when
+            # SOMETHING changed since this session last looked
+            epoch = self.store.epoch()
+            if epoch == getattr(self, "_seen_epoch", None):
+                return
+            self._seen_epoch = epoch
+            names = set(self.store.table_names())
+            for name in list(self.catalog.tables):
+                t = self.catalog.tables[name]
+                if t.backing is None:
+                    continue
+                if name not in names:
+                    del self.catalog.tables[name]
+                    self.catalog.bump_ddl()
+                    continue
+                v = self.store.current_version(name)
+                if v != getattr(t, "_store_version", None):
+                    del self.catalog.tables[name]
+                    self.store.register_cold(self.catalog, name)
+            for name in sorted(names - set(self.catalog.tables)):
                 self.store.register_cold(self.catalog, name)
-        for name in sorted(names - set(self.catalog.tables)):
-            self.store.register_cold(self.catalog, name)
 
     # ----------------------------------------------------- transactions
     # Single-session transactions over the in-memory catalog: BEGIN
@@ -162,17 +164,19 @@ class Session:
             if self.store is not None:
                 # single-writer OCC (the 2PC-role analog, cdbtm.c:883):
                 # first committer wins; a conflicting later COMMIT aborts
-                # and rolls back rather than overwriting
-                conflicts = self.store.conflicting_tables(
-                    getattr(self, "_txn_base", {}))
-                if conflicts:
-                    self.store.abort_txn()
-                    self._restore_snapshot(snap)
-                    raise SerializationError(
-                        "could not serialize access: table(s) "
-                        f"{', '.join(conflicts)} were modified by another "
-                        "session after this transaction began")
-                self.store.commit_txn()
+                # and rolls back rather than overwriting. The store lock
+                # makes check-then-publish atomic ACROSS PROCESSES.
+                with self.store.lock():
+                    conflicts = self.store.conflicting_tables(
+                        getattr(self, "_txn_base", {}))
+                    if conflicts:
+                        self.store.abort_txn()
+                        self._restore_snapshot(snap)
+                        raise SerializationError(
+                            "could not serialize access: table(s) "
+                            f"{', '.join(conflicts)} were modified by "
+                            "another session after this transaction began")
+                    self.store.commit_txn()
             self._txn_snapshot = None
             return "COMMIT"
         # rollback: restore RAM state WITHOUT persisting (the store never

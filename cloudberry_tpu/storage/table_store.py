@@ -129,7 +129,13 @@ class TableStore:
             return json.load(f)
 
     def _commit(self, table: str, manifest: dict) -> int:
-        """Atomically publish a new snapshot (single-coordinator commit)."""
+        """Atomically publish a new snapshot (single-coordinator commit).
+        The store lock closes the version-read → publish window against
+        other processes."""
+        with self.lock():
+            return self._commit_locked(table, manifest)
+
+    def _commit_locked(self, table: str, manifest: dict) -> int:
         mdir = self._mdir(table)
         os.makedirs(mdir, exist_ok=True)
         v = self.current_version(table) + 1
@@ -155,22 +161,64 @@ class TableStore:
         self._bump_epoch()
         return v
 
-    # store-wide change counter: one cheap read tells a session whether ANY
-    # table changed since it last looked (catalog-sync fast path)
+    # store-wide change token: one cheap read tells a session whether ANY
+    # table changed since it last looked (catalog-sync fast path). A unique
+    # token, not a counter — concurrent bumps can never collapse into one
+    # value and hide a commit (no read-modify-write race).
 
-    def epoch(self) -> int:
+    def epoch(self) -> str:
         try:
             with open(os.path.join(self.root, "_EPOCH")) as f:
-                return int(f.read().strip() or 0)
-        except (FileNotFoundError, ValueError):
-            return 0
+                return f.read().strip()
+        except FileNotFoundError:
+            return ""
 
     def _bump_epoch(self) -> None:
-        v = self.epoch() + 1
         fd, tmp = tempfile.mkstemp(dir=self.root)
         with os.fdopen(fd, "w") as f:
-            f.write(str(v))
+            f.write(uuid.uuid4().hex)
         os.replace(tmp, os.path.join(self.root, "_EPOCH"))
+
+    # ---------------------------------------------- inter-process write lock
+
+    def lock(self, timeout_s: float = 30.0):
+        """Store-wide mutual exclusion across PROCESSES (O_EXCL lock file):
+        held around version-check-then-commit so two committers can never
+        both pass the OCC check and overwrite each other. Re-entrant within
+        one store object."""
+        import contextlib
+        import time as _time
+
+        @contextlib.contextmanager
+        def _locked():
+            if getattr(self, "_lock_held", False):
+                yield
+                return
+            path = os.path.join(self.root, "_LOCK")
+            deadline = _time.monotonic() + timeout_s
+            while True:
+                try:
+                    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.write(fd, str(os.getpid()).encode())
+                    os.close(fd)
+                    break
+                except FileExistsError:
+                    if _time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"store lock timeout after {timeout_s}s — if no "
+                            f"writer is alive, remove stale {path}")
+                    _time.sleep(0.01)
+            self._lock_held = True
+            try:
+                yield
+            finally:
+                self._lock_held = False
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+
+        return _locked()
 
     # -------------------------------------------------------------- writes
 
