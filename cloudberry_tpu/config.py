@@ -72,6 +72,9 @@ class ResourceConfig:
     # Admission: max concurrent statements (resgroup slot pool analog,
     # resgroup.c:135-171).
     max_concurrency: int = 8
+    # Tiled out-of-core execution when a plan exceeds the budget (the
+    # workfile-manager / spill analog, exec/tiled.py); off = hard refusal.
+    enable_spill: bool = True
 
 
 @dataclass(frozen=True)

@@ -170,6 +170,42 @@ def make_batch(plan: N.PlanNode, cols, sel) -> ColumnBatch:
                        np.asarray(sel), dicts, validity=validity)
 
 
+def all_nodes(plan: N.PlanNode):
+    """Every node in the plan, including scalar-subquery plans and runtime
+    filters' shared build subtrees (via their joins)."""
+    yield plan
+    from cloudberry_tpu.plan.distribute import _node_exprs
+
+    for e in _node_exprs(plan):
+        for sub in ex.walk(e):
+            if isinstance(sub, ex.SubqueryScalar):
+                yield from all_nodes(sub.plan)
+    for c in plan.children():
+        yield from all_nodes(c)
+
+
+def grow_expansion(plan: N.PlanNode, message: str,
+                   factor: int = 4) -> bool:
+    """Adaptive recovery from a detected join-expansion overflow (the
+    increase-nbatch-and-retry discipline of nodeHash.c): the check message
+    names the node id; grow that join's pair buffer and report success.
+    The caller recompiles and re-runs — results are never truncated."""
+    import re
+
+    m = re.search(r"\(node (\d+)\)", message)
+    if m is None or "expansion overflow" not in message:
+        return False
+    nid = int(m.group(1))
+    for node in all_nodes(plan):
+        if id(node) == nid and isinstance(node, N.PJoin):
+            node.out_capacity = max(node.out_capacity * factor, 64)
+            # capacity re-derivations (e.g. tiled _retile) must never
+            # shrink a runtime-grown buffer back below what overflowed
+            node._min_out_cap = node.out_capacity
+            return True
+    return False
+
+
 def scans_of(plan: N.PlanNode):
     if isinstance(plan, N.PScan) and plan.table_name != "$dual":
         yield plan

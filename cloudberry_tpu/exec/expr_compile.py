@@ -165,11 +165,20 @@ def _safe_div(a, b):
     return jnp.where(nz, a / jnp.where(nz, b, 1), 0)
 
 
+def _safe_mod(a, b):
+    # SQL modulo truncates toward zero (fmod semantics), unlike Python's
+    # floor-mod; zero divisors evaluate total-function style like _safe_div.
+    b = jnp.asarray(b)
+    nz = b != 0
+    return jnp.where(nz, jnp.fmod(a, jnp.where(nz, b, 1)), 0)
+
+
 _BINOPS = {
     "+": jnp.add,
     "-": jnp.subtract,
     "*": jnp.multiply,
     "/": _safe_div,
+    "%": _safe_mod,
     "=": lambda a, b: a == b,
     "<>": lambda a, b: a != b,
     "<": lambda a, b: a < b,

@@ -1,0 +1,637 @@
+"""Tiled (out-of-core) execution — the workfile-manager / spill analog.
+
+The reference survives bigger-than-memory queries by spilling operator state
+to workfiles (src/backend/utils/workfile_manager/workfile_mgr.c, the batch
+discipline of nodeHash.c) under a vmem red zone
+(src/backend/utils/mmgr/redzone_handler.c). The XLA translation cannot page
+a running program, so the spill boundary moves to PLAN TIME: when the
+admission estimator (exec/resource.py) rejects a plan, this module re-plans
+it as a STREAM OF FIXED-SHAPE TILES —
+
+- the plan's big probe-side scan becomes the tile stream: host RAM (or
+  micro-partition files, for cold tables) holds the table; the device only
+  ever sees one tile of ``tile_rows`` rows;
+- every spine join's build subtree is computed ONCE by a prelude program
+  and its (bounded, estimated-and-admitted) result arrays stay resident;
+- one jitted STEP program runs per tile: spine joins/filters/projections,
+  a partial aggregation, and a merge into a fixed-capacity accumulator
+  (the combine-function discipline of the distributed two-stage agg,
+  plan/distribute.py:_split_aggs — partials merge associatively, so any
+  tile order and count gives the same answer);
+- a finalize program applies the post-aggregation chain (HAVING / ORDER BY /
+  LIMIT / avg = sum/count) to the accumulator.
+
+Per-tile capacities keep the engine's checked-overflow discipline: a tile
+that overflows its expansion-join or group buffers raises, never truncates.
+Peak device memory is the admitted estimate: resident builds + one tile's
+working set + the accumulator — independent of the streamed table's size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloudberry_tpu.columnar.batch import ColumnBatch
+from cloudberry_tpu.exec import executor as X
+from cloudberry_tpu.exec import kernels as K
+from cloudberry_tpu.exec.resource import estimate_plan_memory
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.plan.distribute import (_all_exprs, _finalize_project,
+                                            _split_aggs)
+
+_MAX_TILE = 1 << 22
+_MIN_TILE = 1 << 12
+
+
+class _AccLeaf(N.PlanNode):
+    """Plan leaf standing for the accumulator in the finalize program."""
+
+    def title(self):
+        return "TileAccumulator"
+
+
+@dataclass
+class _TileShape:
+    """Everything the rewrite discovered about the plan."""
+
+    agg: N.PAgg                       # the streamed aggregation
+    post: list[N.PlanNode]            # chain above agg, root first
+    spine: list[N.PlanNode]           # agg.child .. just above the stream
+    stream: N.PScan                   # the tiled scan
+    builds: list[N.PlanNode]          # spine joins' build subtrees
+    stream_rows: int = 0              # whole-stream rows (floor scaling)
+    partial_plan: N.PAgg = None       # type: ignore[assignment]
+    merge_specs: list = field(default_factory=list)
+    finalize: dict = field(default_factory=dict)
+    root: N.PlanNode = None           # type: ignore[assignment]
+    g_cap: int = 0                    # accumulator (merged groups) capacity
+
+
+def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
+    """Try to re-plan an admission-rejected statement for tiled execution.
+    Returns None when the plan shape or the budget cannot support it."""
+    if not session.config.resource.enable_spill:
+        return None
+    if session.config.n_segments > 1:
+        return None  # distributed tiling: exec/tiled_dist.py handles it
+    if getattr(plan, "_direct_segment", None) is not None:
+        return None
+    shape = _analyze(plan)
+    if shape is None:
+        return None
+    try:
+        partial_aggs, final_aggs, finalize = _split_aggs(shape.agg.aggs)
+    except ValueError:
+        return None  # an aggregate with no partial/merge decomposition
+    shape.finalize = finalize
+    shape.merge_specs = [K.AggSpec(call.func, name)
+                         for name, call in final_aggs]
+
+    # Accumulator capacity: the binder's agg capacity is the worst case
+    # (child rows) — useless as a resident buffer. Size from the NDV-based
+    # group estimate with 4× headroom; a merge overflow at runtime grows it
+    # and retries (the nodeHash.c increase-nbatch discipline) rather than
+    # ever returning truncated groups.
+    from cloudberry_tpu.plan.cost import estimate_rows
+
+    est_groups = estimate_rows(shape.agg, session.catalog)
+    shape.g_cap = int(min(shape.agg.capacity,
+                          max(1024, 4 * int(est_groups) + 1)))
+
+    # per-tile partial aggregation over the spine (mode/fields mirror the
+    # distributed two-stage construction, plan/distribute.py:532)
+    partial = N.PAgg(shape.agg.child, shape.agg.group_keys, partial_aggs,
+                     capacity=shape.agg.capacity, mode="partial")
+    partial.fields = [
+        N.PlanField(n, e.dtype, _expr_dict(shape.agg.child, e))
+        for n, e in shape.agg.group_keys
+    ] + [N.PlanField(n, c.dtype, None) for n, c in partial_aggs]
+    shape.partial_plan = partial
+
+    budget = session.config.resource.query_mem_bytes
+    tile_rows = _choose_tile(shape, budget)
+    if tile_rows is None:
+        return None
+
+    # finalize plan: (acc leaf) -> finalize project -> original post chain
+    leaf = _AccLeaf()
+    leaf.fields = list(partial.fields)
+    fproj = _finalize_project(leaf, shape.agg, finalize)
+    if shape.post:
+        shape.post[-1].child = fproj
+        shape.root = shape.post[0]
+    else:
+        shape.root = fproj
+
+    return TiledExecutable(shape, session, tile_rows, budget)
+
+
+def _analyze(plan: N.PlanNode) -> Optional[_TileShape]:
+    """Recognize the streamable shape: post chain over one aggregation over
+    a join/filter spine whose probe path ends at a scan."""
+    for e in _all_exprs(plan):
+        for sub in ex.walk(e):
+            if isinstance(sub, ex.SubqueryScalar):
+                return None  # subquery plans scan outside the spine budget
+
+    post: list[N.PlanNode] = []
+    cur = plan
+    while isinstance(cur, (N.PProject, N.PSort, N.PLimit, N.PFilter)):
+        post.append(cur)
+        cur = cur.child
+    if not isinstance(cur, N.PAgg) or cur.mode != "single":
+        return None
+    agg = cur
+
+    spine: list[N.PlanNode] = []
+    builds: list[N.PlanNode] = []
+    cur = agg.child
+    while True:
+        if isinstance(cur, (N.PFilter, N.PProject)):
+            spine.append(cur)
+            cur = cur.child
+        elif isinstance(cur, N.PRuntimeFilter):
+            spine.append(cur)
+            cur = cur.child
+        elif isinstance(cur, N.PJoin):
+            if cur.kind == "full":
+                # FULL joins emit unmatched BUILD rows — once per statement,
+                # not once per tile; not streamable on the probe side
+                return None
+            spine.append(cur)
+            builds.append(cur.build)
+            cur = cur.probe
+        elif isinstance(cur, N.PScan) and cur.table_name != "$dual":
+            rows = cur.num_rows if cur.num_rows >= 0 else cur.capacity
+            return _TileShape(agg, post, spine, cur, builds,
+                              stream_rows=max(rows, 1))
+        else:
+            return None
+
+
+def _retile(shape: _TileShape, tile_rows: int) -> None:
+    """Set the stream scan to one tile and re-derive spine capacities (the
+    same formulas the planner uses, per tile instead of whole): expansion
+    joins keep the NDV pair-estimate floor scaled to the tile fraction, and
+    any runtime-grown buffer (_min_out_cap, set by grow_expansion retries)
+    is never shrunk back."""
+    frac = tile_rows / max(shape.stream_rows, 1)
+    shape.stream.capacity = tile_rows
+    cap = tile_rows
+    for node in reversed(shape.spine):
+        if isinstance(node, N.PJoin):
+            bcap = _out_cap(node.build)
+            est = getattr(node, "_est_pairs", None)
+            floor = int(2 * est * min(frac, 1.0)) + 8 if est else 0
+            floor = max(floor, getattr(node, "_min_out_cap", 0))
+            if node.residual is not None:
+                # pairs expand internally; output rides the probe capacity
+                node.out_capacity = max(bcap + cap, floor)
+            elif not node.unique_build:
+                node.out_capacity = max(bcap + cap, floor)
+                cap = node.out_capacity
+    shape.partial_plan.capacity = min(shape.g_cap, max(cap, 1))
+
+
+def _out_cap(node: N.PlanNode) -> int:
+    if isinstance(node, (N.PScan, N.PAgg)):
+        return node.capacity
+    if isinstance(node, N.PJoin):
+        if not node.unique_build:
+            return node.out_capacity
+        return _out_cap(node.probe)
+    if isinstance(node, N.PMotion):
+        return node.out_capacity or _out_cap(node.child)
+    if isinstance(node, N.PConcat):
+        return sum(_out_cap(c) for c in node.inputs)
+    kids = node.children()
+    return max((_out_cap(c) for c in kids), default=1)
+
+
+def _acc_width(shape: _TileShape) -> int:
+    return 1 + sum(f.type.np_dtype.itemsize
+                   for f in shape.partial_plan.fields)
+
+
+def _choose_tile(shape: _TileShape, budget: int) -> Optional[int]:
+    """Largest power-of-two tile whose estimated step memory fits: the
+    spill-file-count decision of workfile_mgr, made at plan time."""
+    g_cap = shape.g_cap
+    w = _acc_width(shape)
+    t = _MAX_TILE
+    while t >= _MIN_TILE:
+        _retile(shape, t)
+        est = estimate_plan_memory(shape.partial_plan).peak_bytes
+        # accumulator + merge working set: concat of acc and partial rows
+        # flows through one sort-based group_aggregate
+        merge_bytes = 3 * (g_cap + shape.partial_plan.capacity) * w
+        if est + merge_bytes <= budget:
+            return t
+        t >>= 1
+    return None
+
+
+# --------------------------------------------------------------- lowerers
+
+
+class _TileLowerer(X.Lowerer):
+    """Step-program lowerer: the stream scan reads the tile input; spine
+    builds read their prelude-computed arrays."""
+
+    def __init__(self, tables, stream: N.PScan, tile_n, replace: dict,
+                 **kw):
+        super().__init__(tables, **kw)
+        self._stream = stream
+        self._tile_n = tile_n
+        self._replace = replace
+
+    def lower(self, node: N.PlanNode):
+        hit = self._replace.get(id(node))
+        if hit is not None:
+            return hit
+        return super().lower(node)
+
+    def scan(self, node: N.PScan):
+        if node is not self._stream:
+            return super().scan(node)
+        tile = self.tables["$tile"]
+        cols = {}
+        for phys, out in node.column_map.items():
+            cols[out] = tile[phys]
+        for phys, out in node.mask_map.items():
+            cols[out] = tile[f"$nn:{phys}"]
+        sel = jnp.arange(node.capacity) < self._tile_n
+        return cols, sel
+
+
+class _ReplacingLowerer(X.Lowerer):
+    def __init__(self, tables, replace: dict, **kw):
+        super().__init__(tables, **kw)
+        self._replace = replace
+
+    def lower(self, node: N.PlanNode):
+        hit = self._replace.get(id(node))
+        if hit is not None:
+            return hit
+        return super().lower(node)
+
+
+# --------------------------------------------------------------- execution
+
+
+class TiledExecutable:
+    """Compiled tiled statement: prelude (once) → step (per tile) →
+    finalize. ``report`` records the spill decision for tests/EXPLAIN."""
+
+    def __init__(self, shape: _TileShape, session, tile_rows: int,
+                 budget: int):
+        self.shape = shape
+        self.session = session
+        self.tile_rows = tile_rows
+        self.budget = budget
+        self._platform = jax.default_backend()
+        self._use_pallas = session.config.exec.use_pallas
+        self._compiled = None
+        # server handler threads may hit the cached runner concurrently;
+        # retries mutate shared plan capacities, so runs serialize (the
+        # admission gate bounds statement concurrency anyway)
+        import threading
+
+        self._run_lock = threading.Lock()
+        self._refresh_report()
+
+    def _refresh_report(self) -> None:
+        shape = self.shape
+        _retile(shape, self.tile_rows)
+        est = estimate_plan_memory(shape.partial_plan).peak_bytes
+        merge_bytes = 3 * (shape.g_cap
+                           + shape.partial_plan.capacity) * _acc_width(shape)
+        self.report = {
+            "tiled": True,
+            "stream_table": shape.stream.table_name,
+            "tile_rows": self.tile_rows,
+            "acc_capacity": shape.g_cap,
+            "est_step_bytes": est + merge_bytes,
+            "budget_bytes": self.budget,
+        }
+
+    # ------------------------------------------------------------ programs
+
+    def _resident_inputs(self) -> dict:
+        """All step inputs except the tile: whole (non-stream) tables and
+        pruned store reads — exactly prepare_inputs minus the stream."""
+        scans = [s for s in X.scans_of(self._whole_plan())
+                 if s is not self.shape.stream]
+        store_scans = [s for s in scans if hasattr(s, "_store_parts")]
+        names = sorted({s.table_name for s in scans
+                        if not hasattr(s, "_store_parts")})
+        return X._assemble_inputs(names, store_scans, self.session, None)
+
+    def _whole_plan(self) -> N.PlanNode:
+        # scans live under the partial plan (spine + builds); the post
+        # chain/finalize reference only aggregate outputs
+        return self.shape.partial_plan
+
+    def _compile(self):
+        if self._compiled is not None:
+            return self._compiled
+        shape = self.shape
+        plat, pallas = self._platform, self._use_pallas
+
+        def prelude_fn(tables):
+            low = X.Lowerer(tables, platform=plat, use_pallas=pallas)
+            outs = [low.lower_shared(b) for b in shape.builds]
+            return outs, low.checks
+
+        group_names = [n for n, _ in shape.agg.group_keys]
+        specs = shape.merge_specs
+        g_cap = shape.g_cap
+
+        def step_fn(resident, prelude, tile, tile_n, acc):
+            tables = dict(resident)
+            tables["$tile"] = tile
+            replace = {id(b): prelude[i]
+                       for i, b in enumerate(shape.builds)}
+            low = _TileLowerer(tables, shape.stream, tile_n, replace,
+                               platform=plat, use_pallas=pallas)
+            pcols, psel = low.lower(shape.partial_plan)
+            checks = dict(low.checks)
+            acc_cols, acc_sel = acc
+            if group_names:
+                key_cols = {n: jnp.concatenate([acc_cols[n], pcols[n]])
+                            for n in group_names}
+                agg_vals = {s.out_name: jnp.concatenate(
+                    [acc_cols[s.out_name], pcols[s.out_name]])
+                    for s in specs}
+                sel = jnp.concatenate([acc_sel, psel])
+                ok, oa, osel, n_groups = K.group_aggregate(
+                    key_cols, agg_vals, specs, sel, g_cap)
+                checks["tile merge overflow: more groups than capacity "
+                       f"{g_cap}; raise the aggregation capacity"] = \
+                    n_groups > g_cap
+                return ({**ok, **oa}, osel), checks
+            agg_vals = {s.out_name: jnp.concatenate(
+                [acc_cols[s.out_name], pcols[s.out_name]])
+                for s in specs}
+            sel = jnp.concatenate([acc_sel, psel])
+            out = K.global_aggregate(agg_vals, specs, sel)
+            return (out, jnp.ones((1,), dtype=jnp.bool_)), checks
+
+        def finalize_fn(acc):
+            acc_cols, acc_sel = acc
+            low = _ReplacingLowerer(
+                {}, {id(_leaf_of(shape.root)): (acc_cols, acc_sel)},
+                platform=plat, use_pallas=pallas)
+            cols, sel = low.lower(shape.root)
+            out = {f.name: cols[f.name] for f in shape.root.fields}
+            return out, sel, low.checks
+
+        # donate the accumulator so the step updates in place on device;
+        # CPU XLA can't always honor donation — skip the warning noise
+        donate = () if self._platform == "cpu" else (4,)
+        self._compiled = (jax.jit(prelude_fn),
+                          jax.jit(step_fn, donate_argnums=donate),
+                          jax.jit(finalize_fn))
+        return self._compiled
+
+    def _init_acc(self):
+        shape = self.shape
+        g_cap = shape.g_cap
+        group_names = {n for n, _ in shape.agg.group_keys}
+        cols = {}
+        if group_names:
+            for f in shape.partial_plan.fields:
+                cols[f.name] = jnp.zeros((g_cap,), dtype=f.type.np_dtype)
+            return cols, jnp.zeros((g_cap,), dtype=jnp.bool_)
+        for f, spec in zip(
+                [f for f in shape.partial_plan.fields
+                 if f.name not in group_names], shape.merge_specs):
+            dt = f.type.np_dtype
+            if spec.func == "min":
+                ident = np.array(
+                    np.finfo(dt).max if np.issubdtype(dt, np.floating)
+                    else np.iinfo(dt).max, dtype=dt)
+            elif spec.func == "max":
+                ident = np.array(
+                    np.finfo(dt).min if np.issubdtype(dt, np.floating)
+                    else np.iinfo(dt).min, dtype=dt)
+            else:
+                ident = np.zeros((), dtype=dt)
+            cols[f.name] = jnp.full((1,), ident)
+        # identity row stays unselected: min/max identities must not leak
+        # into the merge as real values when a tile contributes rows
+        return cols, jnp.zeros((1,), dtype=jnp.bool_)
+
+    # ----------------------------------------------------------------- run
+
+    def run(self) -> ColumnBatch:
+        with self._run_lock:
+            return self._run_adaptive()
+
+    def _run_adaptive(self) -> ColumnBatch:
+        while True:
+            try:
+                return self._run_once()
+            except X.ExecError as e:
+                msg = str(e)
+                shape = self.shape
+                if not msg.startswith("[tile"):
+                    # prelude (build-side) failure: expansion overflows
+                    # grow that join's pair buffer and retry
+                    if not X.grow_expansion(shape.partial_plan, msg):
+                        raise
+                elif ("merge overflow" in msg
+                      or "aggregation overflow" in msg):
+                    # more groups than estimated: grow the accumulator and
+                    # restart the stream (the increase-nbatch-and-rescan
+                    # discipline of nodeHash.c) — never truncate
+                    if shape.g_cap >= shape.agg.capacity:
+                        raise
+                    shape.g_cap = min(shape.g_cap * 4, shape.agg.capacity)
+                elif "expansion overflow" in msg:
+                    # a tile's join fanout blew its pair buffer: grow that
+                    # join (the growth sticks — _retile honors
+                    # _min_out_cap) when the budget allows, else halve
+                    # the tile (smaller probe slice → fewer pairs)
+                    if not (self._try_grow(msg)
+                            or self._try_halve_tile()):
+                        raise
+                else:
+                    raise
+                self._compiled = None
+                self._refresh_report()
+                if self.report["est_step_bytes"] > self.budget:
+                    raise X.ExecError(
+                        "tiled execution working set "
+                        f"(accumulator {shape.g_cap} groups, tile "
+                        f"{self.tile_rows} rows) exceeds the query memory "
+                        f"budget {self.budget >> 20} MiB; raise "
+                        "config.resource.query_mem_bytes") from e
+
+    def _try_grow(self, msg: str) -> bool:
+        """Grow the overflowing spine join's pair buffer if the grown step
+        still fits the budget; revert (and report False) otherwise."""
+        import re
+
+        m = re.search(r"\(node (\d+)\)", msg)
+        if m is None:
+            return False
+        nid = int(m.group(1))
+        node = next((n for n in X.all_nodes(self.shape.partial_plan)
+                     if id(n) == nid and isinstance(n, N.PJoin)), None)
+        if node is None:
+            return False
+        old = getattr(node, "_min_out_cap", 0)
+        node._min_out_cap = max(node.out_capacity * 4, 64)
+        self._refresh_report()
+        if self.report["est_step_bytes"] <= self.budget:
+            return True
+        node._min_out_cap = old
+        self._refresh_report()
+        return False
+
+    def _try_halve_tile(self) -> bool:
+        if self.tile_rows <= _MIN_TILE:
+            return False
+        self.tile_rows >>= 1
+        return True
+
+    def _run_once(self) -> ColumnBatch:
+        prelude_fn, step_fn, finalize_fn = self._compile()
+        resident = self._resident_inputs()
+        prelude, pchecks = prelude_fn(resident)
+        X.raise_checks(pchecks)
+
+        acc = self._init_acc()
+        n_tiles = 0
+        for tile, tile_n in _tile_feed(self.shape.stream, self.session,
+                                       self.tile_rows):
+            acc, checks = step_fn(resident, prelude, tile,
+                                  jnp.asarray(tile_n, dtype=jnp.int32), acc)
+            _raise_tile_checks(checks, n_tiles)
+            n_tiles += 1
+        if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
+            empty = _empty_tile(self.shape.stream, self.tile_rows)
+            acc, checks = step_fn(resident, prelude, empty,
+                                  jnp.asarray(0, dtype=jnp.int32), acc)
+            _raise_tile_checks(checks, 0)
+            n_tiles = 1
+
+        cols, sel, fchecks = finalize_fn(acc)
+        X.raise_checks(fchecks)
+        self.report["n_tiles"] = n_tiles
+        self.session.last_tiled_report = dict(self.report)
+        return X.make_batch(self.shape.root, cols, sel)
+
+
+def _leaf_of(root: N.PlanNode) -> N.PlanNode:
+    cur = root
+    while not isinstance(cur, _AccLeaf):
+        cur = cur.child  # post chain + finalize project are all unary
+    return cur
+
+
+def _raise_tile_checks(checks: dict, tile_idx: int) -> None:
+    for msg, bad in checks.items():
+        if bool(np.asarray(bad).any()):
+            raise X.ExecError(f"[tile {tile_idx}] {msg}")
+
+
+def _expr_dict(plan: N.PlanNode, e: ex.Expr):
+    if isinstance(e, ex.ColumnRef):
+        try:
+            return plan.field(e.name).sdict
+        except KeyError:
+            return None
+    return None
+
+
+# -------------------------------------------------------------- tile feed
+
+
+def _phys_cols(scan: N.PScan) -> list[str]:
+    return sorted(set(scan.column_map) | set(scan.mask_map))
+
+
+def _empty_tile(scan: N.PScan, tile_rows: int) -> dict:
+    t = {}
+    for phys in scan.column_map:
+        t[phys] = np.zeros((tile_rows,), dtype=np.int64)
+    for phys in scan.mask_map:
+        t[f"$nn:{phys}"] = np.zeros((tile_rows,), dtype=np.bool_)
+    return t
+
+
+def _tile_feed(scan: N.PScan, session, tile_rows: int):
+    """Yield (tile dict of padded arrays, n_valid). Cold tables stream
+    micro-partition files (host staging: the device never holds more than
+    one tile); warm tables slice their RAM arrays."""
+    if hasattr(scan, "_store_parts"):
+        yield from _store_tiles(scan, session, tile_rows)
+        return
+    t = session.catalog.table(scan.table_name)
+    t.ensure_loaded()
+    cols = {phys: np.asarray(t.data[phys]) for phys in scan.column_map}
+    for phys in scan.mask_map:
+        vm = t.validity.get(phys)
+        cols[f"$nn:{phys}"] = (np.asarray(vm, dtype=np.bool_)
+                               if vm is not None
+                               else np.ones(t.num_rows, dtype=np.bool_))
+    rows = t.num_rows
+    for off in range(0, max(rows, 0), tile_rows):
+        n = min(tile_rows, rows - off)
+        yield _pad_tile(cols, off, n, tile_rows), n
+
+
+def _store_tiles(scan: N.PScan, session, tile_rows: int):
+    """Stream a pruned cold scan part-by-part, re-chunked to tile_rows:
+    the out-of-core path — peak host memory is one partition + one tile."""
+    store = session.catalog.store
+    needed = _phys_cols(scan)
+    pend: dict[str, list[np.ndarray]] = {}
+    pend_rows = 0
+
+    def drain(final: bool):
+        nonlocal pend, pend_rows
+        while pend_rows >= tile_rows or (final and pend_rows > 0):
+            take = min(tile_rows, pend_rows)
+            tile = {}
+            for name, chunks in pend.items():
+                cat = chunks[0] if len(chunks) == 1 \
+                    else np.concatenate(chunks)
+                tile[name] = cat[:take]
+                pend[name] = [cat[take:]]
+            pend_rows -= take
+            yield _pad_tile(tile, 0, take, tile_rows), take
+
+    for part in scan._store_parts:
+        cols, validity = store.read_partitions(
+            scan.table_name, [part], needed)
+        n = len(next(iter(cols.values()))) if cols else 0
+        for phys in scan.column_map:
+            pend.setdefault(phys, []).append(np.asarray(cols[phys]))
+        for phys in scan.mask_map:
+            vm = validity.get(phys)
+            pend.setdefault(f"$nn:{phys}", []).append(
+                np.asarray(vm, dtype=np.bool_) if vm is not None
+                else np.ones(n, dtype=np.bool_))
+        pend_rows += n
+        yield from drain(final=False)
+    yield from drain(final=True)
+
+
+def _pad_tile(cols: dict, off: int, n: int, tile_rows: int) -> dict:
+    out = {}
+    for name, arr in cols.items():
+        sl = arr[off:off + n]
+        if n < tile_rows:
+            sl = np.concatenate(
+                [sl, np.zeros((tile_rows - n,), dtype=arr.dtype)])
+        out[name] = np.ascontiguousarray(sl)
+    return out

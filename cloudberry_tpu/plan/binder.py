@@ -813,7 +813,17 @@ class Binder:
                               and not _build_is_unique(build, build_keys,
                                                        self.catalog)):
             j.unique_build = False
-            j.out_capacity = _plan_capacity(build) + _plan_capacity(probe)
+            # bcap+pcap is NOT an upper bound for many-to-many fanout; take
+            # the NDV-based pair estimate with 2× headroom as a floor
+            # (overflow stays a detected error, and the session grows the
+            # buffer and retries — nodeHash.c's increase-nbatch discipline)
+            from cloudberry_tpu.plan.cost import estimate_rows
+
+            est = estimate_rows(j, self.catalog)
+            j._est_pairs = est  # distribution/tiling re-derive from this
+            j.out_capacity = max(
+                _plan_capacity(build) + _plan_capacity(probe),
+                int(2 * est) + 8)
         nm = match_name if kind in ("left", "full") else None
         pm = self.gensym("pmatch") if kind == "full" else None
         j.probe_match_name = pm
@@ -1703,7 +1713,18 @@ class Binder:
                              + [RangeEntry("$sq", subplan)])
             j.residual = self.bind_scalar(_and_all(res_rw), combined)
             j.build_payload = [f.name for f in subplan.fields]
-            j.out_capacity = _plan_capacity(subplan) + _plan_capacity(plan)
+            # pair buffer: equi-match PAIRS expand internally before the
+            # residual filters them — size from the inner-join estimate
+            # with headroom, not just bcap+pcap (see _make_join)
+            from cloudberry_tpu.plan.cost import estimate_rows
+
+            pairs = N.PJoin("inner", subplan, plan,
+                            list(build_keys), list(probe_keys), [])
+            est = estimate_rows(pairs, self.catalog)
+            j._est_pairs = est  # distribution/tiling re-derive from this
+            j.out_capacity = max(
+                _plan_capacity(subplan) + _plan_capacity(plan),
+                int(2 * est) + 8)
         return j
 
     def _apply_in_subquery(self, node: ast.InSubquery, plan: N.PlanNode,

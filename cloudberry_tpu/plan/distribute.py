@@ -436,11 +436,11 @@ class Distributor:
                 node.build = build
                 node.probe = probe
                 node.sharding = Sharding.singleton()
-                return node, _join_out_cap(node, bcap, pcap)
+                return node, _join_out_cap(node, bcap, pcap, self.nseg)
             node.build = build
             node.probe = probe
             node.sharding = psh
-            return node, _join_out_cap(node, bcap, pcap)
+            return node, _join_out_cap(node, bcap, pcap, self.nseg)
 
         b_part = bsh.is_partitioned
         p_part = psh.is_partitioned
@@ -493,7 +493,7 @@ class Distributor:
                                      else Sharding.strewn())
                 else:
                     node.sharding = Sharding.strewn()
-                return node, _join_out_cap(node, bcap, pcap)
+                return node, _join_out_cap(node, bcap, pcap, self.nseg)
             # left/anti joins select probe rows that match NOWHERE — every
             # segment must see the whole build side to decide that
             build, bcap = self.broadcast(build, bcap)
@@ -503,7 +503,7 @@ class Distributor:
         node.sharding = probe.sharding if p_part else (
             Sharding.strewn() if build.sharding.is_partitioned
             else probe.sharding)
-        return node, _join_out_cap(node, bcap, pcap)
+        return node, _join_out_cap(node, bcap, pcap, self.nseg)
 
     # ------------------------------------------------------------------ agg
 
@@ -589,15 +589,21 @@ class Distributor:
         return out, 1
 
 
-def _join_out_cap(node: N.PJoin, bcap: int, pcap: int) -> int:
+def _join_out_cap(node: N.PJoin, bcap: int, pcap: int,
+                  nseg: int = 1) -> int:
     """Per-segment output capacity; expansion joins get resized to the
-    post-motion per-segment inputs."""
+    post-motion per-segment inputs, floored by the NDV-based PAIR estimate
+    the binder memoized (bcap+pcap is no bound for many-to-many fanout —
+    a detected overflow grows the buffer and retries, executor.py:
+    grow_expansion)."""
+    est = getattr(node, "_est_pairs", None)
+    floor = int(2 * est / max(nseg, 1)) + 8 if est is not None else 0
     if node.residual is not None:
         # semi/anti residual: pairs expand internally, output rides probe
-        node.out_capacity = bcap + pcap
+        node.out_capacity = max(bcap + pcap, floor)
         return pcap
     if not node.unique_build:
-        node.out_capacity = bcap + pcap
+        node.out_capacity = max(bcap + pcap, floor)
         return node.out_capacity
     return pcap
 

@@ -67,6 +67,8 @@ class Session:
         self._gate = AdmissionGate(self.config.resource.max_concurrency)
         # prepared-statement cache: sql text -> (tables, versions, nseg, run)
         self._stmt_cache: dict = {}
+        # spill diagnostics for the LAST statement (None = not tiled)
+        self.last_tiled_report = None
 
     def sql(self, query: str, **params: Any):
         from cloudberry_tpu.exec.resource import check_admission
@@ -75,6 +77,7 @@ class Session:
         from cloudberry_tpu.utils.faultinject import fault_point
 
         self._sync_store()
+        self.last_tiled_report = None  # set again by a tiled runner
         cached = self._cached_statement(query)
         if cached is not None:
             fault_point("dispatch_start")
@@ -86,11 +89,59 @@ class Session:
         if result.is_ddl:
             return result.ddl_result
         # admission control: memory budget check + statement slot
-        # (vmem-tracker / resgroup analog, exec/resource.py)
-        check_admission(result.plan, self)
+        # (vmem-tracker / resgroup analog, exec/resource.py); an over-budget
+        # plan falls back to tiled out-of-core execution (the workfile
+        # manager / spill analog, exec/tiled.py) before refusing
+        from cloudberry_tpu.exec.resource import ResourceError
+
+        try:
+            check_admission(result.plan, self)
+        except ResourceError:
+            from cloudberry_tpu.exec.tiled import plan_tiled
+
+            texe = plan_tiled(result.plan, self)
+            if texe is None:
+                raise
+            fault_point("dispatch_start")
+            with self._gate:
+                return self._run_cached_tiled(query, texe)
         fault_point("dispatch_start")
         with self._gate:
-            return self._execute_and_cache(query, result.plan)
+            return self._run_with_growth(query, result.plan)
+
+    def _run_with_growth(self, query: str, plan):
+        """Execute; on a detected join-expansion overflow, grow the pair
+        buffer (re-checking admission) and retry — adaptive capacity, never
+        truncation (exec/executor.py:grow_expansion). Growth that blows the
+        budget falls back to tiled execution like any over-budget plan."""
+        from cloudberry_tpu.exec.executor import ExecError, grow_expansion
+        from cloudberry_tpu.exec.resource import ResourceError, check_admission
+
+        for _ in range(6):
+            try:
+                return self._execute_and_cache(query, plan)
+            except ExecError as e:
+                self._stmt_cache.pop(query, None)  # drop the failed runner
+                if not grow_expansion(plan, str(e)):
+                    raise
+                try:
+                    check_admission(plan, self)  # growth stays in budget…
+                except ResourceError:
+                    from cloudberry_tpu.exec.tiled import plan_tiled
+
+                    texe = plan_tiled(plan, self)  # …or the plan spills
+                    if texe is None:
+                        raise
+                    return self._run_cached_tiled(query, texe)
+        return self._execute_and_cache(query, plan)
+
+    def _run_cached_tiled(self, query: str, texe):
+        from cloudberry_tpu.exec import executor as X
+
+        names = sorted({s.table_name
+                        for s in X.scans_of(texe._whole_plan())})
+        self._cache_statement(query, names, texe.run)
+        return texe.run()
 
     def _sync_store(self) -> None:
         """Pick up OTHER sessions' committed changes at statement start
@@ -252,6 +303,10 @@ class Session:
             exe = X.compile_plan(plan, self)
             runner = lambda: X.run_executable(
                 exe, X.prepare_inputs(exe, self))
+        self._cache_statement(query, names, runner)
+        return runner()
+
+    def _cache_statement(self, query: str, names, runner) -> None:
         if len(self._stmt_cache) >= self._STMT_CACHE_MAX:
             # FIFO eviction keeps the cache (and its pinned XLA programs)
             # bounded under literal-inlining workloads
@@ -259,7 +314,6 @@ class Session:
         self._stmt_cache[query] = (
             names, self._table_versions(names),
             self.config.n_segments, self.catalog.ddl_version, runner)
-        return runner()
 
     def explain(self, query: str) -> str:
         from cloudberry_tpu.sql.parser import parse_sql
