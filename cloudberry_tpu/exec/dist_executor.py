@@ -65,7 +65,13 @@ def compile_distributed(plan: N.PlanNode, session):
         low = DistLowerer(tables, nseg)
         cols, sel = low.lower(plan)
         out = {f.name: cols[f.name][None] for f in plan.fields}
-        checks = {k: jnp.asarray(v).reshape(1) for k, v in low.checks.items()}
+        # reduce checks to replicated scalars (any segment tripped) so
+        # every HOST can read them — per-seg shards are not addressable
+        # across processes on a multi-host mesh
+        checks = {
+            k: jax.lax.psum(jnp.asarray(v).astype(jnp.int32),
+                            SEG_AXIS) > 0
+            for k, v in low.checks.items()}
         return out, sel[None], checks
 
     return jax.jit(_shard_map(seg_fn, mesh, (in_specs,),
@@ -79,16 +85,29 @@ def execute_distributed(plan: N.PlanNode, session,
     inputs, _ = prepare_dist_inputs(plan, session)
     cols, sel, checks = fn(inputs)
     X.raise_checks(checks)
-    # every segment computed the (gathered) final result; take segment 0
-    host_cols = {k: np.asarray(v)[0] for k, v in cols.items()}
-    host_sel = np.asarray(sel)[0]
+    # every segment computed the (gathered) final result; read the first
+    # shard THIS HOST can address (on a multi-host mesh, segment 0 may
+    # live on another process — any local copy is identical post-gather)
+    host_cols = {k: _local_row(v) for k, v in cols.items()}
+    host_sel = _local_row(sel)
     return X.make_batch(plan, host_cols, host_sel)
+
+
+def _local_row(v) -> np.ndarray:
+    if hasattr(v, "is_fully_addressable") and not v.is_fully_addressable:
+        shards = v.addressable_shards
+        if not shards:  # guarded up front by segment_mesh's host check
+            raise X.ExecError(
+                "this host owns no segment in the mesh and cannot read "
+                "the result")
+        return np.asarray(shards[0].data)[0]
+    return np.asarray(v)[0]
 
 
 def _out_specs_like(plan: N.PlanNode):
     cols_spec = {f.name: P(SEG_AXIS) for f in plan.fields}
-    # checks dict spec is dynamic; P(SEG_AXIS) for every leaf via tree prefix
-    return (cols_spec, P(SEG_AXIS), P(SEG_AXIS))
+    # checks reduce to replicated scalars (P()) — readable on every host
+    return (cols_spec, P(SEG_AXIS), P())
 
 
 def _shard_map(f, mesh, in_specs, out_specs):

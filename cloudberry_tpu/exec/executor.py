@@ -184,26 +184,35 @@ def all_nodes(plan: N.PlanNode):
         yield from all_nodes(c)
 
 
-def grow_expansion(plan: N.PlanNode, message: str,
-                   factor: int = 4) -> bool:
-    """Adaptive recovery from a detected join-expansion overflow (the
-    increase-nbatch-and-retry discipline of nodeHash.c): the check message
-    names the node id; grow that join's pair buffer and report success.
-    The caller recompiles and re-runs — results are never truncated."""
+def find_expansion_node(plan: N.PlanNode, message: str):
+    """The join a detected expansion-overflow check message points at
+    (messages embed the node id), or None."""
     import re
 
     m = re.search(r"\(node (\d+)\)", message)
     if m is None or "expansion overflow" not in message:
-        return False
+        return None
     nid = int(m.group(1))
     for node in all_nodes(plan):
         if id(node) == nid and isinstance(node, N.PJoin):
-            node.out_capacity = max(node.out_capacity * factor, 64)
-            # capacity re-derivations (e.g. tiled _retile) must never
-            # shrink a runtime-grown buffer back below what overflowed
-            node._min_out_cap = node.out_capacity
-            return True
-    return False
+            return node
+    return None
+
+
+def grow_expansion(plan: N.PlanNode, message: str,
+                   factor: int = 4) -> bool:
+    """Adaptive recovery from a detected join-expansion overflow (the
+    increase-nbatch-and-retry discipline of nodeHash.c): grow the named
+    join's pair buffer and report success. The caller recompiles and
+    re-runs — results are never truncated."""
+    node = find_expansion_node(plan, message)
+    if node is None:
+        return False
+    node.out_capacity = max(node.out_capacity * factor, 64)
+    # capacity re-derivations (e.g. tiled _retile) must never shrink a
+    # runtime-grown buffer back below what overflowed
+    node._min_out_cap = node.out_capacity
+    return True
 
 
 def scans_of(plan: N.PlanNode):

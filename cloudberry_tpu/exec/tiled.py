@@ -85,6 +85,13 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
     shape = _analyze(plan)
     if shape is None:
         return None
+    # whole-run growth marks (session._run_with_growth) are meaningless at
+    # tile scale and would poison the per-tile floor — the tiled adaptive
+    # loop re-learns spine buffer sizes itself. Build-side joins keep
+    # theirs: the prelude still computes whole builds.
+    for node in shape.spine:
+        if isinstance(node, N.PJoin) and hasattr(node, "_min_out_cap"):
+            del node._min_out_cap
     try:
         partial_aggs, final_aggs, finalize = _split_aggs(shape.agg.aggs)
     except ValueError:
@@ -219,19 +226,21 @@ def _acc_width(shape: _TileShape) -> int:
                    for f in shape.partial_plan.fields)
 
 
+def _merge_bytes(shape: _TileShape) -> int:
+    """Accumulator + merge working set: the concat of acc and partial rows
+    flowing through one sort-based group_aggregate."""
+    return 3 * (shape.g_cap + shape.partial_plan.capacity) \
+        * _acc_width(shape)
+
+
 def _choose_tile(shape: _TileShape, budget: int) -> Optional[int]:
     """Largest power-of-two tile whose estimated step memory fits: the
     spill-file-count decision of workfile_mgr, made at plan time."""
-    g_cap = shape.g_cap
-    w = _acc_width(shape)
     t = _MAX_TILE
     while t >= _MIN_TILE:
         _retile(shape, t)
         est = estimate_plan_memory(shape.partial_plan).peak_bytes
-        # accumulator + merge working set: concat of acc and partial rows
-        # flows through one sort-based group_aggregate
-        merge_bytes = 3 * (g_cap + shape.partial_plan.capacity) * w
-        if est + merge_bytes <= budget:
+        if est + _merge_bytes(shape) <= budget:
             return t
         t >>= 1
     return None
@@ -240,15 +249,13 @@ def _choose_tile(shape: _TileShape, budget: int) -> Optional[int]:
 # --------------------------------------------------------------- lowerers
 
 
-class _TileLowerer(X.Lowerer):
-    """Step-program lowerer: the stream scan reads the tile input; spine
-    builds read their prelude-computed arrays."""
+class _ReplacingLowerer(X.Lowerer):
+    """Lowerer with a node-identity substitution table: nodes whose ids
+    appear in ``replace`` lower to the given (cols, sel) instead of being
+    traced (prelude-computed builds, the finalize accumulator leaf)."""
 
-    def __init__(self, tables, stream: N.PScan, tile_n, replace: dict,
-                 **kw):
+    def __init__(self, tables, replace: dict, **kw):
         super().__init__(tables, **kw)
-        self._stream = stream
-        self._tile_n = tile_n
         self._replace = replace
 
     def lower(self, node: N.PlanNode):
@@ -256,6 +263,17 @@ class _TileLowerer(X.Lowerer):
         if hit is not None:
             return hit
         return super().lower(node)
+
+
+class _TileLowerer(_ReplacingLowerer):
+    """Step-program lowerer: the stream scan reads the tile input; spine
+    builds read their prelude-computed arrays."""
+
+    def __init__(self, tables, stream: N.PScan, tile_n, replace: dict,
+                 **kw):
+        super().__init__(tables, replace, **kw)
+        self._stream = stream
+        self._tile_n = tile_n
 
     def scan(self, node: N.PScan):
         if node is not self._stream:
@@ -268,18 +286,6 @@ class _TileLowerer(X.Lowerer):
             cols[out] = tile[f"$nn:{phys}"]
         sel = jnp.arange(node.capacity) < self._tile_n
         return cols, sel
-
-
-class _ReplacingLowerer(X.Lowerer):
-    def __init__(self, tables, replace: dict, **kw):
-        super().__init__(tables, **kw)
-        self._replace = replace
-
-    def lower(self, node: N.PlanNode):
-        hit = self._replace.get(id(node))
-        if hit is not None:
-            return hit
-        return super().lower(node)
 
 
 # --------------------------------------------------------------- execution
@@ -310,8 +316,7 @@ class TiledExecutable:
         shape = self.shape
         _retile(shape, self.tile_rows)
         est = estimate_plan_memory(shape.partial_plan).peak_bytes
-        merge_bytes = 3 * (shape.g_cap
-                           + shape.partial_plan.capacity) * _acc_width(shape)
+        merge_bytes = _merge_bytes(shape)
         self.report = {
             "tiled": True,
             "stream_table": shape.stream.table_name,
@@ -477,14 +482,7 @@ class TiledExecutable:
     def _try_grow(self, msg: str) -> bool:
         """Grow the overflowing spine join's pair buffer if the grown step
         still fits the budget; revert (and report False) otherwise."""
-        import re
-
-        m = re.search(r"\(node (\d+)\)", msg)
-        if m is None:
-            return False
-        nid = int(m.group(1))
-        node = next((n for n in X.all_nodes(self.shape.partial_plan)
-                     if id(n) == nid and isinstance(n, N.PJoin)), None)
+        node = X.find_expansion_node(self.shape.partial_plan, msg)
         if node is None:
             return False
         old = getattr(node, "_min_out_cap", 0)

@@ -1,28 +1,87 @@
 """Device mesh — the gp_segment_configuration analog.
 
 The reference's cluster topology is a catalog of N segment postmasters
-(cdbutil.c getCdbComponentInfo); here it is a jax.sharding.Mesh with one
-``seg`` axis: mesh slot ↔ segment. Multi-host later extends this to a
-(host, seg) mesh with DCN between hosts; the executor only ever names the
-``seg`` axis, so that change is local to this module.
+(cdbutil.c getCdbComponentInfo) wired by a socket interconnect
+(contrib/interconnect/udp/ic_udpifc.c); here it is a jax.sharding.Mesh
+with one ``seg`` axis: mesh slot ↔ segment.
+
+Multi-host (the DCN path): each host process calls ``init_distributed``
+(the gpinitsystem / interconnect-setup analog) before creating a session.
+After ``jax.distributed.initialize`` the device list is GLOBAL — the
+segment mesh then spans hosts, and XLA routes intra-host collectives over
+ICI and inter-host collectives over DCN (Gloo on CPU test clusters) with
+no change anywhere else in the engine: the executor only ever names the
+``seg`` axis. Segments stay stateless (data placement is recomputed from
+shared/deterministic storage), so there is no per-segment WAL to ship —
+a failed host re-runs statements against pinned snapshots.
 """
 
 from __future__ import annotations
 
+import os
+
 import jax
 from jax.sharding import Mesh
-
 
 SEG_AXIS = "seg"
 
 
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Join (or start) a multi-host cluster. Arguments default to the
+    CBTPU_COORDINATOR / CBTPU_NUM_PROCS / CBTPU_PROC_ID environment —
+    this engine's gp_segment_configuration bootstrap. Idempotent; a
+    single-host run (no coordinator configured) is a no-op."""
+    if getattr(init_distributed, "_done", False):
+        return
+    coordinator = coordinator or os.environ.get("CBTPU_COORDINATOR")
+    if coordinator is None:
+        return
+    num_processes = int(num_processes
+                       or os.environ.get("CBTPU_NUM_PROCS", "1"))
+    process_id = int(process_id
+                     if process_id is not None
+                     else os.environ.get("CBTPU_PROC_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    init_distributed._done = True  # type: ignore[attr-defined]
+
+
 def segment_mesh(n_segments: int) -> Mesh:
+    """Mesh over the first n_segments GLOBAL devices (all hosts)."""
     devices = jax.devices()
     if len(devices) < n_segments:
         raise RuntimeError(
             f"config asks for {n_segments} segments but only "
             f"{len(devices)} devices are visible; for tests set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count={n_segments}")
+    chosen = devices[:n_segments]
+    if jax.process_count() > 1:
+        # every host must own at least one mesh segment: a host outside
+        # the mesh could neither feed its shards nor read results
+        owners = {int(getattr(d, "process_index", 0)) for d in chosen}
+        if owners != set(range(jax.process_count())):
+            raise RuntimeError(
+                f"n_segments={n_segments} covers only hosts "
+                f"{sorted(owners)} of {jax.process_count()}; every host "
+                "must own at least one segment (raise n_segments or "
+                "shrink the cluster)")
     import numpy as np
 
-    return Mesh(np.asarray(devices[:n_segments]), (SEG_AXIS,))
+    return Mesh(np.asarray(chosen), (SEG_AXIS,))
+
+
+def mesh_topology(n_segments: int) -> dict:
+    """Host → segment layout (the gp_segment_configuration view)."""
+    devices = jax.devices()[:n_segments]
+    hosts: dict[int, list[int]] = {}
+    for i, d in enumerate(devices):
+        hosts.setdefault(int(getattr(d, "process_index", 0)), []).append(i)
+    return {
+        "n_segments": n_segments,
+        "n_hosts": max(len(hosts), 1),
+        "this_host": jax.process_index(),
+        "segments_by_host": hosts,
+    }

@@ -1,0 +1,70 @@
+"""Worker process for the multi-host (DCN-analog) test.
+
+Each worker is one "host": it joins the cluster via
+``mesh.init_distributed`` (CBTPU_* env), owns 4 local virtual devices, and
+runs the SAME statements over the 8-segment mesh that now spans both
+processes — collectives cross the process boundary the way the
+reference's interconnect crosses machines (ic_udpifc.c). Results print as
+JSON for the parent to compare across hosts and against the single-host
+oracle.
+
+The spawner provides the per-host env (JAX_PLATFORMS=cpu, XLA_FLAGS with
+4 local devices, CBTPU_* cluster coordinates) — this module must NOT
+mutate os.environ, because the test imports it for QUERIES/load."""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from cloudberry_tpu.parallel.mesh import (init_distributed,  # noqa: E402
+                                          mesh_topology)
+
+init_distributed()
+
+import numpy as np  # noqa: E402
+
+import cloudberry_tpu as cb  # noqa: E402
+from cloudberry_tpu.config import get_config  # noqa: E402
+
+QUERIES = [
+    # redistribute + two-stage agg + gathered sort
+    ("SELECT g, sum(v) AS sv, count(*) AS c FROM fact "
+     "JOIN dim ON fact.k = dim.k GROUP BY g ORDER BY g"),
+    # broadcast join (small build) + filter
+    ("SELECT count(*) AS n FROM fact JOIN dim ON fact.k = dim.k "
+     "WHERE g < 3"),
+    # top-N pushdown through the gather motion
+    ("SELECT k, v FROM fact ORDER BY v DESC, k LIMIT 7"),
+]
+
+
+def load(session):
+    rng = np.random.default_rng(11)  # identical on every host
+    session.sql("CREATE TABLE dim (k BIGINT, g BIGINT) DISTRIBUTED BY (k)")
+    session.sql(
+        "CREATE TABLE fact (k BIGINT, v BIGINT) DISTRIBUTED BY (k)")
+    session.catalog.table("dim").set_data(
+        {"k": np.arange(400), "g": np.arange(400) % 6})
+    session.catalog.table("fact").set_data(
+        {"k": rng.integers(0, 400, 20_000),
+         "v": rng.integers(0, 1000, 20_000)})
+
+
+def main():
+    topo = mesh_topology(8)
+    assert topo["n_hosts"] == 2, f"expected 2 hosts, got {topo}"
+    session = cb.Session(get_config().with_overrides(n_segments=8))
+    load(session)
+    results = []
+    for q in QUERIES:
+        df = session.sql(q).to_pandas()
+        results.append({c: df[c].tolist() for c in df.columns})
+    print("RESULT " + json.dumps(
+        {"host": topo["this_host"], "results": results}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Standalone interconnect benchmark — the ic_bench / udp2 analog.
+
+The reference ships a kernel-independent interconnect benchmark
+(contrib/interconnect/test/ic_bench.c, contrib/udp2's standalone-testable
+transport): measure the motion layer WITHOUT the executor on top. Here the
+motion layer is XLA collectives over the segment mesh, so this tool times
+exactly the three collectives the engine's motions lower to
+(exec/dist_executor.py):
+
+- GATHER / BROADCAST  -> all_gather
+- HASH redistribute   -> all_to_all
+- check reduction     -> psum
+
+Runs on whatever mesh is visible: 8 virtual CPU devices (tests), a real
+TPU slice, or a multi-host cluster joined via mesh.init_distributed
+(CBTPU_* env). Prints one JSON line per (collective, payload size) with
+achieved per-segment bandwidth.
+
+Usage: python -m tools.ic_bench [--segs N] [--sizes bytes,bytes,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--segs", type=int, default=0,
+                    help="segments (default: all visible devices)")
+    ap.add_argument("--sizes", type=str, default="65536,1048576,16777216",
+                    help="per-segment payload bytes, comma-separated")
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    import os
+
+    import jax
+
+    # the terminal's sitecustomize presets the axon TPU relay and imports
+    # jax before this script runs, so the JAX_PLATFORMS env var alone is
+    # too late — re-assert it through jax.config (tests/conftest.py note)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from cloudberry_tpu.parallel.mesh import (SEG_AXIS, init_distributed,
+                                              segment_mesh)
+    from cloudberry_tpu.exec.dist_executor import _shard_map
+
+    init_distributed()
+    nseg = args.segs or len(jax.devices())
+    mesh = segment_mesh(nseg)
+
+    def bench(fn, x, label, nbytes):
+        out = jax.block_until_ready(fn(x))
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.time()
+            out = jax.block_until_ready(fn(x))
+            best = min(best, time.time() - t0)
+        print(json.dumps({
+            "collective": label,
+            "payload_bytes_per_seg": nbytes,
+            "n_segments": nseg,
+            "wall_ms": round(best * 1e3, 3),
+            "gbps_per_seg": round(nbytes * 8 / best / 1e9, 3),
+        }), flush=True)
+        return out
+
+    for size in (int(s) for s in args.sizes.split(",") if s.strip()):
+        n = max(size // 4, nseg)           # f32 lanes per segment
+        n += (-n) % nseg                   # all_to_all splits evenly
+        x = np.arange(nseg * n, dtype=np.float32).reshape(nseg, n)
+
+        def ag(v):
+            return jax.lax.all_gather(v[0], SEG_AXIS, axis=0, tiled=True)
+
+        def a2a(v):
+            return jax.lax.all_to_all(
+                v[0].reshape(nseg, n // nseg), SEG_AXIS,
+                split_axis=0, concat_axis=0)
+
+        def ps(v):
+            return jax.lax.psum(jnp.sum(v[0]), SEG_AXIS)
+
+        for label, fn, spec in (("all_gather", ag, P(SEG_AXIS)),
+                                ("all_to_all", a2a, P(SEG_AXIS)),
+                                ("psum", ps, P())):
+            f = jax.jit(_shard_map(
+                lambda v, _fn=fn: _fn(v), mesh,
+                (P(SEG_AXIS, None),), spec))
+            bench(f, x, label, n * 4)
+
+
+if __name__ == "__main__":
+    main()
