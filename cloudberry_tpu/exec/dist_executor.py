@@ -172,11 +172,15 @@ class DistLowerer(X.Lowerer):
             ranges.append((lo, span))
         kb = jnp.where(bsel, K.pack_with_ranges(bkeys, ranges), K._U64_MAX)
         kp = K.pack_with_ranges(pkeys, ranges)
+        big = K._U64_MAX
+        if node.pack_bits == 32:
+            # stats-proven narrow keys halve the all-gathered bytes too
+            kb, kp, big = K.downcast32(kb), K.downcast32(kp), K._U32_MAX
         kb_all = jax.lax.all_gather(kb, SEG_AXIS, axis=0, tiled=True)
         kb_sorted = jnp.sort(kb_all)
         pos = jnp.clip(jnp.searchsorted(kb_sorted, kp), 0,
                        kb_sorted.shape[0] - 1)
-        hit = (kb_sorted[pos] == kp) & (kp != K._U64_MAX)
+        hit = (kb_sorted[pos] == kp) & (kp != big)
         return pcols, psel & hit
 
     def motion(self, node: N.PMotion):

@@ -397,7 +397,8 @@ class Lowerer:
             return self._join_expand(node, bcols, bsel, bselm, bkeys,
                                      pcols, psel, pselm, pkeys)
 
-        idx, matched, has_dup = K.join_lookup(bkeys, bselm, pkeys, pselm)
+        idx, matched, has_dup = K.join_lookup(bkeys, bselm, pkeys, pselm,
+                                              bits=node.pack_bits)
         if node.kind in ("inner", "left"):
             # semi/anti only test membership; inner/left rely on the
             # planner's uniqueness proof — verify it at runtime (free:
@@ -525,7 +526,7 @@ class Lowerer:
         OR-reduce back onto probe rows."""
         cap = node.out_capacity
         pi, bi, osel, _matched, total = K.join_expand(
-            bkeys, bselm, pkeys, pselm, cap)
+            bkeys, bselm, pkeys, pselm, cap, bits=node.pack_bits)
         self.checks[
             f"semi-join expansion overflow: match pairs exceed capacity "
             f"{cap} (node {id(node)})"] = total > cap
@@ -547,7 +548,7 @@ class Lowerer:
         matching, bsel/psel keep them in the preserved regions)."""
         cap = node.out_capacity
         pi, bi, osel, matched, total = K.join_expand(
-            bkeys, bselm, pkeys, pselm, cap)
+            bkeys, bselm, pkeys, pselm, cap, bits=node.pack_bits)
         need = total
         is_pair = osel
         j = jnp.arange(cap, dtype=total.dtype)

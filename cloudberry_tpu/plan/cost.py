@@ -158,6 +158,67 @@ def _col_source(plan: N.PlanNode, name: str):
     return None
 
 
+def annotate_pack_bits(plan: N.PlanNode, catalog) -> None:
+    """Prove 32-bit packed join keys from build-side column statistics.
+
+    The kernels pack key tuples into one order-preserving integer using the
+    BUILD side's runtime ranges (kernels.pack_with_ranges); probe values
+    outside those ranges hit the sentinel. The runtime build range is a
+    subset of the build column's table min/max, so if the product of
+    stats-proven spans fits 32 bits (minus the sentinel), every in-range
+    pack does too — and the sort/search/collective lanes halve. TPC-H keys
+    stay 32-bit provable through SF100 (orderkey max 6e9·0.1 < 2^31)."""
+    from cloudberry_tpu.types import DType
+
+    # value-space spans only translate to pack-space for types whose
+    # sort_key_u64 mapping is affine: integers, dates, scaled decimals,
+    # and dictionary codes. FLOATS pack by IEEE bit pattern — a tiny value
+    # span can cover ~2^52 bit patterns, so they are never narrowable.
+    _AFFINE = (DType.INT32, DType.INT64, DType.DATE, DType.DECIMAL,
+               DType.STRING)
+
+    def bits_of(build: N.PlanNode, keys) -> int:
+        prod = 1
+        for k in keys:
+            if not isinstance(k, ex.ColumnRef) \
+                    or k.dtype.base not in _AFFINE:
+                return 64
+            src = _col_source(build, k.name)
+            if src is None:
+                return 64
+            try:
+                mm = catalog.table(src[0]).stats.min_max.get(src[1])
+            except KeyError:
+                return 64
+            if mm is None:
+                return 64
+            # stats store float64 min/max: beyond 2^53 the rounding could
+            # understate a span that straddles the 32-bit threshold
+            if abs(mm[0]) >= 2 ** 53 or abs(mm[1]) >= 2 ** 53:
+                return 64
+            span = int(mm[1]) - int(mm[0]) + 1
+            if span <= 0:
+                return 64
+            prod *= span
+            if prod > (1 << 32) - 2:
+                return 64
+        return 32
+
+    def walk(n: N.PlanNode):
+        if isinstance(n, (N.PJoin, N.PRuntimeFilter)):
+            n.pack_bits = bits_of(n.build, n.build_keys)
+        from cloudberry_tpu.plan.distribute import _node_exprs
+
+        for e in _node_exprs(n):
+            for sub in ex.walk(e):
+                if isinstance(sub, ex.SubqueryScalar):
+                    walk(sub.plan)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+
+
 def selectivity(pred: ex.Expr, child: N.PlanNode, catalog) -> float:
     s = _sel(pred, child, catalog)
     return min(max(s, 1e-6), 1.0)

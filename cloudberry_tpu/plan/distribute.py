@@ -402,7 +402,8 @@ class Distributor:
                 or est_build_rows > thresh or est_semi_rows is None:
             return probe, None
         rf = N.PRuntimeFilter(probe, build_src,
-                              list(node.build_keys), list(node.probe_keys))
+                              list(node.build_keys), list(node.probe_keys),
+                              pack_bits=node.pack_bits)
         rf.fields = list(probe.fields)
         rf.sharding = probe.sharding
         return rf, max(est_semi_rows, 1.0)

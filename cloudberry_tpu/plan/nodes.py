@@ -151,6 +151,10 @@ class PJoin(PlanNode):
     # NOT IN (subquery) null-awareness: if ANY build key is NULL, the anti
     # join yields no rows at all (x NOT IN (..., NULL) is never TRUE)
     null_aware: bool = False
+    # packed-key width: 32 when build-side column stats PROVE every
+    # in-range pack fits u32 (cost.annotate_pack_bits) — TPU sorts and
+    # searches run ~2× faster on 32-bit lanes
+    pack_bits: int = 64
 
     def children(self):
         return [self.build, self.probe]
@@ -266,6 +270,7 @@ class PRuntimeFilter(PlanNode):
     build: PlanNode                  # shared with the join's build input
     build_keys: list[ex.Expr] = dc_field(default_factory=list)
     probe_keys: list[ex.Expr] = dc_field(default_factory=list)
+    pack_bits: int = 64              # see PJoin.pack_bits
 
     def children(self):
         return [self.child]          # build is walked under the join

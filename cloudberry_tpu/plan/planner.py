@@ -508,6 +508,9 @@ def _optimize(plan: N.PlanNode, session) -> N.PlanNode:
 
     plan = prune_plan(plan)
     apply_storage_scans(plan, session)
+    from cloudberry_tpu.plan.cost import annotate_pack_bits
+
+    annotate_pack_bits(plan, session.catalog)
     if session.config.n_segments > 1 \
             and session.config.planner.enable_direct_dispatch:
         from cloudberry_tpu.plan.distribute import (apply_direct_dispatch,

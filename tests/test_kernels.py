@@ -183,3 +183,79 @@ def test_decimal_int_ingest():
                                 Schema.of(p=DECIMAL(2)))
     np.testing.assert_array_equal(np.asarray(b.columns["p"]), [10000, 20000])
     assert b.to_pandas()["p"].tolist() == [100.0, 200.0]
+
+
+def test_join_lookup_32bit_matches_64bit():
+    """Stats-proven narrow packing (kernels.downcast32) must be
+    bit-identical to the u64 path, including sentinel (no-match) rows."""
+    rng = np.random.default_rng(2)
+    bk = jnp.asarray(rng.permutation(1000).astype(np.int64))
+    bs = jnp.asarray(rng.random(1000) < 0.9)
+    pk = jnp.asarray(rng.integers(-50, 1100, 5000).astype(np.int64))
+    ps = jnp.asarray(rng.random(5000) < 0.95)
+    i64, m64, d64 = K.join_lookup([bk], bs, [pk], ps, bits=64)
+    i32, m32, d32 = K.join_lookup([bk], bs, [pk], ps, bits=32)
+    np.testing.assert_array_equal(np.asarray(m64), np.asarray(m32))
+    np.testing.assert_array_equal(np.asarray(i64)[np.asarray(m64)],
+                                  np.asarray(i32)[np.asarray(m32)])
+    assert bool(d64) == bool(d32)
+
+
+def test_join_expand_32bit_matches_64bit():
+    rng = np.random.default_rng(3)
+    bk = jnp.asarray(rng.integers(0, 200, 1000).astype(np.int64))
+    bs = jnp.ones(1000, dtype=bool)
+    pk = jnp.asarray(rng.integers(0, 250, 2000).astype(np.int64))
+    ps = jnp.asarray(rng.random(2000) < 0.9)
+    cap = 16384
+    r64 = K.join_expand([bk], bs, [pk], ps, cap, bits=64)
+    r32 = K.join_expand([bk], bs, [pk], ps, cap, bits=32)
+    for a, b in zip(r64, r32):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pack_bits_annotation_tpch():
+    """TPC-H integer-key joins (orderkey/custkey class) must be proven
+    32-bit packable from table stats; the plan carries the proof."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+    from cloudberry_tpu.exec.executor import all_nodes
+    from cloudberry_tpu.plan import nodes as N
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.sql.parser import parse_sql
+    from tools.tpch_queries import QUERIES
+    from tools.tpchgen import load_tpch
+
+    s = cb.Session(get_config().with_overrides(n_segments=1))
+    load_tpch(s, sf=0.01, seed=7)
+    plan = plan_statement(parse_sql(QUERIES["q3"]), s, {}).plan
+    joins = [n for n in all_nodes(plan) if isinstance(n, N.PJoin)]
+    assert joins and all(j.pack_bits == 32 for j in joins), \
+        [(j.title(), j.pack_bits) for j in joins]
+
+
+def test_pack_bits_rejects_float_keys():
+    """FLOAT keys pack by IEEE bit pattern (sort_key_u64), where a tiny
+    value span covers ~2^52 patterns — the 32-bit proof must refuse them
+    (narrowing would alias distinct keys)."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+    from cloudberry_tpu.exec.executor import all_nodes
+    from cloudberry_tpu.plan import nodes as N
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.sql.parser import parse_sql
+
+    s = cb.Session(get_config().with_overrides(n_segments=1))
+    s.sql("CREATE TABLE fb (x DOUBLE, p BIGINT) DISTRIBUTED BY (p)")
+    s.sql("CREATE TABLE fp (y DOUBLE, v BIGINT) DISTRIBUTED BY (v)")
+    s.catalog.table("fb").set_data(
+        {"x": np.array([1.5, 2.5, 3.5]), "p": np.arange(3)})
+    s.catalog.table("fp").set_data(
+        {"y": np.array([2.5, 3.5, 9.0, 1.5]), "v": np.arange(4)})
+    plan = plan_statement(parse_sql(
+        "SELECT sum(v) AS sv FROM fp JOIN fb ON fp.y = fb.x"), s, {}).plan
+    joins = [n for n in all_nodes(plan) if isinstance(n, N.PJoin)]
+    assert joins and all(j.pack_bits == 64 for j in joins)
+    # and the join itself must still be correct
+    assert s.sql("SELECT count(*) AS c FROM fp JOIN fb ON fp.y = fb.x"
+                 ).to_pandas()["c"].tolist() == [3]

@@ -47,7 +47,6 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from cloudberry_tpu.parallel.mesh import (SEG_AXIS, init_distributed,
@@ -88,7 +87,9 @@ def main() -> None:
                 split_axis=0, concat_axis=0)
 
         def ps(v):
-            return jax.lax.psum(jnp.sum(v[0]), SEG_AXIS)
+            # reduce the FULL payload so the reported bytes really cross
+            # the interconnect (a scalar psum would move 4 bytes)
+            return jax.lax.psum(v[0], SEG_AXIS)
 
         for label, fn, spec in (("all_gather", ag, P(SEG_AXIS)),
                                 ("all_to_all", a2a, P(SEG_AXIS)),

@@ -1,0 +1,95 @@
+"""Standalone relational-kernel benchmark — per-primitive timing.
+
+The reference benchmarks its executor primitives outside the engine
+(contrib/pax_storage's pax_gbench.cc, ic_bench.c for the transport); this
+is the same stance for the TPU kernels in exec/kernels.py: time each hot
+primitive — sorted-build lookup join (u64 and stats-proven u32 packing),
+many-to-many expansion, sort-based grouped aggregation, sort — on whatever
+backend is live (real TPU under the terminal default, CPU with
+JAX_PLATFORMS=cpu), one JSON line per measurement.
+
+Usage: python -m tools.kernel_bench [--build N] [--probe N] [--reps R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--build", type=int, default=1_500_000)
+    ap.add_argument("--probe", type=int, default=6_000_000)
+    ap.add_argument("--groups", type=int, default=4_000_000)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # sitecustomize presets the axon relay before this script runs;
+        # re-assert the requested platform (tests/conftest.py note)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_enable_x64", True)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloudberry_tpu.exec import kernels as K
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    NB, NP = args.build, args.probe
+
+    def bench(label, fn, *xs, rows):
+        out = jax.block_until_ready(fn(*xs))  # compile + warm
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.time()
+            out = jax.block_until_ready(fn(*xs))
+            best = min(best, time.time() - t0)
+        print(json.dumps({
+            "kernel": label, "rows": rows, "device": str(dev),
+            "wall_ms": round(best * 1e3, 2),
+            "mrows_per_s": round(rows / best / 1e6, 1),
+        }), flush=True)
+        return out
+
+    bk = jnp.asarray(rng.permutation(NB).astype(np.int64))
+    bs = jnp.ones(NB, bool)
+    pk = jnp.asarray(rng.integers(0, NB, NP).astype(np.int64))
+    ps = jnp.ones(NP, bool)
+
+    for bits in (64, 32):
+        bench(f"join_lookup_u{bits}",
+              jax.jit(lambda b, s, p, q, _bits=bits:
+                      K.join_lookup([b], s, [p], q, bits=_bits)),
+              bk, bs, pk, ps, rows=NP)
+
+    dup = jnp.asarray(rng.integers(0, NB // 8, NB).astype(np.int64))
+    cap = NP + NB
+    for bits in (64, 32):
+        bench(f"join_expand_u{bits}",
+              jax.jit(lambda b, s, p, q, _bits=bits:
+                      K.join_expand([b], s, [p], q, cap, bits=_bits)),
+              dup, bs, pk, ps, rows=NP)
+
+    gk = jnp.asarray(rng.integers(0, args.groups, NP).astype(np.int64))
+    gv = jnp.asarray(rng.integers(0, 1000, NP).astype(np.int64))
+    bench("group_aggregate",
+          jax.jit(lambda k, v, s: K.group_aggregate(
+              {"k": k}, {"s": v, "c": None},
+              [K.AggSpec("sum", "s"), K.AggSpec("count", "c")],
+              s, args.groups)),
+          gk, gv, ps, rows=NP)
+
+    bench("sort_indices",
+          jax.jit(lambda k, s: K.sort_indices([k], s)),
+          pk, ps, rows=NP)
+
+
+if __name__ == "__main__":
+    main()
