@@ -481,36 +481,79 @@ class Lowerer:
                 [jnp.zeros((1,), dtype=csum.dtype), csum])
 
         out_cols = dict(cols)
-        for name, func, arg in node.calls:
+        valids = node.valids or [None] * len(node.calls)
+        for (name, func, arg), valid in zip(node.calls, valids):
+            # per-call argument validity in sorted row order: count counts
+            # only valid rows, avg divides by the valid count, 'anyvalid'
+            # is the null mask for nullable agg outputs
+            va = (s_sel & self.expr(valid, cols)[perm]) \
+                if valid is not None else s_sel
             if func == "row_number":
                 o = (idx - seg_start + 1).astype(jnp.int64)
             elif func == "rank":
                 o = (run_start - seg_start + 1).astype(jnp.int64)
             elif func == "dense_rank":
                 o = (run_cum - run_cum[seg_start] + 1).astype(jnp.int64)
-            elif func in ("sum", "count", "avg"):
-                if func == "count" and arg is None:
-                    v = s_sel.astype(jnp.int64)
+            elif func in ("sum", "count", "avg", "anyvalid"):
+                if func in ("count", "anyvalid") or arg is None:
+                    v = va.astype(jnp.int64)
                 else:
-                    v = jnp.where(s_sel, self.expr(arg, cols)[perm], 0) \
-                        if func != "count" else s_sel.astype(jnp.int64)
+                    v = jnp.where(va, self.expr(arg, cols)[perm], 0)
                 S = pref(v)
                 hi = (run_end if node.order_keys else seg_end)
                 o = S[hi + 1] - S[seg_start]
                 if func == "avg":
-                    C = pref(s_sel.astype(jnp.int64))
+                    C = pref(va.astype(jnp.int64))
                     cnt = C[hi + 1] - C[seg_start]
                     o = o.astype(jnp.float64) / jnp.maximum(cnt, 1)
                     if arg is not None and arg.dtype.base == DType.DECIMAL:
                         o = o / (10.0 ** arg.dtype.scale)
+                elif func == "anyvalid":
+                    o = o > 0
+            elif func in ("min", "max") and node.order_keys:
+                # running extreme (RANGE UNBOUNDED PRECEDING..CURRENT ROW,
+                # peers included via run_end): segmented scan over sorted
+                # rows. The combine is the standard segmented-scan operator
+                # (reset flag ? right : extreme(left, right)) with the
+                # extreme taken lexicographically over (sort rank, code) so
+                # it stays associative on ties. NULL lanes get the worst
+                # possible rank so they never win (an all-NULL prefix is
+                # nullified by the 'anyvalid' mask).
+                v = self.expr(arg, cols)
+                ks = _sortable(arg, node.child, cols)[perm]
+                cs = v[perm]
+                mx = func == "max"
+                if valid is not None:
+                    ks = jnp.where(va, ks, _worst_rank(ks.dtype, mx))
+
+                def comb(a, b, mx=mx):
+                    f1, r1, c1 = a
+                    f2, r2, c2 = b
+                    if mx:
+                        better = (r2 > r1) | ((r2 == r1) & (c2 > c1))
+                    else:
+                        better = (r2 < r1) | ((r2 == r1) & (c2 < c1))
+                    take2 = f2 | better
+                    return (f1 | f2, jnp.where(take2, r2, r1),
+                            jnp.where(take2, c2, c1))
+
+                _, _, runext = jax.lax.associative_scan(
+                    comb, (seg_flag, ks, cs))
+                o = runext[run_end]
             elif func in ("min", "max"):
                 # whole-partition extreme: re-sort with the value last; the
                 # extreme lands on each partition's boundary row (strings
-                # order by collation rank, output keeps the code)
+                # order by collation rank, output keeps the code). Invalid
+                # (NULL) lanes sort behind every valid row in their
+                # partition, so they reach the boundary only for all-NULL
+                # partitions — which the 'anyvalid' mask nullifies.
                 v = self.expr(arg, cols)
                 vkey = _sortable(arg, node.child, cols)
-                p2 = K.sort_indices(pk + [vkey], sel,
-                                    descending=[False] * len(pk)
+                extra = [] if valid is None else \
+                    [(~self.expr(valid, cols)).astype(jnp.int32)]
+                p2 = K.sort_indices(pk + extra + [vkey], sel,
+                                    descending=[False] * (len(pk)
+                                                          + len(extra))
                                     + [func == "max"])
                 o = v[p2][seg_start]
             else:
@@ -730,6 +773,14 @@ class Lowerer:
             out_aggs = {n: jnp.pad(c, (0, pad)) for n, c in out_aggs.items()}
             occupied = jnp.pad(occupied, (0, pad))
         return {**out_keys, **out_aggs}, occupied
+
+
+def _worst_rank(dtype, for_max: bool):
+    """The rank value a lane must hold to never win a min/max scan."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf if for_max else jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.min if for_max else info.max, dtype)
 
 
 def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:

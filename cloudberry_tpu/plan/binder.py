@@ -211,21 +211,38 @@ class Binder:
             if not node.all:
                 plan = self._distinct_on_all(plan)
         elif node.op in ("intersect", "except"):
-            if node.all:
-                raise BindError(
-                    f"{node.op.upper()} ALL is not supported yet "
-                    "(bag semantics need per-row multiplicity)")
-            # distinct(left) filtered by membership in right; set ops treat
-            # NULLs as equal ("not distinct"), so keys are canonical-zero
-            # values plus the mask columns — no key-validity exclusion
-            probe = self._distinct_on_all(left)
             kind = "semi" if node.op == "intersect" else "anti"
-            keys_b = [_canonical_ref(f) for f in right.fields]
-            keys_p = [_canonical_ref(f) for f in probe.fields]
-            j = N.PJoin(kind, right, probe, keys_b, keys_p, [],
-                        self.gensym("match"))
-            j.fields = list(probe.fields)
-            plan = j
+            if node.all:
+                # Bag semantics via occurrence numbering: number duplicate
+                # copies 1..n on each side (row_number partitioned on every
+                # column), then semi/anti join on (columns…, occurrence) —
+                # the i-th left copy survives INTERSECT ALL iff the right
+                # has an i-th copy too (min of the counts); EXCEPT ALL is
+                # the anti join (max(l_count − r_count, 0) copies). The
+                # textbook reduction the reference executes via SetOp's
+                # per-group counters (nodeSetOp.c SETOP_HASHED ALL modes).
+                lw, locc = self._occurrence_numbered(left)
+                rw, rocc = self._occurrence_numbered(right)
+                keys_p = [_canonical_ref(f) for f in left.fields] \
+                    + [ex.ColumnRef(locc, T.INT64)]
+                keys_b = [_canonical_ref(f) for f in right.fields] \
+                    + [ex.ColumnRef(rocc, T.INT64)]
+                j = N.PJoin(kind, rw, lw, keys_b, keys_p, [],
+                            self.gensym("match"))
+                j.fields = list(left.fields)
+                plan = j
+            else:
+                # distinct(left) filtered by membership in right; set ops
+                # treat NULLs as equal ("not distinct"), so keys are
+                # canonical-zero values plus the mask columns — no
+                # key-validity exclusion
+                probe = self._distinct_on_all(left)
+                keys_b = [_canonical_ref(f) for f in right.fields]
+                keys_p = [_canonical_ref(f) for f in probe.fields]
+                j = N.PJoin(kind, right, probe, keys_b, keys_p, [],
+                            self.gensym("match"))
+                j.fields = list(probe.fields)
+                plan = j
         else:
             raise BindError(f"unknown set operation {node.op!r}")
 
@@ -244,6 +261,16 @@ class Binder:
             lim.fields = list(plan.fields)
             plan = lim
         return plan
+
+    def _occurrence_numbered(self, plan: N.PlanNode):
+        """Append a 1..n occurrence column within each duplicate group
+        (row_number window partitioned on every column, order immaterial)
+        — the multiplicity bookkeeping for INTERSECT/EXCEPT ALL."""
+        occ = self.gensym("occ")
+        w = N.PWindow(plan, [_canonical_ref(f) for f in plan.fields], [],
+                      [(occ, "row_number", None)], [None])
+        w.fields = list(plan.fields) + [N.PlanField(occ, T.INT64, None)]
+        return w, occ
 
     def _distinct_on_all(self, plan: N.PlanNode) -> N.PAgg:
         # Nullable columns group by (canonical-zero value, validity mask):
@@ -1120,24 +1147,25 @@ class Binder:
                 else:
                     okeys.append((bound, o.ascending))
             bound_calls = []
+            call_valids = []
             new_fields = []
+            mask_by_valid: dict[str, str] = {}
             for name, func, arg_ast in calls:
-                arg = self.bind_scalar(arg_ast, scope)                     if arg_ast is not None else None
-                if arg is not None and _valid_of(arg) is not None:
-                    v = _valid_of(arg)
-                    if func == "sum":
+                arg = self.bind_scalar(arg_ast, scope) \
+                    if arg_ast is not None else None
+                valid = _valid_of(arg) if arg is not None else None
+                if valid is not None:
+                    # NULL args never contribute: sum/avg zero-fill the
+                    # value (the executor additionally restricts sums to
+                    # valid lanes and divides avg by the valid count);
+                    # min/max exclude invalid lanes executor-side by
+                    # worst-rank substitution — a value-space identity
+                    # fill would be unsound for strings, whose sort order
+                    # is collation rank, not code order
+                    if func in ("sum", "avg"):
                         z = 0.0 if arg.dtype.base == DType.FLOAT64 else 0
-                        arg = ex.CaseWhen(((v, arg),),
+                        arg = ex.CaseWhen(((valid, arg),),
                                           ex.Literal(z, arg.dtype), arg.dtype)
-                    elif func in ("min", "max"):
-                        ident = _dtype_extreme(arg.dtype, func == "min")
-                        arg = ex.CaseWhen(((v, arg),),
-                                          ex.Literal(ident, arg.dtype),
-                                          arg.dtype)
-                    else:
-                        raise BindError(
-                            f"window {func}() over a nullable argument is "
-                            "not supported yet")
                 if func in ("row_number", "rank", "dense_rank", "count"):
                     t = T.INT64
                 elif func == "avg":
@@ -1145,12 +1173,28 @@ class Binder:
                 else:
                     assert arg is not None, f"{func}() needs an argument"
                     t = arg.dtype
-                if func in ("min", "max") and okeys:
-                    raise BindError("running min/max windows not "
-                                    "supported yet (drop ORDER BY)")
+                sd = _expr_dict(arg) if func in ("min", "max") \
+                    and arg is not None else None
                 bound_calls.append((name, func, arg))
-                new_fields.append(N.PlanField(name, t, None))
-            w = N.PWindow(plan, pk, okeys, bound_calls)
+                call_valids.append(valid)
+                if valid is not None and func in ("sum", "min", "max",
+                                                  "avg"):
+                    # agg over an all-NULL frame is NULL — materialize the
+                    # frame's any-valid as this output's hidden null mask
+                    # (one mask per distinct validity expr, shared by every
+                    # call over the same argument)
+                    vkey = repr(valid)
+                    mname = mask_by_valid.get(vkey)
+                    if mname is None:
+                        mname = mask_by_valid[vkey] = self.gensym("vmw")
+                        bound_calls.append((mname, "anyvalid", None))
+                        call_valids.append(valid)
+                        new_fields.append(N.PlanField(mname, T.BOOL, None))
+                    new_fields.append(
+                        N.PlanField(name, t, sd, null_mask=(mname,)))
+                else:
+                    new_fields.append(N.PlanField(name, t, sd))
+            w = N.PWindow(plan, pk, okeys, bound_calls, call_valids)
             w.fields = list(plan.fields) + new_fields
             plan = w
         # window outputs resolve by exact generated name; rebind existing

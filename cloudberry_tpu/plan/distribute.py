@@ -690,6 +690,21 @@ def _node_exprs(node: N.PlanNode):
         yield from node.probe_keys
         if node.residual is not None:
             yield node.residual
+    elif isinstance(node, N.PWindow):
+        yield from node.partition_keys
+        for e, _ in node.order_keys:
+            yield e
+        for _, _, arg in node.calls:
+            if arg is not None:
+                yield arg
+        for vexpr in (node.valids or ()):
+            if vexpr is not None:
+                yield vexpr
+    elif isinstance(node, N.PRuntimeFilter):
+        yield from node.build_keys
+        yield from node.probe_keys
+    elif isinstance(node, N.PMotion):
+        yield from node.hash_keys
 
 
 def _field_ref(plan: N.PlanNode, name: str) -> ex.ColumnRef:

@@ -215,6 +215,13 @@ class PWindow(PlanNode):
     partition_keys: list[ex.Expr]
     order_keys: list[tuple[ex.Expr, bool]]
     calls: list[tuple[str, str, Optional[ex.Expr]]]  # (out, func, arg)
+    # per-call argument-validity exprs (parallel to ``calls``; None entry =
+    # arg provably non-NULL). count() counts only valid rows; avg divides
+    # by the valid count; the pseudo-func 'anyvalid' emits a bool column
+    # that is True where the frame holds ≥1 valid arg — the null_mask for
+    # nullable sum/min/max/avg outputs (SQL: agg over an all-NULL frame is
+    # NULL, src/backend/executor/nodeWindowAgg.c semantics).
+    valids: Optional[list] = None
 
     def children(self):
         return [self.child]

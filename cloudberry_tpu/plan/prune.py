@@ -200,6 +200,9 @@ def _prune(node: N.PlanNode, req: set[str]) -> None:
         for _, _, arg in node.calls:
             if arg is not None:
                 child_req |= _expr_cols(arg)
+        for vexpr in (node.valids or ()):
+            if vexpr is not None:
+                child_req |= _expr_cols(vexpr)
         _prune(node.child, child_req)
         return
 

@@ -1,0 +1,164 @@
+"""INTERSECT ALL / EXCEPT ALL (bag semantics) and the window gaps they
+share machinery with (running min/max, nullable count/avg windows).
+
+Reference behavior: nodeSetOp.c SETOP_HASHED *_ALL modes (per-group
+counters); nodeWindowAgg.c default-frame aggregates. Oracles are computed
+with pandas, same discipline as tests/test_tpch.py.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+
+
+@pytest.fixture(params=[1, 8], ids=["seg1", "seg8"])
+def session(request):
+    return cb.Session(Config(n_segments=request.param))
+
+
+def _load(s, name, rows):
+    s.sql(f"create table {name} (k bigint, v bigint)")
+    vals = ", ".join(f"({k}, {v})" for k, v in rows)
+    s.sql(f"insert into {name} values {vals}")
+
+
+L = [(1, 1), (1, 1), (1, 2), (2, 5), (3, 7), (3, 7), (3, 7), (4, 0)]
+R = [(1, 1), (1, 2), (3, 7), (3, 7), (9, 9)]
+
+
+def _bag_oracle(op):
+    from collections import Counter
+    cl, cr = Counter(L), Counter(R)
+    out = []
+    for key in sorted(set(cl) | set(cr)):
+        n = min(cl[key], cr[key]) if op == "intersect" \
+            else max(cl[key] - cr[key], 0)
+        out.extend([key] * n)
+    return sorted(out)
+
+
+@pytest.mark.parametrize("op", ["intersect", "except"])
+def test_setop_all_bag_semantics(session, op):
+    _load(session, "tl", L)
+    _load(session, "tr", R)
+    got = session.sql(
+        f"select k, v from tl {op} all select k, v from tr "
+        "order by k, v").to_pandas()
+    want = _bag_oracle(op)
+    assert [tuple(r) for r in got[["k", "v"]].to_numpy()] == want
+
+
+def test_setop_all_with_nulls(session):
+    # set ops treat NULLs as equal; ALL keeps multiplicities of NULL rows
+    session.sql("create table nl (k bigint, v bigint)")
+    session.sql("insert into nl values (1, null), (1, null), (1, 1)")
+    session.sql("create table nr (k bigint, v bigint)")
+    session.sql("insert into nr values (1, null), (2, null)")
+    got = session.sql("select k, v from nl intersect all "
+                      "select k, v from nr order by k").to_pandas()
+    # exactly ONE (1, NULL) survives (min(2, 1))
+    assert len(got) == 1
+    assert got["k"].iloc[0] == 1 and pd.isna(got["v"].iloc[0])
+    got2 = session.sql("select k, v from nl except all "
+                       "select k, v from nr order by k, v").to_pandas()
+    # exactly one (1,NULL) and one (1,1) remain
+    from collections import Counter
+    vals = Counter((int(k), None if pd.isna(v) else int(v))
+                   for k, v in got2[["k", "v"]].to_numpy())
+    assert vals == Counter([(1, 1), (1, None)])
+
+
+def test_running_min_max(session):
+    session.sql("create table w (g bigint, t bigint, v bigint)")
+    rng = np.random.default_rng(7)
+    rows = [(int(g), int(t), int(rng.integers(-50, 50)))
+            for g in range(5) for t in range(17)]
+    session.sql("insert into w values " +
+                ", ".join(str(r) for r in rows))
+    got = session.sql(
+        "select g, t, v, min(v) over (partition by g order by t) as rmin, "
+        "max(v) over (partition by g order by t) as rmax "
+        "from w order by g, t").to_pandas()
+    df = pd.DataFrame(rows, columns=["g", "t", "v"]).sort_values(["g", "t"])
+    df["rmin"] = df.groupby("g")["v"].cummin()
+    df["rmax"] = df.groupby("g")["v"].cummax()
+    for c in ("rmin", "rmax"):
+        assert list(got[c]) == list(df[c]), c
+
+
+def test_running_extreme_peers_included(session):
+    # RANGE frame: peers (equal order keys) are all included
+    session.sql("create table p (g bigint, t bigint, v bigint)")
+    session.sql("insert into p values (1,1,5), (1,1,3), (1,2,9), (1,2,1)")
+    got = session.sql(
+        "select t, min(v) over (partition by g order by t) as rmin "
+        "from p order by t, rmin").to_pandas()
+    # t=1 peers both see min(5,3)=3; t=2 peers see min over all four = 1
+    assert list(got["rmin"]) == [3, 3, 1, 1]
+
+
+def test_window_count_avg_nullable(session):
+    session.sql("create table nv (k bigint, v bigint)")
+    session.sql("insert into nv values (1,10),(1,null),(1,30),"
+                "(2,null),(2,null),(3,5)")
+    got = session.sql(
+        "select k, count(v) over (partition by k) as c, "
+        "avg(v) over (partition by k) as a, "
+        "sum(v) over (partition by k) as s, "
+        "min(v) over (partition by k) as mn "
+        "from nv order by k").to_pandas()
+    assert list(got["c"]) == [2, 2, 2, 0, 0, 1]
+    assert got["a"].iloc[0] == pytest.approx(20.0)
+    # all-NULL partition: every aggregate except count is NULL
+    assert pd.isna(got["a"].iloc[3]) and pd.isna(got["s"].iloc[3]) \
+        and pd.isna(got["mn"].iloc[3])
+    assert got["s"].iloc[5] == 5 and got["mn"].iloc[5] == 5
+
+
+def test_running_count_nullable(session):
+    session.sql("create table rc (k bigint, t bigint, v bigint)")
+    session.sql("insert into rc values (1,1,10),(1,2,null),(1,3,7)")
+    got = session.sql(
+        "select t, count(v) over (partition by k order by t) as c, "
+        "sum(v) over (partition by k order by t) as s "
+        "from rc order by t").to_pandas()
+    assert list(got["c"]) == [1, 1, 2]
+    assert list(got["s"]) == [10, 10, 17]
+
+
+def test_window_minmax_nullable_strings(session):
+    # strings order by COLLATION RANK, not dictionary code: insertion
+    # order is adversarial ('zz' gets code 0) so a code-space identity
+    # fill would return the wrong extreme
+    session.sql("create table sw (k bigint, v text)")
+    session.sql("insert into sw values (1,'zz'),(1,'aa'),(1,null),"
+                "(2,null),(2,null)")
+    got = session.sql(
+        "select k, min(v) over (partition by k) as mn, "
+        "max(v) over (partition by k) as mx from sw order by k").to_pandas()
+    assert list(got["mn"][:3]) == ["aa"] * 3
+    assert list(got["mx"][:3]) == ["zz"] * 3
+    assert pd.isna(got["mn"].iloc[3]) and pd.isna(got["mx"].iloc[4])
+    # running variant over the same adversarial dictionary
+    session.sql("create table sw2 (k bigint, t bigint, v text)")
+    session.sql("insert into sw2 values (1,1,'zz'),(1,2,null),(1,3,'aa')")
+    got2 = session.sql(
+        "select t, min(v) over (partition by k order by t) as rmn "
+        "from sw2 order by t").to_pandas()
+    assert list(got2["rmn"]) == ["zz", "zz", "aa"]
+
+
+def test_setop_all_strings(session):
+    session.sql("create table sl (k bigint, name text)")
+    session.sql("insert into sl values (1,'aa'),(1,'aa'),(2,'bb'),(3,'cc')")
+    session.sql("create table sr (k bigint, name text)")
+    session.sql("insert into sr values (1,'aa'),(3,'cc'),(3,'cc')")
+    got = session.sql("select k, name from sl intersect all "
+                      "select k, name from sr order by k").to_pandas()
+    assert [tuple(r) for r in got.to_numpy()] == [(1, "aa"), (3, "cc")]
+    got2 = session.sql("select k, name from sl except all "
+                       "select k, name from sr order by k").to_pandas()
+    assert [tuple(r) for r in got2.to_numpy()] == [(1, "aa"), (2, "bb")]

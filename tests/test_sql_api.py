@@ -168,10 +168,15 @@ def test_intersect_precedence(sess):
     assert df["x"].tolist() == [1, 2]
 
 
-def test_except_all_rejected(sess):
+def test_except_all_supported(sess):
     sess.sql("create table q1 (x int)")
-    with pytest.raises(BindError):
-        sess.sql("select x from q1 except all select x from q1")
+    sess.sql("insert into q1 values (1), (1), (2)")
+    sess.sql("create table q2 (x int)")
+    sess.sql("insert into q2 values (1)")
+    df = sess.sql("select x from q1 except all "
+                  "select x from q2").to_pandas()
+    # bag semantics: ONE copy of 1 removed, the other and the 2 remain
+    assert sorted(df["x"].tolist()) == [1, 2]
 
 
 def test_explain_does_not_mutate_dictionary(sess):
