@@ -70,6 +70,11 @@ class Table:
     # per query; ensure_loaded() materializes for paths that need RAM arrays
     backing: object = None
     cold: bool = False
+    # PARTITION BY spec (gp_partition_template analog): stored writes route
+    # rows into partition-pure micro-partition files so manifest min/max
+    # stats become exact partition bounds — elimination needs no separate
+    # partition catalog. ('range', col, start, end, every) | ('list', col)
+    partition_spec: tuple | None = None
 
     @property
     def num_rows(self) -> int:
@@ -306,13 +311,20 @@ class Catalog:
 
     def create_table(self, name: str, schema: Schema,
                      policy: DistributionPolicy | None = None,
-                     if_not_exists: bool = False) -> Table:
+                     if_not_exists: bool = False,
+                     partition_spec: tuple | None = None) -> Table:
         name = name.lower()
         if name in self.tables:
             if if_not_exists:
                 return self.tables[name]
             raise ValueError(f"table {name!r} already exists")
         t = Table(name, schema, policy or DistributionPolicy.random())
+        if partition_spec is not None:
+            if partition_spec[1] not in schema.names:
+                raise ValueError(
+                    f"partition column {partition_spec[1]!r} is not a "
+                    "column of the table")
+            t.partition_spec = partition_spec
         # empty columns from the start so scans of unpopulated tables work
         t.data = {f.name: np.zeros(0, dtype=f.type.np_dtype)
                   for f in schema.fields}

@@ -90,6 +90,12 @@ class StorageConfig:
     # Rows per micro-partition file — smaller means finer pruning
     # granularity, more files (the AO blocksize / PAX partition-size knob).
     rows_per_partition: int = 1 << 20
+    # Dynamic partition elimination (nodePartitionSelector.c analog): when
+    # an inner/semi join probes a PARTITION BY table on its partition
+    # column and the build side is at most this many rows, the build side
+    # runs host-side first and its key values prune probe partitions
+    # before any fact-table IO. 0 disables.
+    partition_selector_max_build: int = 1 << 17
 
 
 @dataclass(frozen=True)

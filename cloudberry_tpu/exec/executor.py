@@ -515,30 +515,31 @@ class Lowerer:
                 # peers included via run_end): segmented scan over sorted
                 # rows. The combine is the standard segmented-scan operator
                 # (reset flag ? right : extreme(left, right)) with the
-                # extreme taken lexicographically over (sort rank, code) so
-                # it stays associative on ties. NULL lanes get the worst
-                # possible rank so they never win (an all-NULL prefix is
-                # nullified by the 'anyvalid' mask).
+                # extreme taken lexicographically over (validity desc,
+                # sort rank, code) so it stays associative on ties and an
+                # invalid (NULL) lane can NEVER beat a valid one — not
+                # even when a valid value equals the dtype extreme (an
+                # all-NULL prefix is nullified by the 'anyvalid' mask).
                 v = self.expr(arg, cols)
                 ks = _sortable(arg, node.child, cols)[perm]
                 cs = v[perm]
                 mx = func == "max"
-                if valid is not None:
-                    ks = jnp.where(va, ks, _worst_rank(ks.dtype, mx))
 
                 def comb(a, b, mx=mx):
-                    f1, r1, c1 = a
-                    f2, r2, c2 = b
+                    f1, w1, r1, c1 = a
+                    f2, w2, r2, c2 = b
                     if mx:
-                        better = (r2 > r1) | ((r2 == r1) & (c2 > c1))
+                        by_rank = (r2 > r1) | ((r2 == r1) & (c2 > c1))
                     else:
-                        better = (r2 < r1) | ((r2 == r1) & (c2 < c1))
+                        by_rank = (r2 < r1) | ((r2 == r1) & (c2 < c1))
+                    better = (w2 & ~w1) | ((w2 == w1) & by_rank)
                     take2 = f2 | better
-                    return (f1 | f2, jnp.where(take2, r2, r1),
+                    return (f1 | f2, jnp.where(take2, w2, w1),
+                            jnp.where(take2, r2, r1),
                             jnp.where(take2, c2, c1))
 
-                _, _, runext = jax.lax.associative_scan(
-                    comb, (seg_flag, ks, cs))
+                _, _, _, runext = jax.lax.associative_scan(
+                    comb, (seg_flag, va, ks, cs))
                 o = runext[run_end]
             elif func in ("min", "max"):
                 # whole-partition extreme: re-sort with the value last; the
@@ -773,14 +774,6 @@ class Lowerer:
             out_aggs = {n: jnp.pad(c, (0, pad)) for n, c in out_aggs.items()}
             occupied = jnp.pad(occupied, (0, pad))
         return {**out_keys, **out_aggs}, occupied
-
-
-def _worst_rank(dtype, for_max: bool):
-    """The rank value a lane must hold to never win a min/max scan."""
-    if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.array(-jnp.inf if for_max else jnp.inf, dtype)
-    info = jnp.iinfo(dtype)
-    return jnp.array(info.min if for_max else info.max, dtype)
 
 
 def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:

@@ -96,6 +96,8 @@ class PScan(PlanNode):
             if skips:
                 base += (f" (minmax-skip {rep['skipped_minmax']}, "
                          f"bloom-skip {rep['skipped_bloom']})")
+            if rep.get("skipped_dynamic"):
+                base += f" (partition-selector-skip {rep['skipped_dynamic']})"
         return base
 
 

@@ -48,7 +48,8 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
             "random": DistributionPolicy.random(),
         }[stmt.distribution]
         catalog.create_table(stmt.name, Schema(tuple(fields)), policy,
-                             if_not_exists=stmt.if_not_exists)
+                             if_not_exists=stmt.if_not_exists,
+                             partition_spec=stmt.partition)
         return PlanResult(is_ddl=True, ddl_result=f"CREATE TABLE {stmt.name}")
 
     if isinstance(stmt, ast.CreateTableAs):

@@ -259,6 +259,9 @@ class CreateTable(Node):
     distribution: str = "random"  # 'hash' | 'random' | 'replicated'
     dist_keys: tuple[str, ...] = ()
     if_not_exists: bool = False
+    # PARTITION BY clause (gram.y partition grammar analog):
+    # ('range', col, start, end, every) | ('list', col) | None
+    partition: Optional[tuple] = None
 
 
 @dataclass

@@ -173,8 +173,52 @@ class Parser:
                 break
         self.expect_op(")")
         distribution, keys = self._parse_distribution()
+        partition = self._parse_partition()
+        if distribution is None:
+            # DISTRIBUTED may follow PARTITION too (order is free)
+            distribution, keys = self._parse_distribution()
         return ast.CreateTable(name, cols, distribution or "random",
-                               keys or (), if_not_exists)
+                               keys or (), if_not_exists, partition)
+
+    def _parse_partition(self):
+        """PARTITION BY RANGE (col) (START a END b EVERY s) | LIST (col)
+        — the gram.y partition-clause analog, numeric bounds only."""
+        if not self.at_kw("partition"):
+            return None
+        self.advance()
+        self.expect_kw("by")
+        if self.accept_kw("range"):
+            self.expect_op("(")
+            col = self.expect_ident()
+            self.expect_op(")")
+            self.expect_op("(")
+            self.expect_kw("start")
+            start = self._partition_bound()
+            self.expect_kw("end")
+            end = self._partition_bound()
+            self.expect_kw("every")
+            every = self._partition_bound()
+            self.expect_op(")")
+            if every <= 0 or end <= start:
+                raise ParseError("PARTITION BY RANGE needs END > START "
+                                 "and EVERY > 0")
+            return ("range", col, start, end, every)
+        if self.accept_kw("list"):
+            self.expect_op("(")
+            col = self.expect_ident()
+            self.expect_op(")")
+            return ("list", col)
+        raise ParseError("PARTITION BY expects RANGE or LIST")
+
+    def _partition_bound(self) -> int:
+        neg = bool(self.accept_op("-"))
+        tok = self.advance()
+        try:
+            v = int(tok.text)
+        except ValueError:
+            raise ParseError(
+                f"partition bound must be an integer, got {tok.text!r}")
+        return -v if neg else v
 
     def _parse_distribution(self):
         if not self.accept_kw("distributed"):

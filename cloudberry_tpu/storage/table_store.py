@@ -227,7 +227,8 @@ class TableStore:
                rows_per_partition: int = 1 << 20,
                replace: bool = False, policy=None,
                validity: dict[str, np.ndarray] | None = None,
-               unique: dict[str, bool] | None = None) -> int:
+               unique: dict[str, bool] | None = None,
+               partition_spec: tuple | None = None) -> int:
         """Append rows as new micro-partitions (``replace=True``: the new
         snapshot contains ONLY these rows — still one atomic commit, so a
         crash mid-write never publishes an empty intermediate).
@@ -250,19 +251,29 @@ class TableStore:
                 phys_data[f"$nn:{c}"] = np.asarray(v, dtype=np.bool_)
                 extra.append(TField(f"$nn:{c}", BOOL))
             phys_schema = Schema(tuple(schema.fields) + tuple(extra))
+        spec = partition_spec if partition_spec is not None \
+            else (tuple(man["partition_spec"])
+                  if man.get("partition_spec") else None)
         new_parts = []
-        for lo in range(0, max(n, 1), rows_per_partition):
-            hi = min(lo + rows_per_partition, n)
-            if hi <= lo:
-                break
-            chunk = {k: v[lo:hi] for k, v in phys_data.items()}
-            fname = f"part-{uuid.uuid4().hex}.cbmp"
-            footer = mp.write_micropartition(
-                os.path.join(tdir, fname), chunk, phys_schema, dicts)
-            stats = {c["name"]: [c["min"], c["max"]]
-                     for c in footer["columns"] if "min" in c}
-            new_parts.append({"file": fname, "num_rows": hi - lo,
-                              "stats": stats, "deleted": []})
+        for pkey, idx in _partition_rows(spec, phys_data, n):
+            group = phys_data if idx is None \
+                else {k: v[idx] for k, v in phys_data.items()}
+            gn = n if idx is None else len(idx)
+            for lo in range(0, max(gn, 1), rows_per_partition):
+                hi = min(lo + rows_per_partition, gn)
+                if hi <= lo:
+                    break
+                chunk = {k: v[lo:hi] for k, v in group.items()}
+                fname = f"part-{uuid.uuid4().hex}.cbmp"
+                footer = mp.write_micropartition(
+                    os.path.join(tdir, fname), chunk, phys_schema, dicts)
+                stats = {c["name"]: [c["min"], c["max"]]
+                         for c in footer["columns"] if "min" in c}
+                entry = {"file": fname, "num_rows": hi - lo,
+                         "stats": stats, "deleted": []}
+                if pkey is not None:
+                    entry["pkey"] = pkey
+                new_parts.append(entry)
         # dictionaries are table-level, append-only state: a new dict must
         # EXTEND the stored one (codes in already-written partitions keep
         # decoding correctly); anything else is a caller error, not silent
@@ -278,6 +289,8 @@ class TableStore:
             man["unique"] = unique
         if policy is not None:
             man["policy"] = {"kind": policy.kind, "keys": list(policy.keys)}
+        if spec is not None:
+            man["partition_spec"] = list(spec)
         old_dicts = man.get("dicts", {}) if not replace else {}
         new_dicts = {k: list(d.values) for k, d in (dicts or {}).items()}
         for k, old in old_dicts.items():
@@ -335,16 +348,29 @@ class TableStore:
                                   for c, (lo, hi) in ranges.items()):
                 report["skipped_minmax"] += 1
                 continue
-            if eqs:
-                footer = mp.read_footer(os.path.join(tdir, part["file"]))
-                encs = {c["name"]: c for c in footer["columns"]}
-                if any(c in encs
-                       and not mp.bloom_may_contain(encs[c], v)
-                       for c, v in eqs.items()):
-                    report["skipped_bloom"] += 1
-                    continue
+            if eqs and not self.bloom_may_match(
+                    table, part, {c: [v] for c, v in eqs.items()}):
+                report["skipped_bloom"] += 1
+                continue
             out.append(part)
         return out, report
+
+    def bloom_may_match(self, table: str, part: dict,
+                        col_values: dict) -> bool:
+        """One footer read answering: could this partition hold ANY of the
+        given values in EVERY listed column? (False = provably not — the
+        shared membership primitive for eq pruning and the partition
+        selector.)"""
+        footer = mp.read_footer(
+            os.path.join(self.root, table, part["file"]))
+        encs = {c["name"]: c for c in footer["columns"]}
+        for col, vals in col_values.items():
+            enc = encs.get(col)
+            if enc is None:
+                continue
+            if not any(mp.bloom_may_contain(enc, v) for v in vals):
+                return False
+        return True
 
     def read_partitions(self, table: str, parts: list[dict],
                         columns: list[str] | None = None,
@@ -421,6 +447,7 @@ class TableStore:
         v = self.append(t.name, t.data, t.schema, t.dicts, replace=True,
                         policy=t.policy, validity=t.validity,
                         unique=unique,
+                        partition_spec=t.partition_spec,
                         rows_per_partition=rows_per_partition)
         if t.stats.ndv:
             # ANALYZE output survives the snapshot (deferred-commit path)
@@ -474,6 +501,8 @@ class TableStore:
         from cloudberry_tpu.catalog.catalog import Table
 
         t = Table(name, Schema(fields), policy)
+        if man.get("partition_spec"):
+            t.partition_spec = tuple(man["partition_spec"])
         t.data = {f.name: np.zeros(0, dtype=f.type.np_dtype)
                   for f in fields}
         catalog.adopt(t)  # no create_table: must not write a new snapshot
@@ -523,6 +552,42 @@ class TableStore:
         t.dicts = dicts
         t.set_data(data, dicts, validity=validity)
         return t
+
+
+def _partition_rows(spec, phys_data: dict, n: int):
+    """Yield (pkey, row_indices) groups per the PARTITION BY spec — each
+    group becomes partition-pure files whose manifest min/max stats are
+    exact partition bounds (the reference keeps a partition catalog +
+    PartitionSelector; here the stats ARE the partition metadata). Rows
+    outside the declared RANGE land in a DEFAULT-partition analog."""
+    if spec is None or n == 0:
+        yield None, None
+        return
+    kind, col = spec[0], spec[1]
+    vals = phys_data.get(col)
+    if vals is None:  # partition column pruned out of this write — no route
+        yield None, None
+        return
+    v = np.asarray(vals)
+    if kind == "range":
+        start, end, every = int(spec[2]), int(spec[3]), int(spec[4])
+        # floor_divide BEFORE any int cast: truncation toward zero would
+        # misroute negative fractional values into the wrong bucket
+        if v.dtype.kind == "f":
+            ids = np.floor_divide(v - start, every).astype(np.int64)
+        else:
+            ids = np.floor_divide(v.astype(np.int64) - start, every)
+        nbuckets = -(-(end - start) // every)
+        ids = np.where((v < start) | (v >= end), np.int64(-1), ids)
+        for b in range(-1, nbuckets):
+            idx = np.nonzero(ids == b)[0]
+            if len(idx):
+                yield ("default" if b < 0
+                       else f"r{start + b * every}"), idx
+    else:  # list
+        for val in np.unique(v):
+            idx = np.nonzero(v == val)[0]
+            yield f"l{val}", idx
 
 
 def _part_may_match(part: dict, col: str, lo, hi) -> bool:

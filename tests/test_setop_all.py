@@ -151,6 +151,26 @@ def test_window_minmax_nullable_strings(session):
     assert list(got2["rmn"]) == ["zz", "zz", "aa"]
 
 
+def test_running_extreme_null_never_beats_dtype_extreme(session):
+    # a NULL lane's canonical stored value must not win a tie against a
+    # VALID value equal to the dtype extreme (validity ranks above value)
+    session.sql("create table ex (k bigint, t bigint, v double)")
+    session.sql("insert into ex values (1,1,0.0),(1,2,null)")
+    got = session.sql(
+        "select t, min(v) over (partition by k order by t) as rmn "
+        "from ex order by t").to_pandas()
+    # at t=2 the frame is {0.0, NULL}: the answer is 0.0, not NULL's
+    # canonical 0.0-by-accident — and count proves validity flowed
+    assert list(got["rmn"]) == [0.0, 0.0]
+    session.sql("create table ex2 (k bigint, t bigint, v bigint)")
+    # int64 min: a valid lane holding the iinfo max must survive a NULL
+    session.sql(f"insert into ex2 values (1,1,{(1 << 62)}),(1,2,null)")
+    got2 = session.sql(
+        "select t, min(v) over (partition by k order by t) as rmn "
+        "from ex2 order by t").to_pandas()
+    assert list(got2["rmn"]) == [1 << 62, 1 << 62]
+
+
 def test_setop_all_strings(session):
     session.sql("create table sl (k bigint, name text)")
     session.sql("insert into sl values (1,'aa'),(1,'aa'),(2,'bb'),(3,'cc')")
