@@ -297,9 +297,89 @@ class Catalog:
         # bumped on any DDL that can change name resolution (view create/
         # drop, table create/drop) — statement caches key on it
         self.ddl_version: int = 0
+        # sequences (gp_fastsequence / '?'-message analog): storeless
+        # sessions keep state here; store-backed sessions delegate every
+        # allocation to the store's locked _SEQUENCES.json so all sessions
+        # draw from one coordinator-owned number line. nextval never rolls
+        # back (PostgreSQL semantics) — deliberately outside txn snapshots.
+        self.sequences: dict[str, dict] = {}
+        self._seq_currval: dict[str, int] = {}  # session-local currval
+        # storeless allocation is read-modify-write on shared session
+        # state — server handler threads share one Session, so it needs
+        # its own lock (the store path is covered by the store file lock)
+        self._seq_lock = __import__("threading").Lock()
 
     def bump_ddl(self) -> None:
         self.ddl_version += 1
+
+    # ------------------------------------------------------------ sequences
+
+    def create_sequence(self, name: str, start: int = 1, increment: int = 1,
+                        if_not_exists: bool = False) -> None:
+        name = name.lower()
+        if self.store is not None:
+            self.store.create_sequence(name, start, increment, if_not_exists)
+            return
+        with self._seq_lock:
+            if name in self.sequences:
+                if if_not_exists:
+                    return
+                raise ValueError(f"sequence {name!r} already exists")
+            self.sequences[name] = {"next": int(start),
+                                    "inc": int(increment)}
+
+    def drop_sequence(self, name: str, if_exists: bool = False) -> None:
+        name = name.lower()
+        if self.store is not None:
+            self.store.drop_sequence(name, if_exists)
+            self._seq_currval.pop(name, None)
+            return
+        if name not in self.sequences:
+            if if_exists:
+                return
+            raise KeyError(f"unknown sequence {name!r}")
+        del self.sequences[name]
+        self._seq_currval.pop(name, None)
+
+    def seq_nextval(self, name: str) -> int:
+        """Allocate the next value — the segments-fetch-from-the-QD
+        protocol (postgres.c '?' message, cdb_sequence_nextval_qe): the
+        coordinator owns the number line; here that is the locked store
+        file (durable) or this catalog under its own lock."""
+        name = name.lower()
+        if self.store is not None:
+            base = self.store.sequence_alloc(name)
+        else:
+            with self._seq_lock:
+                s = self.sequences.get(name)
+                if s is None:
+                    raise KeyError(f"unknown sequence {name!r}")
+                base = s["next"]
+                s["next"] = base + s["inc"]
+        self._seq_currval[name] = base
+        return base
+
+    def seq_currval(self, name: str) -> int:
+        name = name.lower()
+        v = self._seq_currval.get(name)
+        if v is None:
+            raise ValueError(
+                f"currval of sequence {name!r} is not yet defined in "
+                "this session")
+        return v
+
+    def seq_setval(self, name: str, value: int) -> int:
+        name = name.lower()
+        if self.store is not None:
+            self.store.sequence_setval(name, value)
+        else:
+            with self._seq_lock:
+                s = self.sequences.get(name)
+                if s is None:
+                    raise KeyError(f"unknown sequence {name!r}")
+                s["next"] = int(value) + s["inc"]
+        self._seq_currval[name] = int(value)
+        return int(value)
 
     def adopt(self, t: "Table") -> "Table":
         """Register an externally-constructed table (store registration)

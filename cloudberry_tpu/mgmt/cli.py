@@ -92,6 +92,9 @@ def cmd_state(args) -> int:
                        for p in man["partitions"])
             print(f"table {name}: v{man['version']}, "
                   f"{len(man['partitions'])} partitions, {rows} rows")
+    for sname in ts.sequence_names():
+        s = ts._read_sequences()[sname]
+        print(f"sequence {sname}: next {s['next']} (increment {s['inc']})")
     return 0
 
 

@@ -28,7 +28,8 @@ class PlanResult:
     plan: Optional[N.PlanNode] = None
 
 
-def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
+def plan_statement(stmt: ast.Node, session, params: dict,
+                   explain_only: bool = False) -> PlanResult:
     catalog = session.catalog
 
     if isinstance(stmt, ast.CreateTable):
@@ -54,6 +55,23 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
 
     if isinstance(stmt, ast.CreateTableAs):
         return PlanResult(is_ddl=True, ddl_result=_ctas(session, stmt))
+
+    if isinstance(stmt, ast.CreateSequence):
+        try:
+            catalog.create_sequence(stmt.name, stmt.start, stmt.increment,
+                                    if_not_exists=stmt.if_not_exists)
+        except ValueError as e:
+            raise BindError(str(e))
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"CREATE SEQUENCE {stmt.name}")
+
+    if isinstance(stmt, ast.DropSequence):
+        try:
+            catalog.drop_sequence(stmt.name, if_exists=stmt.if_exists)
+        except KeyError as e:
+            raise BindError(str(e.args[0]))
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"DROP SEQUENCE {stmt.name}")
 
     if isinstance(stmt, ast.CreateView):
         if stmt.name.lower() in catalog.tables:
@@ -83,15 +101,34 @@ def plan_statement(stmt: ast.Node, session, params: dict) -> PlanResult:
                           ddl_result=_insert_values(catalog, stmt))
 
     if isinstance(stmt, ast.Explain):
+        inner = stmt.stmt
+        if isinstance(inner, ast.Select) and not inner.from_refs:
+            # plain EXPLAIN has no side effects: fold sequence calls to a
+            # placeholder WITHOUT allocating (PostgreSQL semantics)
+            inner = _fold_sequence_calls(catalog, inner, allocate=False)
         binder = Binder(catalog)
-        plan = binder.bind_query(stmt.stmt)
+        plan = binder.bind_query(inner)
         plan = _optimize(plan, session)
         return PlanResult(is_ddl=True, ddl_result=plan.explain())
 
     if isinstance(stmt, (ast.Select, ast.SetOp, ast.WithQuery)):
+        folded = False
+        if isinstance(stmt, ast.Select) and not stmt.from_refs:
+            # FROM-less sequence calls evaluate host-side at the QD — the
+            # coordinator owns the number line (sequence.c '?' protocol).
+            # Session.explain() plans without executing, so it must not
+            # consume values (allocate=False placeholder fold).
+            stmt2 = _fold_sequence_calls(catalog, stmt,
+                                         allocate=not explain_only)
+            folded = stmt2 is not stmt
+            stmt = stmt2
         binder = Binder(catalog)
         plan = binder.bind_query(stmt)
         plan = _optimize(plan, session)
+        if folded:
+            # replaying a cached program would replay the SAME value —
+            # sequence statements must re-plan every execution
+            plan._no_stmt_cache = True
         return PlanResult(plan=plan)
 
     if isinstance(stmt, ast.Analyze):
@@ -551,7 +588,9 @@ def _insert_values(catalog, stmt: ast.InsertValues) -> str:
         if len(row) != len(cols):
             raise BindError("INSERT row arity mismatch")
         for c, v in zip(cols, row):
-            by_col[c].append(_literal_value(v))
+            sv = _eval_sequence_call(catalog, v)
+            by_col[c].append(str(sv) if sv is not None
+                             else _literal_value(v))
     new_data = {}
     new_valid = {}
     for f in table.schema.fields:
@@ -628,6 +667,75 @@ def _int_literal(v) -> int:
         return int(math.floor(x + 0.5)) if x >= 0 else \
             int(math.ceil(x - 0.5))
     return _exact_decimal(text, 0)  # digit-exact, rounds half up
+
+
+_SEQ_FUNCS = ("nextval", "currval", "setval")
+
+
+def _signed_int_lit(e: ast.ExprNode):
+    """Integer from a NumberLit or a negated NumberLit, else None."""
+    if isinstance(e, ast.NumberLit):
+        try:
+            return int(e.text)
+        except ValueError:
+            return None
+    if isinstance(e, ast.UnaryOp) and e.op == "-":
+        v = _signed_int_lit(e.operand)
+        return -v if v is not None else None
+    return None
+
+
+def _eval_sequence_call(catalog, e: ast.ExprNode):
+    """Evaluate nextval/currval/setval('name'[, n]) host-side, or None if
+    ``e`` is not a sequence call. Allocation goes through the durable
+    store's locked number line when one is bound (catalog.seq_* )."""
+    if not (isinstance(e, ast.FuncCall) and e.name in _SEQ_FUNCS):
+        return None
+    if not e.args or not isinstance(e.args[0], ast.StringLit):
+        raise BindError(f"{e.name}() takes a quoted sequence name")
+    name = e.args[0].value
+    try:
+        if e.name == "nextval":
+            return catalog.seq_nextval(name)
+        if e.name == "currval":
+            return catalog.seq_currval(name)
+        val = _signed_int_lit(e.args[1]) if len(e.args) == 2 else None
+        if val is None:
+            raise BindError("setval('name', value) takes an integer value")
+        return catalog.seq_setval(name, val)
+    except KeyError as k:
+        raise BindError(str(k.args[0]))
+    except ValueError as v:
+        raise BindError(str(v))
+
+
+def _fold_sequence_calls(catalog, sel: ast.Select,
+                         allocate: bool = True) -> ast.Select:
+    """Replace sequence calls in a FROM-less select list with the values
+    they evaluate to (each call evaluated exactly once, left to right).
+    ``allocate=False`` (plain EXPLAIN): a zero placeholder binds the same
+    plan shape with NO state change — EXPLAIN never consumes values."""
+    if not any(isinstance(i.expr, ast.FuncCall)
+               and i.expr.name in _SEQ_FUNCS for i in sel.items):
+        return sel
+    items = []
+    for i, item in enumerate(sel.items):
+        if not allocate and isinstance(item.expr, ast.FuncCall) \
+                and item.expr.name in _SEQ_FUNCS:
+            alias = item.alias or item.expr.name
+            items.append(ast.SelectItem(ast.NumberLit("0"), alias))
+            continue
+        v = _eval_sequence_call(catalog, item.expr)
+        if v is None:
+            items.append(item)
+        else:
+            alias = item.alias or item.expr.name
+            items.append(ast.SelectItem(ast.NumberLit(str(v)), alias))
+    return ast.Select(items=items, from_refs=sel.from_refs,
+                      where=sel.where, group_by=sel.group_by,
+                      having=sel.having, order_by=sel.order_by,
+                      limit=sel.limit, offset=sel.offset,
+                      distinct=sel.distinct)
 
 
 def _literal_value(e: ast.ExprNode):

@@ -303,7 +303,8 @@ class Session:
             exe = X.compile_plan(plan, self)
             runner = lambda: X.run_executable(
                 exe, X.prepare_inputs(exe, self))
-        self._cache_statement(query, names, runner)
+        if not getattr(plan, "_no_stmt_cache", False):
+            self._cache_statement(query, names, runner)
         return runner()
 
     def _cache_statement(self, query: str, names, runner) -> None:
@@ -321,7 +322,7 @@ class Session:
 
         self._sync_store()
         stmt = parse_sql(query)
-        result = plan_statement(stmt, self, {})
+        result = plan_statement(stmt, self, {}, explain_only=True)
         if result.is_ddl:
             return str(result.ddl_result)
         return result.plan.explain()

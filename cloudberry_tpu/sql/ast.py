@@ -274,6 +274,20 @@ class CreateTableAs(Node):
 
 
 @dataclass
+class CreateSequence(Node):
+    name: str
+    start: int = 1
+    increment: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class CreateView(Node):
     name: str
     query: Node  # Select or SetOp

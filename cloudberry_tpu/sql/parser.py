@@ -93,16 +93,22 @@ class Parser:
             return self.parse_create_table()
         if self.at_kw("drop"):
             self.advance()
-            is_view = bool(self.accept_kw("view"))
-            if not is_view:
+            kind = "table"
+            if self.accept_kw("view"):
+                kind = "view"
+            elif self.accept_kw("sequence"):
+                kind = "sequence"
+            else:
                 self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):
                 self.expect_kw("exists")
                 if_exists = True
             name = self.expect_ident()
-            if is_view:
+            if kind == "view":
                 return ast.DropView(name, if_exists)
+            if kind == "sequence":
+                return ast.DropSequence(name, if_exists)
             return ast.DropTable(name, if_exists)
         if self.at_kw("insert"):
             return self.parse_insert()
@@ -136,6 +142,24 @@ class Parser:
             name = self.expect_ident()
             self.expect_kw("as")
             return ast.CreateView(name, self.parse_query())
+        if self.accept_kw("sequence"):
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            name = self.expect_ident()
+            start, inc = 1, 1
+            while True:
+                if self.accept_kw("start"):
+                    self.accept_kw("with")
+                    start = self._signed_int()
+                elif self.accept_kw("increment"):
+                    self.accept_kw("by")
+                    inc = self._signed_int()
+                else:
+                    break
+            return ast.CreateSequence(name, start, inc, if_not_exists)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -193,11 +217,11 @@ class Parser:
             self.expect_op(")")
             self.expect_op("(")
             self.expect_kw("start")
-            start = self._partition_bound()
+            start = self._signed_int()
             self.expect_kw("end")
-            end = self._partition_bound()
+            end = self._signed_int()
             self.expect_kw("every")
-            every = self._partition_bound()
+            every = self._signed_int()
             self.expect_op(")")
             if every <= 0 or end <= start:
                 raise ParseError("PARTITION BY RANGE needs END > START "
@@ -210,14 +234,14 @@ class Parser:
             return ("list", col)
         raise ParseError("PARTITION BY expects RANGE or LIST")
 
-    def _partition_bound(self) -> int:
+    def _signed_int(self) -> int:
         neg = bool(self.accept_op("-"))
         tok = self.advance()
         try:
             v = int(tok.text)
         except ValueError:
             raise ParseError(
-                f"partition bound must be an integer, got {tok.text!r}")
+                f"expected an integer, got {tok.text!r}")
         return -v if neg else v
 
     def _parse_distribution(self):

@@ -435,6 +435,75 @@ class TableStore:
         dicts = {k: StringDictionary(v) for k, v in man["dicts"].items()}
         return cols, schema, dicts
 
+    # ------------------------------------------------------------ sequences
+    # Durable, store-wide sequences (the gp_fastsequence / QD-owned nextval
+    # analog): one JSON file guarded by the store lock; allocation is
+    # write-through (nextval never rolls back — PostgreSQL semantics) and
+    # every session on the root draws from the same number line.
+
+    def _seq_path(self) -> str:
+        return os.path.join(self.root, "_SEQUENCES.json")
+
+    def _read_sequences(self) -> dict:
+        try:
+            with open(self._seq_path()) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+
+    def _write_sequences(self, seqs: dict) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        with os.fdopen(fd, "w") as f:
+            json.dump(seqs, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._seq_path())
+
+    def create_sequence(self, name: str, start: int = 1, increment: int = 1,
+                        if_not_exists: bool = False) -> None:
+        with self.lock():
+            seqs = self._read_sequences()
+            if name in seqs:
+                if if_not_exists:
+                    return
+                raise ValueError(f"sequence {name!r} already exists")
+            seqs[name] = {"next": int(start), "inc": int(increment)}
+            self._write_sequences(seqs)
+
+    def drop_sequence(self, name: str, if_exists: bool = False) -> None:
+        with self.lock():
+            seqs = self._read_sequences()
+            if name not in seqs:
+                if if_exists:
+                    return
+                raise KeyError(f"unknown sequence {name!r}")
+            del seqs[name]
+            self._write_sequences(seqs)
+
+    def sequence_alloc(self, name: str) -> int:
+        """Reserve and return the next value."""
+        with self.lock():
+            seqs = self._read_sequences()
+            s = seqs.get(name)
+            if s is None:
+                raise KeyError(f"unknown sequence {name!r}")
+            base = s["next"]
+            s["next"] = base + s["inc"]
+            self._write_sequences(seqs)
+            return base
+
+    def sequence_setval(self, name: str, value: int) -> None:
+        with self.lock():
+            seqs = self._read_sequences()
+            s = seqs.get(name)
+            if s is None:
+                raise KeyError(f"unknown sequence {name!r}")
+            s["next"] = int(value) + s["inc"]
+            self._write_sequences(seqs)
+
+    def sequence_names(self) -> list[str]:
+        return sorted(self._read_sequences())
+
     # ------------------------------------------------------ session bridge
 
     def save_table(self, t, rows_per_partition: int = 1 << 20) -> int:
