@@ -303,6 +303,9 @@ class Catalog:
         # draw from one coordinator-owned number line. nextval never rolls
         # back (PostgreSQL semantics) — deliberately outside txn snapshots.
         self.sequences: dict[str, dict] = {}
+        # materialized views: name -> plan/matview.MatViewDef (the data
+        # lives in an ordinary table of the same name)
+        self.matviews: dict[str, object] = {}
         self._seq_currval: dict[str, int] = {}  # session-local currval
         # storeless allocation is read-modify-write on shared session
         # state — server handler threads share one Session, so it needs
@@ -317,6 +320,8 @@ class Catalog:
     def create_sequence(self, name: str, start: int = 1, increment: int = 1,
                         if_not_exists: bool = False) -> None:
         name = name.lower()
+        if increment == 0:
+            raise ValueError("INCREMENT must not be zero")
         if self.store is not None:
             self.store.create_sequence(name, start, increment, if_not_exists)
             return
@@ -334,11 +339,12 @@ class Catalog:
             self.store.drop_sequence(name, if_exists)
             self._seq_currval.pop(name, None)
             return
-        if name not in self.sequences:
-            if if_exists:
-                return
-            raise KeyError(f"unknown sequence {name!r}")
-        del self.sequences[name]
+        with self._seq_lock:
+            if name not in self.sequences:
+                if if_exists:
+                    return
+                raise KeyError(f"unknown sequence {name!r}")
+            del self.sequences[name]
         self._seq_currval.pop(name, None)
 
     def seq_nextval(self, name: str) -> int:

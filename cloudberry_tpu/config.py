@@ -61,6 +61,9 @@ class PlannerConfig:
     # plannodes.h:1638): immune to hash-space skew across destinations,
     # and cheaper than an all_to_all for small partials. 0 disables.
     gather_single_threshold: int = 8192
+    # Answer-query-using-matview rewrite (aqumv.c): SELECTs subsumed by a
+    # FRESH aggregate materialized view read the view instead.
+    enable_aqumv: bool = True
 
 
 @dataclass(frozen=True)

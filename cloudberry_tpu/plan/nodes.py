@@ -68,6 +68,9 @@ class PlanNode:
         if seg is not None and indent == 0:
             lines.append(f"Direct dispatch: segment {seg} "
                          "(point predicate on distribution key)")
+        mv = getattr(self, "_aqumv", None)
+        if mv is not None and indent == 0:
+            lines.append(f"AQUMV: answered from materialized view {mv}")
         lines.append(" " * indent + "-> " + self.title()
                      + (f"  [{self.sharding}]" if self.sharding else ""))
         for c in self.children():

@@ -73,6 +73,33 @@ def plan_statement(stmt: ast.Node, session, params: dict,
         return PlanResult(is_ddl=True,
                           ddl_result=f"DROP SEQUENCE {stmt.name}")
 
+    if isinstance(stmt, ast.CreateMatView):
+        from cloudberry_tpu.plan import matview as MV
+
+        try:
+            return PlanResult(is_ddl=True,
+                              ddl_result=MV.create_matview(session, stmt))
+        except MV.MatViewError as e:
+            raise BindError(str(e))
+
+    if isinstance(stmt, ast.DropMatView):
+        from cloudberry_tpu.plan import matview as MV
+
+        try:
+            return PlanResult(is_ddl=True, ddl_result=MV.drop_matview(
+                session, stmt.name, stmt.if_exists))
+        except MV.MatViewError as e:
+            raise BindError(str(e))
+
+    if isinstance(stmt, ast.RefreshMatView):
+        from cloudberry_tpu.plan import matview as MV
+
+        try:
+            return PlanResult(is_ddl=True, ddl_result=MV.refresh_matview(
+                session, stmt.name))
+        except MV.MatViewError as e:
+            raise BindError(str(e))
+
     if isinstance(stmt, ast.CreateView):
         if stmt.name.lower() in catalog.tables:
             raise BindError(f"{stmt.name!r} already exists as a table")
@@ -97,8 +124,9 @@ def plan_statement(stmt: ast.Node, session, params: dict,
         return PlanResult(is_ddl=True, ddl_result=f"DROP TABLE {stmt.name}")
 
     if isinstance(stmt, ast.InsertValues):
-        return PlanResult(is_ddl=True,
-                          ddl_result=_insert_values(catalog, stmt))
+        res = _insert_values(catalog, stmt)
+        _maintain(session, stmt.table, appended=len(stmt.rows))
+        return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.Explain):
         inner = stmt.stmt
@@ -106,9 +134,19 @@ def plan_statement(stmt: ast.Node, session, params: dict,
             # plain EXPLAIN has no side effects: fold sequence calls to a
             # placeholder WITHOUT allocating (PostgreSQL semantics)
             inner = _fold_sequence_calls(catalog, inner, allocate=False)
+        aqumv_from = None
+        if isinstance(inner, ast.Select) \
+                and session.config.planner.enable_aqumv:
+            # EXPLAIN must show the plan that would EXECUTE — including
+            # the matview rewrite
+            from cloudberry_tpu.plan import matview as MV
+
+            inner, aqumv_from = MV.aqumv_rewrite(session, inner)
         binder = Binder(catalog)
         plan = binder.bind_query(inner)
         plan = _optimize(plan, session)
+        if aqumv_from is not None:
+            plan._aqumv = aqumv_from
         return PlanResult(is_ddl=True, ddl_result=plan.explain())
 
     if isinstance(stmt, (ast.Select, ast.SetOp, ast.WithQuery)):
@@ -122,12 +160,23 @@ def plan_statement(stmt: ast.Node, session, params: dict,
                                          allocate=not explain_only)
             folded = stmt2 is not stmt
             stmt = stmt2
+        aqumv_from = None
+        if isinstance(stmt, ast.Select) \
+                and session.config.planner.enable_aqumv:
+            from cloudberry_tpu.plan import matview as MV
+
+            stmt, aqumv_from = MV.aqumv_rewrite(session, stmt)
         binder = Binder(catalog)
         plan = binder.bind_query(stmt)
         plan = _optimize(plan, session)
         if folded:
             # replaying a cached program would replay the SAME value —
             # sequence statements must re-plan every execution
+            plan._no_stmt_cache = True
+        if aqumv_from is not None:
+            plan._aqumv = aqumv_from
+            # view freshness is checked at PLAN time; a cached program
+            # would replay a possibly-stale view after base-table DML
             plan._no_stmt_cache = True
         return PlanResult(plan=plan)
 
@@ -143,22 +192,42 @@ def plan_statement(stmt: ast.Node, session, params: dict,
                           ddl_result=session.txn(stmt.kind))
 
     if isinstance(stmt, ast.CopyFrom):
-        return PlanResult(is_ddl=True, ddl_result=_copy_from(session, stmt))
+        res = _copy_from(session, stmt)
+        _maintain(session, stmt.table, appended=int(res.split()[-1]))
+        return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.CopyTo):
         return PlanResult(is_ddl=True, ddl_result=_copy_to(session, stmt))
 
     if isinstance(stmt, ast.Delete):
-        return PlanResult(is_ddl=True, ddl_result=_delete(session, stmt))
+        res = _delete(session, stmt)
+        _maintain(session, stmt.table, appended=None)
+        return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.Update):
-        return PlanResult(is_ddl=True, ddl_result=_update(session, stmt))
+        res = _update(session, stmt)
+        _maintain(session, stmt.table, appended=None)
+        return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.InsertSelect):
-        return PlanResult(is_ddl=True,
-                          ddl_result=_insert_select(session, stmt))
+        res = _insert_select(session, stmt)
+        _maintain(session, stmt.table, appended=int(res.split()[-1]))
+        return PlanResult(is_ddl=True, ddl_result=res)
 
     raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+
+def _maintain(session, table_name: str, appended) -> None:
+    """Post-DML materialized-view maintenance (the IMMV trigger analog):
+    appends merge incrementally; other DML forces refresh/staleness."""
+    if not session.catalog.matviews:
+        return
+    from cloudberry_tpu.plan import matview as MV
+
+    if appended is not None:
+        MV.maintain_on_append(session, table_name, appended)
+    else:
+        MV.maintain_full(session, table_name)
 
 
 def _run_internal(session, query: ast.Node):

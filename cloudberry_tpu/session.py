@@ -56,6 +56,9 @@ class Session:
             for name in self.store.table_names():
                 self.store.register_cold(self.catalog, name)
             self.catalog.store = self.store
+            from cloudberry_tpu.plan.matview import load_defs
+
+            load_defs(self)
         # per-query pruned store reads, keyed (table, version, parts, cols)
         self._store_scan_cache: dict = {}
         self._sync_lock = __import__("threading").Lock()
@@ -174,6 +177,12 @@ class Session:
                     self.store.register_cold(self.catalog, name)
             for name in sorted(names - set(self.catalog.tables)):
                 self.store.register_cold(self.catalog, name)
+            # matview definitions are store state too (another session may
+            # have created/refreshed one)
+            from cloudberry_tpu.plan.matview import load_defs
+
+            self.catalog.matviews = {}
+            load_defs(self)
 
     # ----------------------------------------------------- transactions
     # Single-session transactions over the in-memory catalog: BEGIN
@@ -202,6 +211,7 @@ class Session:
                            copy.deepcopy(t.stats))
                     for name, t in self.catalog.tables.items()},
                 "views": dict(self.catalog.views),
+                "matviews": dict(self.catalog.matviews),
             }
             if self.store is not None:
                 # durable writes defer to COMMIT; ROLLBACK never touches
@@ -252,6 +262,12 @@ class Session:
             t.stats = stats  # manifest-derived stats survive (cold tables)
             self.catalog.tables[name] = t
         self.catalog.views = snap["views"]
+        self.catalog.matviews = snap.get("matviews", {})
+        # rolled-back DML may have advanced view contents/tokens — every
+        # view is conservatively stale until refreshed or re-maintained
+        from cloudberry_tpu.plan.matview import invalidate_all
+
+        invalidate_all(self)
         self.catalog.bump_ddl()
         self._txn_snapshot = None
 

@@ -288,6 +288,24 @@ class DropSequence(Node):
 
 
 @dataclass
+class CreateMatView(Node):
+    name: str
+    query: Node
+    incremental: bool = False
+
+
+@dataclass
+class DropMatView(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class RefreshMatView(Node):
+    name: str
+
+
+@dataclass
 class CreateView(Node):
     name: str
     query: Node  # Select or SetOp

@@ -24,6 +24,9 @@ def parse_sql(sql: str) -> ast.Node:
     stmt = p.parse_statement()
     p.accept_op(";")
     p.expect_eof()
+    # original text rides along for DDL that persists its definition
+    # (materialized views re-parse it on load)
+    stmt._sql_text = sql
     return stmt
 
 
@@ -94,7 +97,10 @@ class Parser:
         if self.at_kw("drop"):
             self.advance()
             kind = "table"
-            if self.accept_kw("view"):
+            if self.accept_kw("materialized"):
+                self.expect_kw("view")
+                kind = "matview"
+            elif self.accept_kw("view"):
                 kind = "view"
             elif self.accept_kw("sequence"):
                 kind = "sequence"
@@ -107,9 +113,16 @@ class Parser:
             name = self.expect_ident()
             if kind == "view":
                 return ast.DropView(name, if_exists)
+            if kind == "matview":
+                return ast.DropMatView(name, if_exists)
             if kind == "sequence":
                 return ast.DropSequence(name, if_exists)
             return ast.DropTable(name, if_exists)
+        if self.at_kw("refresh"):
+            self.advance()
+            self.expect_kw("materialized")
+            self.expect_kw("view")
+            return ast.RefreshMatView(self.expect_ident())
         if self.at_kw("insert"):
             return self.parse_insert()
         if self.at_kw("begin", "commit", "rollback", "abort", "start", "end"):
@@ -138,6 +151,13 @@ class Parser:
 
     def parse_create_table(self):
         self.expect_kw("create")
+        if self.at_kw("materialized", "incremental"):
+            incremental = bool(self.accept_kw("incremental"))
+            self.expect_kw("materialized")
+            self.expect_kw("view")
+            name = self.expect_ident()
+            self.expect_kw("as")
+            return ast.CreateMatView(name, self.parse_query(), incremental)
         if self.accept_kw("view"):
             name = self.expect_ident()
             self.expect_kw("as")

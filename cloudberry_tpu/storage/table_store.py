@@ -441,6 +441,16 @@ class TableStore:
     # write-through (nextval never rolls back — PostgreSQL semantics) and
     # every session on the root draws from the same number line.
 
+    def _atomic_json(self, path: str, obj) -> None:
+        """Durable atomic JSON replace (shared by sequences/matview defs —
+        same discipline as the manifest CURRENT swap)."""
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
     def _seq_path(self) -> str:
         return os.path.join(self.root, "_SEQUENCES.json")
 
@@ -452,15 +462,12 @@ class TableStore:
             return {}
 
     def _write_sequences(self, seqs: dict) -> None:
-        fd, tmp = tempfile.mkstemp(dir=self.root)
-        with os.fdopen(fd, "w") as f:
-            json.dump(seqs, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._seq_path())
+        self._atomic_json(self._seq_path(), seqs)
 
     def create_sequence(self, name: str, start: int = 1, increment: int = 1,
                         if_not_exists: bool = False) -> None:
+        if increment == 0:
+            raise ValueError("INCREMENT must not be zero")
         with self.lock():
             seqs = self._read_sequences()
             if name in seqs:
@@ -503,6 +510,22 @@ class TableStore:
 
     def sequence_names(self) -> list[str]:
         return sorted(self._read_sequences())
+
+    # --------------------------------------------------- matview definitions
+
+    def save_matviews(self, defs: dict) -> None:
+        """Persist materialized-view definitions (full DDL text) — the
+        gp_matview_aux catalog analog."""
+        with self.lock():
+            self._atomic_json(os.path.join(self.root, "_MATVIEWS.json"),
+                              defs)
+
+    def load_matviews(self) -> dict:
+        try:
+            with open(os.path.join(self.root, "_MATVIEWS.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
 
     # ------------------------------------------------------ session bridge
 

@@ -128,6 +128,11 @@ def test_concurrent_nextval_unique(sess):
     assert len(set(got)) == 200
 
 
+def test_increment_zero_rejected(sess):
+    with pytest.raises(Exception):
+        sess.sql("create sequence z increment by 0")
+
+
 def test_statement_cache_not_poisoned(sess):
     sess.sql("create sequence c1")
     q = "select nextval('c1') as v"
