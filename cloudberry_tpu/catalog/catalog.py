@@ -75,6 +75,10 @@ class Table:
     # stats become exact partition bounds — elimination needs no separate
     # partition catalog. ('range', col, start, end, every) | ('list', col)
     partition_spec: tuple | None = None
+    # readable external table source (access/external analog): {url,
+    # delimiter, header, reject_limit, reject_percent, log_errors}; data
+    # re-reads from the source at every statement (never stored)
+    external: dict | None = None
 
     @property
     def num_rows(self) -> int:
@@ -398,7 +402,8 @@ class Catalog:
     def create_table(self, name: str, schema: Schema,
                      policy: DistributionPolicy | None = None,
                      if_not_exists: bool = False,
-                     partition_spec: tuple | None = None) -> Table:
+                     partition_spec: tuple | None = None,
+                     durable: bool = True) -> Table:
         name = name.lower()
         if name in self.tables:
             if if_not_exists:
@@ -415,7 +420,7 @@ class Catalog:
         t.data = {f.name: np.zeros(0, dtype=f.type.np_dtype)
                   for f in schema.fields}
         t._version = next(_VERSION_COUNTER)
-        if self.store is not None:
+        if self.store is not None and durable:
             t.backing = self.store
             if self.store.autocommit:
                 # durable schema from CREATE on

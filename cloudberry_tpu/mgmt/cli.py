@@ -228,6 +228,13 @@ def cmd_sql(args) -> int:
     return 0
 
 
+def cmd_fdist(args) -> int:
+    from cloudberry_tpu.serve.fdist import main as fdist_main
+
+    fdist_main(args.root, args.port, args.host)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cloudberry_tpu",
@@ -266,6 +273,13 @@ def main(argv=None) -> int:
     pv.add_argument("--host", default="127.0.0.1")
     pv.add_argument("--port", type=int, default=15432)
     pv.set_defaults(fn=cmd_serve)
+
+    pf = sub.add_parser("fdist",
+                        help="scatter file server (gpfdist analog)")
+    pf.add_argument("--root", default=".")
+    pf.add_argument("--port", type=int, default=8800)
+    pf.add_argument("--host", default="0.0.0.0")
+    pf.set_defaults(fn=cmd_fdist)
 
     args = p.parse_args(argv)
     return args.fn(args)

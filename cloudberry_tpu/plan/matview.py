@@ -220,6 +220,11 @@ def _base_token(session, d: MatViewDef):
 def _persist_defs(session) -> None:
     if session.store is None:
         return
+    if not session.store.autocommit:
+        # inside BEGIN..COMMIT: definitions must not outlive a ROLLBACK —
+        # Session.txn flushes them after the store commit succeeds
+        session._matviews_dirty = True
+        return
     session.store.save_matviews({
         n: {"sql": d.sql, "incremental": d.incremental,
             "base_store_version": d.base_store_version}
@@ -378,6 +383,8 @@ def aqumv_rewrite(session, sel: ast.Select):
     for d in cat.matviews.values():
         if d.base_table != base or d.fresh_token is None:
             continue
+        if d.name not in cat.tables:
+            continue  # definition without a table (e.g. rolled-back CREATE)
         if d.fresh_token != _base_token(session, d):
             continue  # base moved since the view last materialized
         out = _try_rewrite(sel, d)

@@ -31,6 +31,7 @@ class PlanResult:
 def plan_statement(stmt: ast.Node, session, params: dict,
                    explain_only: bool = False) -> PlanResult:
     catalog = session.catalog
+    _refresh_referenced_externals(session, stmt)
 
     if isinstance(stmt, ast.CreateTable):
         if stmt.name.lower() in catalog.views:
@@ -52,6 +53,30 @@ def plan_statement(stmt: ast.Node, session, params: dict,
                              if_not_exists=stmt.if_not_exists,
                              partition_spec=stmt.partition)
         return PlanResult(is_ddl=True, ddl_result=f"CREATE TABLE {stmt.name}")
+
+    if isinstance(stmt, ast.CreateExternalTable):
+        if stmt.name.lower() in catalog.views:
+            raise BindError(f"{stmt.name!r} already exists as a view")
+        fields = []
+        for c in stmt.columns:
+            t = T.SQL_TYPE_MAP.get(c.type_name)
+            if t is None:
+                raise BindError(f"unknown type {c.type_name!r}")
+            if t.base == T.DType.DECIMAL and c.scale is not None:
+                t = T.DECIMAL(c.scale)
+            fields.append(Field(c.name, t, nullable=not c.not_null))
+        # external data is never stored: the catalog entry is ephemeral
+        # and every statement re-reads the LOCATION (external.c behavior)
+        tab = catalog.create_table(stmt.name, Schema(tuple(fields)),
+                                   DistributionPolicy.random(),
+                                   durable=False)
+        tab.external = {"url": stmt.url, "delimiter": stmt.delimiter,
+                        "header": stmt.header,
+                        "reject_limit": stmt.reject_limit,
+                        "reject_percent": stmt.reject_percent,
+                        "log_errors": stmt.log_errors}
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"CREATE EXTERNAL TABLE {stmt.name}")
 
     if isinstance(stmt, ast.CreateTableAs):
         return PlanResult(is_ddl=True, ddl_result=_ctas(session, stmt))
@@ -120,10 +145,21 @@ def plan_statement(stmt: ast.Node, session, params: dict,
         return PlanResult(is_ddl=True, ddl_result=f"DROP VIEW {stmt.name}")
 
     if isinstance(stmt, ast.DropTable):
+        deps = [n for n, d in catalog.matviews.items()
+                if getattr(d, "base_table", None) == stmt.name.lower()]
+        if deps:
+            raise BindError(
+                f"cannot drop table {stmt.name!r}: materialized view(s) "
+                f"{', '.join(sorted(deps))} depend on it")
+        if stmt.name.lower() in catalog.matviews:
+            raise BindError(
+                f"{stmt.name!r} is a materialized view — use DROP "
+                "MATERIALIZED VIEW")
         catalog.drop_table(stmt.name, if_exists=stmt.if_exists)
         return PlanResult(is_ddl=True, ddl_result=f"DROP TABLE {stmt.name}")
 
     if isinstance(stmt, ast.InsertValues):
+        _reject_matview_dml(catalog, stmt.table)
         res = _insert_values(catalog, stmt)
         _maintain(session, stmt.table, appended=len(stmt.rows))
         return PlanResult(is_ddl=True, ddl_result=res)
@@ -192,29 +228,86 @@ def plan_statement(stmt: ast.Node, session, params: dict,
                           ddl_result=session.txn(stmt.kind))
 
     if isinstance(stmt, ast.CopyFrom):
+        _reject_matview_dml(catalog, stmt.table)
         res = _copy_from(session, stmt)
-        _maintain(session, stmt.table, appended=int(res.split()[-1]))
+        _maintain(session, stmt.table, appended=int(res.split()[1]))
         return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.CopyTo):
         return PlanResult(is_ddl=True, ddl_result=_copy_to(session, stmt))
 
     if isinstance(stmt, ast.Delete):
+        _reject_matview_dml(catalog, stmt.table)
         res = _delete(session, stmt)
         _maintain(session, stmt.table, appended=None)
         return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.Update):
+        _reject_matview_dml(catalog, stmt.table)
         res = _update(session, stmt)
         _maintain(session, stmt.table, appended=None)
         return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.InsertSelect):
+        _reject_matview_dml(catalog, stmt.table)
         res = _insert_select(session, stmt)
-        _maintain(session, stmt.table, appended=int(res.split()[-1]))
+        _maintain(session, stmt.table, appended=int(res.split()[1]))
         return PlanResult(is_ddl=True, ddl_result=res)
 
     raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+
+def _reject_matview_dml(catalog, name: str) -> None:
+    """Materialized views change only through REFRESH / maintenance, and
+    readable external tables only through their LOCATION — direct DML
+    would desynchronize both (the reference rejects it the same way)."""
+    if name.lower() in catalog.matviews:
+        raise BindError(
+            f"cannot change materialized view {name!r} (use REFRESH "
+            "MATERIALIZED VIEW)")
+    t = catalog.tables.get(name.lower())
+    if t is not None and getattr(t, "external", None):
+        raise BindError(
+            f"cannot change readable external table {name!r}")
+
+
+def _stmt_table_names(node, catalog) -> set:
+    """Every table name referenced anywhere in a statement AST (joins,
+    subqueries, CTE bodies), with view definitions expanded."""
+    names: set = set()
+
+    def walk(x):
+        if isinstance(x, ast.TableName):
+            nm = x.name.lower()
+            if nm not in names:
+                names.add(nm)
+                v = catalog.views.get(nm)
+                if v is not None:
+                    walk(v)
+            return
+        if isinstance(x, ast.Node):
+            for val in vars(x).items():
+                walk(val[1])
+            return
+        if isinstance(x, (list, tuple)):
+            for item in x:
+                walk(item)
+
+    walk(node)
+    return names
+
+
+def _refresh_referenced_externals(session, stmt) -> None:
+    """Re-read an external table's LOCATION only when THIS statement
+    references it — an unreachable source must not fail unrelated
+    queries, and unrelated statements pay no fetch."""
+    cat = session.catalog
+    ext = {n for n, t in cat.tables.items()
+           if getattr(t, "external", None)}
+    if not ext:
+        return
+    for name in _stmt_table_names(stmt, cat) & ext:
+        refresh_external_table(session, cat.tables[name])
 
 
 def _maintain(session, table_name: str, appended) -> None:
@@ -258,6 +351,8 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
         buf = buf[nl + 1:] if nl >= 0 else b""
     d = stmt.delimiter
     db = d.encode()
+    if stmt.reject_limit is not None:
+        return _copy_from_sreh(session, table, stmt, buf, db)
     # NULLs in the file (\N, or an empty field for non-string columns) need
     # per-row masks: take the host text path. The conservative byte probe
     # keeps the native fast path for files that can't contain NULLs.
@@ -337,6 +432,168 @@ def _parse_text_column(vals, f, table) -> np.ndarray:
     except ValueError as e2:
         raise BindError(
             f"COPY: malformed value in column {f.name!r}: {e2}")
+
+
+def _sreh_convert(tok_b: bytes, f):
+    """One field of one row → physical value or None (NULL); raises
+    ValueError on a malformed token (the per-row reject decision)."""
+    from cloudberry_tpu.types import date_to_days
+
+    tok = tok_b.decode()
+    if tok_b == b"\\N" or (tok == "" and f.dtype != T.DType.STRING):
+        if not f.nullable:
+            raise ValueError(f"null value in NOT NULL column {f.name!r}")
+        return None
+    if f.dtype in (T.DType.INT32, T.DType.INT64):
+        return int(tok)
+    if f.dtype == T.DType.DECIMAL:
+        return _exact_decimal(tok, f.type.scale)
+    if f.dtype == T.DType.FLOAT64:
+        return float(tok)
+    if f.dtype == T.DType.BOOL:
+        lv = tok.lower()
+        if lv in ("t", "true", "1"):
+            return True
+        if lv in ("f", "false", "0"):
+            return False
+        raise ValueError(f"malformed boolean {tok!r}")
+    if f.dtype == T.DType.DATE:
+        return date_to_days(tok)
+    return tok  # STRING
+
+
+def _copy_from_sreh(session, table, stmt: ast.CopyFrom, buf: bytes,
+                    db: bytes) -> str:
+    """COPY with single-row error handling (cdbsreh.c): malformed rows are
+    rejected (and logged with LOG ERRORS) instead of aborting, until the
+    SEGMENT REJECT LIMIT trips — then the whole load aborts with nothing
+    appended (validation precedes the single set_data)."""
+    from cloudberry_tpu.columnar.batch import encode_column
+
+    fields = table.schema.fields
+    good: list[list] = []
+    errors: list[dict] = []
+    lines = [ln for ln in buf.splitlines() if ln]
+    limit = stmt.reject_limit
+
+    def tripped() -> bool:
+        if stmt.reject_percent:
+            return len(errors) * 100 > limit * max(len(lines), 1)
+        # cdbsreh.c aborts when the reject count REACHES the limit
+        return len(errors) >= limit
+
+    for lineno, ln in enumerate(lines, start=1 + int(stmt.header)):
+        toks = ln.split(db)
+        if len(toks) != len(fields):
+            errors.append({"line": lineno,
+                           "errmsg": f"expected {len(fields)} columns, "
+                                     f"got {len(toks)}",
+                           "rawdata": ln.decode(errors="replace")})
+            continue
+        try:
+            good.append([_sreh_convert(t, f)
+                         for t, f in zip(toks, fields)])
+        except (ValueError, BindError, OverflowError) as e:
+            errors.append({"line": lineno, "errmsg": str(e),
+                           "rawdata": ln.decode(errors="replace")})
+    if not stmt.reject_percent and tripped():
+        raise BindError(
+            f"COPY: segment reject limit {limit} reached "
+            f"({len(errors)} rejected rows); load aborted")
+    if stmt.reject_percent and tripped():
+        raise BindError(
+            f"COPY: segment reject limit {limit} PERCENT exceeded "
+            f"({len(errors)}/{len(lines)} rejected); load aborted")
+
+    n_rows = len(good)
+    parsed, new_valid = {}, {}
+    for i, f in enumerate(fields):
+        vals = [r[i] for r in good]
+        isnull = np.asarray([v is None for v in vals], dtype=np.bool_)
+        if f.dtype == T.DType.STRING:
+            arr = encode_column(
+                np.asarray([v if v is not None else "" for v in vals],
+                           dtype=object), f, table.dicts)
+        else:
+            arr = np.asarray([0 if v is None else v for v in vals]) \
+                .astype(f.type.np_dtype) if vals else \
+                np.zeros(0, dtype=f.type.np_dtype)
+        old = table.data.get(f.name)
+        n_old = len(old) if old is not None else 0
+        parsed[f.name] = arr if n_old == 0 else np.concatenate([old, arr])
+        old_v = table.validity.get(f.name)
+        if isnull.any() or old_v is not None:
+            if old_v is None:
+                old_v = np.ones(n_old, dtype=np.bool_)
+            new_valid[f.name] = np.concatenate([old_v, ~isnull]) \
+                if n_old else ~isnull
+    table.set_data(parsed, table.dicts, validity=new_valid,
+                   appended=n_rows)
+    if stmt.log_errors and errors:
+        session.copy_errors.setdefault(table.name, []).extend(errors)
+    if errors:
+        return f"COPY {n_rows} (rejected {len(errors)} rows)"
+    return f"COPY {n_rows}"
+
+
+def refresh_external_table(session, t) -> None:
+    """(Re)load an external table from its LOCATION — called at statement
+    start, so every query sees the source's current contents (external
+    scans in the reference read the URL per query, url_curl.c). cbfdist
+    URLs fetch one stripe per segment IN PARALLEL (the gpfdist scatter
+    protocol); file:// reads locally."""
+    from urllib.parse import urlparse
+
+    spec = t.external
+    parsed = urlparse(spec["url"])
+    if parsed.scheme == "file":
+        with open(parsed.netloc + parsed.path, "rb") as fh:
+            buf = fh.read()
+    elif parsed.scheme == "cbfdist":
+        import urllib.request
+        from concurrent.futures import ThreadPoolExecutor
+
+        n = max(session.config.n_segments, 1)
+
+        def fetch(i: int) -> bytes:
+            u = (f"http://{parsed.netloc}{parsed.path}"
+                 f"?segment={i}&nseg={n}")
+            with urllib.request.urlopen(u, timeout=30) as r:
+                return r.read()
+
+        try:
+            with ThreadPoolExecutor(max_workers=min(n, 8)) as ex:
+                buf = b"".join(ex.map(fetch, range(n)))
+        except Exception as e:
+            raise BindError(
+                f"external table {t.name!r}: cbfdist fetch failed: {e}")
+    else:
+        raise BindError(
+            f"external table {t.name!r}: unsupported URL scheme "
+            f"{parsed.scheme!r} (use cbfdist:// or file://)")
+    if spec["header"]:
+        nl = buf.find(b"\n")
+        buf = buf[nl + 1:] if nl >= 0 else b""
+    # replace semantics: the table IS the file's current contents
+    t._loading = True
+    try:
+        t.set_data({f.name: np.zeros(0, dtype=f.type.np_dtype)
+                    for f in t.schema.fields}, t.dicts, validity={})
+    finally:
+        t._loading = False
+    db = spec["delimiter"].encode()
+    if spec["reject_limit"] is not None:
+        from types import SimpleNamespace
+
+        # the error log reflects the CURRENT read, not an accumulation
+        # over every statement's re-read
+        session.copy_errors.pop(t.name, None)
+        opts = SimpleNamespace(reject_limit=spec["reject_limit"],
+                               reject_percent=spec["reject_percent"],
+                               log_errors=spec["log_errors"], header=False)
+        _copy_from_sreh(session, t, opts, buf, db)
+    else:
+        _copy_from_text(t, buf, db)
 
 
 def _copy_from_text(table, buf: bytes, db: bytes) -> str:

@@ -72,6 +72,17 @@ class Session:
         self._stmt_cache: dict = {}
         # spill diagnostics for the LAST statement (None = not tiled)
         self.last_tiled_report = None
+        # COPY ... LOG ERRORS row rejects, per table (the error-log /
+        # gp_read_error_log analog, cdbsreh.c)
+        self.copy_errors: dict[str, list] = {}
+
+    def read_error_log(self, table: str):
+        """Rejected rows recorded by COPY ... LOG ERRORS for ``table``
+        (the gp_read_error_log() analog): DataFrame of line/errmsg/rawdata."""
+        import pandas as pd
+
+        return pd.DataFrame(self.copy_errors.get(table.lower(), []),
+                            columns=["line", "errmsg", "rawdata"])
 
     def sql(self, query: str, **params: Any):
         from cloudberry_tpu.exec.resource import check_admission
@@ -238,6 +249,13 @@ class Session:
                             f"{', '.join(conflicts)} were modified by "
                             "another session after this transaction began")
                     self.store.commit_txn()
+                if getattr(self, "_matviews_dirty", False):
+                    # definitions deferred during the transaction flush
+                    # only after the data commit succeeded
+                    from cloudberry_tpu.plan.matview import _persist_defs
+
+                    self._matviews_dirty = False
+                    _persist_defs(self)
             self._txn_snapshot = None
             return "COMMIT"
         # rollback: restore RAM state WITHOUT persisting (the store never
@@ -268,6 +286,7 @@ class Session:
         from cloudberry_tpu.plan.matview import invalidate_all
 
         invalidate_all(self)
+        self._matviews_dirty = False  # deferred defs die with the rollback
         self.catalog.bump_ddl()
         self._txn_snapshot = None
 
@@ -319,7 +338,12 @@ class Session:
             exe = X.compile_plan(plan, self)
             runner = lambda: X.run_executable(
                 exe, X.prepare_inputs(exe, self))
-        if not getattr(plan, "_no_stmt_cache", False):
+        # external tables re-read their source per statement — a cached
+        # program would replay the previous read
+        any_external = any(
+            getattr(self.catalog.tables.get(n), "external", None)
+            for n in names)
+        if not getattr(plan, "_no_stmt_cache", False) and not any_external:
             self._cache_statement(query, names, runner)
         return runner()
 

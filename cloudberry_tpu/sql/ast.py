@@ -265,6 +265,22 @@ class CreateTable(Node):
 
 
 @dataclass
+class CreateExternalTable(Node):
+    """CREATE EXTERNAL TABLE ... LOCATION('cbfdist://h:p/f' | 'file://p')
+    FORMAT 'csv' [DELIMITER 'c'] [SEGMENT REJECT LIMIT ...] — readable
+    external tables (access/external, gpfdist URLs)."""
+
+    name: str
+    columns: list[ColumnDef]
+    url: str
+    delimiter: str = "|"
+    header: bool = False
+    reject_limit: Optional[int] = None
+    reject_percent: bool = False
+    log_errors: bool = False
+
+
+@dataclass
 class CreateTableAs(Node):
     name: str
     query: Node
@@ -356,6 +372,12 @@ class CopyFrom(Node):
     path: str
     delimiter: str = "|"
     header: bool = False
+    # single-row error handling (cdbsreh.c): tolerate up to this many
+    # malformed rows (or percent of rows when reject_percent) instead of
+    # aborting the load; rejected rows land in the error log
+    reject_limit: Optional[int] = None
+    reject_percent: bool = False
+    log_errors: bool = False
 
 
 @dataclass

@@ -180,6 +180,8 @@ class Parser:
                 else:
                     break
             return ast.CreateSequence(name, start, inc, if_not_exists)
+        if self.accept_kw("external"):
+            return self._parse_create_external()
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -196,6 +198,16 @@ class Parser:
                 distribution, keys = self._parse_distribution()
             return ast.CreateTableAs(name, q, distribution or "random",
                                      keys or (), if_not_exists)
+        cols = self._parse_column_defs()
+        distribution, keys = self._parse_distribution()
+        partition = self._parse_partition()
+        if distribution is None:
+            # DISTRIBUTED may follow PARTITION too (order is free)
+            distribution, keys = self._parse_distribution()
+        return ast.CreateTable(name, cols, distribution or "random",
+                               keys or (), if_not_exists, partition)
+
+    def _parse_column_defs(self) -> list:
         self.expect_op("(")
         cols = []
         while True:
@@ -216,13 +228,52 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        distribution, keys = self._parse_distribution()
-        partition = self._parse_partition()
-        if distribution is None:
-            # DISTRIBUTED may follow PARTITION too (order is free)
-            distribution, keys = self._parse_distribution()
-        return ast.CreateTable(name, cols, distribution or "random",
-                               keys or (), if_not_exists, partition)
+        return cols
+
+    def _parse_create_external(self):
+        """CREATE EXTERNAL TABLE name (cols) LOCATION('url')
+        [FORMAT 'csv'] [DELIMITER 'c'] [HEADER]
+        [SEGMENT REJECT LIMIT n [ROWS|PERCENT]] [LOG ERRORS]"""
+        self.expect_kw("table")
+        name = self.expect_ident()
+        cols = self._parse_column_defs()
+        self.expect_kw("location")
+        self.expect_op("(")
+        if self.cur.kind != "string":
+            raise ParseError("LOCATION takes a quoted URL")
+        url = self.advance().text
+        self.expect_op(")")
+        delim, header = "|", False
+        reject_limit, reject_percent, log_errors = None, False, False
+        while True:
+            if self.accept_kw("format"):
+                if self.cur.kind != "string":
+                    raise ParseError("FORMAT takes a quoted name")
+                fmt = self.advance().text.lower()
+                if fmt not in ("csv", "text"):
+                    raise ParseError(f"unsupported FORMAT {fmt!r}")
+            elif self.accept_kw("delimiter"):
+                if self.cur.kind != "string" or len(self.cur.text) != 1:
+                    raise ParseError("DELIMITER must be a 1-char string")
+                delim = self.advance().text
+            elif self.accept_kw("header"):
+                header = True
+            elif self.accept_kw("log"):
+                self.expect_kw("errors")
+                log_errors = True
+            elif self.accept_kw("segment"):
+                self.expect_kw("reject")
+                self.expect_kw("limit")
+                reject_limit = self._signed_int()
+                if self.accept_kw("percent"):
+                    reject_percent = True
+                else:
+                    self.accept_kw("rows")
+            else:
+                break
+        return ast.CreateExternalTable(name, cols, url, delim, header,
+                                       reject_limit, reject_percent,
+                                       log_errors)
 
     def _parse_partition(self):
         """PARTITION BY RANGE (col) (START a END b EVERY s) | LIST (col)
@@ -315,6 +366,7 @@ class Parser:
             raise ParseError("COPY path must be a string literal")
         path = self.advance().text
         delim, header = "|", False
+        reject_limit, reject_percent, log_errors = None, False, False
         self.accept_kw("with")
         while True:
             if self.accept_kw("delimiter"):
@@ -323,10 +375,24 @@ class Parser:
                 delim = self.advance().text
             elif self.accept_kw("header"):
                 header = True
+            elif self.accept_kw("log"):
+                self.expect_kw("errors")
+                log_errors = True
+            elif self.accept_kw("segment"):
+                # SEGMENT REJECT LIMIT n [ROWS | PERCENT] (gram.y sreh)
+                self.expect_kw("reject")
+                self.expect_kw("limit")
+                reject_limit = self._signed_int()
+                if self.accept_kw("percent"):
+                    reject_percent = True
+                else:
+                    self.accept_kw("rows")
             else:
                 break
-        cls = ast.CopyFrom if direction == "from" else ast.CopyTo
-        return cls(table, path, delim, header)
+        if direction == "to":
+            return ast.CopyTo(table, path, delim, header)
+        return ast.CopyFrom(table, path, delim, header,
+                            reject_limit, reject_percent, log_errors)
 
     def parse_update(self) -> ast.Update:
         self.expect_kw("update")

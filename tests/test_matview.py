@@ -190,6 +190,41 @@ def test_incremental_unknown_table_is_bind_error():
               "select k, sum(v) as s from nosuch group by k")
 
 
+def test_drop_base_table_refused_with_dependents(sess):
+    sess.sql(MV)
+    with pytest.raises(BindError, match="depend"):
+        sess.sql("drop table sales")
+    sess.sql("drop materialized view mv_sales")
+    sess.sql("drop table sales")  # fine once the dependent is gone
+
+
+def test_dml_into_matview_rejected(sess):
+    sess.sql(MV)
+    with pytest.raises(BindError, match="materialized view"):
+        sess.sql("insert into mv_sales values ('zz', 1.00, 1, 1, 1)")
+    with pytest.raises(BindError, match="materialized view"):
+        sess.sql("delete from mv_sales where cnt > 0")
+    with pytest.raises(BindError, match="materialized view"):
+        sess.sql("update mv_sales set cnt = 0")
+
+
+def test_rolled_back_create_leaves_no_durable_def(tmp_path):
+    cfg = Config(n_segments=1).with_overrides(
+        **{"storage.root": str(tmp_path / "store")})
+    a = cb.Session(cfg)
+    a.sql("create table t (k bigint not null, v bigint not null)")
+    a.sql("insert into t values (1, 10)")
+    a.sql("begin")
+    a.sql("create materialized view m as select k, sum(v) as s from t "
+          "group by k")
+    a.sql("rollback")
+    b = cb.Session(cfg)
+    assert "m" not in b.catalog.matviews
+    # base-table queries in the new session are unaffected
+    assert b.sql("select k, sum(v) as s from t group by k") \
+        .to_pandas()["s"].iloc[0] == 10
+
+
 def test_drop_matview(sess):
     sess.sql(MV)
     sess.sql("drop materialized view mv_sales")

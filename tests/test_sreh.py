@@ -1,0 +1,88 @@
+"""COPY single-row error handling — the cdbsreh.c analog.
+
+Reference: COPY ... SEGMENT REJECT LIMIT n [ROWS|PERCENT] [LOG ERRORS]
+tolerates malformed rows up to the limit (logging them for
+gp_read_error_log) instead of aborting the load; past the limit the load
+aborts with nothing appended.
+"""
+
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.plan.binder import BindError
+
+
+@pytest.fixture
+def sess():
+    s = cb.Session(Config(n_segments=1))
+    s.sql("create table ld (k bigint, amt decimal(8,2), name text)")
+    return s
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "in.csv"
+    p.write_text(text)
+    return str(p)
+
+
+GOOD_AND_BAD = ("1|10.50|aa\n"
+                "oops|20.00|bb\n"      # bad int
+                "3|not-a-num|cc\n"     # bad decimal
+                "4|40.25|dd\n"
+                "5|50.00\n"            # short row
+                "6|60.75|ff\n")
+
+
+def test_reject_limit_tolerates(sess, tmp_path):
+    path = _write(tmp_path, GOOD_AND_BAD)
+    res = sess.sql(f"copy ld from '{path}' with segment reject limit 5 "
+                   "log errors")
+    assert res == "COPY 3 (rejected 3 rows)"
+    df = sess.sql("select k, name from ld order by k").to_pandas()
+    assert list(df["k"]) == [1, 4, 6]
+    log = sess.read_error_log("ld")
+    assert len(log) == 3
+    assert set(log["line"]) == {2, 3, 5}
+    assert any("columns" in m for m in log["errmsg"])
+
+
+def test_reject_limit_trips_aborts_whole_load(sess, tmp_path):
+    path = _write(tmp_path, GOOD_AND_BAD)
+    with pytest.raises(BindError, match="reject limit"):
+        sess.sql(f"copy ld from '{path}' with segment reject limit 2")
+    # nothing appended on abort
+    assert sess.sql("select count(*) as c from ld").to_pandas()["c"].iloc[0] \
+        == 0
+    # cdbsreh.c semantics: REACHING the limit aborts (3 bad rows, limit 3)
+    with pytest.raises(BindError, match="reject limit"):
+        sess.sql(f"copy ld from '{path}' with segment reject limit 3")
+    res = sess.sql(f"copy ld from '{path}' with segment reject limit 4")
+    assert res.startswith("COPY 3")
+
+
+def test_reject_percent(sess, tmp_path):
+    path = _write(tmp_path, GOOD_AND_BAD)  # 3/6 = 50% rejected
+    res = sess.sql(f"copy ld from '{path}' with segment reject limit 60 "
+                   "percent")
+    assert res.startswith("COPY 3")
+    with pytest.raises(BindError, match="PERCENT"):
+        sess.sql(f"copy ld from '{path}' with segment reject limit 40 "
+                 "percent")
+
+
+def test_nulls_and_not_null_rejects(sess, tmp_path):
+    sess.sql("create table nn (k bigint not null, v bigint)")
+    path = _write(tmp_path, "1|10\n\\N|20\n3|\\N\n")
+    res = sess.sql(f"copy nn from '{path}' with segment reject limit 5 "
+                   "log errors")
+    assert res == "COPY 2 (rejected 1 rows)"
+    df = sess.sql("select k from nn order by k").to_pandas()
+    assert list(df["k"]) == [1, 3]
+    assert "NOT NULL" in sess.read_error_log("nn")["errmsg"].iloc[0]
+
+
+def test_without_sreh_still_aborts(sess, tmp_path):
+    path = _write(tmp_path, GOOD_AND_BAD)
+    with pytest.raises(BindError):
+        sess.sql(f"copy ld from '{path}'")
