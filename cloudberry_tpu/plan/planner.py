@@ -98,6 +98,26 @@ def plan_statement(stmt: ast.Node, session, params: dict,
         return PlanResult(is_ddl=True,
                           ddl_result=f"DROP SEQUENCE {stmt.name}")
 
+    if isinstance(stmt, ast.DeclareParallelCursor):
+        from cloudberry_tpu.exec import endpoint as EP
+
+        try:
+            return PlanResult(is_ddl=True,
+                              ddl_result=EP.declare(session, stmt.name,
+                                                    stmt.query))
+        except EP.CursorError as e:
+            raise BindError(str(e))
+
+    if isinstance(stmt, ast.CloseCursor):
+        from cloudberry_tpu.exec import endpoint as EP
+
+        try:
+            return PlanResult(is_ddl=True,
+                              ddl_result=EP.close_cursor(session,
+                                                         stmt.name))
+        except EP.CursorError as e:
+            raise BindError(str(e))
+
     if isinstance(stmt, ast.CreateMatView):
         from cloudberry_tpu.plan import matview as MV
 
@@ -234,6 +254,12 @@ def plan_statement(stmt: ast.Node, session, params: dict,
         return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.CopyTo):
+        t = catalog.tables.get(stmt.table.lower())
+        if t is not None and getattr(t, "external", None):
+            # CopyTo names its table as a plain string, invisible to the
+            # TableName walker — refresh explicitly so the export sees
+            # the source's current contents
+            refresh_external_table(session, t)
         return PlanResult(is_ddl=True, ddl_result=_copy_to(session, stmt))
 
     if isinstance(stmt, ast.Delete):
@@ -445,9 +471,16 @@ def _sreh_convert(tok_b: bytes, f):
             raise ValueError(f"null value in NOT NULL column {f.name!r}")
         return None
     if f.dtype in (T.DType.INT32, T.DType.INT64):
-        return int(tok)
+        v = int(tok)
+        bits = 31 if f.dtype == T.DType.INT32 else 63
+        if not -(1 << bits) <= v < (1 << bits):
+            raise ValueError(f"value {tok} out of range for {f.name!r}")
+        return v
     if f.dtype == T.DType.DECIMAL:
-        return _exact_decimal(tok, f.type.scale)
+        v = _exact_decimal(tok, f.type.scale)
+        if not -(1 << 63) <= v < (1 << 63):
+            raise ValueError(f"value {tok} out of range for {f.name!r}")
+        return v
     if f.dtype == T.DType.FLOAT64:
         return float(tok)
     if f.dtype == T.DType.BOOL:
@@ -547,8 +580,12 @@ def refresh_external_table(session, t) -> None:
     spec = t.external
     parsed = urlparse(spec["url"])
     if parsed.scheme == "file":
-        with open(parsed.netloc + parsed.path, "rb") as fh:
-            buf = fh.read()
+        try:
+            with open(parsed.netloc + parsed.path, "rb") as fh:
+                buf = fh.read()
+        except OSError as e:
+            raise BindError(
+                f"external table {t.name!r}: cannot read source: {e}")
     elif parsed.scheme == "cbfdist":
         import urllib.request
         from concurrent.futures import ThreadPoolExecutor

@@ -34,6 +34,23 @@ class Client:
     def rows(self, query: str) -> list[list]:
         return self.sql(query)["rows"]
 
+    def retrieve(self, cursor: str, segment: int, token: str,
+                 limit: int | None = None) -> dict:
+        """Drain one endpoint of a PARALLEL RETRIEVE CURSOR (the
+        retrieve-mode connection, cdbendpointretrieve.c)."""
+        req = {"retrieve": {"cursor": cursor, "segment": segment,
+                            "token": token, "limit": limit}}
+        self._w.write(json.dumps(req).encode() + b"\n")
+        self._w.flush()
+        line = self._r.readline()
+        if not line:
+            raise ServerError("server closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise ServerError(resp.get("error", "unknown server error"))
+        resp.pop("ok")
+        return resp
+
     def close(self) -> None:
         try:
             self._r.close()

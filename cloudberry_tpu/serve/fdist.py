@@ -44,8 +44,11 @@ class _Handler(BaseHTTPRequestHandler):
             nseg = max(int(q["nseg"][0]), 1)
             seg = int(q.get("segment", ["0"])[0]) % nseg
             lines = body.splitlines(keepends=True)
-            body = b"".join(ln for i, ln in enumerate(lines)
-                            if i % nseg == seg)
+            # a final line without its newline must not merge into the
+            # next stripe when the client concatenates segment fetches
+            body = b"".join(
+                ln if ln.endswith((b"\n", b"\r")) else ln + b"\n"
+                for i, ln in enumerate(lines) if i % nseg == seg)
         self.send_response(200)
         self.send_header("Content-Type", "text/plain")
         self.send_header("Content-Length", str(len(body)))

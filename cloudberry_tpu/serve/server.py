@@ -149,6 +149,23 @@ class Server:
     # ------------------------------------------------------------- execution
 
     def _execute(self, req: dict) -> dict:
+        if "retrieve" in req:
+            # retrieve-mode request (cdbendpointretrieve.c analog): drain
+            # one endpoint of a parallel cursor; token REQUIRED on the wire
+            r = req["retrieve"]
+            if not isinstance(r, dict) or "token" not in r:
+                return {"ok": False,
+                        "error": "retrieve needs cursor/segment/token"}
+            self._rw.acquire_read()
+            try:
+                out = self.session.retrieve(
+                    r.get("cursor", ""), int(r.get("segment", 0)),
+                    r.get("limit"), r["token"])
+            finally:
+                self._rw.release_read()
+            out["rows"] = [[_json_safe(v) for v in row]
+                           for row in out["rows"]]
+            return {"ok": True, **out}
         sql = req.get("sql")
         if not isinstance(sql, str):
             return {"ok": False, "error": "request must carry a 'sql' string"}
@@ -175,6 +192,10 @@ class Server:
                 result = self.session.sql(sql)
             finally:
                 self._rw.release_write()
+        if isinstance(result, dict):
+            # DECLARE PARALLEL RETRIEVE CURSOR: endpoint directory + token
+            return {"ok": True, **{k: _json_safe(v) if not isinstance(
+                v, (list, dict)) else v for k, v in result.items()}}
         if hasattr(result, "decoded_columns"):
             # pandas-free serialization: DataFrame construction with arrow
             # string dtypes is not thread-safe, and handlers run threaded

@@ -75,6 +75,17 @@ class Session:
         # COPY ... LOG ERRORS row rejects, per table (the error-log /
         # gp_read_error_log analog, cdbsreh.c)
         self.copy_errors: dict[str, list] = {}
+        # open parallel retrieve cursors (the endpoint registry analog,
+        # cdbendpoint.c EndpointTokenHash) — name -> ParallelCursor
+        self.parallel_cursors: dict[str, object] = {}
+
+    def retrieve(self, cursor: str, segment: int,
+                 limit: int | None = None, token: str | None = None):
+        """Drain rows from one endpoint of a PARALLEL RETRIEVE CURSOR
+        (the retrieve-mode connection analog, cdbendpointretrieve.c)."""
+        from cloudberry_tpu.exec.endpoint import retrieve as _r
+
+        return _r(self, cursor, segment, limit, token)
 
     def read_error_log(self, table: str):
         """Rejected rows recorded by COPY ... LOG ERRORS for ``table``
@@ -154,8 +165,13 @@ class Session:
 
         names = sorted({s.table_name
                         for s in X.scans_of(texe._whole_plan())})
-        self._cache_statement(query, names, texe.run)
+        if not self._any_external(names):
+            self._cache_statement(query, names, texe.run)
         return texe.run()
+
+    def _any_external(self, names) -> bool:
+        return any(getattr(self.catalog.tables.get(n), "external", None)
+                   for n in names)
 
     def _sync_store(self) -> None:
         """Pick up OTHER sessions' committed changes at statement start
@@ -340,10 +356,8 @@ class Session:
                 exe, X.prepare_inputs(exe, self))
         # external tables re-read their source per statement — a cached
         # program would replay the previous read
-        any_external = any(
-            getattr(self.catalog.tables.get(n), "external", None)
-            for n in names)
-        if not getattr(plan, "_no_stmt_cache", False) and not any_external:
+        if not getattr(plan, "_no_stmt_cache", False) \
+                and not self._any_external(names):
             self._cache_statement(query, names, runner)
         return runner()
 

@@ -304,6 +304,17 @@ class DropSequence(Node):
 
 
 @dataclass
+class DeclareParallelCursor(Node):
+    name: str
+    query: Node
+
+
+@dataclass
+class CloseCursor(Node):
+    name: str
+
+
+@dataclass
 class CreateMatView(Node):
     name: str
     query: Node

@@ -123,6 +123,17 @@ class Parser:
             self.expect_kw("materialized")
             self.expect_kw("view")
             return ast.RefreshMatView(self.expect_ident())
+        if self.at_kw("declare"):
+            self.advance()
+            name = self.expect_ident()
+            self.expect_kw("parallel")
+            self.expect_kw("retrieve")
+            self.expect_kw("cursor")
+            self.expect_kw("for")
+            return ast.DeclareParallelCursor(name, self.parse_query())
+        if self.at_kw("close"):
+            self.advance()
+            return ast.CloseCursor(self.expect_ident())
         if self.at_kw("insert"):
             return self.parse_insert()
         if self.at_kw("begin", "commit", "rollback", "abort", "start", "end"):

@@ -110,6 +110,40 @@ def test_dml_into_external_rejected(data_dir, fdist):
         s.sql("insert into ext values (1, 2, 'x')")
 
 
+def test_no_trailing_newline_never_merges_rows(tmp_path):
+    # a final unterminated line must not concatenate into the next stripe
+    (tmp_path / "nt.csv").write_bytes(b"1|10\n2|20\n3|30")
+    srv, port = serve(str(tmp_path))
+    try:
+        s = cb.Session(Config(n_segments=1))
+        s.sql(f"create external table nt (k bigint, v bigint) "
+              f"location('cbfdist://127.0.0.1:{port}/nt.csv')")
+        df = s.sql("select k, v from nt order by k").to_pandas()
+        assert [tuple(r) for r in df.to_numpy()] \
+            == [(1, 10), (2, 20), (3, 30)]
+    finally:
+        srv.shutdown()
+
+
+def test_copy_external_to_file_sees_current_source(data_dir, fdist,
+                                                   tmp_path):
+    s = cb.Session(Config(n_segments=1))
+    s.sql(f"create external table cx (k bigint, v bigint, name text) "
+          f"location('cbfdist://127.0.0.1:{fdist}/t.csv')")
+    out = tmp_path / "out.csv"
+    s.sql(f"copy cx to '{out}'")
+    assert len(out.read_text().strip().splitlines()) == 100
+
+
+def test_file_scheme_missing_is_clean_error(tmp_path):
+    s = cb.Session(Config(n_segments=1))
+    s.sql(f"create external table gone (k bigint) "
+          f"location('file://{tmp_path}/nope.csv')")
+    from cloudberry_tpu.plan.binder import BindError
+    with pytest.raises(BindError, match="cannot read source"):
+        s.sql("select k from gone")
+
+
 def test_external_table_sreh(data_dir, fdist):
     (data_dir / "bad.csv").write_text("1|10|aa\nxx|20|bb\n3|30|cc\n")
     s = cb.Session(Config(n_segments=1))

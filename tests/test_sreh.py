@@ -82,6 +82,21 @@ def test_nulls_and_not_null_rejects(sess, tmp_path):
     assert "NOT NULL" in sess.read_error_log("nn")["errmsg"].iloc[0]
 
 
+def test_out_of_range_values_reject_not_wrap(sess, tmp_path):
+    sess.sql("create table narrow (k integer, v bigint)")  # int32 column
+    path = _write(tmp_path, "1|10\n5000000000|20\n"
+                            "3|99999999999999999999\n4|40\n")
+    res = sess.sql(f"copy narrow from '{path}' with segment reject limit 5 "
+                   "log errors")
+    # int32 overflow and int64 overflow both REJECT (never wrap, never
+    # abort the whole load)
+    assert res == "COPY 2 (rejected 2 rows)"
+    df = sess.sql("select k from narrow order by k").to_pandas()
+    assert list(df["k"]) == [1, 4]
+    assert all("out of range" in m
+               for m in sess.read_error_log("narrow")["errmsg"])
+
+
 def test_without_sreh_still_aborts(sess, tmp_path):
     path = _write(tmp_path, GOOD_AND_BAD)
     with pytest.raises(BindError):
