@@ -310,6 +310,12 @@ class Catalog:
         # materialized views: name -> plan/matview.MatViewDef (the data
         # lives in an ordinary table of the same name)
         self.matviews: dict[str, object] = {}
+        # resource queues (resqueue.c analog); "default" always exists and
+        # is unlimited — sessions pick one via config.resource.queue
+        from cloudberry_tpu.exec.resource import ResourceQueue
+
+        self.resource_queues: dict[str, ResourceQueue] = {
+            "default": ResourceQueue("default")}
         self._seq_currval: dict[str, int] = {}  # session-local currval
         # storeless allocation is read-modify-write on shared session
         # state — server handler threads share one Session, so it needs

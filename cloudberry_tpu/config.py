@@ -78,6 +78,14 @@ class ResourceConfig:
     # Tiled out-of-core execution when a plan exceeds the budget (the
     # workfile-manager / spill analog, exec/tiled.py); off = hard refusal.
     enable_spill: bool = True
+    # Engine-wide memory red line across CONCURRENT statements (the vmem
+    # tracker / red-zone analog, redzone_handler.c): admissions reserve
+    # their estimate against it; adaptive growth crossing it terminates
+    # the growing statement (runaway_cleaner.c).
+    total_mem_bytes: int = 16 << 30
+    # The resource queue this session's statements run in (resqueue.c);
+    # queues are created with CREATE RESOURCE QUEUE.
+    queue: str = "default"
 
 
 @dataclass(frozen=True)

@@ -42,7 +42,7 @@ class Endpoint:
 
     @property
     def rows_total(self) -> int:
-        return int(np.asarray(self.batch.sel).sum())
+        return self.batch.num_rows()
 
     def decoded(self) -> dict:
         if self._decoded is None:
@@ -56,6 +56,7 @@ class ParallelCursor:
     token: str
     endpoints: list = field(default_factory=list)
     parallel: bool = True   # False = ON_ENTRY fallback (one endpoint)
+    vmem_id: int = 0        # lifetime reservation for the held results
 
     def info(self) -> dict:
         return {"cursor": self.name, "token": self.token,
@@ -72,42 +73,56 @@ def declare(session, name: str, query_ast) -> dict:
     from cloudberry_tpu.plan.binder import Binder
     from cloudberry_tpu.plan.planner import _optimize
 
+    from cloudberry_tpu.exec.resource import check_admission
+
     name = name.lower()
     if name in session.parallel_cursors:
         raise CursorError(f"cursor {name!r} already exists")
     plan = _optimize(Binder(session.catalog).bind_query(query_ast), session)
+    # the cursor's query is a statement like any other: per-query budget,
+    # queue slot (MAX_COST, priority) and vmem reservation all apply
+    est = check_admission(plan, session)
     nseg = session.config.n_segments
     endpoints: list[Endpoint] = []
     parallel = False
-    if nseg > 1 and getattr(plan, "_direct_segment", None) is None:
-        stripped = _strip_top_gather(plan)
-        if stripped is not None:
-            from cloudberry_tpu.exec.dist_executor import (
-                compile_distributed, prepare_dist_inputs)
+    with session._gate, session._admitted(est.peak_bytes):
+        if nseg > 1 and getattr(plan, "_direct_segment", None) is None:
+            stripped = _strip_top_gather(plan)
+            if stripped is not None:
+                from cloudberry_tpu.exec.dist_executor import (
+                    compile_distributed, prepare_dist_inputs)
 
-            fn = compile_distributed(stripped, session)
-            inputs, _ = prepare_dist_inputs(stripped, session)
-            cols, sel, checks = fn(inputs)
-            X.raise_checks(checks)
-            sel_np = np.asarray(sel)
-            for s in range(nseg):
-                shard_cols = {k: np.asarray(v)[s] for k, v in cols.items()}
-                endpoints.append(Endpoint(
-                    s, X.make_batch(stripped, shard_cols, sel_np[s])))
-            parallel = True
-    if not endpoints:
-        # ON_ENTRY fallback: the top demands a singleton (global sort/
-        # limit/aggregate) — one endpoint at the coordinator
-        from cloudberry_tpu.exec.executor import execute
+                fn = compile_distributed(stripped, session)
+                inputs, _ = prepare_dist_inputs(stripped, session)
+                cols, sel, checks = fn(inputs)
+                X.raise_checks(checks)
+                sel_np = np.asarray(sel)
+                for s in range(nseg):
+                    shard_cols = {k: np.asarray(v)[s]
+                                  for k, v in cols.items()}
+                    endpoints.append(Endpoint(
+                        s, X.make_batch(stripped, shard_cols, sel_np[s])))
+                parallel = True
+        if not endpoints:
+            # ON_ENTRY fallback: the top demands a singleton (global sort/
+            # limit/aggregate) — one endpoint at the coordinator
+            from cloudberry_tpu.exec.executor import execute
 
-        if nseg > 1:
-            from cloudberry_tpu.exec.dist_executor import execute_distributed
+            if nseg > 1:
+                from cloudberry_tpu.exec.dist_executor import (
+                    execute_distributed)
 
-            batch = execute_distributed(plan, session)
-        else:
-            batch = execute(plan, session)
-        endpoints = [Endpoint(0, batch)]
+                batch = execute_distributed(plan, session)
+            else:
+                batch = execute(plan, session)
+            endpoints = [Endpoint(0, batch)]
     cur = ParallelCursor(name, uuid.uuid4().hex, endpoints, parallel)
+    # endpoints HOLD their result shards until CLOSE — that memory stays
+    # reserved against the engine-wide red line for the cursor's lifetime
+    held = sum(int(np.asarray(a).nbytes)
+               for e in endpoints for a in e.batch.columns.values())
+    cur.vmem_id = next(session._stmt_ids)
+    session._vmem.reserve(cur.vmem_id, held, timeout_s=10)
     session.parallel_cursors[name] = cur
     return cur.info()
 
@@ -142,8 +157,10 @@ def retrieve(session, name: str, segment: int, limit: int | None = None,
 
 
 def close_cursor(session, name: str) -> str:
-    if session.parallel_cursors.pop(name.lower(), None) is None:
+    cur = session.parallel_cursors.pop(name.lower(), None)
+    if cur is None:
         raise CursorError(f"unknown cursor {name!r}")
+    session._vmem.release(cur.vmem_id)
     return f"CLOSE {name}"
 
 

@@ -98,6 +98,41 @@ def plan_statement(stmt: ast.Node, session, params: dict,
         return PlanResult(is_ddl=True,
                           ddl_result=f"DROP SEQUENCE {stmt.name}")
 
+    if isinstance(stmt, ast.CreateResourceQueue):
+        from cloudberry_tpu.exec.resource import _PRIORITY, ResourceQueue
+
+        name = stmt.name.lower()
+        if name in catalog.resource_queues:
+            raise BindError(f"resource queue {name!r} already exists")
+        known = {"active_statements", "max_cost", "priority"}
+        bad = set(stmt.options) - known
+        if bad:
+            raise BindError(f"unknown resource queue option(s) "
+                            f"{sorted(bad)}; valid: {sorted(known)}")
+        prio = str(stmt.options.get("priority", "medium")).lower()
+        if prio not in _PRIORITY:
+            raise BindError(f"unknown priority {prio!r}")
+        catalog.resource_queues[name] = ResourceQueue(
+            name,
+            active_statements=int(stmt.options.get("active_statements", 0)),
+            max_cost=int(stmt.options.get("max_cost", 0)),
+            priority=prio)
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"CREATE RESOURCE QUEUE {stmt.name}")
+
+    if isinstance(stmt, ast.DropResourceQueue):
+        name = stmt.name.lower()
+        if name == "default":
+            raise BindError("cannot drop the default resource queue")
+        if name not in catalog.resource_queues:
+            if stmt.if_exists:
+                return PlanResult(is_ddl=True,
+                                  ddl_result="DROP RESOURCE QUEUE")
+            raise BindError(f"unknown resource queue {name!r}")
+        del catalog.resource_queues[name]
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"DROP RESOURCE QUEUE {stmt.name}")
+
     if isinstance(stmt, ast.DeclareParallelCursor):
         from cloudberry_tpu.exec import endpoint as EP
 

@@ -16,30 +16,7 @@ class Client:
         self._r = self._sock.makefile("rb")
         self._w = self._sock.makefile("wb")
 
-    def sql(self, query: str) -> dict:
-        """Execute one statement; returns {"columns", "rows", "rowcount"}
-        for queries or {"status": ...} for DDL/DML; raises ServerError on
-        engine errors."""
-        self._w.write(json.dumps({"sql": query}).encode() + b"\n")
-        self._w.flush()
-        line = self._r.readline()
-        if not line:
-            raise ServerError("server closed the connection")
-        resp = json.loads(line)
-        if not resp.get("ok"):
-            raise ServerError(resp.get("error", "unknown server error"))
-        resp.pop("ok")
-        return resp
-
-    def rows(self, query: str) -> list[list]:
-        return self.sql(query)["rows"]
-
-    def retrieve(self, cursor: str, segment: int, token: str,
-                 limit: int | None = None) -> dict:
-        """Drain one endpoint of a PARALLEL RETRIEVE CURSOR (the
-        retrieve-mode connection, cdbendpointretrieve.c)."""
-        req = {"retrieve": {"cursor": cursor, "segment": segment,
-                            "token": token, "limit": limit}}
+    def _request(self, req: dict) -> dict:
         self._w.write(json.dumps(req).encode() + b"\n")
         self._w.flush()
         line = self._r.readline()
@@ -50,6 +27,24 @@ class Client:
             raise ServerError(resp.get("error", "unknown server error"))
         resp.pop("ok")
         return resp
+
+    def sql(self, query: str) -> dict:
+        """Execute one statement; returns {"columns", "rows", "rowcount"}
+        for queries or {"status": ...} for DDL/DML; raises ServerError on
+        engine errors."""
+        return self._request({"sql": query})
+
+    def rows(self, query: str) -> list[list]:
+        return self.sql(query)["rows"]
+
+    def retrieve(self, cursor: str, segment: int, token: str,
+                 limit: int | None = None) -> dict:
+        """Drain one endpoint of a PARALLEL RETRIEVE CURSOR (the
+        retrieve-mode connection, cdbendpointretrieve.c)."""
+        return self._request({"retrieve": {"cursor": cursor,
+                                           "segment": segment,
+                                           "token": token,
+                                           "limit": limit}})
 
     def close(self) -> None:
         try:

@@ -65,9 +65,15 @@ class Session:
         self._shard_cache: dict[str, ShardedTable] = {}
         # query_info_collect_hook analog: callables receiving QueryMetrics
         self.metrics_hooks: list = []
-        from cloudberry_tpu.exec.resource import AdmissionGate
+        from cloudberry_tpu.exec.resource import (AdmissionGate,
+                                                  QueueManager, VmemTracker)
 
         self._gate = AdmissionGate(self.config.resource.max_concurrency)
+        # resource queues + engine-wide vmem red line (resqueue.c /
+        # vmem_tracker.c analogs, exec/resource.py)
+        self._queues = QueueManager()
+        self._vmem = VmemTracker(self.config.resource.total_mem_bytes)
+        self._stmt_ids = __import__("itertools").count(1)
         # prepared-statement cache: sql text -> (tables, versions, nseg, run)
         self._stmt_cache: dict = {}
         # spill diagnostics for the LAST statement (None = not tiled)
@@ -105,22 +111,23 @@ class Session:
         self.last_tiled_report = None  # set again by a tiled runner
         cached = self._cached_statement(query)
         if cached is not None:
+            runner, cost = cached
             fault_point("dispatch_start")
-            with self._gate:
-                return cached()
+            with self._gate, self._admitted(cost):
+                return runner()
 
         stmt = parse_sql(query)
         result = plan_statement(stmt, self, params)
         if result.is_ddl:
             return result.ddl_result
-        # admission control: memory budget check + statement slot
-        # (vmem-tracker / resgroup analog, exec/resource.py); an over-budget
-        # plan falls back to tiled out-of-core execution (the workfile
-        # manager / spill analog, exec/tiled.py) before refusing
+        # admission control: memory budget check + queue slot + vmem
+        # reservation (vmem-tracker / resqueue analogs, exec/resource.py);
+        # an over-budget plan falls back to tiled out-of-core execution
+        # (the workfile manager / spill analog, exec/tiled.py) first
         from cloudberry_tpu.exec.resource import ResourceError
 
         try:
-            check_admission(result.plan, self)
+            est = check_admission(result.plan, self)
         except ResourceError:
             from cloudberry_tpu.exec.tiled import plan_tiled
 
@@ -128,17 +135,42 @@ class Session:
             if texe is None:
                 raise
             fault_point("dispatch_start")
-            with self._gate:
+            with self._gate, self._admitted(
+                    self.config.resource.query_mem_bytes):
                 return self._run_cached_tiled(query, texe)
         fault_point("dispatch_start")
-        with self._gate:
-            return self._run_with_growth(query, result.plan)
+        with self._gate, self._admitted(est.peak_bytes) as sid:
+            return self._run_with_growth(query, result.plan, sid)
 
-    def _run_with_growth(self, query: str, plan):
+    def _admitted(self, cost: int):
+        """Queue slot (bounded active statements, MAX_COST, priority wake
+        order) + engine-wide vmem reservation for one statement; yields
+        the statement id growth re-reservations key on."""
+        import contextlib
+
+        q = self.catalog.resource_queues.get(
+            self.config.resource.queue.lower()) \
+            or self.catalog.resource_queues["default"]
+
+        @contextlib.contextmanager
+        def _cm():
+            with self._queues.slot(q, cost, q.priority):
+                sid = next(self._stmt_ids)
+                self._vmem.reserve(sid, cost)
+                try:
+                    yield sid
+                finally:
+                    self._vmem.release(sid)
+
+        return _cm()
+
+    def _run_with_growth(self, query: str, plan, stmt_id: int = 0):
         """Execute; on a detected join-expansion overflow, grow the pair
         buffer (re-checking admission) and retry — adaptive capacity, never
         truncation (exec/executor.py:grow_expansion). Growth that blows the
-        budget falls back to tiled execution like any over-budget plan."""
+        per-query budget falls back to tiled execution; growth that would
+        cross the ENGINE-WIDE vmem red line terminates this statement (the
+        runaway_cleaner.c decision)."""
         from cloudberry_tpu.exec.executor import ExecError, grow_expansion
         from cloudberry_tpu.exec.resource import ResourceError, check_admission
 
@@ -149,8 +181,13 @@ class Session:
                 self._stmt_cache.pop(query, None)  # drop the failed runner
                 if not grow_expansion(plan, str(e)):
                     raise
+                from cloudberry_tpu.exec.resource import RunawayError
+
                 try:
-                    check_admission(plan, self)  # growth stays in budget…
+                    est = check_admission(plan, self)  # budget-ok growth…
+                    self._vmem.grow(stmt_id, est.peak_bytes)  # …red-zone ok
+                except RunawayError:
+                    raise  # red-zone termination, never a spill case
                 except ResourceError:
                     from cloudberry_tpu.exec.tiled import plan_tiled
 
@@ -166,7 +203,8 @@ class Session:
         names = sorted({s.table_name
                         for s in X.scans_of(texe._whole_plan())})
         if not self._any_external(names):
-            self._cache_statement(query, names, texe.run)
+            self._cache_statement(query, names, texe.run,
+                                  self.config.resource.query_mem_bytes)
         return texe.run()
 
     def _any_external(self, names) -> bool:
@@ -319,10 +357,13 @@ class Session:
     _STMT_CACHE_MAX = 64
 
     def _cached_statement(self, query: str):
+        """(runner, cost) from a live cache entry, else None — returned
+        together so the caller never re-indexes an entry a concurrent
+        thread may have evicted."""
         entry = self._stmt_cache.get(query)
         if entry is None:
             return None
-        names, versions, nseg, ddlv, runner = entry
+        names, versions, nseg, ddlv, runner, cost = entry
         stale = (nseg != self.config.n_segments
                  or ddlv != self.catalog.ddl_version)
         if not stale:
@@ -331,9 +372,9 @@ class Session:
             except KeyError:
                 stale = True
         if stale:
-            del self._stmt_cache[query]  # free the compiled program
+            self._stmt_cache.pop(query, None)  # free the compiled program
             return None
-        return runner
+        return runner, cost
 
     def _execute_and_cache(self, query: str, plan):
         from cloudberry_tpu.exec import executor as X
@@ -358,17 +399,21 @@ class Session:
         # program would replay the previous read
         if not getattr(plan, "_no_stmt_cache", False) \
                 and not self._any_external(names):
-            self._cache_statement(query, names, runner)
+            from cloudberry_tpu.exec.resource import estimate_plan_memory
+
+            self._cache_statement(query, names, runner,
+                                  estimate_plan_memory(plan).peak_bytes)
         return runner()
 
-    def _cache_statement(self, query: str, names, runner) -> None:
+    def _cache_statement(self, query: str, names, runner,
+                         cost: int = 0) -> None:
         if len(self._stmt_cache) >= self._STMT_CACHE_MAX:
             # FIFO eviction keeps the cache (and its pinned XLA programs)
             # bounded under literal-inlining workloads
             self._stmt_cache.pop(next(iter(self._stmt_cache)))
         self._stmt_cache[query] = (
             names, self._table_versions(names),
-            self.config.n_segments, self.catalog.ddl_version, runner)
+            self.config.n_segments, self.catalog.ddl_version, runner, cost)
 
     def explain(self, query: str) -> str:
         from cloudberry_tpu.sql.parser import parse_sql

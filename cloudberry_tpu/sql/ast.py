@@ -304,6 +304,18 @@ class DropSequence(Node):
 
 
 @dataclass
+class CreateResourceQueue(Node):
+    name: str
+    options: dict  # active_statements, max_cost, priority
+
+
+@dataclass
+class DropResourceQueue(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class DeclareParallelCursor(Node):
     name: str
     query: Node

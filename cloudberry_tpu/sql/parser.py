@@ -104,6 +104,9 @@ class Parser:
                 kind = "view"
             elif self.accept_kw("sequence"):
                 kind = "sequence"
+            elif self.accept_kw("resource"):
+                self.expect_kw("queue")
+                kind = "resqueue"
             else:
                 self.expect_kw("table")
             if_exists = False
@@ -117,6 +120,8 @@ class Parser:
                 return ast.DropMatView(name, if_exists)
             if kind == "sequence":
                 return ast.DropSequence(name, if_exists)
+            if kind == "resqueue":
+                return ast.DropResourceQueue(name, if_exists)
             return ast.DropTable(name, if_exists)
         if self.at_kw("refresh"):
             self.advance()
@@ -173,6 +178,23 @@ class Parser:
             name = self.expect_ident()
             self.expect_kw("as")
             return ast.CreateView(name, self.parse_query())
+        if self.accept_kw("resource"):
+            self.expect_kw("queue")
+            name = self.expect_ident()
+            opts = {}
+            if self.accept_kw("with"):
+                self.expect_op("(")
+                while True:
+                    key = self.expect_ident()
+                    self.expect_op("=")
+                    if self.cur.kind == "string":
+                        opts[key] = self.advance().text
+                    else:
+                        opts[key] = self._signed_int()
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return ast.CreateResourceQueue(name, opts)
         if self.accept_kw("sequence"):
             if_not_exists = False
             if self.accept_kw("if"):

@@ -78,6 +78,25 @@ def test_close_and_errors(sess):
         sess.retrieve("c5", 0)
 
 
+def test_cursor_respects_queue_max_cost(sess):
+    from cloudberry_tpu.exec.resource import ResourceError
+
+    sess.sql("create resource queue tiny with (max_cost=1024)")
+    sess.config = sess.config.with_overrides(**{"resource.queue": "tiny"})
+    with pytest.raises(ResourceError, match="MAX_COST"):
+        sess.sql("declare cq parallel retrieve cursor for "
+                 "select k, v from t")
+    assert "cq" not in sess.parallel_cursors
+
+
+def test_cursor_holds_vmem_until_close(sess):
+    before = sess._vmem.used
+    sess.sql("declare ch parallel retrieve cursor for select k, v from t")
+    assert sess._vmem.used > before  # held results stay reserved
+    sess.sql("close ch")
+    assert sess._vmem.used == before
+
+
 def test_wire_parallel_retrieval_with_token():
     session = cb.Session(Config(n_segments=8))
     session.sql("create table w (k bigint, v bigint) distributed by (k)")

@@ -1,0 +1,140 @@
+"""Resource queues, statement prioritization, and the vmem red zone.
+
+Reference: cost/count-based resource queues with waiters
+(resscheduler/resqueue.c), priority weights (postmaster/backoff.c), and
+the engine-wide memory red line with runaway termination
+(redzone_handler.c, runaway_cleaner.c).
+"""
+
+import threading
+import time
+
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.exec.resource import (QueueManager, ResourceError,
+                                          ResourceQueue, VmemTracker)
+from cloudberry_tpu.plan.binder import BindError
+
+
+def test_create_drop_resource_queue_sql():
+    s = cb.Session(Config(n_segments=1))
+    s.sql("create resource queue etl with (active_statements=2, "
+          "priority='high')")
+    q = s.catalog.resource_queues["etl"]
+    assert q.active_statements == 2 and q.priority == "high"
+    with pytest.raises(BindError):
+        s.sql("create resource queue etl")
+    s.sql("drop resource queue etl")
+    with pytest.raises(BindError):
+        s.sql("drop resource queue etl")
+    with pytest.raises(BindError):
+        s.sql("drop resource queue default")
+
+
+def test_max_cost_rejects_expensive_statements():
+    s = cb.Session(Config(n_segments=1).with_overrides(
+        **{"resource.queue": "small"}))
+    s.sql("create resource queue small with (max_cost=1024)")
+    s.sql("create table big (k bigint, v bigint)")
+    s.sql("insert into big values " +
+          ", ".join(f"({i}, {i})" for i in range(500)))
+    with pytest.raises(ResourceError, match="MAX_COST"):
+        s.sql("select sum(v) as s from big")
+
+
+def test_active_statements_bounds_concurrency():
+    qm = QueueManager()
+    q = ResourceQueue("q", active_statements=2)
+    running, peak, done = [0], [0], []
+    lock = threading.Lock()
+
+    def work(i):
+        with qm.slot(q, 0, "medium"):
+            with lock:
+                running[0] += 1
+                peak[0] = max(peak[0], running[0])
+            time.sleep(0.05)
+            with lock:
+                running[0] -= 1
+            done.append(i)
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(6)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(done) == 6
+    assert peak[0] <= 2  # the queue's whole point
+
+
+def test_priority_orders_waiters():
+    qm = QueueManager()
+    q = ResourceQueue("q", active_statements=1)
+    order = []
+    hold = threading.Event()
+    started = threading.Event()
+
+    def holder():
+        with qm.slot(q, 0, "medium"):
+            started.set()
+            hold.wait(5)
+
+    def waiter(prio, tag, delay):
+        time.sleep(delay)
+        with qm.slot(q, 0, prio):
+            order.append(tag)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    started.wait(5)
+    ws = [threading.Thread(target=waiter, args=("low", "low", 0.0)),
+          threading.Thread(target=waiter, args=("max", "max", 0.1))]
+    [w.start() for w in ws]
+    time.sleep(0.3)  # both queued: low arrived first, max outranks it
+    hold.set()
+    th.join()
+    [w.join() for w in ws]
+    assert order[0] == "max"
+
+
+def test_vmem_red_zone_blocks_then_admits():
+    vm = VmemTracker(1000)
+    vm.reserve(1, 800)
+    t0 = time.monotonic()
+    done = []
+
+    def second():
+        vm.reserve(2, 500, timeout_s=10)
+        done.append(time.monotonic() - t0)
+        vm.release(2)
+
+    th = threading.Thread(target=second)
+    th.start()
+    time.sleep(0.15)
+    assert not done  # still waiting: 800 + 500 > 1000
+    vm.release(1)
+    th.join(5)
+    assert done and done[0] >= 0.1
+
+
+def test_runaway_growth_terminated():
+    vm = VmemTracker(1000)
+    vm.reserve(1, 400)
+    vm.reserve(2, 400)
+    vm.grow(1, 550)  # fits: 550 + 400
+    with pytest.raises(ResourceError, match="runaway"):
+        vm.grow(1, 700)  # 700 + 400 > 1000
+    vm.release(1)
+    vm.grow(2, 900)  # after the release there is room
+
+
+def test_queue_admission_visible_through_session():
+    s = cb.Session(Config(n_segments=1).with_overrides(
+        **{"resource.queue": "one"}))
+    s.sql("create resource queue one with (active_statements=1)")
+    s.sql("create table t (k bigint)")
+    s.sql("insert into t values (1), (2)")
+    # statements run (and release their slot) normally
+    assert s.sql("select count(*) as c from t").to_pandas()["c"].iloc[0] == 2
+    q = s.catalog.resource_queues["one"]
+    assert q.active == 0 and q.waiting == 0
