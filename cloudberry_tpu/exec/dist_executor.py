@@ -32,12 +32,16 @@ from cloudberry_tpu.plan import nodes as N
 from cloudberry_tpu.utils import hashing
 
 
-def prepare_dist_inputs(plan: N.PlanNode, session):
+def prepare_dist_inputs(plan: N.PlanNode, session, names=None):
     """(inputs, in_specs) for every scanned table: partitioned columns as
-    (nseg, cap) arrays split on the seg axis, replicated tables whole."""
+    (nseg, cap) arrays split on the seg axis, replicated tables whole.
+    ``names`` overrides the table set (tiled execution keeps the streamed
+    table out of the resident inputs)."""
     inputs = {}
     in_specs = {}
-    for name in sorted({s.table_name for s in X.scans_of(plan)}):
+    if names is None:
+        names = sorted({s.table_name for s in X.scans_of(plan)})
+    for name in names:
         st = session.sharded_table(name)
         if st.replicated:
             inputs[name] = {"$cols": dict(st.columns),
@@ -126,8 +130,9 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 
 class DistLowerer(X.Lowerer):
-    def __init__(self, tables, nseg: int, platform: str | None = None):
-        super().__init__(tables, platform=platform)
+    def __init__(self, tables, nseg: int, platform: str | None = None,
+                 use_pallas: bool = False):
+        super().__init__(tables, platform=platform, use_pallas=use_pallas)
         self.nseg = nseg
 
     def scan(self, node: N.PScan):

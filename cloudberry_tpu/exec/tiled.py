@@ -79,7 +79,9 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
     if not session.config.resource.enable_spill:
         return None
     if session.config.n_segments > 1:
-        return None  # distributed tiling: exec/tiled_dist.py handles it
+        from cloudberry_tpu.exec.tiled_dist import plan_tiled_dist
+
+        return plan_tiled_dist(plan, session)
     if getattr(plan, "_direct_segment", None) is not None:
         return None
     shape = _analyze(plan)
@@ -291,7 +293,96 @@ class _TileLowerer(_ReplacingLowerer):
 # --------------------------------------------------------------- execution
 
 
-class TiledExecutable:
+class AdaptiveTiledMixin:
+    """Shared adaptive-retry discipline for tiled executables (single-node
+    and distributed): classify a detected overflow, grow the guilty buffer
+    (accumulator / join pair buffer) or shrink the tile, and re-run — the
+    increase-nbatch-and-rescan loop of nodeHash.c, never truncation.
+
+    Requires from the concrete class: ``shape`` (with ``partial_plan`` and
+    ``g_cap``), ``tile_rows``, ``budget``, ``report``, ``_compiled``,
+    ``_refresh_report()``, ``_run_once()``, ``_groups_ceiling()``, and
+    ``_what`` (human name for the budget error)."""
+
+    _what = "tiled execution"
+
+    def _run_adaptive(self) -> ColumnBatch:
+        while True:
+            try:
+                return self._run_once()
+            except X.ExecError as e:
+                msg = str(e)
+                shape = self.shape
+                if not msg.startswith("[tile"):
+                    # prelude/finalize failure: expansion overflows grow
+                    # that join's pair buffer and retry
+                    if not X.grow_expansion(shape.partial_plan, msg):
+                        raise
+                elif ("merge overflow" in msg
+                      or "aggregation overflow" in msg):
+                    # more groups than estimated: grow the accumulator and
+                    # restart the stream — never truncate. Doubling (the
+                    # nbatch discipline of nodeHash.c) overshoots the true
+                    # group count by at most 2×, which matters downstream:
+                    # the distributed finalize merges nseg·g_cap rows.
+                    ceiling = self._groups_ceiling()
+                    if shape.g_cap >= ceiling:
+                        raise
+                    shape.g_cap = min(shape.g_cap * 2, ceiling)
+                elif "expansion overflow" in msg:
+                    # a tile's join fanout blew its pair buffer: grow that
+                    # join when the budget allows, else halve the tile
+                    if not (self._try_grow(msg)
+                            or self._try_halve_tile()):
+                        raise
+                elif "redistribute overflow" in msg:
+                    # an estimate-sized bucket overflowed inside a tile:
+                    # smaller tiles shrink every per-tile send bound
+                    if not self._try_halve_tile():
+                        raise
+                else:
+                    raise
+                self._compiled = None
+                self._refresh_report()
+                # a grown accumulator may blow the step budget: smaller
+                # tiles buy the room back before giving up
+                while self._over_budget() and self._try_halve_tile():
+                    self._refresh_report()
+                if self._over_budget():
+                    raise X.ExecError(
+                        f"{self._what} working set (accumulator "
+                        f"{shape.g_cap} groups, tile {self.tile_rows} "
+                        "rows) exceeds the query memory budget "
+                        f"{self.budget >> 20} MiB; raise "
+                        "config.resource.query_mem_bytes") from e
+
+    def _over_budget(self) -> bool:
+        return self.report["est_step_bytes"] > self.budget
+
+    def _try_grow(self, msg: str) -> bool:
+        """Grow the overflowing spine join's pair buffer if the grown step
+        still fits the budget; revert (and report False) otherwise."""
+        node = X.find_expansion_node(self.shape.partial_plan, msg)
+        if node is None:
+            return False
+        old = getattr(node, "_min_out_cap", 0)
+        node._min_out_cap = max(node.out_capacity * 4, 64)
+        self._refresh_report()
+        if self.report["est_step_bytes"] <= self.budget:
+            return True
+        node._min_out_cap = old
+        self._refresh_report()
+        return False
+
+    def _try_halve_tile(self) -> bool:
+        if self.tile_rows <= _MIN_TILE:
+            return False
+        self.tile_rows >>= 1
+        return True
+
+
+
+class TiledExecutable(AdaptiveTiledMixin):
     """Compiled tiled statement: prelude (once) → step (per tile) →
     finalize. ``report`` records the spill decision for tests/EXPLAIN."""
 
@@ -439,66 +530,8 @@ class TiledExecutable:
         with self._run_lock:
             return self._run_adaptive()
 
-    def _run_adaptive(self) -> ColumnBatch:
-        while True:
-            try:
-                return self._run_once()
-            except X.ExecError as e:
-                msg = str(e)
-                shape = self.shape
-                if not msg.startswith("[tile"):
-                    # prelude (build-side) failure: expansion overflows
-                    # grow that join's pair buffer and retry
-                    if not X.grow_expansion(shape.partial_plan, msg):
-                        raise
-                elif ("merge overflow" in msg
-                      or "aggregation overflow" in msg):
-                    # more groups than estimated: grow the accumulator and
-                    # restart the stream (the increase-nbatch-and-rescan
-                    # discipline of nodeHash.c) — never truncate
-                    if shape.g_cap >= shape.agg.capacity:
-                        raise
-                    shape.g_cap = min(shape.g_cap * 4, shape.agg.capacity)
-                elif "expansion overflow" in msg:
-                    # a tile's join fanout blew its pair buffer: grow that
-                    # join (the growth sticks — _retile honors
-                    # _min_out_cap) when the budget allows, else halve
-                    # the tile (smaller probe slice → fewer pairs)
-                    if not (self._try_grow(msg)
-                            or self._try_halve_tile()):
-                        raise
-                else:
-                    raise
-                self._compiled = None
-                self._refresh_report()
-                if self.report["est_step_bytes"] > self.budget:
-                    raise X.ExecError(
-                        "tiled execution working set "
-                        f"(accumulator {shape.g_cap} groups, tile "
-                        f"{self.tile_rows} rows) exceeds the query memory "
-                        f"budget {self.budget >> 20} MiB; raise "
-                        "config.resource.query_mem_bytes") from e
-
-    def _try_grow(self, msg: str) -> bool:
-        """Grow the overflowing spine join's pair buffer if the grown step
-        still fits the budget; revert (and report False) otherwise."""
-        node = X.find_expansion_node(self.shape.partial_plan, msg)
-        if node is None:
-            return False
-        old = getattr(node, "_min_out_cap", 0)
-        node._min_out_cap = max(node.out_capacity * 4, 64)
-        self._refresh_report()
-        if self.report["est_step_bytes"] <= self.budget:
-            return True
-        node._min_out_cap = old
-        self._refresh_report()
-        return False
-
-    def _try_halve_tile(self) -> bool:
-        if self.tile_rows <= _MIN_TILE:
-            return False
-        self.tile_rows >>= 1
-        return True
+    def _groups_ceiling(self) -> int:
+        return self.shape.agg.capacity
 
     def _run_once(self) -> ColumnBatch:
         prelude_fn, step_fn, finalize_fn = self._compile()

@@ -1,0 +1,613 @@
+"""Distributed tiled (out-of-core) execution — spill on the segment mesh.
+
+The reference spills operator state per segment process (workfile_mgr.c,
+nodeHash.c's increase-nbatch discipline) while Motion keeps flowing between
+slices. The XLA translation (exec/tiled.py rationale) moves the spill
+boundary to plan time; HERE it moves onto the mesh: when an
+admission-rejected plan is distributed (n_segments > 1), the probe-side
+stream is tiled PER SEGMENT and each step is one shard_map program over the
+segment mesh — the plan's Motions (redistribute / runtime filters) execute
+INSIDE every step as per-tile collectives:
+
+- prelude (once): every spine join's build subtree — including its own
+  motions (broadcast of small tables, build-side redistributes) — computed
+  by one SPMD program; the per-segment results stay resident on device;
+- step (per tile): each segment feeds tile t of ITS shard; the spine's
+  redistribute motions run per tile with bucket capacity min(planned, tile)
+  — a tile of T rows can never send more than T rows to one destination,
+  so per-tile flow control is overflow-free whenever the planned cap was
+  exact; the tile's partial aggregation merges into a per-segment
+  fixed-capacity accumulator (associative partials — any tile order and
+  count gives the same answer, plan/distribute.py:_split_aggs);
+- finalize (once): the accumulators take the partial aggregation's place in
+  the ORIGINAL distributed plan — the merge motion (gather / redistribute
+  by group keys), final aggregation, and post chain run unchanged as one
+  last SPMD program.
+
+Peak device memory per segment is the admitted estimate: resident builds +
+one tile's working set (including its post-motion receive buffers) + the
+accumulator — independent of the streamed table's size. That is the SF100
+contract: shard size is bounded by host RAM, device HBM only by the budget.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from cloudberry_tpu.columnar.batch import ColumnBatch
+from cloudberry_tpu.exec import executor as X
+from cloudberry_tpu.exec import kernels as K
+from cloudberry_tpu.exec.dist_executor import (DistLowerer, _local_row,
+                                               _shard_map,
+                                               prepare_dist_inputs)
+from cloudberry_tpu.exec.resource import estimate_plan_memory
+from cloudberry_tpu.exec.tiled import (_MAX_TILE, _MIN_TILE, _acc_width,
+                                       _expr_dict, _merge_bytes, _out_cap,
+                                       _raise_tile_checks, AdaptiveTiledMixin)
+from cloudberry_tpu.parallel.mesh import SEG_AXIS, segment_mesh
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.plan.distribute import (_all_exprs, _finalize_project,
+                                            _split_aggs)
+
+
+@dataclass
+class _DistTileShape:
+    """Everything the rewrite discovered about the distributed plan."""
+
+    root: N.PlanNode                 # finalize program root (whole plan)
+    replace_node: N.PlanNode         # node the accumulator stands in for
+    partial_plan: N.PAgg             # per-tile partial aggregation
+    merge_motion: Optional[N.PMotion]  # motion above the partial (case A)
+    final_agg: Optional[N.PAgg]      # merge aggregation (case A)
+    spine: list[N.PlanNode]          # partial.child .. just above the stream
+    stream: N.PScan                  # the tiled per-segment scan
+    builds: list[N.PlanNode]         # prelude-computed subtrees
+    stream_rows: int = 0             # max per-segment shard rows
+    merge_specs: list = field(default_factory=list)
+    group_names: list = field(default_factory=list)
+    g_cap: int = 0                   # per-segment accumulator capacity
+    max_groups: int = 0              # hard ceiling for g_cap growth
+
+
+def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"]:
+    """Re-plan an admission-rejected DISTRIBUTED statement for tiled
+    execution over the segment mesh. None when the plan shape or the
+    budget cannot support it."""
+    if not session.config.resource.enable_spill:
+        return None
+    if getattr(plan, "_direct_segment", None) is not None:
+        return None
+    shape = _analyze_dist(plan, session)
+    if shape is None:
+        return None
+
+    # whole-run growth marks belong to the untiled attempt; the tiled
+    # adaptive loop re-learns spine buffer sizes per tile (builds keep
+    # theirs — the prelude still computes whole builds)
+    for node in shape.spine:
+        if isinstance(node, N.PJoin) and hasattr(node, "_min_out_cap"):
+            del node._min_out_cap
+
+    from cloudberry_tpu.plan.cost import estimate_rows
+
+    try:
+        est_groups = estimate_rows(shape.partial_plan, session.catalog)
+    except Exception:
+        est_groups = 1024
+    shape.g_cap = int(min(shape.max_groups,
+                          max(1024, 4 * int(est_groups) + 1)))
+    if not shape.group_names:
+        shape.g_cap = 1
+
+    budget = session.config.resource.query_mem_bytes
+    tile_rows = _choose_tile_dist(shape, budget, session.config.n_segments)
+    if tile_rows is None:
+        return None
+    return DistTiledExecutable(shape, session, tile_rows, budget)
+
+
+def _analyze_dist(plan: N.PlanNode, session) -> Optional[_DistTileShape]:
+    """Recognize the streamable distributed shape: post chain (projections /
+    sorts / limits / gather motions) over a two-stage aggregation
+    (final ← motion ← partial) — or a colocated one-stage aggregation —
+    over a join/filter/redistribute spine whose probe path ends at a
+    partitioned scan."""
+    for e in _all_exprs(plan):
+        for sub in ex.walk(e):
+            if isinstance(sub, ex.SubqueryScalar):
+                return None  # subquery plans scan outside the spine budget
+
+    post: list[N.PlanNode] = []
+    cur = plan
+    while True:
+        if isinstance(cur, (N.PProject, N.PSort, N.PLimit, N.PFilter)):
+            post.append(cur)
+            cur = cur.child
+        elif isinstance(cur, N.PMotion) and cur.kind == "gather":
+            post.append(cur)
+            cur = cur.child
+        else:
+            break
+    if not isinstance(cur, N.PAgg):
+        return None
+
+    if cur.mode == "final":
+        final_agg = cur
+        motion = final_agg.child
+        if not isinstance(motion, N.PMotion) \
+                or motion.kind not in ("gather", "redistribute"):
+            return None
+        partial = motion.child
+        if not isinstance(partial, N.PAgg) or partial.mode != "partial":
+            return None
+        merge_specs = [K.AggSpec(call.func, name)
+                       for name, call in final_agg.aggs]
+        group_names = [n for n, _ in partial.group_keys]
+        spine_res = _walk_spine(partial.child, session)
+        if spine_res is None:
+            return None
+        spine, stream, builds, stream_rows = spine_res
+        return _DistTileShape(
+            root=plan, replace_node=partial, partial_plan=partial,
+            merge_motion=motion, final_agg=final_agg, spine=spine,
+            stream=stream, builds=builds, stream_rows=stream_rows,
+            merge_specs=merge_specs, group_names=group_names,
+            max_groups=partial.capacity)
+
+    if cur.mode != "single":
+        return None
+    # one-stage colocated aggregation: build the partial/merge split the
+    # single-node tiled planner uses; the accumulator IS the final state
+    # per segment (groups are colocated), so finalize is just the
+    # finalize-projection + post chain
+    agg = cur
+    try:
+        partial_aggs, final_aggs, finalize = _split_aggs(agg.aggs)
+    except ValueError:
+        return None
+    spine_res = _walk_spine(agg.child, session)
+    if spine_res is None:
+        return None
+    spine, stream, builds, stream_rows = spine_res
+
+    from cloudberry_tpu.exec.tiled import _AccLeaf
+
+    partial = N.PAgg(agg.child, agg.group_keys, partial_aggs,
+                     capacity=agg.capacity, mode="partial")
+    partial.fields = [
+        N.PlanField(n, e.dtype, _expr_dict(agg.child, e))
+        for n, e in agg.group_keys
+    ] + [N.PlanField(n, c.dtype, None) for n, c in partial_aggs]
+
+    leaf = _AccLeaf()
+    leaf.fields = list(partial.fields)
+    leaf.sharding = agg.sharding
+    fproj = _finalize_project(leaf, agg, finalize)
+    fproj.sharding = agg.sharding
+    if post:
+        post[-1].child = fproj
+        root = post[0]
+    else:
+        root = fproj
+    merge_specs = [K.AggSpec(call.func, name) for name, call in final_aggs]
+    return _DistTileShape(
+        root=root, replace_node=leaf, partial_plan=partial,
+        merge_motion=None, final_agg=None, spine=spine, stream=stream,
+        builds=builds, stream_rows=stream_rows, merge_specs=merge_specs,
+        group_names=[n for n, _ in agg.group_keys],
+        max_groups=agg.capacity)
+
+
+def _walk_spine(top: N.PlanNode, session):
+    """Descend the probe path: filters/projections/runtime filters/joins/
+    redistribute motions down to a partitioned scan (the stream)."""
+    spine: list[N.PlanNode] = []
+    builds: list[N.PlanNode] = []
+    seen: set[int] = set()
+    cur = top
+    while True:
+        if isinstance(cur, (N.PFilter, N.PProject)):
+            spine.append(cur)
+            cur = cur.child
+        elif isinstance(cur, N.PRuntimeFilter):
+            spine.append(cur)
+            if id(cur.build) not in seen:
+                seen.add(id(cur.build))
+                builds.append(cur.build)
+            cur = cur.child
+        elif isinstance(cur, N.PMotion) and cur.kind == "redistribute":
+            cur._orig_bucket_cap = cur.bucket_cap
+            spine.append(cur)
+            cur = cur.child
+        elif isinstance(cur, N.PJoin):
+            if cur.kind == "full":
+                return None  # unmatched-BUILD emission is once-per-stmt
+            spine.append(cur)
+            if id(cur.build) not in seen:
+                seen.add(id(cur.build))
+                builds.append(cur.build)
+            cur = cur.probe
+        elif isinstance(cur, N.PScan) and cur.table_name != "$dual":
+            try:
+                t = session.catalog.table(cur.table_name)
+            except KeyError:
+                return None
+            if t.policy.kind == "replicated":
+                return None  # stream the partitioned side only
+            st = session.sharded_table(cur.table_name)
+            rows = int(st.counts.max()) if len(st.counts) else 0
+            return spine, cur, builds, max(rows, 1)
+        else:
+            return None
+
+
+def _retile_dist(shape: _DistTileShape, tile_rows: int, nseg: int) -> None:
+    """Re-derive spine capacities for one tile per segment. Redistribute
+    buckets are clamped to the per-tile send bound (a source segment's tile
+    holds at most ``cap`` rows, so no destination bucket can exceed it);
+    expansion joins keep the NDV pair-estimate floor scaled to the tile
+    fraction, and runtime-grown buffers (_min_out_cap) never shrink."""
+    frac = tile_rows / max(shape.stream_rows, 1)
+    shape.stream.capacity = tile_rows
+    shape.stream.num_rows = -2
+    cap = tile_rows
+    for node in reversed(shape.spine):
+        if isinstance(node, N.PMotion):  # redistribute (walk guarantees)
+            node.bucket_cap = max(min(node._orig_bucket_cap, cap), 8)
+            node.out_capacity = node.bucket_cap * nseg
+            cap = node.out_capacity
+        elif isinstance(node, N.PJoin):
+            bcap = _out_cap(node.build)
+            est = getattr(node, "_est_pairs", None)
+            floor = int(2 * est / nseg * min(frac, 1.0)) + 8 if est else 0
+            floor = max(floor, getattr(node, "_min_out_cap", 0))
+            if node.residual is not None:
+                node.out_capacity = max(bcap + cap, floor)
+            elif not node.unique_build:
+                node.out_capacity = max(bcap + cap, floor)
+                cap = node.out_capacity
+    shape.partial_plan.capacity = min(shape.g_cap, max(cap, 1)) \
+        if shape.group_names else 1
+
+
+def _finalize_bytes(shape: _DistTileShape, nseg: int) -> int:
+    """Working set of the one-shot finalize program per segment: the merge
+    motion's receive buffer and final aggregation both hold up to
+    nseg·g_cap accumulator rows (one g_cap block from every segment); the
+    colocated one-stage case never leaves the segment."""
+    rows = shape.g_cap * (nseg if shape.merge_motion is not None else 1)
+    return 3 * rows * _acc_width(shape)
+
+
+def _choose_tile_dist(shape: _DistTileShape, budget: int,
+                      nseg: int) -> Optional[int]:
+    if _finalize_bytes(shape, nseg) > budget:
+        return None  # no tile size can shrink the finalize program
+    t = _MAX_TILE
+    while t >= _MIN_TILE:
+        _retile_dist(shape, t, nseg)
+        est = estimate_plan_memory(shape.partial_plan).peak_bytes
+        if est + _merge_bytes(shape) <= budget:
+            return t
+        t >>= 1
+    return None
+
+
+# --------------------------------------------------------------- lowerers
+
+
+class _DistReplacingLowerer(DistLowerer):
+    """DistLowerer with a node-identity substitution table (prelude-computed
+    builds; the finalize accumulator)."""
+
+    def __init__(self, tables, nseg: int, replace: dict, **kw):
+        super().__init__(tables, nseg, **kw)
+        self._replace = replace
+
+    def lower(self, node: N.PlanNode):
+        hit = self._replace.get(id(node))
+        if hit is not None:
+            return hit
+        return super().lower(node)
+
+
+class _DistTileLowerer(_DistReplacingLowerer):
+    """Step-program lowerer: the stream scan reads this segment's tile."""
+
+    def __init__(self, tables, nseg: int, stream: N.PScan, tile_n,
+                 replace: dict, **kw):
+        super().__init__(tables, nseg, replace, **kw)
+        self._stream = stream
+        self._tile_n = tile_n
+
+    def scan(self, node: N.PScan):
+        if node is not self._stream:
+            return super().scan(node)
+        tile = self.tables["$tile"]
+        cols = {}
+        for phys, out in node.column_map.items():
+            cols[out] = tile[phys]
+        for phys, out in node.mask_map.items():
+            cols[out] = tile[f"$nn:{phys}"]
+        sel = jnp.arange(node.capacity) < self._tile_n
+        return cols, sel
+
+
+# --------------------------------------------------------------- execution
+
+
+def _strip_seg(tree):
+    """Per-segment block view inside shard_map: drop the leading (1,) axis
+    every sharded leaf carries."""
+    return jax.tree_util.tree_map(lambda a: a[0], tree)
+
+
+def _add_seg(tree):
+    return jax.tree_util.tree_map(lambda a: a[None], tree)
+
+
+def _reduce_checks(checks: dict) -> dict:
+    """Replicated any-segment-tripped scalars — readable on every host."""
+    return {k: jax.lax.psum(jnp.asarray(v).astype(jnp.int32), SEG_AXIS) > 0
+            for k, v in checks.items()}
+
+
+class DistTiledExecutable(AdaptiveTiledMixin):
+    """Compiled distributed tiled statement: prelude (once) → step (per
+    tile, lock-step across segments) → finalize. ``report`` records the
+    spill decision for tests/EXPLAIN."""
+
+    _what = "distributed tiled execution"
+
+    def __init__(self, shape: _DistTileShape, session, tile_rows: int,
+                 budget: int):
+        self.shape = shape
+        self.session = session
+        self.nseg = session.config.n_segments
+        self.tile_rows = tile_rows
+        self.budget = budget
+        self._use_pallas = session.config.exec.use_pallas
+        self._compiled = None
+        self._run_lock = threading.Lock()
+        self._refresh_report()
+
+    def _refresh_report(self) -> None:
+        shape = self.shape
+        _retile_dist(shape, self.tile_rows, self.nseg)
+        est = estimate_plan_memory(shape.partial_plan).peak_bytes
+        self.report = {
+            "tiled": True,
+            "distributed": True,
+            "n_segments": self.nseg,
+            "stream_table": shape.stream.table_name,
+            "tile_rows": self.tile_rows,
+            "acc_capacity": shape.g_cap,
+            "est_step_bytes": est + _merge_bytes(shape),
+            "est_finalize_bytes": _finalize_bytes(shape, self.nseg),
+            "budget_bytes": self.budget,
+        }
+
+    def _over_budget(self) -> bool:
+        return (self.report["est_step_bytes"] > self.budget
+                or self.report["est_finalize_bytes"] > self.budget)
+
+    def _groups_ceiling(self) -> int:
+        return self.shape.max_groups
+
+    # ------------------------------------------------------------ programs
+
+    def _whole_plan(self) -> N.PlanNode:
+        return self.shape.partial_plan
+
+    def _resident_names(self) -> list[str]:
+        return sorted({s.table_name
+                       for s in X.scans_of(self.shape.partial_plan)
+                       if s is not self.shape.stream})
+
+    def _compile(self):
+        if self._compiled is not None:
+            return self._compiled
+        shape = self.shape
+        nseg = self.nseg
+        mesh = segment_mesh(nseg)
+        names = self._resident_names()
+        _, res_specs = prepare_dist_inputs(None, self.session, names=names)
+
+        def prelude_seg(tables):
+            low = DistLowerer(tables, nseg, use_pallas=self._use_pallas)
+            outs = [_add_seg(low.lower_shared(b)) for b in shape.builds]
+            return outs, _reduce_checks(low.checks)
+
+        prelude_fn = jax.jit(_shard_map(
+            prelude_seg, mesh, (res_specs,), (P(SEG_AXIS), P())))
+
+        group_names = list(shape.group_names)
+        specs = shape.merge_specs
+
+        def step_seg(resident, prelude, tile, tile_n, acc):
+            tables = dict(resident)
+            tables["$tile"] = _strip_seg(tile)
+            plocal = _strip_seg(prelude)
+            replace = {id(b): tuple(plocal[i])
+                       for i, b in enumerate(shape.builds)}
+            low = _DistTileLowerer(tables, nseg, shape.stream,
+                                   tile_n.reshape(()), replace,
+                                   use_pallas=self._use_pallas)
+            pcols, psel = low.lower(shape.partial_plan)
+            checks = dict(low.checks)
+            acc_cols, acc_sel = _strip_seg(tuple(acc))
+            g_cap = shape.g_cap
+            if group_names:
+                key_cols = {n: jnp.concatenate([acc_cols[n], pcols[n]])
+                            for n in group_names}
+                agg_vals = {s.out_name: jnp.concatenate(
+                    [acc_cols[s.out_name], pcols[s.out_name]])
+                    for s in specs}
+                sel = jnp.concatenate([acc_sel, psel])
+                ok, oa, osel, n_groups = K.group_aggregate(
+                    key_cols, agg_vals, specs, sel, g_cap)
+                checks["tile merge overflow: more groups than capacity "
+                       f"{g_cap}; raise the aggregation capacity"] = \
+                    n_groups > g_cap
+                return _add_seg(({**ok, **oa}, osel)), \
+                    _reduce_checks(checks)
+            agg_vals = {s.out_name: jnp.concatenate(
+                [acc_cols[s.out_name], pcols[s.out_name]])
+                for s in specs}
+            sel = jnp.concatenate([acc_sel, psel])
+            out = K.global_aggregate(agg_vals, specs, sel)
+            return _add_seg((out, jnp.ones((1,), dtype=jnp.bool_))), \
+                _reduce_checks(checks)
+
+        step_in = (res_specs, P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS),
+                   P(SEG_AXIS))
+        # donate the accumulator so the step updates in place on device;
+        # CPU XLA can't always honor donation — skip the warning noise
+        donate = () if jax.default_backend() == "cpu" else (4,)
+        step_fn = jax.jit(_shard_map(step_seg, mesh, step_in,
+                                     (P(SEG_AXIS), P())),
+                          donate_argnums=donate)
+
+        def finalize_seg(acc):
+            acc_cols, acc_sel = _strip_seg(tuple(acc))
+            low = _DistReplacingLowerer(
+                {}, nseg, {id(shape.replace_node): (acc_cols, acc_sel)},
+                use_pallas=self._use_pallas)
+            cols, sel = low.lower(shape.root)
+            out = {f.name: cols[f.name][None] for f in shape.root.fields}
+            return out, sel[None], _reduce_checks(low.checks)
+
+        finalize_fn = jax.jit(_shard_map(
+            finalize_seg, mesh, (P(SEG_AXIS),),
+            (P(SEG_AXIS), P(SEG_AXIS), P())))
+
+        self._compiled = (prelude_fn, step_fn, finalize_fn)
+        return self._compiled
+
+    def _refinalize(self) -> None:
+        """Size the merge boundary for the accumulator: a segment's acc has
+        at most g_cap rows, so a redistribute bucket (all of one source's
+        acc to one destination) is bounded by g_cap, and the final
+        aggregation sees at most nseg·g_cap rows."""
+        shape = self.shape
+        if shape.merge_motion is not None:
+            if shape.merge_motion.kind == "redistribute":
+                shape.merge_motion.bucket_cap = shape.g_cap
+            shape.merge_motion.out_capacity = shape.g_cap * self.nseg
+        if shape.final_agg is not None:
+            shape.final_agg.capacity = max(shape.g_cap * self.nseg, 1)
+
+    def _init_acc(self):
+        shape = self.shape
+        g_cap = shape.g_cap
+        cols = {}
+        if shape.group_names:
+            for f in shape.partial_plan.fields:
+                cols[f.name] = np.zeros((self.nseg, g_cap),
+                                        dtype=f.type.np_dtype)
+            return cols, np.zeros((self.nseg, g_cap), dtype=np.bool_)
+        for f, spec in zip(shape.partial_plan.fields, shape.merge_specs):
+            dt = f.type.np_dtype
+            if spec.func == "min":
+                ident = np.array(
+                    np.finfo(dt).max if np.issubdtype(dt, np.floating)
+                    else np.iinfo(dt).max, dtype=dt)
+            elif spec.func == "max":
+                ident = np.array(
+                    np.finfo(dt).min if np.issubdtype(dt, np.floating)
+                    else np.iinfo(dt).min, dtype=dt)
+            else:
+                ident = np.zeros((), dtype=dt)
+            cols[f.name] = np.full((self.nseg, 1), ident)
+        # identity row stays unselected: min/max identities must not leak
+        return cols, np.zeros((self.nseg, 1), dtype=np.bool_)
+
+    # ----------------------------------------------------------------- run
+
+    def run(self) -> ColumnBatch:
+        with self._run_lock:
+            return self._run_adaptive()
+
+    def _run_once(self) -> ColumnBatch:
+        _retile_dist(self.shape, self.tile_rows, self.nseg)
+        self._refinalize()
+        prelude_fn, step_fn, finalize_fn = self._compile()
+        resident, _ = prepare_dist_inputs(
+            None, self.session, names=self._resident_names())
+        if self.shape.builds:
+            prelude, pchecks = prelude_fn(resident)
+            X.raise_checks(pchecks)
+        else:
+            prelude, pchecks = [], {}
+
+        acc = self._init_acc()
+        n_tiles = 0
+        for tile, tile_ns in _dist_tile_feed(self.shape.stream,
+                                             self.session, self.tile_rows):
+            acc, checks = step_fn(resident, prelude, tile, tile_ns, acc)
+            _raise_tile_checks(checks, n_tiles)
+            n_tiles += 1
+        if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
+            tile, _ = _empty_dist_tile(self.shape.stream, self.tile_rows,
+                                       self.nseg)
+            zeros = np.zeros((self.nseg,), dtype=np.int64)
+            acc, checks = step_fn(resident, prelude, tile, zeros, acc)
+            _raise_tile_checks(checks, 0)
+            n_tiles = 1
+
+        cols, sel, fchecks = finalize_fn(acc)
+        X.raise_checks(fchecks)
+        self.report["n_tiles"] = n_tiles
+        self.session.last_tiled_report = dict(self.report)
+        host_cols = {k: _local_row(v) for k, v in cols.items()}
+        return X.make_batch(self.shape.root, host_cols, _local_row(sel))
+
+
+# -------------------------------------------------------------- tile feed
+
+
+def _empty_dist_tile(scan: N.PScan, tile_rows: int, nseg: int):
+    t = {}
+    for phys in scan.column_map:
+        t[phys] = np.zeros((nseg, tile_rows), dtype=np.int64)
+    for phys in scan.mask_map:
+        t[f"$nn:{phys}"] = np.zeros((nseg, tile_rows), dtype=np.bool_)
+    return t, np.zeros((nseg,), dtype=np.int64)
+
+
+def _dist_tile_feed(scan: N.PScan, session, tile_rows: int):
+    """Yield (tile dict of (nseg, tile_rows) arrays, per-segment valid
+    counts). All segments step in lock-step; a segment whose shard ran dry
+    contributes masked rows — the SPMD analog of a QE sending EOS while
+    its peers still stream."""
+    st = session.sharded_table(scan.table_name)
+    nseg, shard_cap = len(st.counts), st.capacity
+    cols: dict[str, np.ndarray] = {}
+    for phys in scan.column_map:
+        cols[phys] = np.asarray(st.columns[phys])
+    for phys in scan.mask_map:
+        vm = st.columns.get(f"$nn:{phys}")
+        cols[f"$nn:{phys}"] = (np.asarray(vm) if vm is not None
+                               else np.ones((nseg, shard_cap),
+                                            dtype=np.bool_))
+    max_rows = int(st.counts.max()) if len(st.counts) else 0
+    for off in range(0, max(max_rows, 0), tile_rows):
+        n = min(tile_rows, max_rows - off)
+        tile = {}
+        for name, arr in cols.items():
+            sl = arr[:, off:off + n]
+            if n < tile_rows:
+                sl = np.concatenate(
+                    [sl, np.zeros((nseg, tile_rows - n), dtype=arr.dtype)],
+                    axis=1)
+            tile[name] = np.ascontiguousarray(sl)
+        tile_ns = np.clip(np.asarray(st.counts) - off, 0, tile_rows)
+        yield tile, tile_ns

@@ -1,0 +1,164 @@
+"""Distributed tiled execution (exec/tiled_dist.py) — spill on the mesh.
+
+The contract under test: an admission-rejected DISTRIBUTED statement (8
+segments) completes by streaming per-segment tiles through the plan's
+motions — redistribute per tile, per-segment accumulators, one finalize
+SPMD program — and produces exactly the same result as the in-memory
+distributed run. The workfile_mgr.c / nodeHash.c batch discipline
+interacting with Motion, on the segment mesh."""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+
+# dim is distributed on a DIFFERENT key than the join key, so the probe
+# side (fact) must redistribute — the motion then runs inside every tile
+JOIN_GROUP_Q = ("SELECT g, sum(v) AS sv, count(*) AS c "
+                "FROM fact JOIN dim ON fact.d = dim.d "
+                "GROUP BY g ORDER BY g")
+
+
+def _load(session, n_fact=400_000, n_dim=500, seed=3):
+    rng = np.random.default_rng(seed)
+    session.sql("CREATE TABLE dim (d BIGINT, g BIGINT) DISTRIBUTED BY (g)")
+    session.sql("CREATE TABLE fact (k BIGINT, d BIGINT, v BIGINT) "
+                "DISTRIBUTED BY (k)")
+    session.catalog.table("dim").set_data(
+        {"d": np.arange(n_dim), "g": np.arange(n_dim) % 9})
+    # k: 997 distinct values — a colocatable GROUP BY key whose group
+    # count stays far below the per-segment row count
+    session.catalog.table("fact").set_data(
+        {"k": np.arange(n_fact) % 997,
+         "d": rng.integers(0, n_dim, n_fact),
+         "v": rng.integers(0, 100, n_fact)})
+
+
+def _mk(budget=None, **extra):
+    ov = {"n_segments": 8,
+          # keep the small dim out of broadcast so the probe redistributes
+          "planner.broadcast_threshold": 0}
+    if budget is not None:
+        ov["resource.query_mem_bytes"] = budget
+    ov.update(extra)
+    return cb.Session(get_config().with_overrides(**ov))
+
+
+@pytest.fixture(scope="module")
+def expected():
+    s = _mk()
+    _load(s)
+    return s.sql(JOIN_GROUP_Q).to_pandas()
+
+
+def test_dist_tiled_join_group_matches_in_memory(expected):
+    s = _mk(budget=2 << 20)
+    _load(s)
+    got = s.sql(JOIN_GROUP_Q).to_pandas()
+    assert expected.equals(got)
+    rep = s.last_tiled_report
+    assert rep["tiled"] and rep["distributed"] and rep["n_tiles"] > 1
+    assert rep["n_segments"] == 8
+    assert rep["stream_table"] == "fact"
+    assert rep["est_step_bytes"] <= rep["budget_bytes"] == 2 << 20
+
+
+def test_dist_tiled_statement_cache_reuses_runner(expected):
+    s = _mk(budget=2 << 20)
+    _load(s)
+    got1 = s.sql(JOIN_GROUP_Q).to_pandas()
+    got2 = s.sql(JOIN_GROUP_Q).to_pandas()
+    assert expected.equals(got1) and expected.equals(got2)
+
+
+def test_dist_tiled_global_agg():
+    q = ("SELECT sum(v) AS sv, min(v) AS mn, max(v) AS mx, "
+         "count(*) AS c, avg(v) AS av FROM fact")
+    big = _mk()
+    _load(big)
+    exp = big.sql(q).to_pandas()
+    s = _mk(budget=256 << 10)
+    _load(s)
+    got = s.sql(q).to_pandas()
+    rep = s.last_tiled_report
+    assert rep["distributed"] and rep["n_tiles"] > 1
+    for c in exp.columns:
+        np.testing.assert_allclose(got[c].to_numpy().astype(float),
+                                   exp[c].to_numpy().astype(float))
+
+
+def test_dist_tiled_colocated_one_stage_agg():
+    """Grouping on the distribution key: the distributed plan keeps a
+    one-stage colocated aggregation — the accumulator IS the final
+    per-segment state and finalize needs no merge motion."""
+    q = "SELECT k, sum(v) AS sv FROM fact GROUP BY k ORDER BY k LIMIT 20"
+    big = _mk()
+    _load(big)
+    exp = big.sql(q).to_pandas()
+    s = _mk(budget=1 << 20)
+    _load(s)
+    got = s.sql(q).to_pandas()
+    assert exp.equals(got)
+    assert s.last_tiled_report["n_tiles"] > 1
+
+
+def test_dist_merge_overflow_grows_accumulator():
+    """Under-estimated group count grows the per-segment accumulator and
+    restarts the stream rather than truncating groups. The budget leaves
+    room for the finalize program (nseg x grown-accumulator rows), which
+    est_finalize_bytes now accounts for."""
+    s = _mk(budget=10 << 20)
+    _load(s, n_fact=800_000, n_dim=10_000)
+    q = ("SELECT d % 7000 AS dd, count(*) AS c, sum(v) AS sv "
+         "FROM fact GROUP BY d % 7000 ORDER BY dd LIMIT 50")
+    big = _mk()
+    _load(big, n_fact=800_000, n_dim=10_000)
+    exp = big.sql(q).to_pandas()
+    got = s.sql(q).to_pandas()
+    assert exp.equals(got)
+    assert s.last_tiled_report["acc_capacity"] >= 7000
+
+
+def test_dist_spill_disabled_refuses():
+    from cloudberry_tpu.exec.resource import ResourceError
+
+    s = _mk(budget=4 << 20, **{"resource.enable_spill": False})
+    _load(s)
+    with pytest.raises(ResourceError, match="memory estimate"):
+        s.sql(JOIN_GROUP_Q)
+
+
+def test_tpch_q5_q9_tiled_distributed():
+    """The round-2 done-criterion: admission-rejected Q5/Q9-shape queries
+    complete on the 8-device mesh under a small per-segment budget with
+    results matching the in-memory run and n_tiles > 1."""
+    from tools.tpch_oracle import ORACLES
+    from tools.tpch_queries import QUERIES
+    from tools.tpchgen import load_tpch
+
+    big = cb.Session(get_config().with_overrides(n_segments=8))
+    load_tpch(big, sf=0.02, seed=7)
+    tables = {n: t.to_pandas() for n, t in big.catalog.tables.items()}
+
+    # per-SEGMENT budgets: SF0.02 shards are ~1/8 of the single-node test's
+    # working set, so each budget sits just under that query's untiled
+    # estimate (q9's resident builds + accumulator need more floor than q5)
+    for qn, budget in (("q5", 2 << 20), ("q9", 3 << 20)):
+        s = cb.Session(get_config().with_overrides(
+            n_segments=8, **{"resource.query_mem_bytes": budget}))
+        load_tpch(s, sf=0.02, seed=7)
+        got = s.sql(QUERIES[qn]).to_pandas()
+        rep = s.last_tiled_report
+        assert rep and rep["n_tiles"] > 1, f"{qn} did not tile"
+        assert rep["distributed"] and rep["est_step_bytes"] <= budget
+        exp = ORACLES[qn](tables)
+        assert len(got) == len(exp)
+        for gc, ec in zip(got.columns, exp.columns):
+            g, e = got[gc].to_numpy(), exp[ec].to_numpy()
+            if g.dtype.kind == "f" or e.dtype.kind == "f":
+                np.testing.assert_allclose(
+                    g.astype(np.float64), e.astype(np.float64),
+                    rtol=1e-9, atol=1e-2, err_msg=f"{qn}.{gc}")
+            else:
+                np.testing.assert_array_equal(g, e, err_msg=f"{qn}.{gc}")
