@@ -762,10 +762,56 @@ def _fmt_decimal(raw: int, scale: int) -> str:
     return f"{sign}{raw // 10 ** scale}.{raw % 10 ** scale:0{scale}d}"
 
 
+def _eval_aligned(session, table_name: str, items: list):
+    """Run ``SELECT items FROM table`` (no WHERE — every row, exactly once)
+    and return (columns, validity, dicts) ALIGNED to the table's canonical
+    host row order.
+
+    This is the DML read path: only the expressions DML actually needs flow
+    through the executor (and, distributed, through the gather motion) —
+    never the whole table. Distributed results arrive segment-major (the
+    shard layout order), so they scatter back through the same stable
+    placement permutation ``sharded_table`` used; canonical row order is
+    therefore STABLE under DML in every mode."""
+    q = ast.Select(items=items, from_refs=[ast.TableName(table_name)])
+    batch = _run_internal(session, q)
+    sel = np.asarray(batch.sel)
+    cols = {f.name: np.asarray(batch.columns[f.name])[sel]
+            for f in batch.schema.fields}
+    valid = {n: np.asarray(v).astype(np.bool_)[sel]
+             for n, v in batch.validity.items()}
+    t = session.catalog.table(table_name)
+    n = t.num_rows
+    for name, arr in cols.items():
+        if len(arr) != n:
+            raise BindError(
+                f"DML row evaluation returned {len(arr)} rows for "
+                f"{table_name!r} ({n} rows) — internal error")
+    nseg = session.config.n_segments
+    if nseg > 1 and t.policy.kind != "replicated" and n:
+        assign = t.shard_assignment(nseg)
+        order = np.argsort(assign, kind="stable")
+        cols = {name: _unpermute(arr, order) for name, arr in cols.items()}
+        valid = {name: _unpermute(arr, order)
+                 for name, arr in valid.items()}
+    return cols, valid, dict(batch.dicts)
+
+
+def _unpermute(arr: np.ndarray, order: np.ndarray) -> np.ndarray:
+    out = np.empty_like(arr)
+    out[order] = arr
+    return out
+
+
 def _delete(session, stmt: ast.Delete) -> str:
     """DELETE = keep the complement (delete-and-rewrite over immutable
-    columns — the visimap-style store path lives in storage/table_store)."""
+    columns — the visimap-style store path lives in storage/table_store).
+    Only the PREDICATE flows through the executor (nodeSplitUpdate.c's
+    discipline of shipping decisions, not payloads): survivors are sliced
+    from the canonical host arrays, so peak extra memory is one bool column
+    plus the survivor arrays — independent of column count."""
     table = session.catalog.table(stmt.table)
+    table.ensure_loaded()
     before = table.num_rows
     if stmt.where is None:
         table.set_data({f.name: np.zeros(0, dtype=f.type.np_dtype)
@@ -773,21 +819,17 @@ def _delete(session, stmt: ast.Delete) -> str:
         return f"DELETE {before}"
     # DELETE removes rows where the predicate is TRUE; a NULL predicate
     # KEEPS the row (3VL) — so keep NOT pred OR pred IS NULL
-    keep = ast.Select(
-        items=[ast.SelectItem(ast.Name((f.name,)), f.name)
-               for f in table.schema.fields],
-        from_refs=[ast.TableName(stmt.table)],
-        where=ast.BinOp("or", ast.UnaryOp("not", stmt.where),
-                        ast.IsNull(stmt.where, False)))
-    batch = _run_internal(session, keep)
-    sel = np.asarray(batch.sel)
-    new_data = {f.name: np.asarray(batch.columns[f.name])[sel]
+    keep_expr = ast.BinOp("or", ast.UnaryOp("not", stmt.where),
+                          ast.IsNull(stmt.where, False))
+    cols, _, _ = _eval_aligned(session, stmt.table,
+                               [ast.SelectItem(keep_expr, "keep")])
+    keep = cols["keep"].astype(np.bool_)
+    new_data = {f.name: table.data[f.name][keep]
                 for f in table.schema.fields}
-    new_valid = {f.name: np.asarray(batch.validity[f.name])
-                 .astype(np.bool_)[sel]
-                 for f in table.schema.fields if f.name in batch.validity}
+    new_valid = {c: np.asarray(v)[keep]
+                 for c, v in table.validity.items()}
     table.set_data(new_data, table.dicts, validity=new_valid)
-    return f"DELETE {before - int(sel.sum())}"
+    return f"DELETE {before - int(keep.sum())}"
 
 
 _TYPE_NAME = {T.DType.BOOL: ("boolean", None), T.DType.INT32: ("integer", None),
@@ -797,58 +839,64 @@ _TYPE_NAME = {T.DType.BOOL: ("boolean", None), T.DType.INT32: ("integer", None),
 
 
 def _update(session, stmt: ast.Update) -> str:
-    """UPDATE col = CASE WHEN pred THEN expr ELSE col END, rewritten through
-    the normal executor (distributed UPDATE without SplitUpdate: the result
-    re-shards on the next statement if a distribution key changed)."""
+    """UPDATE col = CASE WHEN pred THEN expr ELSE col END — but ONLY the
+    SET columns (plus the predicate) flow through the executor; untouched
+    columns pass to set_data as the SAME host arrays, copy-free (the
+    nodeSplitUpdate.c role: ship the changed values, not the table). The
+    result re-shards lazily if a distribution key changed (version bump
+    invalidates the shard cache)."""
     table = session.catalog.table(stmt.table)
+    table.ensure_loaded()
     set_cols = {c for c, _ in stmt.sets}
     unknown = set_cols - set(table.schema.names)
     if unknown:
         raise BindError(f"UPDATE of unknown column(s) {sorted(unknown)}")
     items = []
-    for f in table.schema.fields:
+    sets = dict(stmt.sets)
+    set_fields = [f for f in table.schema.fields if f.name in set_cols]
+    for f in set_fields:
         src: ast.ExprNode = ast.Name((f.name,))
-        expr = dict(stmt.sets).get(f.name)
-        if expr is not None:
-            if stmt.where is not None:
-                val = ast.CaseExpr([(stmt.where, expr)], src)
-            elif f.dtype == T.DType.STRING:
-                # CASE wrapper even without WHERE: the string-CASE binder is
-                # what assigns dictionary codes to string literals
-                val = ast.CaseExpr([(ast.BoolLit(True), expr)], src)
-            else:
-                val = expr
-            if f.dtype == T.DType.DECIMAL:
-                val = ast.CastExpr(val, "decimal", f.type.scale)
-            elif f.dtype != T.DType.STRING:
-                tname, _ = _TYPE_NAME[f.dtype]
-                val = ast.CastExpr(val, tname)
-            src = val
-        items.append(ast.SelectItem(src, f.name))
+        expr = sets[f.name]
+        if stmt.where is not None:
+            val = ast.CaseExpr([(stmt.where, expr)], src)
+        elif f.dtype == T.DType.STRING:
+            # CASE wrapper even without WHERE: the string-CASE binder is
+            # what assigns dictionary codes to string literals
+            val = ast.CaseExpr([(ast.BoolLit(True), expr)], src)
+        else:
+            val = expr
+        if f.dtype == T.DType.DECIMAL:
+            val = ast.CastExpr(val, "decimal", f.type.scale)
+        elif f.dtype != T.DType.STRING:
+            tname, _ = _TYPE_NAME[f.dtype]
+            val = ast.CastExpr(val, tname)
+        items.append(ast.SelectItem(val, f.name))
     if stmt.where is not None:
         items.append(ast.SelectItem(stmt.where, "$updated"))
-    q = ast.Select(items=items, from_refs=[ast.TableName(stmt.table)])
-    batch = _run_internal(session, q)
-    sel = np.asarray(batch.sel)
-    n_upd = int(np.asarray(batch.columns["$updated"])[sel].sum()) \
-        if stmt.where is not None else int(sel.sum())
-    new_data = {}
-    new_valid = {}
+    cols, valid, qdicts = _eval_aligned(session, stmt.table, items)
+    n = table.num_rows
+    if stmt.where is not None:
+        upd = cols["$updated"].astype(np.bool_)
+        if "$updated" in valid:  # NULL predicate updates nothing (3VL)
+            upd &= valid["$updated"]
+        n_upd = int(upd.sum())
+    else:
+        n_upd = n
+    new_data = dict(table.data)  # untouched columns: same arrays, no copy
+    new_valid = dict(table.validity)
     dicts = dict(table.dicts)
-    for f in table.schema.fields:
-        arr = np.asarray(batch.columns[f.name])[sel]
-        bf = batch.schema.field(f.name)
-        if f.dtype == T.DType.STRING:
-            # the query may have produced codes in a NEW dictionary
-            # (string CASE/literal): adopt it — old codes stay valid only
-            # if it extends the old one, which _bind_string_case guarantees
-            nd = batch.dicts.get(f.name)
-            if nd is not None:
-                dicts[f.name] = nd
-        new_data[f.name] = arr.astype(f.type.np_dtype)
-        vm = batch.validity.get(f.name)
+    for f in set_fields:
+        # the query may have produced codes in a NEW dictionary (string
+        # CASE/literal): adopt it — old codes stay valid only because it
+        # extends the old one, which _bind_string_case guarantees
+        if f.dtype == T.DType.STRING and f.name in qdicts:
+            dicts[f.name] = qdicts[f.name]
+        new_data[f.name] = cols[f.name].astype(f.type.np_dtype)
+        vm = valid.get(f.name)
         if vm is not None:
-            new_valid[f.name] = np.asarray(vm).astype(np.bool_)[sel]
+            new_valid[f.name] = vm
+        else:
+            new_valid.pop(f.name, None)  # column is now fully valid
     table.set_data(new_data, dicts, validity=new_valid)
     return f"UPDATE {n_upd}"
 
@@ -886,9 +934,61 @@ def _ctas(session, stmt: ast.CreateTableAs) -> str:
     return f"SELECT {int(sel.sum())}"
 
 
-def _insert_select(session, stmt: ast.InsertSelect) -> str:
-    from cloudberry_tpu.columnar.batch import encode_column
+def _physical_convert(arr: np.ndarray, qf, f, qdicts, table) -> np.ndarray:
+    """Query-output physical column → target table physical column. Same
+    dtype (and, for decimals, same scale; for strings, the same dictionary)
+    copies raw physical values — digit-exact for decimals, where a decode
+    round-trip through float would lose precision past 2^53. Everything
+    else funnels through the shared decode/encode pair."""
+    from cloudberry_tpu.columnar.batch import decode_column, encode_column
 
+    if qf.dtype == f.dtype:
+        if f.dtype == T.DType.DECIMAL:
+            d = f.type.scale - qf.type.scale
+            if d == 0:
+                return arr.astype(np.int64)
+            if d > 0:
+                a = arr.astype(np.int64)
+                limit = (2 ** 63 - 1) // 10 ** d
+                if len(a) and int(np.abs(a).max()) > limit:
+                    raise BindError(
+                        f"INSERT: value out of range for column "
+                        f"{f.name!r} (DECIMAL scale {f.type.scale})")
+                return a * np.int64(10 ** d)
+            # downscale: round half away from zero, matching numeric
+            div = np.int64(10 ** (-d))
+            a = arr.astype(np.int64)
+            lo = np.iinfo(np.int64).min
+            if len(a) and bool((a == lo).any()):
+                # |int64.min| overflows np.abs — route those lanes
+                # through exact Python ints
+                out = np.empty(len(a), dtype=np.int64)
+                dv = int(div)
+                for i, v in enumerate(a):
+                    av, neg = abs(int(v)), int(v) < 0
+                    qq, rr = divmod(av, dv)
+                    qq += 2 * rr >= dv
+                    out[i] = -qq if neg else qq
+                return out
+            q, r = np.divmod(np.abs(a), div)
+            q = q + (2 * r >= div)
+            return np.where(arr < 0, -q, q)
+        if f.dtype == T.DType.STRING:
+            qd = qdicts.get(qf.name)
+            td = table.dicts.get(f.name)
+            if qd is not None and qd is td:
+                return arr.astype(f.type.np_dtype)
+        else:
+            return arr.astype(f.type.np_dtype)
+    vals = decode_column(np.asarray(arr), qf, qdicts)
+    return encode_column(np.asarray(vals), f, table.dicts)
+
+
+def _insert_select(session, stmt: ast.InsertSelect) -> str:
+    """INSERT ... SELECT appends the query's PHYSICAL columns directly —
+    no pandas round-trip: dictionary codes translate only when the query
+    produced a different dictionary, decimals at the target scale copy raw
+    int64 (exact), and validity masks carry over as-is."""
     table = session.catalog.table(stmt.table)
     cols = stmt.columns or table.schema.names
     if list(cols) != list(table.schema.names):
@@ -901,28 +1001,24 @@ def _insert_select(session, stmt: ast.InsertSelect) -> str:
             f"INSERT arity mismatch: query returns "
             f"{len(batch.schema.fields)} columns, table has "
             f"{len(table.schema.fields)}")
-    df = batch.to_pandas()  # decode, then re-encode into the table's dicts
-    new_rows = len(df)
+    sel = np.asarray(batch.sel)
+    new_rows = int(sel.sum())
     new_data = {}
     new_valid = {}
-    for f, qname in zip(table.schema.fields, df.columns):
-        vals = df[qname]
-        isna = vals.isna().to_numpy()
+    for f, qf in zip(table.schema.fields, batch.schema.fields):
+        arr = np.asarray(batch.columns[qf.name])[sel]
+        vm = batch.validity.get(qf.name)
+        isna = ~np.asarray(vm).astype(np.bool_)[sel] if vm is not None \
+            else np.zeros(new_rows, dtype=np.bool_)
         if isna.any():
             if not f.nullable:
                 raise BindError(
                     f"INSERT: NULL in NOT NULL column {f.name!r}")
-            fill = _NULL_FILL[f.dtype]
-            if f.dtype == T.DType.DATE:
-                fill = np.datetime64(0, "D")
-            elif f.dtype in (T.DType.INT32, T.DType.INT64,
-                             T.DType.DECIMAL, T.DType.FLOAT64):
-                fill = 0
-            vals_np = np.asarray(
-                [fill if m else v for v, m in zip(vals.to_numpy(), isna)])
-        else:
-            vals_np = vals.to_numpy()
-        arr = encode_column(vals_np, f, table.dicts)
+            if f.dtype == T.DType.STRING:
+                # NULL lanes may hold out-of-dictionary codes (e.g. -1
+                # from CASE NULL branches): clamp before any translation
+                arr = np.where(isna, 0, arr)
+        arr = _physical_convert(arr, qf, f, batch.dicts, table)
         old = table.data.get(f.name)
         n_old = len(old) if old is not None else 0
         new_data[f.name] = arr if n_old == 0 \

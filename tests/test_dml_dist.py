@@ -1,0 +1,146 @@
+"""Distributed DML write path (plan/planner.py) — the nodeSplitUpdate.c
+role: ship decisions and changed values through the executor, never the
+whole table.
+
+Contracts under test:
+- UPDATE/DELETE on the 8-segment mesh produce the same rows as single-node
+  execution, in the SAME canonical row order (distributed results scatter
+  back through the placement permutation);
+- only the predicate / SET expressions flow through the executor — an
+  untouched column's host array is passed to set_data by REFERENCE;
+- INSERT ... SELECT appends physical columns directly (no pandas decode):
+  decimals survive digit-exact past 2^53.
+"""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+
+
+def _mk(nseg=8):
+    return cb.Session(get_config().with_overrides(n_segments=nseg))
+
+
+def _loadstr(s, n):
+    from cloudberry_tpu.columnar.batch import ColumnBatch
+
+    rng = np.random.default_rng(11)
+    import pandas as pd
+
+    df = pd.DataFrame({"k": np.arange(n),
+                       "a": rng.integers(0, 1000, n),
+                       "b": rng.integers(0, 1000, n),
+                       "s": np.array(["x", "y", "z"])[np.arange(n) % 3]})
+    b = ColumnBatch.from_pandas(df)
+    t = s.catalog.table("t")
+    t.set_data(dict(b.columns), dict(b.dicts))
+
+
+def _fixture(nseg):
+    s = _mk(nseg)
+    s.sql("CREATE TABLE t (k BIGINT, a BIGINT, b BIGINT, s TEXT) "
+          "DISTRIBUTED BY (k)")
+    _loadstr(s, 50_000)
+    return s
+
+
+@pytest.mark.parametrize("dml", [
+    "UPDATE t SET a = a + b WHERE b % 7 = 0",
+    "UPDATE t SET s = 'w' WHERE a < 100",
+    "DELETE FROM t WHERE a % 5 = 1",
+])
+def test_dist_dml_matches_single_node(dml):
+    s1, s8 = _fixture(1), _fixture(8)
+    r1, r8 = s1.sql(dml), s8.sql(dml)
+    assert r1 == r8
+    q = "SELECT k, a, b, s FROM t ORDER BY k"
+    assert s1.sql(q).to_pandas().equals(s8.sql(q).to_pandas())
+    # canonical row order is stable under DML — even distributed
+    t1, t8 = s1.catalog.table("t"), s8.catalog.table("t")
+    np.testing.assert_array_equal(t1.data["k"], t8.data["k"])
+
+
+def test_update_leaves_untouched_columns_uncopied():
+    s = _fixture(8)
+    t = s.catalog.table("t")
+    b_before = t.data["b"]
+    s.sql("UPDATE t SET a = a * 2 WHERE b > 500")
+    assert t.data["b"] is b_before  # untouched column: same array object
+
+
+def test_dml_ships_only_needed_columns(monkeypatch):
+    """The internal DML query's plan projects the predicate / SET outputs,
+    not every table column — the whole-table materialization the round-2
+    review flagged is gone."""
+    from cloudberry_tpu.plan import planner as P
+
+    seen = []
+    orig = P._run_internal
+
+    def spy(session, query):
+        batch = orig(session, query)
+        seen.append([f.name for f in batch.schema.fields])
+        return batch
+
+    monkeypatch.setattr(P, "_run_internal", spy)
+    s = _fixture(8)
+    s.sql("DELETE FROM t WHERE a % 5 = 1")
+    assert seen[-1] == ["keep"]
+    s.sql("UPDATE t SET a = a + 1 WHERE b = 3")
+    assert seen[-1] == ["a", "$updated"]
+
+
+def test_dml_never_touches_pandas(monkeypatch):
+    from cloudberry_tpu.columnar.batch import ColumnBatch
+
+    def boom(self):
+        raise AssertionError("DML must not round-trip through pandas")
+
+    s = _fixture(8)
+    monkeypatch.setattr(ColumnBatch, "to_pandas", boom)
+    s.sql("UPDATE t SET a = b WHERE a < 10")
+    s.sql("DELETE FROM t WHERE a > 990")
+    s.sql("CREATE TABLE t2 (k BIGINT, a BIGINT, b BIGINT, s TEXT) "
+          "DISTRIBUTED BY (k)")
+    s.sql("INSERT INTO t2 SELECT k, a, b, s FROM t WHERE a < 500")
+
+
+def test_insert_select_decimal_exact_past_2_53():
+    """Raw int64 fixed-point copies exactly; the old pandas float
+    round-trip would corrupt the low digits past 2^53."""
+    s = _mk(1)
+    s.sql("CREATE TABLE src (d DECIMAL(2)) DISTRIBUTED BY (d)")
+    s.sql("CREATE TABLE dst (d DECIMAL(2)) DISTRIBUTED BY (d)")
+    s.sql("INSERT INTO src VALUES (123456789012345.67), "
+          "(-98765432109876.54)")
+    s.sql("INSERT INTO dst SELECT d FROM src")
+    raw = s.catalog.table("dst").data["d"]
+    np.testing.assert_array_equal(
+        np.sort(raw), np.sort(np.asarray([-9876543210987654,
+                                          12345678901234567])))
+
+
+def test_insert_select_string_dict_translation():
+    """A query whose string output uses a different dictionary than the
+    target table translates codes through values, extending the target's
+    dictionary as needed."""
+    s = _mk(8)
+    s.sql("CREATE TABLE a (k BIGINT, s TEXT) DISTRIBUTED BY (k)")
+    s.sql("CREATE TABLE b (k BIGINT, s TEXT) DISTRIBUTED BY (k)")
+    s.sql("INSERT INTO a VALUES (1, 'alpha'), (2, 'beta')")
+    s.sql("INSERT INTO b VALUES (3, 'gamma')")
+    s.sql("INSERT INTO b SELECT k, s FROM a")
+    got = s.sql("SELECT s FROM b ORDER BY k").to_pandas()["s"].tolist()
+    assert got == ["alpha", "beta", "gamma"]
+
+
+def test_dist_insert_select_validity_carries():
+    s = _mk(8)
+    s.sql("CREATE TABLE src (k BIGINT, v BIGINT) DISTRIBUTED BY (k)")
+    s.sql("CREATE TABLE dst (k BIGINT, v BIGINT) DISTRIBUTED BY (k)")
+    s.sql("INSERT INTO src VALUES (1, 10), (2, NULL), (3, 30)")
+    s.sql("INSERT INTO dst SELECT k, v FROM src")
+    got = s.sql("SELECT k, v FROM dst ORDER BY k").to_pandas()
+    assert got["v"].isna().tolist() == [False, True, False]
