@@ -139,6 +139,9 @@ class Table:
                 and not no_change:
             if not getattr(self.backing, "autocommit", True):
                 self.backing._txn_dirty[self.name] = self
+                # append-vs-rewrite note feeds the commit-time OCC merge
+                # decision (concurrent INSERTs both succeed)
+                self.backing.note_txn_write(self.name, appended)
                 self.cold = False
                 return
             if appended is not None and appended < n:

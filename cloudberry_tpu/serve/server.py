@@ -1,18 +1,31 @@
 """Socket serving layer — the engine as a database, not a library.
 
 The reference's serving surface is the libpq wire protocol into a
-per-connection backend (exec_simple_query, src/backend/tcop/postgres.c:506,
-1655). Here one server process owns ONE Session (the QD); clients speak a
-newline-delimited JSON protocol:
+PER-CONNECTION backend process (exec_simple_query,
+src/backend/tcop/postgres.c:506, 1655) over shared storage. Here the same
+shape: when the server runs over a durable store (config.storage.root),
+every connection gets its OWN Session — the backend analog — over the
+shared TableStore, so wire transactions (BEGIN/COMMIT/ROLLBACK) ride the
+storage layer's multi-session OCC exactly like in-process sessions do, and
+a dropped connection rolls its open transaction back (the backend-exit
+abort). Resource governance stays engine-wide: every connection session
+shares the server's admission gate, resource queues, and vmem tracker, and
+parallel-retrieve-cursor endpoints live in a server-shared registry so a
+cursor declared on one connection drains from any other (the shmem
+endpoint directory, cdbendpoint.c).
+
+Without a store there is nothing durable for backends to share, so all
+connections fall back to ONE shared Session: reads run concurrently,
+catalog mutations serialize behind a WRITER-PRIORITY rw-lock (a stream of
+readers can never starve DDL/DML), and wire transactions are refused —
+one client's BEGIN would absorb other clients' autocommit writes.
+
+Clients speak a newline-delimited JSON protocol:
 
     → {"sql": "select ..."}
     ← {"ok": true, "columns": [...], "rows": [[...]], "rowcount": N}
     ← {"ok": true, "status": "CREATE TABLE t"}          (DDL/DML)
-    ← {"ok": false, "error": "..."}
-
-Read statements run concurrently under the session's admission gate (the
-resgroup slot pool); catalog-mutating statements serialize behind a write
-lock — the single-writer discipline the storage layer's OCC assumes.
+    ← {"ok": false, "error": "...", "etype": "BindError"}
 """
 
 from __future__ import annotations
@@ -43,32 +56,43 @@ def _is_read(sql: str) -> bool:
 
 
 class _RWLock:
-    """Readers-writer lock: reads share, catalog mutations exclude — the
-    session's catalog/data swaps are only safe against concurrent readers
-    at statement granularity."""
+    """Readers-writer lock with WRITER PRIORITY: reads share, catalog
+    mutations exclude, and a waiting writer blocks NEW readers — a stream
+    of reads can delay a write by at most the in-flight readers (the
+    lock-queue fairness ProcSleep gives the reference's lmgr)."""
 
     def __init__(self):
+        self._cond = threading.Condition()
         self._readers = 0
-        self._r = threading.Lock()
-        self._w = threading.Lock()
+        self._writer = False
+        self._writers_waiting = 0
 
     def acquire_read(self):
-        with self._r:
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
             self._readers += 1
-            if self._readers == 1:
-                self._w.acquire()
 
     def release_read(self):
-        with self._r:
+        with self._cond:
             self._readers -= 1
             if self._readers == 0:
-                self._w.release()
+                self._cond.notify_all()
 
     def acquire_write(self):
-        self._w.acquire()
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
 
     def release_write(self):
-        self._w.release()
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
 
 
 def _json_safe(v):
@@ -96,23 +120,32 @@ class Server:
         import cloudberry_tpu as cb
 
         self.session = session if session is not None else cb.Session(config)
+        # per-connection backends need shared durable storage to see each
+        # other's commits; an explicit session= pins legacy shared mode
+        self._config = self.session.config
+        self.per_connection = (session is None
+                               and self.session.store is not None)
         self._rw = _RWLock()
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                for line in self.rfile:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        req = json.loads(line)
-                        resp = outer._execute(req)
-                    except Exception as e:  # a bad client must not kill us
-                        resp = {"ok": False,
-                                "error": f"{type(e).__name__}: {e}"}
-                    self.wfile.write(json.dumps(resp).encode() + b"\n")
-                    self.wfile.flush()
+                sess = outer._connection_session()
+                try:
+                    for line in self.rfile:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            req = json.loads(line)
+                            resp = outer._execute(req, sess)
+                        except Exception as e:  # bad client must not kill us
+                            resp = {"ok": False, "etype": type(e).__name__,
+                                    "error": f"{type(e).__name__}: {e}"}
+                        self.wfile.write(json.dumps(resp).encode() + b"\n")
+                        self.wfile.flush()
+                finally:
+                    outer._end_connection(sess)
 
         class TCP(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -121,6 +154,34 @@ class Server:
         self._server = TCP((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------- connection sessions
+
+    def _connection_session(self):
+        """A backend for one connection (postgres.c:1655 fork analog):
+        its own Session/catalog over the shared store, sharing the
+        server's resource governance and endpoint registry."""
+        if not self.per_connection:
+            return self.session
+        import cloudberry_tpu as cb
+
+        s = cb.Session(self._config)
+        s.parallel_cursors = self.session.parallel_cursors
+        s._gate = self.session._gate
+        s._queues = self.session._queues
+        s._vmem = self.session._vmem
+        return s
+
+    def _end_connection(self, sess) -> None:
+        """Backend exit: an open wire transaction aborts (the reference
+        rolls back on backend death — no orphaned prepared state)."""
+        if sess is self.session:
+            return
+        if getattr(sess, "_txn_snapshot", None) is not None:
+            try:
+                sess.txn("rollback")
+            except Exception:
+                pass
 
     # --------------------------------------------------------------- control
 
@@ -148,7 +209,7 @@ class Server:
 
     # ------------------------------------------------------------- execution
 
-    def _execute(self, req: dict) -> dict:
+    def _execute(self, req: dict, sess) -> dict:
         if "retrieve" in req:
             # retrieve-mode request (cdbendpointretrieve.c analog): drain
             # one endpoint of a parallel cursor; token REQUIRED on the wire
@@ -156,31 +217,39 @@ class Server:
             if not isinstance(r, dict) or "token" not in r:
                 return {"ok": False,
                         "error": "retrieve needs cursor/segment/token"}
-            self._rw.acquire_read()
+            if not self.per_connection:
+                self._rw.acquire_read()
             try:
-                out = self.session.retrieve(
+                out = sess.retrieve(
                     r.get("cursor", ""), int(r.get("segment", 0)),
                     r.get("limit"), r["token"])
             finally:
-                self._rw.release_read()
+                if not self.per_connection:
+                    self._rw.release_read()
             out["rows"] = [[_json_safe(v) for v in row]
                            for row in out["rows"]]
             return {"ok": True, **out}
         sql = req.get("sql")
         if not isinstance(sql, str):
             return {"ok": False, "error": "request must carry a 'sql' string"}
-        if _first_word(sql) in _TXN_STARTERS:
+        if self.per_connection:
+            # each connection is its own backend: statement-level locking
+            # is unnecessary (no shared catalog objects) and transactions
+            # ride the store's multi-session OCC
+            result = sess.sql(sql)
+        elif _first_word(sql) in _TXN_STARTERS:
             # all connections share ONE session: a wire-level BEGIN would
             # absorb other clients' autocommit writes into its rollback
             # scope — refuse rather than silently break their durability
             return {"ok": False, "error":
-                    "transactions over the wire are not supported yet "
-                    "(connections share one session); use the in-process "
+                    "transactions over the wire need a durable store "
+                    "(connections share one session); start the server "
+                    "with config.storage.root set, or use the in-process "
                     "API for BEGIN/COMMIT/ROLLBACK"}
-        if _is_read(sql):
+        elif _is_read(sql):
             self._rw.acquire_read()
             try:
-                result = self.session.sql(sql)
+                result = sess.sql(sql)
             finally:
                 self._rw.release_read()
         else:
@@ -189,7 +258,7 @@ class Server:
             # writers; this lock handles threads)
             self._rw.acquire_write()
             try:
-                result = self.session.sql(sql)
+                result = sess.sql(sql)
             finally:
                 self._rw.release_write()
         if isinstance(result, dict):

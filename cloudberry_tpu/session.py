@@ -288,13 +288,18 @@ class Session:
             raise BindError(f"{kind.upper()}: no transaction in progress")
         if kind == "commit":
             if self.store is not None:
-                # single-writer OCC (the 2PC-role analog, cdbtm.c:883):
-                # first committer wins; a conflicting later COMMIT aborts
-                # and rolls back rather than overwriting. The store lock
-                # makes check-then-publish atomic ACROSS PROCESSES.
+                # OCC commit (the 2PC-role analog, cdbtm.c:883): first
+                # committer wins for REWRITES; append-only writes merge
+                # onto the concurrent snapshot instead of aborting (the
+                # concurrent-DML capability of the reference's GDD). The
+                # store lock makes check-then-publish atomic ACROSS
+                # PROCESSES — and because it is the ONLY commit-time lock
+                # and conflicts abort rather than wait, no waits-for cycle
+                # can form: the no-deadlock argument that replaces the
+                # reference's global deadlock detector (gdd/README.md).
                 with self.store.lock():
-                    conflicts = self.store.conflicting_tables(
-                        getattr(self, "_txn_base", {}))
+                    base = getattr(self, "_txn_base", {})
+                    conflicts = self.store.conflicting_tables(base)
                     if conflicts:
                         self.store.abort_txn()
                         self._restore_snapshot(snap)
@@ -302,7 +307,18 @@ class Session:
                             "could not serialize access: table(s) "
                             f"{', '.join(conflicts)} were modified by "
                             "another session after this transaction began")
-                    self.store.commit_txn()
+                    merged = [n for n in list(self.store._txn_dirty)
+                              if self.store.txn_append_only(n)
+                              and self.store.current_version(n)
+                              != base.get(n, 0)]
+                    self.store.commit_txn(base)
+                # a merged table's RAM copy is missing the other
+                # session's rows — drop it so the next statement reloads
+                # the merged snapshot from the store
+                for name in merged:
+                    self.catalog.tables.pop(name, None)
+                    self.store.register_cold(self.catalog, name)
+                    self.catalog.bump_ddl()
                 if getattr(self, "_matviews_dirty", False):
                     # definitions deferred during the transaction flush
                     # only after the data commit succeeded

@@ -69,15 +69,49 @@ class TableStore:
         self._txn_dirty = {}
         self._txn_stats: dict[str, object] = {}
         self._txn_drops = []
+        # append tracking for the OCC merge: a transaction whose writes to
+        # a table were ALL appends can merge onto a concurrently-committed
+        # snapshot instead of aborting (concurrent INSERTs both succeed —
+        # the concurrent-DML capability of the reference's GDD,
+        # src/backend/utils/gdd/README.md)
+        self._txn_appends: dict[str, int] = {}
+        self._txn_rewrites: set[str] = set()
         self.pinned = {name: self.current_version(name)
                        for name in self.table_names()}
 
-    def commit_txn(self) -> None:
+    def note_txn_write(self, name: str, appended: Optional[int]) -> None:
+        """Record whether a deferred in-transaction write was an append
+        (last ``appended`` rows new, rest untouched) or a rewrite."""
+        if appended is None:
+            self._txn_rewrites.add(name)
+            self._txn_appends.pop(name, None)
+        elif name not in self._txn_rewrites:
+            self._txn_appends[name] = \
+                self._txn_appends.get(name, 0) + appended
+
+    def txn_append_only(self, name: str) -> bool:
+        return (name in getattr(self, "_txn_appends", {})
+                and name not in getattr(self, "_txn_rewrites", set())
+                and name not in self._txn_drops)
+
+    def commit_txn(self, base: Optional[dict] = None) -> None:
         self.pinned = {}  # commit writes against CURRENT, not the snapshot
+        base = base or {}
         for name in self._txn_drops:
             self.drop_table(name)
-        for t in self._txn_dirty.values():
-            t._store_version = self.save_table(t, self.rows_per_partition)
+        for name, t in self._txn_dirty.items():
+            moved = self.current_version(name) != base.get(name, 0)
+            if moved and self.txn_append_only(name):
+                # another session committed first but this transaction
+                # only APPENDED: merge the new tail onto their snapshot
+                # (serial order: theirs, then this one)
+                self._merge_append(t, self._txn_appends[name])
+                # this session's RAM copy is missing the other session's
+                # rows — force a cold re-register at the next sync
+                t._store_version = None
+            else:
+                t._store_version = self.save_table(t,
+                                                   self.rows_per_partition)
         # stats-only changes (ANALYZE with no DML): one manifest write,
         # not a full data re-snapshot
         for name, t in getattr(self, "_txn_stats", {}).items():
@@ -90,6 +124,8 @@ class TableStore:
         self._txn_dirty = {}
         self._txn_stats = {}
         self._txn_drops = []
+        self._txn_appends = {}
+        self._txn_rewrites = set()
         self.pinned = {}
 
     def effective_version(self, name: str) -> int:
@@ -98,12 +134,54 @@ class TableStore:
 
     def conflicting_tables(self, base: dict[str, int]) -> list[str]:
         """Tables this transaction wrote whose store version moved past the
-        BEGIN snapshot — the single-writer OCC check (first committer
-        wins; the later COMMIT must fail, not overwrite)."""
-        written = set(self._txn_dirty) | set(self._txn_drops) \
-            | set(getattr(self, "_txn_stats", {}))
+        BEGIN snapshot AND whose writes cannot merge — the OCC check.
+        Append-only writes merge onto the concurrent snapshot (commit_txn);
+        rewrites (UPDATE/DELETE) and drops conflict: first committer wins,
+        the later COMMIT must fail rather than overwrite. Stats-only
+        changes (ANALYZE) never conflict — advisory, last write wins."""
+        written = set(self._txn_dirty) | set(self._txn_drops)
         return sorted(n for n in written
-                      if self.current_version(n) != base.get(n, 0))
+                      if self.current_version(n) != base.get(n, 0)
+                      and not self.txn_append_only(n))
+
+    def _merge_append(self, t, k: int) -> int:
+        """Append transaction ``t``'s last ``k`` rows onto the CURRENT
+        snapshot (which another session committed after this transaction
+        began). String codes re-encode against the stored dictionary (the
+        two sessions may have extended the base dictionary differently),
+        and stored uniqueness flags are re-verified against the merged
+        data — a column stays unique only if the tail neither overlaps the
+        stored values nor repeats internally."""
+        name = t.name
+        tail = {c: np.asarray(v)[-k:] for c, v in t.data.items()}
+        validity = {c: np.asarray(v)[-k:] for c, v in t.validity.items()
+                    if len(v)}
+        man = self.read_manifest(name)
+        stored_dicts = {c: StringDictionary(v)
+                        for c, v in man.get("dicts", {}).items()}
+        dicts = {}
+        for c, d in t.dicts.items():
+            sd = stored_dicts.get(c)
+            if sd is None or sd.values == d.values:
+                dicts[c] = d
+                continue
+            vals = d.decode(tail[c])
+            tail[c] = sd.encode(np.asarray(vals, dtype=object))
+            dicts[c] = sd
+        unique = dict(man.get("unique", {}))
+        for c, was in list(unique.items()):
+            if not was or c not in tail:
+                continue
+            tc = tail[c]
+            if len(np.unique(tc)) != len(tc):
+                unique[c] = False
+                continue
+            stored, _ = self.read_partitions(name, man["partitions"], [c])
+            unique[c] = not bool(np.isin(tc, stored[c]).any())
+        v = self.append(name, tail, t.schema, dicts, replace=False,
+                        validity=validity, unique=unique,
+                        rows_per_partition=self.rows_per_partition)
+        return v
 
     # ----------------------------------------------------------- manifests
 

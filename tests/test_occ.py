@@ -50,12 +50,14 @@ def test_crash_during_commit_preserves_old_snapshot(tmp_path):
 
 
 def test_concurrent_writer_conflict(tmp_path):
-    """First committer wins: a COMMIT whose written tables moved past the
-    BEGIN snapshot fails with a serialization error and rolls back."""
+    """First committer wins for REWRITES: a COMMIT whose UPDATE/DELETE
+    target moved past the BEGIN snapshot fails with a serialization error
+    and rolls back. (Append-only transactions MERGE instead — see
+    test_occ_merge.py.)"""
     a = _mk(tmp_path)
     b = cb.Session(_cfg(tmp_path))
     a.sql("begin")
-    a.sql("insert into t values (100, 1)")
+    a.sql("update t set v = v + 1 where a = 1")
     # B commits first (autocommit)
     b.sql("insert into t values (200, 2)")
     with pytest.raises(SerializationError, match="another\\s+session"):
