@@ -357,14 +357,21 @@ class Lowerer:
             key = id(sq)
             if key not in self._subcache:
                 scols, ssel = self.lower(sq.plan)
-                arr = scols[sq.plan.fields[0].name]
                 n = jnp.sum(ssel.astype(jnp.int64))
-                self.checks[
-                    f"scalar subquery returned a row count != 1 (node "
-                    f"{key}); NULL/multi-row scalar subqueries are not "
-                    "supported yet"] = n != 1
-                idx = jnp.argmax(ssel)  # the single selected row
-                self._subcache[key] = arr[idx]
+                if sq.mode == "exists":
+                    # presence term: did the subplan select any row at
+                    # all (the 0-rows→NULL half of scalar semantics)
+                    self._subcache[key] = n > 0
+                else:
+                    arr = scols[sq.plan.fields[0].name]
+                    self.checks[
+                        f"scalar subquery returned more than one row "
+                        f"(node {key})"] = n > 1
+                    # 0 selected rows: argmax lands on row 0, whose value
+                    # is arbitrary — the binder's presence term masks the
+                    # result NULL, so it is never observed
+                    idx = jnp.argmax(ssel)  # the single selected row
+                    self._subcache[key] = arr[idx]
             name = f"$sqv{key}"
             mapping[key] = name
             aug[name] = self._subcache[key]
@@ -482,14 +489,66 @@ class Lowerer:
 
         out_cols = dict(cols)
         valids = node.valids or [None] * len(node.calls)
-        for (name, func, arg), valid in zip(node.calls, valids):
+        params_list = node.params or [None] * len(node.calls)
+        for (name, func, arg), valid, params in zip(node.calls, valids,
+                                                    params_list):
             # per-call argument validity in sorted row order: count counts
             # only valid rows, avg divides by the valid count, 'anyvalid'
             # is the null mask for nullable agg outputs
             va = (s_sel & self.expr(valid, cols)[perm]) \
                 if valid is not None else s_sel
+            base = func.split("@", 1)[0]
             if func == "row_number":
                 o = (idx - seg_start + 1).astype(jnp.int64)
+            elif func == "ntile":
+                # SQL ntile: larger buckets first — with s rows and n
+                # buckets, the first s%n buckets get s//n+1 rows
+                n = params["n"]
+                rip = idx - seg_start
+                psize = seg_end - seg_start + 1
+                base_sz = psize // n
+                rem = psize % n
+                thresh = rem * (base_sz + 1)
+                o = (jnp.where(rip < thresh,
+                               rip // jnp.maximum(base_sz + 1, 1),
+                               rem + (rip - thresh)
+                               // jnp.maximum(base_sz, 1))
+                     + 1).astype(jnp.int64)
+            elif base in ("lead", "lag", "first_value", "last_value"):
+                # positional reads within the sorted partition. The source
+                # row index is computed per row; '<func>@mask' re-runs the
+                # same gather over the argument's validity (plus the
+                # in-partition range test) to produce the output null mask
+                if base in ("lead", "lag"):
+                    k = params["offset"]
+                    src = idx + k if base == "lead" else idx - k
+                    inrange = (src >= seg_start) & (src <= seg_end)
+                elif base == "first_value":
+                    # default frame starts at the partition head
+                    src, inrange = seg_start, None
+                else:
+                    # last_value under the default frame ends at the
+                    # current row's peer group, not the partition tail
+                    src = run_end if node.order_keys else seg_end
+                    inrange = None
+                srcc = jnp.clip(src, 0, cap - 1)
+                if func.endswith("@mask"):
+                    o = va[srcc]
+                    if inrange is not None:
+                        if params.get("default") is not None:
+                            # out-of-range rows take the (non-NULL) default
+                            o = jnp.where(inrange, o, True)
+                        else:
+                            o = inrange & o
+                else:
+                    v = self.expr(arg, cols)[perm]
+                    o = v[srcc]
+                    if inrange is not None:
+                        dflt = params.get("default")
+                        fill = self.expr(dflt, cols).astype(v.dtype) \
+                            if dflt is not None \
+                            else jnp.zeros((), v.dtype)
+                        o = jnp.where(inrange, o, fill)
             elif func == "rank":
                 o = (run_start - seg_start + 1).astype(jnp.int64)
             elif func == "dense_rank":

@@ -1083,7 +1083,12 @@ class Binder:
         return proj
 
     WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "count",
-                    "avg", "min", "max"}
+                    "avg", "min", "max", "ntile", "lead", "lag",
+                    "first_value", "last_value"}
+    # positional window funcs read another row of the partition; their
+    # NULL story is per-row (source row missing or invalid), not
+    # frame-aggregate, so they get '<func>@mask' companion calls
+    POSITIONAL_WINDOW_FUNCS = {"lead", "lag", "first_value", "last_value"}
 
     def _extract_windows(self, sel: ast.Select, plan: N.PlanNode,
                          scope: Scope):
@@ -1102,8 +1107,7 @@ class Binder:
                 if key not in specs:
                     specs[key] = (node.partition_by, node.order_by, [])
                 name = self.gensym("win")
-                arg = node.args[0] if node.args else None
-                specs[key][2].append((name, node.func, arg))
+                specs[key][2].append((name, node.func, list(node.args)))
                 return ast.Name((name,))
             if not isinstance(node, ast.Node) or isinstance(
                     node, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
@@ -1148,11 +1152,57 @@ class Binder:
                     okeys.append((bound, o.ascending))
             bound_calls = []
             call_valids = []
+            call_params = []
             new_fields = []
             mask_by_valid: dict[str, str] = {}
-            for name, func, arg_ast in calls:
-                arg = self.bind_scalar(arg_ast, scope) \
-                    if arg_ast is not None else None
+            for name, func, arg_asts in calls:
+                params = None
+                if func == "ntile":
+                    if len(arg_asts) != 1:
+                        raise BindError("ntile(n) takes exactly one "
+                                        "argument")
+                    nb = self.bind_scalar(arg_asts[0], scope)
+                    if not isinstance(nb, ex.Literal) \
+                            or not isinstance(nb.value, int) \
+                            or isinstance(nb.value, bool) or nb.value <= 0:
+                        raise BindError("ntile(n): n must be a positive "
+                                        "integer constant")
+                    params = {"n": int(nb.value)}
+                    arg = None
+                elif func in ("lead", "lag"):
+                    if not 1 <= len(arg_asts) <= 3:
+                        raise BindError(
+                            f"{func}(value [, offset [, default]])")
+                    arg = self.bind_scalar(arg_asts[0], scope)
+                    off = 1
+                    if len(arg_asts) >= 2:
+                        ob = self.bind_scalar(arg_asts[1], scope)
+                        if not isinstance(ob, ex.Literal) \
+                                or not isinstance(ob.value, int) \
+                                or isinstance(ob.value, bool) \
+                                or ob.value < 0:
+                            raise BindError(
+                                f"{func}: offset must be a non-negative "
+                                "integer constant")
+                        off = int(ob.value)
+                    dflt = None
+                    if len(arg_asts) == 3:
+                        db = self.bind_scalar(arg_asts[2], scope)
+                        if not isinstance(db, ex.Literal):
+                            raise BindError(
+                                f"{func}: default must be a constant")
+                        if _expr_dict(arg) is not None:
+                            raise BindError(
+                                f"{func}: defaults on string arguments "
+                                "are not supported (the default is not "
+                                "in the column's dictionary)")
+                        if db.dtype.base != arg.dtype.base:
+                            db = ex.Cast(db, arg.dtype)
+                        dflt = db
+                    params = {"offset": off, "default": dflt}
+                else:
+                    arg = self.bind_scalar(arg_asts[0], scope) \
+                        if arg_asts else None
                 valid = _valid_of(arg) if arg is not None else None
                 if valid is not None:
                     # NULL args never contribute: sum/avg zero-fill the
@@ -1166,19 +1216,38 @@ class Binder:
                         z = 0.0 if arg.dtype.base == DType.FLOAT64 else 0
                         arg = ex.CaseWhen(((valid, arg),),
                                           ex.Literal(z, arg.dtype), arg.dtype)
-                if func in ("row_number", "rank", "dense_rank", "count"):
+                if func in ("row_number", "rank", "dense_rank", "count",
+                            "ntile"):
                     t = T.INT64
                 elif func == "avg":
                     t = T.FLOAT64
                 else:
                     assert arg is not None, f"{func}() needs an argument"
                     t = arg.dtype
-                sd = _expr_dict(arg) if func in ("min", "max") \
-                    and arg is not None else None
+                sd = _expr_dict(arg) if func in (
+                    "min", "max", "lead", "lag", "first_value",
+                    "last_value") and arg is not None else None
                 bound_calls.append((name, func, arg))
                 call_valids.append(valid)
-                if valid is not None and func in ("sum", "min", "max",
-                                                  "avg"):
+                call_params.append(params)
+                if func in self.POSITIONAL_WINDOW_FUNCS and (
+                        valid is not None
+                        or (func in ("lead", "lag")
+                            and params["default"] is None)):
+                    # per-row null mask: the source row may fall outside
+                    # the partition (lead/lag without a default) or hold
+                    # an invalid value — both positional facts only the
+                    # executor can see, so a '<func>@mask' pseudo-call
+                    # computes the bool mask alongside the value
+                    mname = self.gensym("vmw")
+                    bound_calls.append((mname, func + "@mask", None))
+                    call_valids.append(valid)
+                    call_params.append(params)
+                    new_fields.append(N.PlanField(mname, T.BOOL, None))
+                    new_fields.append(
+                        N.PlanField(name, t, sd, null_mask=(mname,)))
+                elif valid is not None and func in ("sum", "min", "max",
+                                                    "avg"):
                     # agg over an all-NULL frame is NULL — materialize the
                     # frame's any-valid as this output's hidden null mask
                     # (one mask per distinct validity expr, shared by every
@@ -1189,12 +1258,14 @@ class Binder:
                         mname = mask_by_valid[vkey] = self.gensym("vmw")
                         bound_calls.append((mname, "anyvalid", None))
                         call_valids.append(valid)
+                        call_params.append(None)
                         new_fields.append(N.PlanField(mname, T.BOOL, None))
                     new_fields.append(
                         N.PlanField(name, t, sd, null_mask=(mname,)))
                 else:
                     new_fields.append(N.PlanField(name, t, sd))
-            w = N.PWindow(plan, pk, okeys, bound_calls, call_valids)
+            w = N.PWindow(plan, pk, okeys, bound_calls, call_valids,
+                          call_params)
             w.fields = list(plan.fields) + new_fields
             plan = w
         # window outputs resolve by exact generated name; rebind existing
@@ -1593,14 +1664,18 @@ class Binder:
         if len(ufs) != 1:
             raise BindError("scalar subquery must return one column")
         f = ufs[0]
-        if not f.masks:
+        one_row = _one_row_guaranteed(node.select)
+        if not f.masks and one_row:
             e = ex.SubqueryScalar(plan, f.type)
             if f.sdict is not None:
                 object.__setattr__(e, "_sdict", f.sdict)
             return e
-        # nullable scalar: the value and its validity are TWO scalar
-        # subqueries over ONE shared subplan (PShare → computed once);
-        # validity then composes like any other expression's
+        # nullable scalar: the value and its validity terms are separate
+        # scalar subqueries over ONE shared subplan (PShare → computed
+        # once); validity then composes like any other expression's.
+        # Validity terms: presence (0 rows → NULL, unless the subquery is
+        # an ungrouped aggregate, which always yields exactly one row) AND
+        # the value's own mask (the single row's value may be NULL).
         share_v = N.PShare(plan)
         share_v.fields = list(plan.fields)
         vproj = N.PProject(share_v, [(f.name, ex.ColumnRef(f.name, f.type))])
@@ -1608,12 +1683,19 @@ class Binder:
         e = ex.SubqueryScalar(vproj, f.type)
         if f.sdict is not None:
             object.__setattr__(e, "_sdict", f.sdict)
-        share_m = N.PShare(plan)
-        share_m.fields = list(plan.fields)
-        mname = self.gensym("sqv")
-        mproj = N.PProject(share_m, [(mname, ex.IsValid(f.masks))])
-        mproj.fields = [N.PlanField(mname, T.BOOL, None)]
-        return _set_valid(e, ex.SubqueryScalar(mproj, T.BOOL))
+        vterms = []
+        if not one_row:
+            share_p = N.PShare(plan)
+            share_p.fields = list(plan.fields)
+            vterms.append(ex.SubqueryScalar(share_p, T.BOOL, "exists"))
+        if f.masks:
+            share_m = N.PShare(plan)
+            share_m.fields = list(plan.fields)
+            mname = self.gensym("sqv")
+            mproj = N.PProject(share_m, [(mname, ex.IsValid(f.masks))])
+            mproj.fields = [N.PlanField(mname, T.BOOL, None)]
+            vterms.append(ex.SubqueryScalar(mproj, T.BOOL))
+        return _set_valid(e, _and_valid(*vterms))
 
     def _scratch_inner_scope(self, sub: ast.Select) -> Scope:
         inner = Scope()
@@ -2397,6 +2479,17 @@ def _has_window(node: ast.ExprNode) -> bool:
                 if isinstance(x, ast.ExprNode) and _has_window(x):
                     return True
     return False
+
+
+def _one_row_guaranteed(sel: ast.Select) -> bool:
+    """An ungrouped aggregate SELECT always returns exactly one row (no
+    GROUP BY, no HAVING — which could filter that row away — and no
+    LIMIT/OFFSET games): the common TPC shape ``(SELECT avg(x) FROM t)``,
+    which needs no presence-validity subquery."""
+    return (not sel.group_by and sel.having is None
+            and sel.limit is None and not sel.offset
+            and any(not isinstance(i.expr, ast.Star)
+                    and _has_agg(i.expr) for i in sel.items))
 
 
 def _has_agg(node: ast.ExprNode) -> bool:

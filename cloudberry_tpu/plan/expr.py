@@ -119,10 +119,17 @@ class SubqueryScalar(Expr):
     """Uncorrelated scalar subquery: a full plan whose single-row, single-
     column result is broadcast into the enclosing expression (the InitPlan
     analog). The executor lowers ``plan`` inside the same XLA program;
-    the distribution pass walks into it."""
+    the distribution pass walks into it.
+
+    mode "value" broadcasts the single row's value (>1 rows is a runtime
+    error; 0 rows yields an arbitrary value that the binder masks NULL
+    via a companion mode="exists" validity term — SQL: a scalar subquery
+    over zero rows is NULL). mode "exists" broadcasts a bool: did the
+    subplan select ≥1 row."""
 
     plan: object  # N.PlanNode (untyped to avoid the import cycle)
     dtype: "SqlType" = None  # type: ignore[assignment]
+    mode: str = "value"
 
 
 @dataclass(frozen=True)

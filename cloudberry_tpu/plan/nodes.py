@@ -213,8 +213,12 @@ class PLimit(PlanNode):
 class PWindow(PlanNode):
     """Window computation over one (PARTITION BY, ORDER BY) spec; appends
     one output column per call. funcs: row_number | rank | dense_rank |
-    sum | count | avg | min | max (running when ordered — RANGE UNBOUNDED
-    PRECEDING TO CURRENT ROW, peers included — else whole-partition)."""
+    ntile | lead | lag | first_value | last_value | sum | count | avg |
+    min | max (aggregates are running when ordered — RANGE UNBOUNDED
+    PRECEDING TO CURRENT ROW, peers included — else whole-partition;
+    positional funcs follow src/backend/executor/nodeWindowAgg.c frame
+    rules: first_value = partition head, last_value = current peer-group
+    tail under the default frame)."""
 
     child: PlanNode
     partition_keys: list[ex.Expr]
@@ -225,8 +229,17 @@ class PWindow(PlanNode):
     # by the valid count; the pseudo-func 'anyvalid' emits a bool column
     # that is True where the frame holds ≥1 valid arg — the null_mask for
     # nullable sum/min/max/avg outputs (SQL: agg over an all-NULL frame is
-    # NULL, src/backend/executor/nodeWindowAgg.c semantics).
+    # NULL, src/backend/executor/nodeWindowAgg.c semantics). Positional
+    # funcs carry a companion '<func>@mask' pseudo-call instead: its bool
+    # output is True where the source row exists in-partition AND (when
+    # the arg is nullable) holds a valid value.
     valids: Optional[list] = None
+    # per-call static parameters (parallel to ``calls``; None or a dict):
+    # lead/lag: {"offset": int, "default": ex.Literal|None}; ntile:
+    # {"n": int}. Static by design — XLA traces one program per plan, so
+    # data-dependent offsets would force recompiles per row; the reference
+    # accepts expressions but constant offsets are the only common case.
+    params: Optional[list] = None
 
     def children(self):
         return [self.child]

@@ -1,0 +1,218 @@
+"""Window long tail — lead/lag/ntile/first_value/last_value — and scalar
+subquery row-count semantics (0 rows → NULL, >1 rows → error).
+
+The reference executes these in nodeWindowAgg.c with per-call frame logic;
+here positional window functions are gathers inside the sorted partition
+(exec/executor.py window()), with '<func>@mask' companion calls carrying
+the per-row null mask, and scalar-subquery presence is a mode="exists"
+SubqueryScalar validity term (plan/binder.py _bind_uncorrelated_scalar).
+Both single-segment and 8-segment modes run (windows redistribute on
+PARTITION BY keys).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.exec.executor import ExecError
+from cloudberry_tpu.plan.binder import BindError
+
+
+def _mk(nseg=1):
+    s = cb.Session(Config(n_segments=nseg)) if nseg > 1 else cb.Session()
+    s.sql("create table w (g text, o int, v int, s text) "
+          "distributed by (o)")
+    s.sql("insert into w values "
+          "('a', 1, 10, 'x'), ('a', 2, null, 'y'), ('a', 3, 30, null), "
+          "('b', 1, 100, 'p'), ('b', 2, 200, 'q'), "
+          "('c', 1, null, 'z')")
+    return s
+
+
+@pytest.fixture(scope="module", params=[1, 8], ids=["single", "dist8"])
+def s(request):
+    return _mk(request.param)
+
+
+def _norm(vals):
+    return [None if (v is None or (isinstance(v, float) and np.isnan(v))
+                     or v is pd.NA) else v for v in vals]
+
+
+def col(s, q, name=None):
+    df = s.sql(q).to_pandas()
+    return _norm(df[name if name else df.columns[0]].tolist())
+
+
+# ------------------------------------------------------------- lead / lag
+
+
+def test_lead_basic(s):
+    out = col(s, "select lead(o) over (partition by g order by o) as x "
+                 "from w order by g, o", "x")
+    # past the partition end -> NULL
+    assert out == [2, 3, None, 2, None, None]
+
+
+def test_lag_basic(s):
+    out = col(s, "select lag(o) over (partition by g order by o) as x "
+                 "from w order by g, o", "x")
+    assert out == [None, 1, 2, None, 1, None]
+
+
+def test_lead_offset_and_default(s):
+    out = col(s, "select lead(o, 2) over (partition by g order by o) as x "
+                 "from w order by g, o", "x")
+    assert out == [3, None, None, None, None, None]
+    out = col(s, "select lead(o, 2, -1) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [3, -1, -1, -1, -1, -1]
+    out = col(s, "select lag(o, 1, 0) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [0, 1, 2, 0, 1, 0]
+
+
+def test_lead_lag_nullable_arg(s):
+    # v holds NULLs: a present source row with NULL value stays NULL,
+    # and an out-of-range source is NULL regardless of default absence
+    out = col(s, "select lag(v) over (partition by g order by o) as x "
+                 "from w order by g, o", "x")
+    assert out == [None, 10, None, None, 100, None]
+    # with a default: out-of-range takes the default, NULL source stays NULL
+    out = col(s, "select lag(v, 1, -5) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [-5, 10, None, -5, 100, -5]
+
+
+def test_lead_strings(s):
+    # dictionary-coded argument: output carries the dictionary
+    out = col(s, "select lead(s) over (partition by g order by o) as x "
+                 "from w order by g, o", "x")
+    assert out == ["y", None, None, "q", None, None]
+
+
+def test_lag_zero_offset(s):
+    out = col(s, "select lag(o, 0) over (partition by g order by o) as x "
+                 "from w order by g, o", "x")
+    assert out == [1, 2, 3, 1, 2, 1]
+
+
+def test_lead_requires_constant_offset(s):
+    with pytest.raises(BindError):
+        s.sql("select lead(o, o) over (order by o) from w")
+
+
+# ---------------------------------------------------------------- ntile
+
+
+def test_ntile(s):
+    # 6 rows, 4 buckets: sizes 2,2,1,1 (larger buckets first)
+    out = col(s, "select ntile(4) over (order by g, o) as x "
+                 "from w order by g, o", "x")
+    assert out == [1, 1, 2, 2, 3, 4]
+
+
+def test_ntile_more_buckets_than_rows(s):
+    out = col(s, "select ntile(10) over (partition by g order by o) as x "
+                 "from w order by g, o", "x")
+    assert out == [1, 2, 3, 1, 2, 1]
+
+
+def test_ntile_requires_positive_constant(s):
+    with pytest.raises(BindError):
+        s.sql("select ntile(0) over (order by o) from w")
+    with pytest.raises(BindError):
+        s.sql("select ntile(o) over (order by o) from w")
+
+
+# ------------------------------------------------- first_value / last_value
+
+
+def test_first_value(s):
+    out = col(s, "select first_value(o) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [1, 1, 1, 1, 1, 1]
+    # nullable arg: partition 'c' has first v NULL
+    out = col(s, "select first_value(v) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [10, 10, 10, 100, 100, None]
+
+
+def test_last_value_default_frame(s):
+    # the SQL gotcha: default frame ends at the CURRENT peer group, so
+    # last_value tracks the current row, not the partition tail
+    out = col(s, "select last_value(o) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [1, 2, 3, 1, 2, 1]
+    # without ORDER BY the frame is the whole partition; which row is
+    # "last" is unspecified (PG too) — but it must be one row of the
+    # partition and the same for every row of the partition
+    df = s.sql("select g, o, last_value(o) over (partition by g) as x "
+               "from w order by g, o").to_pandas()
+    for g, grp in df.groupby("g"):
+        assert grp["x"].nunique() == 1
+        assert grp["x"].iloc[0] in set(grp["o"])
+
+
+def test_last_value_nullable(s):
+    # last_value over nullable v: current row's v (peers: none here)
+    out = col(s, "select last_value(v) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [10, None, 30, 100, 200, None]
+
+
+def test_first_value_strings(s):
+    out = col(s, "select first_value(s) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == ["x", "x", "x", "p", "p", "z"]
+
+
+def test_positional_mixed_with_aggregates(s):
+    df = s.sql("""select g, o,
+                  lead(o) over (partition by g order by o) as nxt,
+                  sum(o) over (partition by g order by o) as run,
+                  ntile(2) over (partition by g order by o) as nt
+                  from w order by g, o""").to_pandas()
+    assert _norm(df["nxt"].tolist()) == [2, 3, None, 2, None, None]
+    assert df["run"].tolist() == [1, 3, 6, 1, 3, 1]
+    assert df["nt"].tolist() == [1, 1, 2, 1, 2, 1]
+
+
+# ------------------------------------------------- scalar subquery rows
+
+
+def test_scalar_subquery_zero_rows_is_null(s):
+    out = col(s, "select (select o from w where g = 'nope') as x "
+                 "from w order by o limit 1", "x")
+    assert out == [None]
+
+
+def test_scalar_subquery_zero_rows_in_predicate(s):
+    # NULL comparison -> no rows pass (not an error, not all rows)
+    out = col(s, "select count(*) from w "
+                 "where o > (select o from w where g = 'nope')")
+    assert out == [0]
+
+
+def test_scalar_subquery_one_row_still_works(s):
+    out = col(s, "select (select max(o) from w) as x from w limit 1", "x")
+    assert out == [3]
+    # non-aggregate single-row subquery (needs the presence term)
+    out = col(s, "select (select o from w where g = 'c') as x "
+                 "from w limit 1", "x")
+    assert out == [1]
+
+
+def test_scalar_subquery_multi_row_errors(s):
+    with pytest.raises(ExecError):
+        s.sql("select (select o from w where g = 'a') from w").to_pandas()
+
+
+def test_scalar_subquery_agg_over_zero_rows(s):
+    # ungrouped aggregate of an empty set is one row: count=0, max=NULL
+    assert col(s, "select (select count(*) from w where g='nope') as x "
+                  "from w limit 1", "x") == [0]
+    assert col(s, "select (select max(o) from w where g='nope') as x "
+                  "from w limit 1", "x") == [None]
