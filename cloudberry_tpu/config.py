@@ -110,6 +110,25 @@ class StorageConfig:
 
 
 @dataclass(frozen=True)
+class HealthConfig:
+    """Failure detection / recovery knobs (the FTS analog, fts.c:118).
+
+    Segments are stateless (placement is recomputed from shared storage),
+    so recovery is re-execution rather than mirror promotion: a failed
+    statement probes the devices and re-dispatches — on a shrunken mesh
+    when devices are gone (degraded-mesh replanning, the n−1 payoff of
+    derived placement)."""
+
+    # Re-dispatches of a statement that failed with a device/runtime error.
+    retries: int = 1
+    # Probe every device before a retry (the FTS_MSG_PROBE analog).
+    probe_on_error: bool = True
+    # Shrink the segment mesh to the live device count before retrying.
+    degrade: bool = True
+    backoff_s: float = 0.2
+
+
+@dataclass(frozen=True)
 class Config:
     n_segments: int = 1
     interconnect: InterconnectConfig = field(default_factory=InterconnectConfig)
@@ -117,6 +136,7 @@ class Config:
     planner: PlannerConfig = field(default_factory=PlannerConfig)
     resource: ResourceConfig = field(default_factory=ResourceConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":
         """Return a copy with dotted-path overrides, e.g.

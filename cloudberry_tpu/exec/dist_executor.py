@@ -30,6 +30,7 @@ from cloudberry_tpu.exec.expr_compile import compile_expr
 from cloudberry_tpu.parallel.mesh import SEG_AXIS, segment_mesh
 from cloudberry_tpu.plan import nodes as N
 from cloudberry_tpu.utils import hashing
+from cloudberry_tpu.utils.faultinject import fault_point
 
 
 def prepare_dist_inputs(plan: N.PlanNode, session, names=None):
@@ -87,6 +88,7 @@ def execute_distributed(plan: N.PlanNode, session,
     if fn is None:
         fn = compile_distributed(plan, session)
     inputs, _ = prepare_dist_inputs(plan, session)
+    fault_point("dist_execute_start")
     cols, sel, checks = fn(inputs)
     X.raise_checks(checks)
     # every segment computed the (gathered) final result; read the first

@@ -132,6 +132,9 @@ def retrieve(session, name: str, segment: int, limit: int | None = None,
     """Drain (up to ``limit``) rows from one endpoint — the RETRIEVE
     command. ``token`` must match when given (wire clients always pass
     it; the in-process API may omit)."""
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("endpoint_drain")
     cur = session.parallel_cursors.get(name.lower())
     if cur is None:
         raise CursorError(f"unknown cursor {name!r}")

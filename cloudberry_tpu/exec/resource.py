@@ -82,6 +82,9 @@ def estimate_plan_memory(plan: N.PlanNode) -> MemoryEstimate:
 
 
 def check_admission(plan: N.PlanNode, session) -> MemoryEstimate:
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("admission_check")
     est = estimate_plan_memory(plan)
     budget = session.config.resource.query_mem_bytes
     if est.peak_bytes > budget:

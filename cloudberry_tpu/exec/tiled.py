@@ -42,6 +42,7 @@ from cloudberry_tpu.exec import kernels as K
 from cloudberry_tpu.exec.resource import estimate_plan_memory
 from cloudberry_tpu.plan import expr as ex
 from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.utils.faultinject import fault_point
 from cloudberry_tpu.plan.distribute import (_all_exprs, _finalize_project,
                                             _split_aggs)
 
@@ -543,6 +544,7 @@ class TiledExecutable(AdaptiveTiledMixin):
         n_tiles = 0
         for tile, tile_n in _tile_feed(self.shape.stream, self.session,
                                        self.tile_rows):
+            fault_point("tile_step")
             acc, checks = step_fn(resident, prelude, tile,
                                   jnp.asarray(tile_n, dtype=jnp.int32), acc)
             _raise_tile_checks(checks, n_tiles)
@@ -554,6 +556,7 @@ class TiledExecutable(AdaptiveTiledMixin):
             _raise_tile_checks(checks, 0)
             n_tiles = 1
 
+        fault_point("tiled_finalize")
         cols, sel, fchecks = finalize_fn(acc)
         X.raise_checks(fchecks)
         self.report["n_tiles"] = n_tiles

@@ -54,6 +54,7 @@ from cloudberry_tpu.exec.tiled import (_MAX_TILE, _MIN_TILE, _acc_width,
 from cloudberry_tpu.parallel.mesh import SEG_AXIS, segment_mesh
 from cloudberry_tpu.plan import expr as ex
 from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.utils.faultinject import fault_point
 from cloudberry_tpu.plan.distribute import (_all_exprs, _finalize_project,
                                             _split_aggs)
 
@@ -552,6 +553,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         n_tiles = 0
         for tile, tile_ns in _dist_tile_feed(self.shape.stream,
                                              self.session, self.tile_rows):
+            fault_point("tile_step_dist")
             acc, checks = step_fn(resident, prelude, tile, tile_ns, acc)
             _raise_tile_checks(checks, n_tiles)
             n_tiles += 1

@@ -35,11 +35,18 @@ def probe(n_devices: Optional[int] = None) -> ProbeResult:
     import jax
     import jax.numpy as jnp
 
+    from cloudberry_tpu.utils.faultinject import fault_point
+
     t0 = time.time()
     try:
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
+        if fault_point("probe_degraded"):
+            # chaos seam: report one device lost ('skip' action) — on the
+            # virtual CPU mesh no device can really die, so degraded-mesh
+            # recovery is provoked deterministically (faultinjector.c role)
+            devices = devices[:-1]
         outs = []
         for d in devices:
             x = jax.device_put(jnp.ones((8,), dtype=jnp.float32), d)
@@ -93,19 +100,35 @@ class HealthMonitor:
         return r
 
 
+def recoverable(e: Exception) -> bool:
+    """Failures worth a re-dispatch: device/runtime loss (XLA surfaces
+    dead devices as runtime errors), never semantic errors (bind, OCC
+    serialization, resource refusals). InjectedFault device-loss seams
+    (names containing 'device_lost') count — that is how the virtual CPU
+    mesh provokes a loss deterministically."""
+    name = type(e).__name__
+    if "XlaRuntimeError" in name or "JaxRuntimeError" in name:
+        return True
+    return "device_lost" in str(e)
+
+
 def run_with_retry(fn: Callable, retries: int = 1,
-                   backoff_s: float = 0.5) -> object:
+                   backoff_s: float = 0.5,
+                   on_retry: Optional[Callable[[Exception], None]] = None
+                   ) -> object:
     """Re-dispatch on device/runtime failure (the recovery model: stateless
-    segments over immutable storage → failed statements simply re-run)."""
+    segments over immutable storage → failed statements simply re-run).
+    ``on_retry`` runs between attempts — the Session passes its
+    probe-and-degrade hook there (fts.c probe → configuration update)."""
     last: Exception | None = None
     for attempt in range(retries + 1):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001
-            name = type(e).__name__
-            retriable = "XlaRuntimeError" in name or "JaxRuntimeError" in name
-            if not retriable or attempt == retries:
+            if not recoverable(e) or attempt == retries:
                 raise
             last = e
+            if on_retry is not None:
+                on_retry(e)
             time.sleep(backoff_s * (2 ** attempt))
     raise last  # unreachable

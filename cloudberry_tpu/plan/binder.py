@@ -1188,18 +1188,26 @@ class Binder:
                     dflt = None
                     if len(arg_asts) == 3:
                         db = self.bind_scalar(arg_asts[2], scope)
-                        if not isinstance(db, ex.Literal):
+                        # an explicit NULL default IS the no-default case
+                        # (out-of-range -> NULL via the '@mask' companion)
+                        if _is_null_literal(db):
+                            db = None
+                        elif not isinstance(db, ex.Literal):
                             raise BindError(
                                 f"{func}: default must be a constant")
-                        if _expr_dict(arg) is not None:
+                        elif _expr_dict(arg) is not None:
                             raise BindError(
                                 f"{func}: defaults on string arguments "
                                 "are not supported (the default is not "
                                 "in the column's dictionary)")
-                        if db.dtype.base != arg.dtype.base:
+                        elif db.dtype.base != arg.dtype.base:
                             db = ex.Cast(db, arg.dtype)
                         dflt = db
                     params = {"offset": off, "default": dflt}
+                elif func in ("first_value", "last_value") \
+                        and len(arg_asts) != 1:
+                    raise BindError(f"{func}(value) takes exactly one "
+                                    "argument")
                 else:
                     arg = self.bind_scalar(arg_asts[0], scope) \
                         if arg_asts else None

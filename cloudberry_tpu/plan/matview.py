@@ -170,6 +170,9 @@ def drop_matview(session, name: str, if_exists: bool = False) -> str:
 
 
 def refresh_matview(session, name: str) -> str:
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("matview_refresh")
     cat = session.catalog
     name = name.lower()
     d = cat.matviews.get(name)
@@ -265,6 +268,9 @@ def maintain_on_append(session, table_name: str, n_new: int) -> None:
     every INCREMENTAL view on this base; others go stale."""
     if n_new <= 0:
         return
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("matview_maintain")
     changed = False
     for d in list(session.catalog.matviews.values()):
         if d.base_table != table_name.lower():

@@ -401,6 +401,9 @@ def _copy_from(session, stmt: ast.CopyFrom) -> str:
     """Delimited-file ingest (the COPY / gpfdist load path): numeric and
     decimal columns parse through the native C++ codec
     (cloudberry_tpu.native), strings/dates through the host splitter."""
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("copy_from")
     from cloudberry_tpu import native
 
     table = session.catalog.table(stmt.table)
@@ -810,6 +813,9 @@ def _delete(session, stmt: ast.Delete) -> str:
     discipline of shipping decisions, not payloads): survivors are sliced
     from the canonical host arrays, so peak extra memory is one bool column
     plus the survivor arrays — independent of column count."""
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("dml_delete")
     table = session.catalog.table(stmt.table)
     table.ensure_loaded()
     before = table.num_rows
@@ -845,6 +851,9 @@ def _update(session, stmt: ast.Update) -> str:
     nodeSplitUpdate.c role: ship the changed values, not the table). The
     result re-shards lazily if a distribution key changed (version bump
     invalidates the shard cache)."""
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("dml_update")
     table = session.catalog.table(stmt.table)
     table.ensure_loaded()
     set_cols = {c for c, _ in stmt.sets}
@@ -989,6 +998,9 @@ def _insert_select(session, stmt: ast.InsertSelect) -> str:
     no pandas round-trip: dictionary codes translate only when the query
     produced a different dictionary, decimals at the target scale copy raw
     int64 (exact), and validity masks carry over as-is."""
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("dml_insert_select")
     table = session.catalog.table(stmt.table)
     cols = stmt.columns or table.schema.names
     if list(cols) != list(table.schema.names):

@@ -28,6 +28,9 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):
+        from cloudberry_tpu.utils.faultinject import fault_point
+
+        fault_point("fdist_get")
         u = urlparse(self.path)
         rel = u.path.lstrip("/")
         # no traversal: the resolved path must stay under root

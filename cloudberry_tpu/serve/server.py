@@ -130,6 +130,9 @@ class Server:
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                from cloudberry_tpu.utils.faultinject import fault_point
+
+                fault_point("serve_handler")
                 sess = outer._connection_session()
                 try:
                     for line in self.rfile:

@@ -21,6 +21,19 @@ import numpy as np
 from cloudberry_tpu.config import Config, get_config
 
 
+_READ_ONLY_HEADS = frozenset(
+    {"select", "with", "values", "explain", "show", "retrieve"})
+
+
+def _read_only(query: str) -> bool:
+    """Statements safe to re-execute after a device failure: re-running a
+    query cannot change state; re-running DML/DDL/COPY can double-apply.
+    Classified by leading keyword — the grammar has no WITH-DML, so the
+    head token is decisive."""
+    head = query.lstrip().split(None, 1)
+    return bool(head) and head[0].lower() in _READ_ONLY_HEADS
+
+
 class SerializationError(RuntimeError):
     """COMMIT lost the single-writer OCC race: another session committed a
     conflicting table version after this transaction's BEGIN snapshot."""
@@ -102,6 +115,61 @@ class Session:
                             columns=["line", "errmsg", "rawdata"])
 
     def sql(self, query: str, **params: Any):
+        """Run one statement with failure recovery (the FTS consumption
+        point, fts.c:118): a device/runtime failure probes the devices,
+        optionally shrinks the segment mesh to the live count (stateless
+        segments — placement re-derives for any n), and re-dispatches."""
+        from cloudberry_tpu.parallel.health import run_with_retry
+
+        h = self.config.health
+        if h.retries <= 0 or not _read_only(query):
+            # DML/DDL/COPY are NOT retried: a device failure striking
+            # after the host-side mutation would re-apply the statement
+            # on retry (re-execution is only safe when re-running cannot
+            # change state — the reference's FTS likewise lets in-flight
+            # write transactions abort rather than replay them)
+            return self._sql_once(query, **params)
+        return run_with_retry(
+            lambda: self._sql_once(query, **params),
+            retries=h.retries, backoff_s=h.backoff_s,
+            on_retry=self._recover_mesh if h.probe_on_error else None)
+
+    def _recover_mesh(self, e: Exception) -> None:
+        """Between-retry hook: probe every device; when fewer answer than
+        the mesh expects, re-derive a smaller mesh (probeWalRepUpdateConfig
+        analog — except nothing promotes: placement is recomputed)."""
+        from cloudberry_tpu.parallel.health import probe
+
+        r = probe()
+        if self.config.health.degrade and r.n_devices \
+                and r.n_devices < self.config.n_segments:
+            self.degrade_mesh(r.n_devices)
+
+    def degrade_mesh(self, n_devices: int) -> bool:
+        """Shrink the segment mesh to ``n_devices`` and invalidate every
+        placement/plan cache. Derived placement (jump hash over shared
+        storage) makes this a pure recompute — no data movement protocol,
+        the reference's gprecoverseg/rebalance role collapses into cache
+        invalidation."""
+        with self._sync_lock:  # server handler threads share this session
+            n = max(1, min(self.config.n_segments, n_devices))
+            if n == self.config.n_segments:
+                return False
+            self.config = self.config.with_overrides(n_segments=n)
+            self._shard_cache.clear()
+            self._stmt_cache.clear()
+            self._store_scan_cache.clear()
+            return True
+
+    @staticmethod
+    def _dispatch_seams(fault_point) -> None:
+        """The two seams every dispatch path hits: dispatch_start (not
+        retriable) and exec_device_lost (retriable via health.recoverable
+        — the virtual mesh cannot lose a real device; this seam can)."""
+        fault_point("dispatch_start")
+        fault_point("exec_device_lost")
+
+    def _sql_once(self, query: str, **params: Any):
         from cloudberry_tpu.exec.resource import check_admission
         from cloudberry_tpu.plan.planner import plan_statement
         from cloudberry_tpu.sql.parser import parse_sql
@@ -112,7 +180,7 @@ class Session:
         cached = self._cached_statement(query)
         if cached is not None:
             runner, cost = cached
-            fault_point("dispatch_start")
+            self._dispatch_seams(fault_point)
             with self._gate, self._admitted(cost):
                 return runner()
 
@@ -134,11 +202,11 @@ class Session:
             texe = plan_tiled(result.plan, self)
             if texe is None:
                 raise
-            fault_point("dispatch_start")
+            self._dispatch_seams(fault_point)
             with self._gate, self._admitted(
                     self.config.resource.query_mem_bytes):
                 return self._run_cached_tiled(query, texe)
-        fault_point("dispatch_start")
+        self._dispatch_seams(fault_point)
         with self._gate, self._admitted(est.peak_bytes) as sid:
             return self._run_with_growth(query, result.plan, sid)
 
@@ -221,6 +289,9 @@ class Session:
                 or getattr(self, "_txn_snapshot", None) is not None:
             return
         with self._sync_lock:  # server handler threads share this session
+            from cloudberry_tpu.utils.faultinject import fault_point
+
+            fault_point("sync_store")
             # fast path: one epoch read; the per-table walk only runs when
             # SOMETHING changed since this session last looked
             epoch = self.store.epoch()
@@ -298,6 +369,13 @@ class Session:
                 # can form: the no-deadlock argument that replaces the
                 # reference's global deadlock detector (gdd/README.md).
                 with self.store.lock():
+                    # chaos seam inside the commit critical section:
+                    # 'sleep' widens the conflict window for race tests,
+                    # 'error' exercises in-lock failure cleanup
+                    from cloudberry_tpu.utils.faultinject import \
+                        fault_point
+
+                    fault_point("occ_commit_window")
                     base = getattr(self, "_txn_base", {})
                     conflicts = self.store.conflicting_tables(base)
                     if conflicts:

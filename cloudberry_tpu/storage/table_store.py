@@ -272,6 +272,9 @@ class TableStore:
             if getattr(self, "_lock_held", False):
                 yield
                 return
+            from cloudberry_tpu.utils.faultinject import fault_point
+
+            fault_point("store_lock_acquire")
             path = os.path.join(self.root, "_LOCK")
             deadline = _time.monotonic() + timeout_s
             while True:
@@ -456,6 +459,9 @@ class TableStore:
                         ) -> tuple[dict, dict]:
         """Read (selected columns of) the given partitions; "$nn:" validity
         columns split out. Returns (columns dict, validity dict)."""
+        from cloudberry_tpu.utils.faultinject import fault_point
+
+        fault_point("store_read_partition")
         man = self.read_manifest(table, version)
         schema = Schema(tuple(mp._field_from_json(j) for j in man["schema"]))
         nullable = set(man.get("nullable", []))

@@ -104,6 +104,20 @@ def test_lead_requires_constant_offset(s):
         s.sql("select lead(o, o) over (order by o) from w")
 
 
+def test_lead_explicit_null_default(s):
+    # an explicit NULL default is the no-default case: out-of-range -> NULL
+    out = col(s, "select lead(o, 1, null) over (partition by g order by o) "
+                 "as x from w order by g, o", "x")
+    assert out == [2, 3, None, 2, None, None]
+
+
+def test_first_value_arity_checked(s):
+    with pytest.raises(BindError):
+        s.sql("select first_value(o, 2) over (order by o) from w")
+    with pytest.raises(BindError):
+        s.sql("select last_value(o, 1, 2) over (order by o) from w")
+
+
 # ---------------------------------------------------------------- ntile
 
 
