@@ -63,7 +63,8 @@ def compile_distributed(plan: N.PlanNode, session):
     prepared-statement analog — inputs are re-prepared per call from the
     session's sharded-table cache)."""
     nseg = session.config.n_segments
-    mesh = segment_mesh(nseg)
+    mesh = segment_mesh(nseg,
+                        getattr(session, "_live_device_ids", None))
     _, in_specs = prepare_dist_inputs(plan, session)
 
     def seg_fn(tables):

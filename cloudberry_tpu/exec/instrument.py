@@ -132,7 +132,8 @@ def _run_instrumented_dist(plan: N.PlanNode, session, query: str):
 
     # reuse the dist executor wiring but with an instrumenting lowerer
     nseg = session.config.n_segments
-    mesh = DX.segment_mesh(nseg)
+    mesh = DX.segment_mesh(nseg,
+                           getattr(session, "_live_device_ids", None))
     inputs, in_specs = DX.prepare_dist_inputs(plan, session)
 
     class InstrDistLowerer(InstrumentingMixin, DX.DistLowerer):

@@ -418,7 +418,8 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             return self._compiled
         shape = self.shape
         nseg = self.nseg
-        mesh = segment_mesh(nseg)
+        mesh = segment_mesh(nseg, getattr(self.session,
+                                          "_live_device_ids", None))
         names = self._resident_names()
         _, res_specs = prepare_dist_inputs(None, self.session, names=names)
 

@@ -40,6 +40,16 @@ def load_cluster(store: str) -> dict:
             f"`python -m cloudberry_tpu --store {store} init` first")
 
 
+def cluster_config(store: str):
+    """The one Config a cluster store implies — every entry point (serve,
+    mcp, sql) must build it identically or drift apart."""
+    from cloudberry_tpu.config import Config
+
+    cfg = load_cluster(store)
+    return Config(n_segments=cfg["n_segments"]).with_overrides(
+        **{"storage.root": store})
+
+
 def _open_session(store: str):
     import cloudberry_tpu as cb
     from cloudberry_tpu.config import Config
@@ -175,15 +185,12 @@ def cmd_check(args) -> int:
 def cmd_serve(args) -> int:
     """Run the socket serving layer (the postmaster/tcop analog): one
     process owns the session; clients connect over TCP."""
-    from cloudberry_tpu.config import Config
     from cloudberry_tpu.serve import Server
 
-    cfg = load_cluster(args.store)
-    config = Config(n_segments=cfg["n_segments"]).with_overrides(
-        **{"storage.root": args.store})
-    srv = Server(config=config, host=args.host, port=args.port)
+    srv = Server(config=cluster_config(args.store),
+                 host=args.host, port=args.port)
     print(f"serving on {srv.host}:{srv.port} (store {args.store}, "
-          f"{cfg['n_segments']} segments)", flush=True)
+          f"{srv.session.config.n_segments} segments)", flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -235,6 +242,24 @@ def cmd_fdist(args) -> int:
     return 0
 
 
+def cmd_mcp(args) -> int:
+    """Run the MCP stdio server (the mcp-server analog): AI agents speak
+    JSON-RPC on stdin/stdout; the engine is this process's cluster store,
+    or a running socket server via --connect."""
+    from cloudberry_tpu.serve.mcp import (McpServer, SessionEngine,
+                                          WireEngine)
+
+    if args.connect:
+        host, _, port = args.connect.rpartition(":")
+        engine = WireEngine(host or "127.0.0.1", int(port))
+    else:
+        import cloudberry_tpu as cb
+
+        engine = SessionEngine(cb.Session(cluster_config(args.store)))
+    McpServer(engine).serve_stdio()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cloudberry_tpu",
@@ -280,6 +305,11 @@ def main(argv=None) -> int:
     pf.add_argument("--port", type=int, default=8800)
     pf.add_argument("--host", default="0.0.0.0")
     pf.set_defaults(fn=cmd_fdist)
+
+    pm = sub.add_parser("mcp", help="MCP stdio server (AI-agent surface)")
+    pm.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="back onto a running server instead of in-process")
+    pm.set_defaults(fn=cmd_mcp)
 
     args = p.parse_args(argv)
     return args.fn(args)

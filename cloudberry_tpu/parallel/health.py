@@ -25,13 +25,19 @@ from typing import Callable, Optional
 @dataclass
 class ProbeResult:
     ok: bool
-    n_devices: int
+    n_devices: int            # LIVE device count (the degraded-mesh input)
     latency_s: float
     error: Optional[str] = None
+    # indices (into jax.devices()) of the devices that answered — a real
+    # loss leaves a hole in the MIDDLE of the list, so recovery must mesh
+    # over these exact survivors, not devices[:n]
+    live: Optional[list] = None
 
 
 def probe(n_devices: Optional[int] = None) -> ProbeResult:
-    """One health probe: a tiny reduction touching every device."""
+    """One health probe: a tiny reduction PER DEVICE, each failure
+    isolated — one dead device must report the n−1 survivors, not a
+    whole-probe failure (the per-segment state machine of ftsprobe.c)."""
     import jax
     import jax.numpy as jnp
 
@@ -39,25 +45,30 @@ def probe(n_devices: Optional[int] = None) -> ProbeResult:
 
     t0 = time.time()
     try:
-        devices = jax.devices()
-        if n_devices is not None:
-            devices = devices[:n_devices]
-        if fault_point("probe_degraded"):
-            # chaos seam: report one device lost ('skip' action) — on the
-            # virtual CPU mesh no device can really die, so degraded-mesh
-            # recovery is provoked deterministically (faultinjector.c role)
-            devices = devices[:-1]
-        outs = []
-        for d in devices:
+        devices = list(enumerate(jax.devices()))
+    except Exception as e:  # noqa: BLE001 — runtime itself is gone
+        return ProbeResult(False, 0, time.time() - t0, str(e), live=[])
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if fault_point("probe_degraded"):
+        # chaos seam: report one device lost ('skip' action) — on the
+        # virtual CPU mesh no device can really die, so degraded-mesh
+        # recovery is provoked deterministically (faultinjector.c role)
+        devices = devices[:-1]
+    live: list[int] = []
+    errors: list[str] = []
+    for i, d in devices:
+        try:
             x = jax.device_put(jnp.ones((8,), dtype=jnp.float32), d)
-            outs.append(jnp.sum(x))
-        jax.block_until_ready(outs)
-        vals = [float(o) for o in outs]
-        ok = all(v == 8.0 for v in vals)
-        return ProbeResult(ok, len(devices), time.time() - t0,
-                           None if ok else f"bad probe sums {vals}")
-    except Exception as e:  # noqa: BLE001 — any device failure is a finding
-        return ProbeResult(False, 0, time.time() - t0, str(e))
+            if float(jnp.sum(x)) == 8.0:
+                live.append(i)
+            else:
+                errors.append(f"device {i}: bad probe sum")
+        except Exception as e:  # noqa: BLE001 — this device is a finding
+            errors.append(f"device {i}: {e}")
+    ok = not errors
+    return ProbeResult(ok, len(live), time.time() - t0,
+                       "; ".join(errors) or None, live=live)
 
 
 @dataclass

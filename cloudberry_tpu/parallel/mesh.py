@@ -49,9 +49,14 @@ def init_distributed(coordinator: str | None = None,
     init_distributed._done = True  # type: ignore[attr-defined]
 
 
-def segment_mesh(n_segments: int) -> Mesh:
-    """Mesh over the first n_segments GLOBAL devices (all hosts)."""
+def segment_mesh(n_segments: int, device_ids=None) -> Mesh:
+    """Mesh over the first n_segments GLOBAL devices (all hosts).
+    ``device_ids`` restricts to surviving devices (by index into
+    jax.devices()) after a probe found losses — a real loss leaves a hole
+    mid-list, so the degraded mesh must skip it, not just shrink."""
     devices = jax.devices()
+    if device_ids is not None:
+        devices = [devices[i] for i in device_ids if i < len(devices)]
     if len(devices) < n_segments:
         raise RuntimeError(
             f"config asks for {n_segments} segments but only "

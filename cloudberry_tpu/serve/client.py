@@ -37,6 +37,11 @@ class Client:
     def rows(self, query: str) -> list[list]:
         return self.sql(query)["rows"]
 
+    def meta(self, kind: str, arg=None):
+        """Catalog metadata snapshot (tables/columns/stats/views/matviews/
+        sequences/info/summary) — the pg_catalog role for thin clients."""
+        return self._request({"meta": kind, "arg": arg})["meta"]
+
     def retrieve(self, cursor: str, segment: int, token: str,
                  limit: int | None = None) -> dict:
         """Drain one endpoint of a PARALLEL RETRIEVE CURSOR (the

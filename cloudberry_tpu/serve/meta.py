@@ -1,0 +1,81 @@
+"""Catalog metadata snapshots for thin clients — the pg_catalog role.
+
+The reference's MCP server answers metadata questions with catalog SQL
+(mcp-server/src/cbmcp/database.py: information_schema / pg_class joins);
+here the catalog IS in-process state, so metadata is read directly and
+shipped as JSON-safe dicts. Consumed by the wire protocol's {"meta": ...}
+request (serve/server.py) and the MCP analog (serve/mcp.py).
+"""
+
+from __future__ import annotations
+
+
+def _policy(t) -> str:
+    p = t.policy
+    if p.kind == "hashed":
+        return f"DISTRIBUTED BY ({', '.join(p.keys)})"
+    return f"DISTRIBUTED {p.kind.upper()}"
+
+
+def _table_row(name: str, t) -> dict:
+    return {
+        "name": name,
+        "columns": len(t.schema.fields),
+        "rows": int(t.num_rows),
+        "distribution": _policy(t),
+        "partitioned": t.partition_spec is not None,
+        "cold": bool(getattr(t, "cold", False)),
+        "external": bool(getattr(t, "external", None)),
+    }
+
+
+def describe(session, kind: str, arg=None):
+    """One metadata answer. Kinds: tables | columns | stats | views |
+    matviews | sequences | info | summary."""
+    # metadata must see other sessions' committed DDL — a thin client may
+    # only ever ask metadata questions, so sync here, not just in sql()
+    session._sync_store()
+    cat = session.catalog
+    if kind == "tables":
+        return [_table_row(n, t) for n, t in sorted(cat.tables.items())]
+    if kind == "columns":
+        t = cat.table(str(arg))
+        nullable = set(getattr(t, "validity", {}) or ())
+        uniq = t.stats.unique or {}
+        return [{"name": f.name, "type": str(f.type),
+                 "nullable": f.name in nullable or t.num_rows == 0,
+                 "unique": bool(uniq.get(f.name, False))}
+                for f in t.schema.fields]
+    if kind == "stats":
+        t = cat.table(str(arg))
+        return {
+            "rows": int(t.num_rows),
+            "ndv": {c: int(v) for c, v in (t.stats.ndv or {}).items()},
+            "min_max": {c: [float(lo), float(hi)]
+                        for c, (lo, hi) in (t.stats.min_max or {}).items()},
+            "distribution": _policy(t),
+        }
+    if kind == "views":
+        return sorted(cat.views)
+    if kind == "matviews":
+        return [{"name": n,
+                 "base_table": getattr(d, "base_table", None),
+                 "incremental": bool(getattr(d, "incremental", False)),
+                 "fresh": getattr(d, "fresh_token", None) is not None}
+                for n, d in sorted(cat.matviews.items())]
+    if kind == "sequences":
+        return sorted(getattr(cat, "sequences", {}) or ())
+    if kind == "info":
+        return {
+            "engine": "cloudberry_tpu",
+            "n_segments": int(session.config.n_segments),
+            "durable": session.store is not None,
+            "tables": len(cat.tables),
+            "views": len(cat.views),
+            "matviews": len(cat.matviews),
+        }
+    if kind == "summary":
+        return {n: {"rows": int(t.num_rows),
+                    "columns": [f.name for f in t.schema.fields]}
+                for n, t in sorted(cat.tables.items())}
+    raise ValueError(f"unknown meta kind {kind!r}")

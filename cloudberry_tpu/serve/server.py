@@ -213,6 +213,20 @@ class Server:
     # ------------------------------------------------------------- execution
 
     def _execute(self, req: dict, sess) -> dict:
+        if "meta" in req:
+            # catalog metadata over the wire (the pg_catalog role for thin
+            # clients — the MCP analog, serve/mcp.py, is the main consumer)
+            from cloudberry_tpu.serve.meta import describe
+
+            if not self.per_connection:
+                self._rw.acquire_read()
+            try:
+                return {"ok": True,
+                        "meta": describe(sess, req["meta"],
+                                         req.get("arg"))}
+            finally:
+                if not self.per_connection:
+                    self._rw.release_read()
         if "retrieve" in req:
             # retrieve-mode request (cdbendpointretrieve.c analog): drain
             # one endpoint of a parallel cursor; token REQUIRED on the wire

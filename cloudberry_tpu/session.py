@@ -29,8 +29,15 @@ def _read_only(query: str) -> bool:
     """Statements safe to re-execute after a device failure: re-running a
     query cannot change state; re-running DML/DDL/COPY can double-apply.
     Classified by leading keyword — the grammar has no WITH-DML, so the
-    head token is decisive."""
-    head = query.lstrip().split(None, 1)
+    head token is decisive ('(' heads parenthesized set operations, reads
+    by grammar). nextval() disqualifies: sequence allocation happens at
+    plan time, so a replay would burn values."""
+    s = query.lstrip()
+    if "nextval" in s.lower():
+        return False
+    if s.startswith("("):
+        return True
+    head = s.split(None, 1)
     return bool(head) and head[0].lower() in _READ_ONLY_HEADS
 
 
@@ -135,25 +142,41 @@ class Session:
             on_retry=self._recover_mesh if h.probe_on_error else None)
 
     def _recover_mesh(self, e: Exception) -> None:
-        """Between-retry hook: probe every device; when fewer answer than
-        the mesh expects, re-derive a smaller mesh (probeWalRepUpdateConfig
-        analog — except nothing promotes: placement is recomputed)."""
+        """Between-retry hook: probe every device; when any are gone,
+        re-derive the mesh over the SURVIVORS (probeWalRepUpdateConfig
+        analog — except nothing promotes: placement is recomputed). A
+        real loss leaves a hole mid-list, so the survivor indices matter,
+        not just the count (segment_mesh skips the dead device)."""
         from cloudberry_tpu.parallel.health import probe
 
         r = probe()
-        if self.config.health.degrade and r.n_devices \
-                and r.n_devices < self.config.n_segments:
-            self.degrade_mesh(r.n_devices)
+        if self.config.health.degrade and r.live:
+            self.degrade_mesh(len(r.live), r.live)
 
-    def degrade_mesh(self, n_devices: int) -> bool:
-        """Shrink the segment mesh to ``n_devices`` and invalidate every
-        placement/plan cache. Derived placement (jump hash over shared
-        storage) makes this a pure recompute — no data movement protocol,
-        the reference's gprecoverseg/rebalance role collapses into cache
-        invalidation."""
+    def degrade_mesh(self, n_devices: int, live_ids=None) -> bool:
+        """Shrink the segment mesh to ``n_devices`` (over ``live_ids``
+        when given) and invalidate every placement/plan cache. Derived
+        placement (jump hash over shared storage) makes this a pure
+        recompute — no data movement protocol, the reference's
+        gprecoverseg/rebalance role collapses into cache invalidation."""
         with self._sync_lock:  # server handler threads share this session
             n = max(1, min(self.config.n_segments, n_devices))
-            if n == self.config.n_segments:
+            changed = n != self.config.n_segments
+            if live_ids is not None:
+                ids = list(live_ids)
+                if len(ids) > n:
+                    # more survivors than segments: the first n suffice,
+                    # and an unchanged prefix keeps caches valid
+                    ids = ids[:n]
+                if ids != list(range(n)):
+                    # a hole mid-list: the mesh must skip dead devices
+                    changed = changed or ids != getattr(
+                        self, "_live_device_ids", None)
+                    self._live_device_ids = ids
+                elif getattr(self, "_live_device_ids", None) is not None:
+                    changed = True
+                    self._live_device_ids = None
+            if not changed:
                 return False
             self.config = self.config.with_overrides(n_segments=n)
             self._shard_cache.clear()

@@ -87,6 +87,45 @@ def test_degraded_mesh_replanning():
     assert df["c"][0] == 128
 
 
+def test_degraded_mesh_skips_mid_list_hole():
+    """A REAL device loss leaves a hole in the middle of jax.devices();
+    recovery must mesh over the survivors, not devices[:n-1]."""
+    s = _mk(nseg=8)
+    _load(s, n=256)
+    expect = s.sql("select v, count(*) as c from t group by v "
+                   "order by v").to_pandas()
+    # probe found device 3 dead: survivors are a non-prefix subset
+    assert s.degrade_mesh(7, live_ids=[0, 1, 2, 4, 5, 6, 7])
+    assert s.config.n_segments == 7
+    assert s._live_device_ids == [0, 1, 2, 4, 5, 6, 7]
+    got = s.sql("select v, count(*) as c from t group by v "
+                "order by v").to_pandas()
+    assert expect.equals(got)
+
+
+def test_probe_reports_live_indices():
+    from cloudberry_tpu.parallel import health
+
+    r = health.probe()
+    assert r.ok and r.live == list(range(r.n_devices))
+    FI.inject_fault("probe_degraded", "skip")
+    r2 = health.probe()
+    assert r2.n_devices == r.n_devices - 1
+    assert r2.live == list(range(r.n_devices - 1))
+
+
+def test_read_only_classifier():
+    from cloudberry_tpu.session import _read_only
+
+    assert _read_only("select 1")
+    assert _read_only("  (select 1) union (select 2)")
+    assert _read_only("WITH q AS (select 1) select * from q")
+    assert not _read_only("insert into t values (1)")
+    assert not _read_only("create table t (x int)")
+    # sequence allocation happens at plan time: a replay would burn values
+    assert not _read_only("select nextval('s')")
+
+
 def test_degrade_disabled_still_retries():
     s = _mk(nseg=4, **{"health.degrade": False})
     _load(s)

@@ -1,0 +1,187 @@
+"""MCP server analog (serve/mcp.py) — the AI-agent surface.
+
+Pins the JSON-RPC 2.0 protocol shape (initialize / tools / resources),
+the read-only security gate, and both engines: in-process Session and a
+live wire connection whose {"meta": ...} requests answer the metadata
+tools (the mcp-server/src/cbmcp role)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.serve.mcp import McpServer, SessionEngine, WireEngine
+
+
+@pytest.fixture(scope="module")
+def srv():
+    s = cb.Session(get_config().with_overrides(n_segments=1))
+    s.sql("create table emp (id bigint, dept text, sal bigint) "
+          "distributed by (id)")
+    s.sql("insert into emp values (1,'eng',100),(2,'eng',90),(3,'ops',70)")
+    s.sql("create table tiny (x int)")
+    s.sql("create view v_eng as select * from emp where dept = 'eng'")
+    s.sql("analyze emp")
+    return McpServer(SessionEngine(s))
+
+
+def rpc(m, method, params=None, rid=1):
+    resp = m.handle({"jsonrpc": "2.0", "id": rid, "method": method,
+                     "params": params or {}})
+    assert resp["id"] == rid
+    assert "error" not in resp, resp.get("error")
+    return resp["result"]
+
+
+def tool(m, name, **args):
+    out = rpc(m, "tools/call", {"name": name, "arguments": args})
+    assert out["isError"] is False, out
+    return json.loads(out["content"][0]["text"])
+
+
+def test_initialize_and_lists(srv):
+    init = rpc(srv, "initialize")
+    assert init["serverInfo"]["name"] == "cloudberry-tpu-mcp"
+    assert "tools" in init["capabilities"]
+    tools = {t["name"] for t in rpc(srv, "tools/list")["tools"]}
+    assert {"list_tables", "execute_query", "explain_query",
+            "get_table_stats"} <= tools
+    # notifications get no response
+    assert srv.handle({"jsonrpc": "2.0",
+                       "method": "notifications/initialized"}) is None
+
+
+def test_list_tables_and_columns(srv):
+    tables = tool(srv, "list_tables")
+    byname = {t["name"]: t for t in tables}
+    assert byname["emp"]["rows"] == 3
+    assert byname["emp"]["distribution"] == "DISTRIBUTED BY (id)"
+    cols = tool(srv, "list_columns", table="emp")
+    assert [c["name"] for c in cols] == ["id", "dept", "sal"]
+    assert cols[0]["type"].lower().startswith("bigint") \
+        or "int" in cols[0]["type"].lower()
+
+
+def test_execute_query_and_stats(srv):
+    out = tool(srv, "execute_query",
+               sql="select dept, sum(sal) as s from emp group by dept "
+                   "order by dept")
+    assert out["columns"] == ["dept", "s"]
+    assert out["rows"] == [["eng", 190], ["ops", 70]]
+    st = tool(srv, "get_table_stats", table="emp")
+    assert st["rows"] == 3 and "sal" in st["min_max"]
+    plan = tool(srv, "explain_query", sql="select count(*) from emp")
+    assert "Scan emp" in plan["plan"]
+
+
+def test_read_only_gate(srv):
+    resp = srv.handle({"jsonrpc": "2.0", "id": 7, "method": "tools/call",
+                       "params": {"name": "execute_query",
+                                  "arguments": {
+                                      "sql": "drop table emp"}}})
+    assert resp["error"]["code"] == -32602
+    assert "read-only" in resp["error"]["message"]
+    resp = srv.handle({"jsonrpc": "2.0", "id": 8, "method": "tools/call",
+                       "params": {"name": "execute_query",
+                                  "arguments": {
+                                      "sql": "select 1; drop table emp"}}})
+    assert "stacked" in resp["error"]["message"]
+    # the table survived the attempts
+    assert tool(srv, "get_table_stats", table="emp")["rows"] == 3
+
+
+def test_max_rows_cap(srv):
+    out = tool(srv, "execute_query", sql="select id from emp order by id",
+               max_rows=2)
+    assert len(out["rows"]) == 2 and out["truncated"] is True
+
+
+def test_resources(srv):
+    uris = {r["uri"] for r in rpc(srv, "resources/list")["resources"]}
+    assert "cbtpu://database/info" in uris
+    info = json.loads(rpc(srv, "resources/read",
+                          {"uri": "cbtpu://database/info"}
+                          )["contents"][0]["text"])
+    assert info["engine"] == "cloudberry_tpu" and info["tables"] == 2
+    schemas = json.loads(rpc(srv, "resources/read",
+                             {"uri": "cbtpu://schemas"}
+                             )["contents"][0]["text"])
+    assert "emp" in schemas
+
+
+def test_large_tables_and_views(srv):
+    big = tool(srv, "list_large_tables", limit=1)
+    assert big[0]["name"] == "emp"
+    assert tool(srv, "list_views") == ["v_eng"]
+
+
+def test_unknown_method_and_tool(srv):
+    resp = srv.handle({"jsonrpc": "2.0", "id": 9, "method": "nope"})
+    assert resp["error"]["code"] == -32602
+    resp = srv.handle({"jsonrpc": "2.0", "id": 10, "method": "tools/call",
+                       "params": {"name": "nope", "arguments": {}}})
+    assert "unknown tool" in resp["error"]["message"]
+
+
+def test_stdio_transport(srv):
+    import io
+
+    lines = [
+        json.dumps({"jsonrpc": "2.0", "id": 1, "method": "initialize"}),
+        json.dumps({"jsonrpc": "2.0", "method":
+                    "notifications/initialized"}),
+        "not json",
+        json.dumps({"jsonrpc": "2.0", "id": 2, "method": "tools/call",
+                    "params": {"name": "execute_query",
+                               "arguments": {"sql":
+                                             "select count(*) c from emp"
+                                             }}}),
+    ]
+    out = io.StringIO()
+    srv.serve_stdio(stdin=io.StringIO("\n".join(lines) + "\n"), stdout=out)
+    resps = [json.loads(x) for x in out.getvalue().splitlines()]
+    # notification dropped: init, parse error, tool result
+    assert len(resps) == 3
+    assert resps[0]["result"]["protocolVersion"]
+    assert resps[1]["error"]["code"] == -32700
+    body = json.loads(resps[2]["result"]["content"][0]["text"])
+    assert body["rows"] == [[3]]
+
+
+def test_meta_sees_other_sessions_ddl(tmp_path):
+    """Metadata must sync the store first: a thin client that only asks
+    metadata questions still sees other sessions' committed DDL."""
+    cfg = get_config().with_overrides(**{"storage.root": str(tmp_path)})
+    reader = cb.Session(cfg)
+    m = McpServer(SessionEngine(reader))
+    assert tool(m, "list_tables") == []
+    writer = cb.Session(cfg)
+    writer.sql("create table late (x bigint)")
+    writer.sql("insert into late values (1)")
+    names = [t["name"] for t in tool(m, "list_tables")]
+    assert names == ["late"]
+    assert tool(m, "get_table_stats", table="late")["rows"] == 1
+
+
+def test_wire_engine_end_to_end(tmp_path):
+    """An MCP server backed by a LIVE socket server: metadata rides the
+    wire protocol's {"meta": ...} requests."""
+    from cloudberry_tpu.serve.server import Server
+
+    cfg = get_config().with_overrides(**{"storage.root": str(tmp_path)})
+    with Server(config=cfg, port=0) as server:
+        boot = cb.Session(cfg)
+        boot.sql("create table wt (a bigint, b bigint) distributed by (a)")
+        boot.sql("insert into wt values (1, 10), (2, 20)")
+        m = McpServer(WireEngine(server.host, server.port))
+        tables = tool(m, "list_tables")
+        assert [t["name"] for t in tables] == ["wt"]
+        out = tool(m, "execute_query",
+                   sql="select sum(b) as s from wt")
+        assert out["rows"] == [[30]]
+        info = json.loads(rpc(m, "resources/read",
+                              {"uri": "cbtpu://database/info"}
+                              )["contents"][0]["text"])
+        assert info["durable"] is True
