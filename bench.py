@@ -46,9 +46,28 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# tables each bench query touches (generation cost scales with SF — load
+# only what the selected queries scan)
+QUERY_TABLES = {
+    "q1": ["lineitem"],
+    "q3": ["lineitem", "orders", "customer"],
+    "q5": ["lineitem", "orders", "customer", "supplier", "nation",
+           "region"],
+    "q6": ["lineitem"],
+    "q9": ["lineitem", "orders", "part", "partsupp", "supplier", "nation"],
+    "q10": ["lineitem", "orders", "customer", "nation"],
+    "q18": ["lineitem", "orders", "customer"],
+}
+
+
 def bench_queries() -> list[str]:
+    """Default staged set: Q1 (scan+agg), Q3 (3-way join), Q9 (the
+    BASELINE.md config-#3 multi-join shape — 6 tables, the heaviest join
+    tree; its Motion-heavy variant is benched by tools/ic_bench.py since
+    one chip cannot shard). Override with BENCH_QUERIES / BENCH_SF
+    (e.g. BENCH_QUERIES=q5,q9 BENCH_SF=10 for the full config #3)."""
     return [q.strip() for q in
-            os.environ.get("BENCH_QUERIES", "q1,q3").split(",")
+            os.environ.get("BENCH_QUERIES", "q1,q3,q9").split(",")
             if q.strip()]
 
 
@@ -144,8 +163,10 @@ def measure() -> None:
 
     t0 = time.time()
     session = cb.Session()
-    load_tpch(session, sf=sf, seed=1,
-              tables=["lineitem", "orders", "customer"])
+    needed = sorted({t for q in qnames
+                     for t in QUERY_TABLES.get(q, ["lineitem", "orders",
+                                                   "customer"])})
+    load_tpch(session, sf=sf, seed=1, tables=needed)
     n_rows = session.catalog.table("lineitem").num_rows
     log(f"generated sf={sf}: lineitem {n_rows} rows "
         f"in {time.time()-t0:.1f}s")

@@ -51,6 +51,14 @@ class TableStats:
     # number of distinct values per column (the pg_statistic n_distinct
     # analog) — computed lazily or by ANALYZE; drives join/group costing
     ndv: dict[str, int] = field(default_factory=dict)
+    # equi-depth histogram bounds per numeric column (the pg_statistic
+    # histogram_bounds analog): N+1 ascending values splitting the valid
+    # rows into N equal-count buckets — range selectivity interpolates
+    # within the containing bucket instead of assuming a uniform [min,max]
+    hist: dict[str, list] = field(default_factory=dict)
+    # row count at the last ANALYZE (-1 = never) — the autostats trigger
+    # compares against it (gp_autostats_mode, autostats.c:283)
+    analyzed_rows: int = -1
 
 
 @dataclass
@@ -104,6 +112,24 @@ class Table:
                  dicts: dict[str, StringDictionary] | None = None,
                  validity: dict[str, np.ndarray] | None = None,
                  appended: int | None = None) -> None:
+        # a refused persist (disk quota, full disk) must not leave RAM
+        # ahead of the store — capture enough to restore on failure
+        import copy as _copy
+
+        _prev = (self.data, self.dicts, self.validity,
+                 _copy.deepcopy(self.stats), getattr(self, "_version", 0),
+                 self.cold)
+        try:
+            self._set_data_inner(data, dicts, validity, appended)
+        except Exception:
+            (self.data, self.dicts, self.validity, self.stats,
+             self._version, self.cold) = _prev
+            raise
+
+    def _set_data_inner(self, data: dict[str, np.ndarray],
+                        dicts: dict[str, StringDictionary] | None = None,
+                        validity: dict[str, np.ndarray] | None = None,
+                        appended: int | None = None) -> None:
         self.data = data
         self.dicts = dicts or {}
         n = len(next(iter(data.values()))) if data else 0
@@ -191,20 +217,37 @@ class Table:
         self.stats.ndv[col] = n
         return n
 
+    HIST_BUCKETS = 64
+
     def analyze(self) -> dict[str, int]:
-        """Collect NDV for every column (the distributed-ANALYZE analog,
-        analyze.c:31 — strings count distinct dictionary codes) and persist
-        into the manifest if durable."""
+        """Collect NDV and equi-depth histograms for every numeric column
+        (the distributed-ANALYZE analog, analyze.c:31 — strings count
+        distinct dictionary codes; histogram role: pg_statistic
+        histogram_bounds) and persist into the manifest if durable."""
         self.ensure_loaded()
         for f in self.schema.fields:
             arr = self.data.get(f.name)
-            if arr is not None and arr.dtype.kind in "iufb" \
-                    and self.stats.row_count:
-                self.stats.ndv[f.name] = int(len(np.unique(arr)))
+            if arr is None or arr.dtype.kind not in "iufb" \
+                    or not self.stats.row_count:
+                continue
+            self.stats.ndv[f.name] = int(len(np.unique(arr)))
+            if arr.dtype.kind in "iuf":
+                # valid rows only: canonical-zero NULL fills would put a
+                # false spike at 0
+                vm = self.validity.get(f.name)
+                vals = arr[vm] if vm is not None and len(vm) == len(arr) \
+                    else arr
+                if len(vals):
+                    qs = np.linspace(0.0, 1.0, self.HIST_BUCKETS + 1)
+                    self.stats.hist[f.name] = [
+                        float(v) for v in np.quantile(vals, qs)]
+        self.stats.analyzed_rows = int(self.stats.row_count)
         if self.backing is not None:
             if getattr(self.backing, "autocommit", True):
                 self._store_version = \
-                    self.backing.save_stats(self.name, self.stats.ndv)
+                    self.backing.save_stats(self.name, self.stats.ndv,
+                                            self.stats.hist,
+                                            self.stats.analyzed_rows)
             else:
                 # inside a transaction: a stats-only marker — COMMIT writes
                 # one manifest (save_stats), never a full data re-snapshot,

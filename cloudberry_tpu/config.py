@@ -64,6 +64,12 @@ class PlannerConfig:
     # Answer-query-using-matview rewrite (aqumv.c): SELECTs subsumed by a
     # FRESH aggregate materialized view read the view instead.
     enable_aqumv: bool = True
+    # Auto-ANALYZE after DML (the gp_autostats_mode analog,
+    # autostats.c:283): "none" | "on_no_stats" (first DML on an
+    # unanalyzed table) | "on_change" (row count drifted more than
+    # autostats_threshold since the last ANALYZE).
+    autostats: str = "on_no_stats"
+    autostats_threshold: float = 0.2
 
 
 @dataclass(frozen=True)
@@ -107,6 +113,14 @@ class StorageConfig:
     # runs host-side first and its key values prune probe partitions
     # before any fact-table IO. 0 disables.
     partition_selector_max_build: int = 1 << 17
+    # Store-wide disk quota in bytes (the diskquota extension analog):
+    # once on-disk usage reaches the quota, further writes are refused
+    # (reads, deletes, and drops still work — the way out). 0 = unlimited.
+    quota_bytes: int = 0
+    # TDE cluster key (utils/tde.py): when set, micro-partition files and
+    # manifests encrypt at rest (Fernet: AES-CBC + HMAC). Feed this from
+    # a secret manager; None = plaintext storage.
+    encryption_key: str | None = None
 
 
 @dataclass(frozen=True)

@@ -40,24 +40,44 @@ def load_cluster(store: str) -> dict:
             f"`python -m cloudberry_tpu --store {store} init` first")
 
 
+def _enc_key() -> str | None:
+    """TDE cluster key for CLI entry points: --encryption-key or the
+    CBTPU_ENCRYPTION_KEY environment (the keyring-unlock analog)."""
+    return _ENC_KEY or os.environ.get("CBTPU_ENCRYPTION_KEY") or None
+
+
+_ENC_KEY: str | None = None
+
+
+def _store(root: str):
+    """A TableStore honoring the TDE key (every direct CLI store open)."""
+    from cloudberry_tpu.storage.table_store import TableStore
+    from cloudberry_tpu.utils.tde import make_cipher
+
+    ts = TableStore(root)
+    ts.cipher = make_cipher(_enc_key())
+    return ts
+
+
 def cluster_config(store: str):
     """The one Config a cluster store implies — every entry point (serve,
     mcp, sql) must build it identically or drift apart."""
     from cloudberry_tpu.config import Config
 
     cfg = load_cluster(store)
-    return Config(n_segments=cfg["n_segments"]).with_overrides(
-        **{"storage.root": store})
+    over = {"storage.root": store}
+    if _enc_key():
+        over["storage.encryption_key"] = _enc_key()
+    return Config(n_segments=cfg["n_segments"]).with_overrides(**over)
 
 
 def _open_session(store: str):
     import cloudberry_tpu as cb
     from cloudberry_tpu.config import Config
-    from cloudberry_tpu.storage.table_store import TableStore
 
     cfg = load_cluster(store)
     s = cb.Session(Config(n_segments=cfg["n_segments"]))
-    ts = TableStore(store)
+    ts = _store(store)
     for name in sorted(os.listdir(store)):
         if os.path.isdir(os.path.join(store, name, "_manifests")):
             ts.load_table(s.catalog, name)
@@ -91,9 +111,7 @@ def cmd_state(args) -> int:
     print(f"devices visible: {len(devices)} ({devices[0].platform})")
     print(f"health probe:    {'OK' if r.ok else 'FAILED: ' + str(r.error)}"
           f" ({r.latency_s * 1000:.1f} ms)")
-    from cloudberry_tpu.storage.table_store import TableStore
-
-    ts = TableStore(args.store)  # manifests only: no data decode for status
+    ts = _store(args.store)  # manifests only: no data decode for status
     for name in sorted(os.listdir(args.store)):
         mdir = os.path.join(args.store, name, "_manifests")
         if os.path.isdir(mdir):
@@ -150,9 +168,8 @@ def cmd_check(args) -> int:
     """Storage consistency scan (gpcheckcat analog): every partition file
     must parse, row counts and dictionary code ranges must agree."""
     from cloudberry_tpu.storage import micropartition as mp
-    from cloudberry_tpu.storage.table_store import TableStore
 
-    ts = TableStore(args.store)
+    ts = _store(args.store)
     problems = 0
     for name in sorted(os.listdir(args.store)):
         mdir = os.path.join(args.store, name, "_manifests")
@@ -162,12 +179,12 @@ def cmd_check(args) -> int:
         for part in man["partitions"]:
             path = os.path.join(args.store, name, part["file"])
             try:
-                footer = mp.read_footer(path)
+                footer = mp.read_footer(path, cipher=ts.cipher)
                 if footer["num_rows"] != part["num_rows"]:
                     print(f"MISMATCH {name}/{part['file']}: manifest rows "
                           f"{part['num_rows']} != footer {footer['num_rows']}")
                     problems += 1
-                cols = mp.read_columns(path)
+                cols = mp.read_columns(path, cipher=ts.cipher)
                 for cname, values in man["dicts"].items():
                     if cname in cols and len(cols[cname]) \
                             and cols[cname].max() >= len(values):
@@ -188,9 +205,12 @@ def cmd_serve(args) -> int:
     from cloudberry_tpu.serve import Server
 
     srv = Server(config=cluster_config(args.store),
-                 host=args.host, port=args.port)
+                 host=args.host, port=args.port,
+                 read_only=getattr(args, "standby", False),
+                 auth_token=getattr(args, "auth_token", None))
+    role = "standby (read-only)" if srv.read_only else "primary"
     print(f"serving on {srv.host}:{srv.port} (store {args.store}, "
-          f"{srv.session.config.n_segments} segments)", flush=True)
+          f"{srv.session.config.n_segments} segments, {role})", flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -266,6 +286,9 @@ def main(argv=None) -> int:
         description="TPU-native MPP SQL cluster management")
     p.add_argument("--store", default=os.environ.get("CBTPU_STORE", "./cbtpu"),
                    help="cluster store directory")
+    p.add_argument("--encryption-key", default=None,
+                   help="TDE cluster key (or CBTPU_ENCRYPTION_KEY env) — "
+                        "required to open an encrypted store")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pi = sub.add_parser("init", help="create a cluster (gpinitsystem)")
@@ -297,6 +320,12 @@ def main(argv=None) -> int:
     pv = sub.add_parser("serve", help="run the socket server (tcop analog)")
     pv.add_argument("--host", default="127.0.0.1")
     pv.add_argument("--port", type=int, default=15432)
+    pv.add_argument("--standby", action="store_true",
+                    help="hot standby: serve reads over the shared store, "
+                         "refuse writes")
+    pv.add_argument("--auth-token", default=None,
+                    help="require {\"auth\": token} before requests "
+                         "(failed logins lock the address out)")
     pv.set_defaults(fn=cmd_serve)
 
     pf = sub.add_parser("fdist",
@@ -312,6 +341,9 @@ def main(argv=None) -> int:
     pm.set_defaults(fn=cmd_mcp)
 
     args = p.parse_args(argv)
+    if args.encryption_key:
+        global _ENC_KEY
+        _ENC_KEY = args.encryption_key
     return args.fn(args)
 
 

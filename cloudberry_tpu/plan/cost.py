@@ -269,11 +269,36 @@ def _cmp_sel(e: ex.BinOp, child: N.PlanNode, catalog) -> float:
         nd = t.ndv(src[1])
         s = 1.0 / nd if nd else DEFAULT_EQ_SEL
         return s if op == "=" else 1.0 - s
+    if not isinstance(r.value, (int, float)) or isinstance(r.value, bool):
+        return DEFAULT_RANGE_SEL
+    hist = t.stats.hist.get(src[1])
+    if hist and len(hist) >= 3:
+        # equi-depth histogram (ANALYZE output, pg_statistic
+        # histogram_bounds role): each bucket holds 1/N of the rows, so
+        # P(col <= v) = full buckets below v + linear interpolation
+        # inside the containing bucket — skew-proof where uniform
+        # [min,max] interpolation is wildly wrong
+        frac = _hist_le_frac(hist, float(r.value))
+        return frac if op in ("<", "<=") else 1.0 - frac
     mm = t.stats.min_max.get(src[1])
-    if mm is None or not isinstance(r.value, (int, float)) \
-            or mm[1] <= mm[0]:
+    if mm is None or mm[1] <= mm[0]:
         return DEFAULT_RANGE_SEL
     lo, hi = mm
     frac = (float(r.value) - lo) / (hi - lo)
     frac = min(max(frac, 0.0), 1.0)
     return frac if op in ("<", "<=") else 1.0 - frac
+
+
+def _hist_le_frac(bounds: list, v: float) -> float:
+    """P(col <= v) from equi-depth bounds (N+1 ascending values)."""
+    import bisect
+
+    n = len(bounds) - 1
+    if v < bounds[0]:
+        return 0.0
+    if v >= bounds[-1]:
+        return 1.0
+    i = bisect.bisect_right(bounds, v) - 1  # bucket containing v
+    lo, hi = bounds[i], bounds[i + 1]
+    inner = (v - lo) / (hi - lo) if hi > lo else 1.0
+    return (i + inner) / n

@@ -78,6 +78,46 @@ def plan_statement(stmt: ast.Node, session, params: dict,
         return PlanResult(is_ddl=True,
                           ddl_result=f"CREATE EXTERNAL TABLE {stmt.name}")
 
+    if isinstance(stmt, ast.CreateDirectoryTable):
+        from cloudberry_tpu.storage import dirtable as DT
+
+        if stmt.name.lower() in catalog.views:
+            raise BindError(f"{stmt.name!r} already exists as a view")
+        try:
+            DT.create(session, stmt.name)
+        except DT.DirTableError as e:
+            raise BindError(str(e))
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"CREATE DIRECTORY TABLE {stmt.name}")
+
+    if isinstance(stmt, ast.CreateForeignTable):
+        from cloudberry_tpu.storage.fdw import known_servers
+
+        if stmt.name.lower() in catalog.views:
+            raise BindError(f"{stmt.name!r} already exists as a view")
+        if stmt.server.lower() not in known_servers():
+            raise BindError(
+                f"unknown foreign server {stmt.server!r} "
+                f"(known: {', '.join(known_servers())}); register one "
+                "with cloudberry_tpu.storage.fdw.register_fdw")
+        fields = []
+        for c in stmt.columns:
+            ftype = T.SQL_TYPE_MAP.get(c.type_name)
+            if ftype is None:
+                raise BindError(f"unknown type {c.type_name!r}")
+            if ftype.base == T.DType.DECIMAL and c.scale is not None:
+                ftype = T.DECIMAL(c.scale)
+            fields.append(Field(c.name, ftype, nullable=not c.not_null))
+        # like external tables: ephemeral catalog entry, re-read per
+        # referencing statement — the foreign server owns the data
+        tab = catalog.create_table(stmt.name, Schema(tuple(fields)),
+                                   DistributionPolicy.random(),
+                                   durable=False)
+        tab.foreign = {"server": stmt.server.lower(),
+                       "options": dict(stmt.options)}
+        return PlanResult(is_ddl=True,
+                          ddl_result=f"CREATE FOREIGN TABLE {stmt.name}")
+
     if isinstance(stmt, ast.CreateTableAs):
         return PlanResult(is_ddl=True, ddl_result=_ctas(session, stmt))
 
@@ -278,6 +318,9 @@ def plan_statement(stmt: ast.Node, session, params: dict,
                           ddl_result=f"ANALYZE {stmt.table} "
                                      f"({len(ndv)} columns)")
 
+    if isinstance(stmt, ast.Cluster):
+        return PlanResult(is_ddl=True, ddl_result=_cluster(session, stmt))
+
     if isinstance(stmt, ast.TxnStmt):
         return PlanResult(is_ddl=True,
                           ddl_result=session.txn(stmt.kind))
@@ -359,21 +402,72 @@ def _stmt_table_names(node, catalog) -> set:
 
 
 def _refresh_referenced_externals(session, stmt) -> None:
-    """Re-read an external table's LOCATION only when THIS statement
+    """Re-read an external/foreign table's source only when THIS statement
     references it — an unreachable source must not fail unrelated
     queries, and unrelated statements pay no fetch."""
     cat = session.catalog
     ext = {n for n, t in cat.tables.items()
-           if getattr(t, "external", None)}
+           if getattr(t, "external", None) or getattr(t, "foreign", None)
+           or getattr(t, "directory", None)}
     if not ext:
         return
     for name in _stmt_table_names(stmt, cat) & ext:
-        refresh_external_table(session, cat.tables[name])
+        t = cat.tables[name]
+        if getattr(t, "foreign", None):
+            from cloudberry_tpu.storage.fdw import fetch_foreign
+
+            fetch_foreign(session, t)
+        elif getattr(t, "directory", None):
+            from cloudberry_tpu.storage import dirtable as DT
+
+            DT.refresh(session, t)
+        else:
+            refresh_external_table(session, t)
+
+
+def _cluster(session, stmt: ast.Cluster) -> str:
+    """CLUSTER t BY (cols): rewrite the table in z-order of the named
+    columns (zorder_clustering.cc role). The snapshot writer chunks rows
+    into micro-partition files in row order, so after the reorder each
+    file's manifest min/max is a tight bounding box — predicates on any
+    clustered column prune most files. A one-shot rewrite, like
+    PostgreSQL's CLUSTER: later appends are not re-ordered."""
+    import numpy as np
+
+    from cloudberry_tpu.utils.zorder import zorder_key
+
+    t = session.catalog.table(stmt.table)
+    if getattr(t, "external", None):
+        raise BindError("cannot CLUSTER an external table")
+    t.ensure_loaded()
+    cols = []
+    for c in stmt.columns:
+        name = c.lower()
+        arr = t.data.get(name)
+        if arr is None or name not in t.schema:
+            raise BindError(f"CLUSTER: unknown column {c!r}")
+        # schema type, not array dtype: string columns store int32
+        # dictionary CODES, whose order is insertion order, not collation
+        if t.schema.field(name).type.base == T.DType.STRING:
+            raise BindError(f"CLUSTER: column {c!r} is a string "
+                            "(dictionary codes order by insertion, "
+                            "not value — not supported)")
+        cols.append(arr)
+    if t.num_rows == 0:
+        return f"CLUSTER {stmt.table} (0 rows)"
+    order = np.argsort(zorder_key(cols), kind="stable")
+    data = {c: a[order] for c, a in t.data.items()}
+    validity = {c: np.asarray(v)[order] for c, v in t.validity.items()}
+    t.set_data(data, t.dicts, validity=validity)
+    return f"CLUSTER {stmt.table} ({t.num_rows} rows)"
 
 
 def _maintain(session, table_name: str, appended) -> None:
     """Post-DML materialized-view maintenance (the IMMV trigger analog):
-    appends merge incrementally; other DML forces refresh/staleness."""
+    appends merge incrementally; other DML forces refresh/staleness.
+    Also the autostats trigger point (autostats.c:283 — the reference
+    likewise hooks ANALYZE off DML completion)."""
+    _maybe_autostats(session, table_name)
     if not session.catalog.matviews:
         return
     from cloudberry_tpu.plan import matview as MV
@@ -382,6 +476,28 @@ def _maintain(session, table_name: str, appended) -> None:
         MV.maintain_on_append(session, table_name, appended)
     else:
         MV.maintain_full(session, table_name)
+
+
+def _maybe_autostats(session, table_name: str) -> None:
+    """Auto-ANALYZE after DML (gp_autostats_mode): "on_no_stats" analyzes
+    the first time a never-analyzed table is written; "on_change" when the
+    row count drifted past autostats_threshold since the last ANALYZE.
+    Cold tables are skipped — auto-analyzing would pull the whole table
+    into RAM for a statement that never needed it."""
+    mode = session.config.planner.autostats
+    if mode == "none":
+        return
+    t = session.catalog.tables.get(table_name.lower())
+    if t is None or t.cold or getattr(t, "external", None):
+        return
+    ar = t.stats.analyzed_rows
+    if ar < 0:
+        t.analyze()
+        return
+    if mode == "on_change":
+        thresh = session.config.planner.autostats_threshold
+        if abs(int(t.num_rows) - ar) > max(1.0, ar * thresh):
+            t.analyze()
 
 
 def _run_internal(session, query: ast.Node):

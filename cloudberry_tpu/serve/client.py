@@ -11,10 +11,13 @@ class ServerError(RuntimeError):
 
 
 class Client:
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 token: str | None = None):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._r = self._sock.makefile("rb")
         self._w = self._sock.makefile("wb")
+        if token is not None:
+            self._request({"auth": token})
 
     def _request(self, req: dict) -> dict:
         self._w.write(json.dumps(req).encode() + b"\n")

@@ -26,20 +26,23 @@ from typing import Any, Optional
 PROTOCOL_VERSION = "2024-11-05"
 SERVER_INFO = {"name": "cloudberry-tpu-mcp", "version": "1.0"}
 
-_QUERY_HEADS = ("select", "with", "values", "explain", "show", "(")
-
-
 class McpError(RuntimeError):
     pass
 
 
 def _check_read_only(sql: str) -> None:
+    from cloudberry_tpu.sql.classify import read_only, \
+        strip_string_literals
+
     s = sql.strip()
-    head = s.split(None, 1)[0].lower() if s else ""
-    if not (s.startswith("(") or head in _QUERY_HEADS):
+    if not read_only(s):
+        head = s.split(None, 1)[0].lower() if s else ""
         raise McpError(f"only read-only statements are allowed "
                        f"(got {head or 'empty'!r})")
-    if ";" in s.rstrip().rstrip(";"):
+    # stacked-statement check on the literal-stripped text: a ';' inside
+    # a string ('a;b') is data, not a second statement
+    bare = strip_string_literals(s).rstrip().rstrip(";")
+    if ";" in bare:
         raise McpError("stacked statements are not allowed")
 
 

@@ -40,10 +40,12 @@ def describe(session, kind: str, arg=None):
         return [_table_row(n, t) for n, t in sorted(cat.tables.items())]
     if kind == "columns":
         t = cat.table(str(arg))
-        nullable = set(getattr(t, "validity", {}) or ())
         uniq = t.stats.unique or {}
         return [{"name": f.name, "type": str(f.type),
-                 "nullable": f.name in nullable or t.num_rows == 0,
+                 # DECLARED nullability (information_schema semantics) —
+                 # the in-RAM validity mask is absent for cold tables and
+                 # says nothing about the declaration
+                 "nullable": bool(f.nullable),
                  "unique": bool(uniq.get(f.name, False))}
                 for f in t.schema.fields]
     if kind == "stats":

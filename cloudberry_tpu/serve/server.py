@@ -38,7 +38,11 @@ from typing import Optional
 
 import numpy as np
 
-_READ_STARTERS = ("select", "with", "explain", "show")
+from cloudberry_tpu.sql.classify import read_only as _is_read  # noqa: E402
+# shared classifier (sql/classify.py): the standby gate, the rw-lock
+# choice, and the Session retry policy must agree on what a "read" is —
+# notably `select nextval(...)` is a WRITE (plan-time sequence allocation)
+
 _TXN_STARTERS = ("begin", "commit", "rollback", "abort", "start", "end")
 
 
@@ -48,11 +52,6 @@ def _first_word(sql: str) -> str:
         return "("
     head = s.split(None, 1)
     return head[0].lower() if head else ""
-
-
-def _is_read(sql: str) -> bool:
-    w = _first_word(sql)
-    return w == "(" or w in _READ_STARTERS
 
 
 class _RWLock:
@@ -113,10 +112,27 @@ def _json_safe(v):
 
 
 class Server:
-    """One engine process serving many clients over TCP."""
+    """One engine process serving many clients over TCP.
+
+    ``read_only=True`` runs the process as a HOT STANDBY (the
+    hot_standby / mirroring analog): a second server over the SAME store
+    serves reads while refusing writes. No WAL ships and nothing
+    promotes-on-command — immutable snapshot manifests ARE the
+    replication stream (the standby's epoch sync picks up every commit),
+    and "promotion" is restarting without the flag.
+
+    ``auth_token`` enables authentication: clients must send
+    {"auth": "<token>"} before anything else. Repeated failures from one
+    client address lock that address out for ``lockout_s`` seconds (the
+    login-monitor analog — the reference disables accounts after
+    consecutive failed logins)."""
 
     def __init__(self, session=None, config=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 read_only: bool = False,
+                 auth_token: Optional[str] = None,
+                 max_login_failures: int = 3,
+                 lockout_s: float = 60.0):
         import cloudberry_tpu as cb
 
         self.session = session if session is not None else cb.Session(config)
@@ -125,6 +141,13 @@ class Server:
         self._config = self.session.config
         self.per_connection = (session is None
                                and self.session.store is not None)
+        self.read_only = read_only
+        self.auth_token = auth_token
+        self.max_login_failures = max_login_failures
+        self.lockout_s = lockout_s
+        # login monitor state: client address -> (failures, locked_until)
+        self._login_failures: dict[str, list] = {}
+        self._login_lock = threading.Lock()
         self._rw = _RWLock()
         outer = self
 
@@ -133,6 +156,8 @@ class Server:
                 from cloudberry_tpu.utils.faultinject import fault_point
 
                 fault_point("serve_handler")
+                addr = self.client_address[0]
+                authed = outer.auth_token is None
                 sess = outer._connection_session()
                 try:
                     for line in self.rfile:
@@ -141,12 +166,18 @@ class Server:
                             continue
                         try:
                             req = json.loads(line)
-                            resp = outer._execute(req, sess)
+                            if not authed:
+                                resp, authed = outer._authenticate(req,
+                                                                   addr)
+                            else:
+                                resp = outer._execute(req, sess)
                         except Exception as e:  # bad client must not kill us
                             resp = {"ok": False, "etype": type(e).__name__,
                                     "error": f"{type(e).__name__}: {e}"}
                         self.wfile.write(json.dumps(resp).encode() + b"\n")
                         self.wfile.flush()
+                        if resp.get("fatal"):
+                            return
                 finally:
                     outer._end_connection(sess)
 
@@ -157,6 +188,40 @@ class Server:
         self._server = TCP((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
+        # scheduled statements (pg_cron analog): jobs persist in the store
+        # and run in the serving process's session
+        from cloudberry_tpu.serve.cron import Scheduler
+
+        self.cron = Scheduler(self.session).load()
+
+    # ----------------------------------------------------- authentication
+
+    def _authenticate(self, req: dict, addr: str) -> tuple[dict, bool]:
+        """First-request auth + the login-monitor lockout. Returns
+        (response, now_authenticated); a lockout or bad token closes the
+        connection (resp["fatal"])."""
+        import time
+
+        with self._login_lock:
+            fails, until = self._login_failures.get(addr, [0, 0.0])
+            if time.monotonic() < until:
+                return ({"ok": False, "fatal": True,
+                         "error": "too many failed logins; address locked "
+                                  f"for {self.lockout_s:.0f}s"}, False)
+        token = req.get("auth")
+        if token == self.auth_token:
+            with self._login_lock:
+                self._login_failures.pop(addr, None)
+            return ({"ok": True, "status": "authenticated"}, True)
+        with self._login_lock:
+            fails, until = self._login_failures.get(addr, [0, 0.0])
+            fails += 1
+            if fails >= self.max_login_failures:
+                until = time.monotonic() + self.lockout_s
+            self._login_failures[addr] = [fails, until]
+        msg = ("authentication required: send {\"auth\": \"<token>\"} first"
+               if "auth" not in req else "authentication failed")
+        return ({"ok": False, "fatal": True, "error": msg}, False)
 
     # ------------------------------------------------- connection sessions
 
@@ -192,12 +257,19 @@ class Server:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if not self.read_only:
+            # a standby never runs jobs: the primary owns the schedule
+            # (pg_cron likewise runs on the primary only)
+            self.cron.start()
         return self
 
     def serve_forever(self) -> None:
+        if not self.read_only:
+            self.cron.start()  # foreground entry point runs jobs too
         self._server.serve_forever()
 
     def stop(self) -> None:
+        self.cron.stop()
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
@@ -227,6 +299,33 @@ class Server:
             finally:
                 if not self.per_connection:
                     self._rw.release_read()
+        if "cron" in req:
+            # scheduled statements over the wire (cron.schedule role)
+            from cloudberry_tpu.serve.cron import CronError
+
+            c = req["cron"] if isinstance(req["cron"], dict) else {}
+            op = c.get("op")
+            try:
+                if op == "status":
+                    return {"ok": True, "jobs": self.cron.status()}
+                if self.read_only:
+                    return {"ok": False, "etype": "ReadOnlyError",
+                            "error": "read-only standby: the primary "
+                                     "owns the cron schedule"}
+                if op == "schedule":
+                    self.cron.schedule(c.get("name", ""),
+                                       float(c.get("interval_s", 0)),
+                                       c.get("sql", ""))
+                    return {"ok": True, "status": f"SCHEDULE {c['name']}"}
+                if op == "unschedule":
+                    self.cron.unschedule(c.get("name", ""))
+                    return {"ok": True,
+                            "status": f"UNSCHEDULE {c['name']}"}
+                return {"ok": False,
+                        "error": f"unknown cron op {op!r}"}
+            except (CronError, ValueError) as e:
+                return {"ok": False, "etype": type(e).__name__,
+                        "error": str(e)}
         if "retrieve" in req:
             # retrieve-mode request (cdbendpointretrieve.c analog): drain
             # one endpoint of a parallel cursor; token REQUIRED on the wire
@@ -249,6 +348,12 @@ class Server:
         sql = req.get("sql")
         if not isinstance(sql, str):
             return {"ok": False, "error": "request must carry a 'sql' string"}
+        if self.read_only and not _is_read(sql):
+            # hot standby: reads only; the store's epoch sync delivers the
+            # primary's commits, nothing here may produce one
+            return {"ok": False, "etype": "ReadOnlyError",
+                    "error": "read-only standby: route writes to the "
+                             "primary server"}
         if self.per_connection:
             # each connection is its own backend: statement-level locking
             # is unnecessary (no shared catalog objects) and transactions

@@ -21,24 +21,10 @@ import numpy as np
 from cloudberry_tpu.config import Config, get_config
 
 
-_READ_ONLY_HEADS = frozenset(
-    {"select", "with", "values", "explain", "show", "retrieve"})
-
-
-def _read_only(query: str) -> bool:
-    """Statements safe to re-execute after a device failure: re-running a
-    query cannot change state; re-running DML/DDL/COPY can double-apply.
-    Classified by leading keyword — the grammar has no WITH-DML, so the
-    head token is decisive ('(' heads parenthesized set operations, reads
-    by grammar). nextval() disqualifies: sequence allocation happens at
-    plan time, so a replay would burn values."""
-    s = query.lstrip()
-    if "nextval" in s.lower():
-        return False
-    if s.startswith("("):
-        return True
-    head = s.split(None, 1)
-    return bool(head) and head[0].lower() in _READ_ONLY_HEADS
+from cloudberry_tpu.sql.classify import read_only as _read_only  # noqa: E402
+# (the shared classifier: statements safe to re-execute after a device
+# failure — re-running a query cannot change state; replayed DML/DDL/COPY
+# or nextval() double-applies)
 
 
 class SerializationError(RuntimeError):
@@ -73,6 +59,12 @@ class Session:
             self.store = TableStore(self.config.storage.root)
             self.store.rows_per_partition = \
                 self.config.storage.rows_per_partition
+            self.store.quota_bytes = self.config.storage.quota_bytes
+            if self.config.storage.encryption_key:
+                from cloudberry_tpu.utils.tde import make_cipher
+
+                self.store.cipher = make_cipher(
+                    self.config.storage.encryption_key)
             for name in self.store.table_names():
                 self.store.register_cold(self.catalog, name)
             self.catalog.store = self.store
@@ -98,6 +90,10 @@ class Session:
         self._stmt_cache: dict = {}
         # spill diagnostics for the LAST statement (None = not tiled)
         self.last_tiled_report = None
+        # adaptive-capacity growths this session (expansion-overflow
+        # recoveries, exec/executor.py:grow_expansion) — observability for
+        # skew tests and EXPLAIN ANALYZE consumers
+        self.growth_events = 0
         # COPY ... LOG ERRORS row rejects, per table (the error-log /
         # gp_read_error_log analog, cdbsreh.c)
         self.copy_errors: dict[str, list] = {}
@@ -112,6 +108,23 @@ class Session:
         from cloudberry_tpu.exec.endpoint import retrieve as _r
 
         return _r(self, cursor, segment, limit, token)
+
+    def dir_upload(self, table: str, rel: str, data: bytes) -> str:
+        """Put a file into a DIRECTORY TABLE (the gpdirtableload role)."""
+        from cloudberry_tpu.storage import dirtable as DT
+
+        return DT.upload(self, table, rel, data)
+
+    def dir_read(self, table: str, rel: str) -> bytes:
+        """Read one file's content from a DIRECTORY TABLE."""
+        from cloudberry_tpu.storage import dirtable as DT
+
+        return DT.read(self, table, rel)
+
+    def dir_remove(self, table: str, rel: str) -> None:
+        from cloudberry_tpu.storage import dirtable as DT
+
+        DT.remove(self, table, rel)
 
     def read_error_log(self, table: str):
         """Rejected rows recorded by COPY ... LOG ERRORS for ``table``
@@ -272,6 +285,7 @@ class Session:
                 self._stmt_cache.pop(query, None)  # drop the failed runner
                 if not grow_expansion(plan, str(e)):
                     raise
+                self.growth_events += 1
                 from cloudberry_tpu.exec.resource import RunawayError
 
                 try:
@@ -299,7 +313,15 @@ class Session:
         return texe.run()
 
     def _any_external(self, names) -> bool:
-        return any(getattr(self.catalog.tables.get(n), "external", None)
+        # foreign (FDW) and directory tables count: their rows change
+        # outside this engine's versioning, so cached programs would
+        # replay stale reads
+        def _t(n):
+            return self.catalog.tables.get(n)
+
+        return any(getattr(_t(n), "external", None)
+                   or getattr(_t(n), "foreign", None)
+                   or getattr(_t(n), "directory", None)
                    for n in names)
 
     def _sync_store(self) -> None:

@@ -265,6 +265,26 @@ class CreateTable(Node):
 
 
 @dataclass
+class CreateDirectoryTable(Node):
+    """CREATE DIRECTORY TABLE name — files as catalog objects
+    (storage/dirtable.py; the dirtable analog)."""
+
+    name: str
+
+
+@dataclass
+class CreateForeignTable(Node):
+    """CREATE FOREIGN TABLE name (cols) SERVER srv OPTIONS (k 'v', ...)
+    — the FDW surface; servers resolve through storage/fdw.py's
+    registry (built-ins: sqlite; register_fdw adds more)."""
+
+    name: str
+    columns: list["ColumnDef"]
+    server: str
+    options: dict
+
+
+@dataclass
 class CreateExternalTable(Node):
     """CREATE EXTERNAL TABLE ... LOCATION('cbfdist://h:p/f' | 'file://p')
     FORMAT 'csv' [DELIMITER 'c'] [SEGMENT REJECT LIMIT ...] — readable
@@ -426,3 +446,10 @@ class Explain(Node):
 class Analyze(Node):
     """ANALYZE <table> — collect column statistics (NDV)."""
     table: str
+
+
+@dataclass
+class Cluster(Node):
+    """CLUSTER <table> BY (cols) — z-order rewrite for pruning locality."""
+    table: str
+    columns: list[str]

@@ -153,6 +153,17 @@ class Parser:
         if self.at_kw("analyze"):
             self.advance()
             return ast.Analyze(self.expect_ident())
+        if self.at_kw("cluster"):
+            # CLUSTER t BY (a, b) — z-order write clustering
+            self.advance()
+            table = self.expect_ident()
+            self.expect_kw("by")
+            self.expect_op("(")
+            cols = [self.expect_ident()]
+            while self.accept_op(","):
+                cols.append(self.expect_ident())
+            self.expect_op(")")
+            return ast.Cluster(table, cols)
         if self.at_kw("copy"):
             return self.parse_copy()
         if self.at_kw("update"):
@@ -215,6 +226,30 @@ class Parser:
             return ast.CreateSequence(name, start, inc, if_not_exists)
         if self.accept_kw("external"):
             return self._parse_create_external()
+        if self.accept_kw("directory"):
+            self.expect_kw("table")
+            return ast.CreateDirectoryTable(self.expect_ident())
+        if self.accept_kw("foreign"):
+            # CREATE FOREIGN TABLE name (cols) SERVER srv
+            # OPTIONS (key 'value', ...) — the FDW surface
+            self.expect_kw("table")
+            name = self.expect_ident()
+            cols = self._parse_column_defs()
+            self.expect_kw("server")
+            server = self.expect_ident()
+            options: dict = {}
+            if self.accept_kw("options"):
+                self.expect_op("(")
+                while True:
+                    k = self.expect_ident()
+                    if self.cur.kind != "string":
+                        raise ParseError(
+                            "OPTIONS values must be quoted strings")
+                    options[k] = self.advance().text
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return ast.CreateForeignTable(name, cols, server, options)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):

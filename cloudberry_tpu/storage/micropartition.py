@@ -37,6 +37,7 @@ from cloudberry_tpu.columnar.dictionary import StringDictionary
 from cloudberry_tpu.types import DType, Field, Schema, SqlType
 
 MAGIC = b"CBTPMP1\n"
+MAGIC_ENC = b"CBMPENC1"  # TDE-encrypted container (utils/tde.py)
 
 
 def _compress(raw: bytes, codec: str) -> bytes:
@@ -79,8 +80,13 @@ def _rle_decode(raw: bytes, n_runs: int, dtype: np.dtype, n: int) -> np.ndarray:
 def write_micropartition(path: str, data: dict[str, np.ndarray],
                          schema: Schema,
                          dicts: dict[str, StringDictionary] | None = None,
-                         codec: str | None = None) -> dict:
-    """Write one immutable micro-partition; returns its footer dict."""
+                         codec: str | None = None,
+                         cipher=None) -> dict:
+    """Write one immutable micro-partition; returns its footer dict.
+    ``cipher`` (TDE, the pg_tde analog): an object with
+    encrypt(bytes)/decrypt(bytes) — the whole file encrypts, because
+    footers carry min/max stats and string dictionaries (data, not just
+    metadata)."""
     dicts = dicts or {}
     codec = codec or ("zstd" if _zstd is not None else "zlib")
     n = len(next(iter(data.values()))) if data else 0
@@ -136,43 +142,95 @@ def write_micropartition(path: str, data: dict[str, np.ndarray],
         "columns": columns,
     }
     fbytes = json.dumps(footer).encode()
+    body = bytearray(MAGIC)
+    for b in blobs:
+        body += b
+    body += fbytes
+    body += struct.pack("<I", len(fbytes))
+    body += MAGIC
+    if cipher is not None:
+        # TDE: the WHOLE file encrypts — footers carry min/max stats and
+        # string dictionaries, which are data, not just metadata
+        body = MAGIC_ENC + cipher.encrypt(bytes(body))
     with open(path, "wb") as fh:
-        fh.write(MAGIC)
-        for b in blobs:
-            fh.write(b)
-        fh.write(fbytes)
-        fh.write(struct.pack("<I", len(fbytes)))
-        fh.write(MAGIC)
+        fh.write(bytes(body))
     return footer
 
 
-def read_footer(path: str) -> dict:
+def _file_bytes(path: str, cipher) -> bytes:
+    """Whole file, decrypted when TDE is on. Random access trades away:
+    an encrypted file reads fully even for one column — the at-rest
+    security boundary costs sequential IO, like the reference's TDE."""
     with open(path, "rb") as fh:
-        head = fh.read(len(MAGIC))
-        if head != MAGIC:
-            raise ValueError(f"{path}: not a micro-partition file")
-        fh.seek(-(len(MAGIC) + 4), 2)
-        (flen,) = struct.unpack("<I", fh.read(4))
-        tail = fh.read(len(MAGIC))
-        if tail != MAGIC:
-            raise ValueError(f"{path}: corrupt trailer")
-        fh.seek(-(len(MAGIC) + 4 + flen), 2)
-        return json.loads(fh.read(flen))
+        head = fh.read(len(MAGIC_ENC))
+        if head == MAGIC_ENC:
+            if cipher is None:
+                raise ValueError(
+                    f"{path}: encrypted micro-partition but no "
+                    "storage.encryption_key configured")
+            return cipher.decrypt(fh.read())
+        return head + fh.read()
+
+
+def read_footer(path: str, cipher=None) -> dict:
+    with open(path, "rb") as fh:
+        head = fh.read(len(MAGIC_ENC))
+        if head != MAGIC_ENC:
+            # plaintext: seek to the trailer — footer-only pruning reads
+            # must stay ~KB regardless of partition size
+            if head[:len(MAGIC)] != MAGIC:
+                raise ValueError(f"{path}: not a micro-partition file")
+            fh.seek(-(len(MAGIC) + 4), 2)
+            (flen,) = struct.unpack("<I", fh.read(4))
+            tail = fh.read(len(MAGIC))
+            if tail != MAGIC:
+                raise ValueError(f"{path}: corrupt trailer")
+            fh.seek(-(len(MAGIC) + 4 + flen), 2)
+            return json.loads(fh.read(flen))
+    # TDE: random access trades away — decrypt the whole file
+    buf = _file_bytes(path, cipher)
+    if buf[:len(MAGIC)] != MAGIC or buf[-len(MAGIC):] != MAGIC:
+        raise ValueError(f"{path}: corrupt encrypted container")
+    (flen,) = struct.unpack(
+        "<I", buf[-(len(MAGIC) + 4):-len(MAGIC)])
+    return json.loads(buf[-(len(MAGIC) + 4 + flen):-(len(MAGIC) + 4)])
 
 
 def read_columns(path: str, names: Iterable[str] | None = None,
-                 footer: dict | None = None) -> dict[str, np.ndarray]:
-    footer = footer or read_footer(path)
-    want = set(names) if names is not None else None
-    schema = {c["name"]: c for c in footer["columns"]}
-    types = {f["name"]: _field_from_json(f) for f in footer["schema"]}
-    out = {}
+                 footer: dict | None = None,
+                 cipher=None) -> dict[str, np.ndarray]:
     with open(path, "rb") as fh:
+        head = fh.read(len(MAGIC_ENC))
+    if head == MAGIC_ENC:
+        # TDE: sequential whole-file decrypt, then in-memory slicing
+        buf = _file_bytes(path, cipher)
+
+        def read_blob(enc):
+            return buf[enc["offset"]:enc["offset"] + enc["length"]]
+
+        if footer is None:
+            (flen,) = struct.unpack(
+                "<I", buf[-(len(MAGIC) + 4):-len(MAGIC)])
+            footer = json.loads(
+                buf[-(len(MAGIC) + 4 + flen):-(len(MAGIC) + 4)])
+    else:
+        # plaintext: seek-based column projection (no whole-file read)
+        footer = footer or read_footer(path)
+        fh = open(path, "rb")
+
+        def read_blob(enc, fh=fh):
+            fh.seek(enc["offset"])
+            return fh.read(enc["length"])
+
+    try:
+        want = set(names) if names is not None else None
+        schema = {c["name"]: c for c in footer["columns"]}
+        types = {f["name"]: _field_from_json(f) for f in footer["schema"]}
+        out = {}
         for name, enc in schema.items():
             if want is not None and name not in want:
                 continue
-            fh.seek(enc["offset"])
-            blob = fh.read(enc["length"])
+            blob = read_blob(enc)
             raw = _decompress(blob, enc["codec"])
             dt = types[name].type.np_dtype
             if enc["encoding"] == "rle":
@@ -186,7 +244,10 @@ def read_columns(path: str, names: Iterable[str] | None = None,
             else:
                 out[name] = np.frombuffer(raw, dtype=dt,
                                           count=footer["num_rows"]).copy()
-    return out
+        return out
+    finally:
+        if head != MAGIC_ENC:
+            fh.close()
 
 
 _BLOOM_BITS = 2048

@@ -44,6 +44,10 @@ class PartitionEntry:
     deleted: list[int]
 
 
+class QuotaError(RuntimeError):
+    """Store disk usage reached storage.quota_bytes (diskquota analog)."""
+
+
 class TableStore:
     def __init__(self, root: str):
         self.root = root
@@ -56,6 +60,14 @@ class TableStore:
         self._txn_dirty: dict[str, object] = {}
         self._txn_drops: list[str] = []
         self.rows_per_partition = 1 << 20
+        # TDE (utils/tde.py): set via storage.encryption_key; encrypts
+        # micro-partition files and manifests at rest
+        self.cipher = None
+        # disk quota (diskquota extension analog): enforced at write time
+        # against real on-disk usage; 0 = unlimited. Like the reference's,
+        # enforcement is a hard stop once usage REACHES the quota — the
+        # write that crosses it succeeds, the next one is refused.
+        self.quota_bytes = 0
         # snapshot pinning: while a session transaction is open, every read
         # through read_manifest resolves to the version current at BEGIN —
         # repeatable reads even while OTHER sessions commit (the
@@ -116,7 +128,9 @@ class TableStore:
         # not a full data re-snapshot
         for name, t in getattr(self, "_txn_stats", {}).items():
             if name not in self._txn_dirty and t.stats.ndv:
-                t._store_version = self.save_stats(name, t.stats.ndv)
+                t._store_version = self.save_stats(
+                    name, t.stats.ndv, t.stats.hist,
+                    t.stats.analyzed_rows)
         self.abort_txn()
 
     def abort_txn(self) -> None:
@@ -203,8 +217,17 @@ class TableStore:
         if v == 0:
             return {"version": 0, "schema": None, "partitions": [],
                     "dicts": {}}
-        with open(os.path.join(self._mdir(table), f"v{v}.json")) as f:
-            return json.load(f)
+        mpath = os.path.join(self._mdir(table), f"v{v}.json")
+        with open(mpath, "rb") as f:
+            raw = f.read()
+        if raw[:8] == b"CBMPENC1":
+            if self.cipher is None:
+                from cloudberry_tpu.utils.tde import TdeError
+
+                raise TdeError(f"{mpath}: encrypted manifest but no "
+                               "storage.encryption_key configured")
+            raw = self.cipher.decrypt(raw[8:])
+        return json.loads(raw)
 
     def _commit(self, table: str, manifest: dict) -> int:
         """Atomically publish a new snapshot (single-coordinator commit).
@@ -219,8 +242,11 @@ class TableStore:
         v = self.current_version(table) + 1
         manifest["version"] = v
         path = os.path.join(mdir, f"v{v}.json")
-        with open(path, "w") as f:
-            json.dump(manifest, f)
+        raw = json.dumps(manifest).encode()
+        if self.cipher is not None:
+            raw = b"CBMPENC1" + self.cipher.encrypt(raw)
+        with open(path, "wb") as f:
+            f.write(raw)
             f.flush()
             os.fsync(f.fileno())
         # atomic CURRENT swap — the commit point; the fault point simulates
@@ -317,6 +343,7 @@ class TableStore:
         Returns the new snapshot version."""
         tdir = os.path.join(self.root, table)
         os.makedirs(tdir, exist_ok=True)
+        self._check_quota(table)
         man = self.read_manifest(table)
         if replace:
             man["partitions"] = []
@@ -347,7 +374,8 @@ class TableStore:
                 chunk = {k: v[lo:hi] for k, v in group.items()}
                 fname = f"part-{uuid.uuid4().hex}.cbmp"
                 footer = mp.write_micropartition(
-                    os.path.join(tdir, fname), chunk, phys_schema, dicts)
+                    os.path.join(tdir, fname), chunk, phys_schema, dicts,
+                    cipher=self.cipher)
                 stats = {c["name"]: [c["min"], c["max"]]
                          for c in footer["columns"] if "min" in c}
                 entry = {"file": fname, "num_rows": hi - lo,
@@ -386,6 +414,48 @@ class TableStore:
         man["partitions"] = man["partitions"] + new_parts
         return self._commit(table, man)
 
+    _QUOTA_TTL_S = 5.0
+
+    def disk_usage(self, fresh: bool = False) -> int:
+        """Bytes on disk under the store root (partition files, manifests,
+        sequences — everything the store owns). Cached for a few seconds:
+        quota enforcement is approximate by design (the reference's
+        diskquota worker likewise refreshes usage on an interval rather
+        than walking per write)."""
+        import time as _time
+
+        now = _time.monotonic()
+        cached = getattr(self, "_usage_cache", None)
+        if not fresh and cached is not None \
+                and now - cached[0] < self._QUOTA_TTL_S:
+            return cached[1]
+        total = 0
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, f))
+                except OSError:
+                    pass
+        self._usage_cache = (now, total)
+        return total
+
+    def _invalidate_usage(self) -> None:
+        self._usage_cache = None
+
+    def _check_quota(self, table: str) -> None:
+        if self.quota_bytes <= 0:
+            return
+        used = self.disk_usage()
+        if used >= self.quota_bytes:
+            # re-walk before refusing: the cache may predate a reclaim
+            used = self.disk_usage(fresh=True)
+            if used < self.quota_bytes:
+                return
+            raise QuotaError(
+                f"disk quota exceeded: store uses {used} of "
+                f"{self.quota_bytes} quota bytes; writes to {table!r} "
+                "refused (DELETE / DROP TABLE to reclaim)")
+
     def delete_rows(self, table: str, pred) -> int:
         """Mark rows deleted (visimap-style) where pred(columns)->bool mask;
         pred receives decoded per-partition columns. Returns new version."""
@@ -393,7 +463,8 @@ class TableStore:
         schema = Schema(tuple(mp._field_from_json(j) for j in man["schema"]))
         tdir = os.path.join(self.root, table)
         for part in man["partitions"]:
-            cols = mp.read_columns(os.path.join(tdir, part["file"]))
+            cols = mp.read_columns(os.path.join(tdir, part["file"]),
+                                    cipher=self.cipher)
             mask = np.asarray(pred(cols))
             if mask.any():
                 dead = set(part["deleted"]) | set(np.nonzero(mask)[0].tolist())
@@ -443,7 +514,8 @@ class TableStore:
         shared membership primitive for eq pruning and the partition
         selector.)"""
         footer = mp.read_footer(
-            os.path.join(self.root, table, part["file"]))
+            os.path.join(self.root, table, part["file"]),
+            cipher=self.cipher)
         encs = {c["name"]: c for c in footer["columns"]}
         for col, vals in col_values.items():
             enc = encs.get(col)
@@ -470,7 +542,8 @@ class TableStore:
         want = names + [f"$nn:{c}" for c in names if c in nullable]
         chunks: list[dict[str, np.ndarray]] = []
         for part in parts:
-            cols = mp.read_columns(os.path.join(tdir, part["file"]), want)
+            cols = mp.read_columns(os.path.join(tdir, part["file"]),
+                                   want, cipher=self.cipher)
             if part["deleted"]:
                 keep = np.ones(part["num_rows"], dtype=bool)
                 keep[np.asarray(part["deleted"], dtype=np.int64)] = False
@@ -627,7 +700,8 @@ class TableStore:
                         rows_per_partition=rows_per_partition)
         if t.stats.ndv:
             # ANALYZE output survives the snapshot (deferred-commit path)
-            v = self.save_stats(t.name, t.stats.ndv)
+            v = self.save_stats(t.name, t.stats.ndv, t.stats.hist,
+                                t.stats.analyzed_rows)
         return v
 
     def drop_table(self, name: str) -> None:
@@ -636,6 +710,7 @@ class TableStore:
         tdir = os.path.join(self.root, name)
         if os.path.isdir(tdir):
             shutil.rmtree(tdir)
+            self._invalidate_usage()  # reclaim visible to the next quota check
             self._bump_epoch()
 
     def table_names(self) -> list[str]:
@@ -645,11 +720,21 @@ class TableStore:
                 out.append(name)
         return out
 
-    def save_stats(self, name: str, ndv: dict[str, int]) -> int:
+    def save_stats(self, name: str, ndv: dict[str, int],
+                   hist: dict | None = None,
+                   analyzed_rows: int | None = None) -> int:
         """Persist ANALYZE output as a new manifest version (stats change
-        is a catalog change — same atomic commit discipline)."""
+        is a catalog change — same atomic commit discipline). ``hist``:
+        equi-depth histogram bounds per column (pg_statistic
+        histogram_bounds role); ``analyzed_rows``: row count at ANALYZE
+        time (the autostats change baseline)."""
         man = self.read_manifest(name)
         man["ndv"] = {k: int(v) for k, v in ndv.items()}
+        if hist is not None:
+            man["hist"] = {k: [float(x) for x in v]
+                           for k, v in hist.items()}
+        if analyzed_rows is not None:
+            man["analyzed_rows"] = int(analyzed_rows)
         return self._commit(name, man)
 
     def register_cold(self, catalog, name: str):
@@ -706,6 +791,8 @@ class TableStore:
         t.stats.unique = {c: bool(u)
                           for c, u in man.get("unique", {}).items()}
         t.stats.ndv = {c: int(v) for c, v in man.get("ndv", {}).items()}
+        t.stats.hist = {c: list(v) for c, v in man.get("hist", {}).items()}
+        t.stats.analyzed_rows = int(man.get("analyzed_rows", -1))
         return t
 
     def load_table(self, catalog, name: str,

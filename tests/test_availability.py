@@ -1,0 +1,140 @@
+"""Service availability + governance long tail (round 4).
+
+- Hot standby (hot_standby / mirroring analog): a second read-only server
+  over the shared store serves fresh reads (epoch sync = the replication
+  stream) and refuses writes; "promotion" is restarting without the flag.
+- Login monitor: token auth with address lockout after repeated failures.
+- Disk quota (diskquota extension analog): writes refused once store
+  usage reaches storage.quota_bytes; deletes/drops reclaim.
+"""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.serve.client import Client, ServerError
+from cloudberry_tpu.serve.server import Server
+
+
+def _cfg(tmp_path, **ov):
+    over = {"storage.root": str(tmp_path)}
+    over.update(ov)
+    return get_config().with_overrides(**over)
+
+
+# ------------------------------------------------------------ hot standby
+
+
+def test_hot_standby_serves_fresh_reads_refuses_writes(tmp_path):
+    cfg = _cfg(tmp_path)
+    primary = cb.Session(cfg)
+    primary.sql("create table ht (x bigint)")
+    primary.sql("insert into ht values (1),(2)")
+    with Server(config=cfg, port=0, read_only=True) as standby:
+        with Client(standby.host, standby.port) as c:
+            assert c.rows("select count(*) from ht") == [[2]]
+            # the primary commits; the standby's next read sees it
+            # (snapshot manifests are the replication stream)
+            primary.sql("insert into ht values (3)")
+            assert c.rows("select count(*) from ht") == [[3]]
+            with pytest.raises(ServerError, match="read-only standby"):
+                c.sql("insert into ht values (99)")
+            with pytest.raises(ServerError, match="read-only standby"):
+                c.sql("create table nope (x int)")
+            with pytest.raises(ServerError, match="read-only standby"):
+                c.sql("begin")
+    # nothing leaked through
+    assert primary.sql("select count(*) from ht").to_pandas().iloc[0, 0] == 3
+
+
+def test_standby_refuses_sequence_allocation(tmp_path):
+    """`select nextval(...)` LOOKS like a read but durably advances the
+    sequence — the standby must classify it as a write (the shared
+    sql/classify.py gate)."""
+    cfg = _cfg(tmp_path)
+    primary = cb.Session(cfg)
+    primary.sql("create sequence sq")
+    with Server(config=cfg, port=0, read_only=True) as standby:
+        with Client(standby.host, standby.port) as c:
+            with pytest.raises(ServerError, match="read-only standby"):
+                c.sql("select nextval('sq')")
+            # parenthesized set ops are reads and pass the gate
+            assert c.rows("(select 1) union (select 2)")
+
+
+def test_promotion_is_restart_without_flag(tmp_path):
+    cfg = _cfg(tmp_path)
+    boot = cb.Session(cfg)
+    boot.sql("create table pt (x bigint)")
+    with Server(config=cfg, port=0, read_only=True) as standby:
+        with Client(standby.host, standby.port) as c:
+            with pytest.raises(ServerError):
+                c.sql("insert into pt values (1)")
+    with Server(config=cfg, port=0) as promoted:
+        with Client(promoted.host, promoted.port) as c:
+            c.sql("insert into pt values (1)")
+            assert c.rows("select count(*) from pt") == [[1]]
+
+
+# ---------------------------------------------------------- login monitor
+
+
+def test_auth_required_and_lockout(tmp_path):
+    cfg = _cfg(tmp_path)
+    cb.Session(cfg).sql("create table au (x bigint)")
+    with Server(config=cfg, port=0, auth_token="sekret",
+                max_login_failures=2, lockout_s=30.0) as srv:
+        # no auth -> refused, connection closed
+        with pytest.raises(ServerError, match="authentication required"):
+            Client(srv.host, srv.port).sql("select 1")
+        # wrong token (failure 2 of 2 -> lockout armed)
+        with pytest.raises(ServerError, match="authentication failed"):
+            Client(srv.host, srv.port, token="wrong")
+        # locked out now — even the RIGHT token is refused
+        with pytest.raises(ServerError, match="locked"):
+            Client(srv.host, srv.port, token="sekret")
+
+
+def test_auth_success_path(tmp_path):
+    cfg = _cfg(tmp_path)
+    boot = cb.Session(cfg)
+    boot.sql("create table av (x bigint)")
+    boot.sql("insert into av values (7)")
+    with Server(config=cfg, port=0, auth_token="sekret") as srv:
+        with Client(srv.host, srv.port, token="sekret") as c:
+            assert c.rows("select x from av") == [[7]]
+
+
+# ------------------------------------------------------------- disk quota
+
+
+def test_disk_quota_blocks_writes_delete_reclaims(tmp_path):
+    s = cb.Session(_cfg(tmp_path, **{"storage.quota_bytes": 20_000}))
+    s.sql("create table q (x bigint)")
+    # incompressible payload: random full-range int64 defeats the
+    # delta-varint/zstd encoders, pushing the store past the 20kB quota
+    rng = np.random.default_rng(5)
+    s.catalog.table("q").set_data(
+        {"x": rng.integers(-(2**62), 2**62, 8192).astype(np.int64)})
+    from cloudberry_tpu.storage.table_store import QuotaError
+
+    assert s.store.disk_usage(fresh=True) >= 20_000
+    with pytest.raises(QuotaError, match="disk quota exceeded"):
+        s.sql("insert into q values (1)")
+    # reads still fine, and the refused INSERT did NOT land in RAM either
+    # (set_data restores on persist failure — no RAM/disk divergence)
+    assert s.sql("select count(*) from q").to_pandas().iloc[0, 0] == 8192
+    # DROP reclaims; writes work again
+    s.sql("drop table q")
+    s.sql("create table q2 (x bigint)")
+    s.sql("insert into q2 values (1)")
+    assert s.sql("select count(*) from q2").to_pandas().iloc[0, 0] == 1
+
+
+def test_quota_zero_is_unlimited(tmp_path):
+    s = cb.Session(_cfg(tmp_path))
+    s.sql("create table uq (x bigint)")
+    s.catalog.table("uq").set_data({"x": np.arange(100_000,
+                                                   dtype=np.int64)})
+    s.sql("insert into uq values (1)")  # no quota, no refusal

@@ -1,0 +1,73 @@
+"""Scheduled statements (serve/cron.py) — the pg_cron analog."""
+
+import time
+
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.serve.client import Client
+from cloudberry_tpu.serve.cron import CronError, Scheduler
+from cloudberry_tpu.serve.server import Server
+
+
+def test_scheduler_runs_jobs_deterministically(tmp_path):
+    s = cb.Session(get_config().with_overrides(
+        **{"storage.root": str(tmp_path)}))
+    s.sql("create table log (x bigint)")
+    sched = Scheduler(s)
+    sched.schedule("tick", 10.0, "insert into log values (1)")
+    now = time.monotonic()
+    assert sched.run_due(now + 11) == 1
+    assert sched.run_due(now + 12) == 0   # not due again yet
+    assert sched.run_due(now + 22) == 1
+    assert s.sql("select count(*) from log").to_pandas().iloc[0, 0] == 2
+    st = sched.status()[0]
+    assert st["runs"] == 2 and st["failures"] == 0
+
+
+def test_job_failure_isolated(tmp_path):
+    s = cb.Session(get_config().with_overrides(
+        **{"storage.root": str(tmp_path)}))
+    sched = Scheduler(s)
+    sched.schedule("bad", 5.0, "select * from missing_table")
+    now = time.monotonic()
+    assert sched.run_due(now + 6) == 1  # ran, failed, scheduler alive
+    st = sched.status()[0]
+    assert st["failures"] == 1 and "missing_table" in st["last_error"]
+
+
+def test_jobs_persist_across_restart(tmp_path):
+    cfg = get_config().with_overrides(**{"storage.root": str(tmp_path)})
+    a = cb.Session(cfg)
+    Scheduler(a).schedule("keep", 60.0, "select 1")
+    b = Scheduler(cb.Session(cfg)).load()
+    assert [j["name"] for j in b.status()] == ["keep"]
+    b.unschedule("keep")
+    c = Scheduler(cb.Session(cfg)).load()
+    assert c.status() == []
+    with pytest.raises(CronError):
+        c.unschedule("keep")
+
+
+def test_cron_over_the_wire(tmp_path):
+    cfg = get_config().with_overrides(**{"storage.root": str(tmp_path)})
+    boot = cb.Session(cfg)
+    boot.sql("create table wlog (x bigint)")
+    with Server(config=cfg, port=0) as srv:
+        srv.cron.tick_s = 0.05
+        with Client(srv.host, srv.port) as c:
+            c._request({"cron": {"op": "schedule", "name": "w",
+                                 "interval_s": 0.1,
+                                 "sql": "insert into wlog values (1)"}})
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                n = c.rows("select count(*) from wlog")[0][0]
+                if n >= 2:
+                    break
+                time.sleep(0.1)
+            assert n >= 2, "cron job never ran over the wire"
+            jobs = c._request({"cron": {"op": "status"}})["jobs"]
+            assert jobs[0]["name"] == "w" and jobs[0]["runs"] >= 2
+            c._request({"cron": {"op": "unschedule", "name": "w"}})
+            assert c._request({"cron": {"op": "status"}})["jobs"] == []

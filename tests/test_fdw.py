@@ -1,0 +1,95 @@
+"""Foreign data wrappers (storage/fdw.py) — the FDW / CustomScan hook.
+
+A FOREIGN TABLE re-fetches from its server per referencing statement, so
+queries track the source; the sqlite built-in covers the
+contrib-wrapper role and register_fdw() is the custom-provider hook.
+"""
+
+import sqlite3
+
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.plan.binder import BindError
+from cloudberry_tpu.storage.fdw import FdwError, register_fdw
+
+
+@pytest.fixture
+def db(tmp_path):
+    path = str(tmp_path / "src.db")
+    con = sqlite3.connect(path)
+    con.execute("create table emp (id integer, name text, sal real, "
+                "hired text)")
+    con.executemany("insert into emp values (?,?,?,?)", [
+        (1, "ann", 100.5, "2024-01-02"),
+        (2, "bob", 90.0, "2023-06-30"),
+        (3, None, None, "2022-12-01")])
+    con.commit()
+    con.close()
+    return path
+
+
+def test_sqlite_foreign_table_scans_and_joins(db):
+    s = cb.Session()
+    s.sql(f"""create foreign table femp
+              (id bigint, name text, sal double, hired date)
+              server sqlite options (database '{db}', table 'emp')""")
+    df = s.sql("select id, name, sal from femp order by id").to_pandas()
+    assert df["id"].tolist() == [1, 2, 3]
+    assert df["name"].tolist()[:2] == ["ann", "bob"]
+    assert df["name"][2] is None or df["name"].isna()[2]  # NULL survives
+    # joins against native tables work like any table
+    s.sql("create table bonus (id bigint, b bigint)")
+    s.sql("insert into bonus values (1, 10), (3, 30)")
+    df = s.sql("select f.id, b.b from femp f join bonus b on f.id = b.id "
+               "order by f.id").to_pandas()
+    assert df.values.tolist() == [[1, 10], [3, 30]]
+    # date typing round-trips
+    df = s.sql("select id from femp where hired >= date '2023-01-01' "
+               "order by id").to_pandas()
+    assert df["id"].tolist() == [1, 2]
+
+
+def test_foreign_table_tracks_source(db):
+    s = cb.Session()
+    s.sql(f"create foreign table ft (id bigint, name text, sal double, "
+          f"hired date) server sqlite options (database '{db}', "
+          f"table 'emp')")
+    assert s.sql("select count(*) from ft").to_pandas().iloc[0, 0] == 3
+    con = sqlite3.connect(db)
+    con.execute("insert into emp values (4, 'dee', 70.0, '2025-01-01')")
+    con.commit()
+    con.close()
+    # next statement re-fetches: the source's new row is visible
+    assert s.sql("select count(*) from ft").to_pandas().iloc[0, 0] == 4
+
+
+def test_foreign_query_option(db):
+    s = cb.Session()
+    s.sql(f"""create foreign table top (name text) server sqlite
+              options (database '{db}',
+                       query 'select name from emp where sal > 95')""")
+    assert s.sql("select name from top").to_pandas()["name"].tolist() \
+        == ["ann"]
+
+
+def test_unknown_server_and_bad_source(db, tmp_path):
+    s = cb.Session()
+    with pytest.raises(BindError, match="unknown foreign server"):
+        s.sql("create foreign table x (a int) server nope")
+    s.sql(f"create foreign table y (a int) server sqlite "
+          f"options (database '{tmp_path}/missing.db', table 'emp')")
+    with pytest.raises(FdwError):
+        s.sql("select * from y")
+
+
+def test_register_custom_provider():
+    """register_fdw is the CustomScan-style hook: any callable becomes a
+    scannable relation."""
+    register_fdw("range", lambda opts, schema:
+                 ((i, i * i) for i in range(int(opts.get("n", "5")))))
+    s = cb.Session()
+    s.sql("create foreign table sq (i bigint, isq bigint) server range "
+          "options (n '4')")
+    df = s.sql("select sum(isq) as t from sq where i > 0").to_pandas()
+    assert df["t"][0] == 1 + 4 + 9

@@ -92,6 +92,19 @@ def test_read_only_gate(srv):
     assert tool(srv, "get_table_stats", table="emp")["rows"] == 3
 
 
+def test_read_only_gate_edge_cases(srv):
+    # a semicolon inside a string literal is data, not a second statement
+    out = tool(srv, "execute_query",
+               sql="select count(*) as c from emp where dept = 'a;b'")
+    assert out["rows"] == [[0]]
+    # nextval is a WRITE despite the select head (plan-time allocation)
+    resp = srv.handle({"jsonrpc": "2.0", "id": 11, "method": "tools/call",
+                       "params": {"name": "execute_query",
+                                  "arguments": {
+                                      "sql": "select nextval('s1')"}}})
+    assert "read-only" in resp["error"]["message"]
+
+
 def test_max_rows_cap(srv):
     out = tool(srv, "execute_query", sql="select id from emp order by id",
                max_rows=2)

@@ -100,9 +100,9 @@ def test_column_projection_never_reads_unreferenced(tmp_path, monkeypatch):
     read_log = []
     orig = mp.read_columns
 
-    def spy(path, names=None, footer=None):
+    def spy(path, names=None, footer=None, **kw):
         read_log.append(sorted(names) if names is not None else None)
-        return orig(path, names, footer)
+        return orig(path, names, footer, **kw)
 
     monkeypatch.setattr(mp, "read_columns", spy)
     out = s2.sql("select b from t where a >= 150 order by b").to_pandas()

@@ -1,0 +1,72 @@
+"""Transparent data encryption (utils/tde.py) — at-rest protection.
+
+Micro-partition files and manifests encrypt whole under the cluster key
+(footers and manifests carry min/max stats and string dictionaries —
+data, not metadata). Wrong key -> MAC failure, never silent garbage; no
+key -> refusal; plaintext on-disk bytes must not contain row values.
+"""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.utils.tde import TdeError
+
+
+def _cfg(tmp_path, key=None):
+    over = {"storage.root": str(tmp_path)}
+    if key is not None:
+        over["storage.encryption_key"] = key
+    return get_config().with_overrides(**over)
+
+
+def _populate(cfg):
+    s = cb.Session(cfg)
+    s.sql("create table sec (id bigint, name text)")
+    s.sql("insert into sec values (7001, 'topsecretvalue'), "
+          "(7002, 'alsosecret')")
+    return s
+
+
+def test_roundtrip_under_encryption(tmp_path):
+    cfg = _cfg(tmp_path, "cluster-key-1")
+    _populate(cfg)
+    # a fresh session with the key reads everything back
+    s2 = cb.Session(cfg)
+    df = s2.sql("select id, name from sec order by id").to_pandas()
+    assert df["name"].tolist() == ["topsecretvalue", "alsosecret"]
+    # DML + pruning paths work through the cipher
+    s2.sql("update sec set name = 'renamed' where id = 7001")
+    s3 = cb.Session(cfg)
+    assert s3.sql("select name from sec where id = 7001 "
+                  ).to_pandas()["name"][0] == "renamed"
+
+
+def test_no_plaintext_on_disk(tmp_path):
+    _populate(_cfg(tmp_path, "cluster-key-1"))
+    blob = b""
+    for p in tmp_path.rglob("*"):
+        if p.is_file():
+            blob += p.read_bytes()
+    assert b"topsecretvalue" not in blob
+    assert b"7001" not in blob  # manifests/stats leak no values either
+
+
+def test_plaintext_store_does_leak_for_contrast(tmp_path):
+    """Sanity check on the assertion above: without TDE the dictionary IS
+    on disk in the clear."""
+    _populate(_cfg(tmp_path))
+    blob = b""
+    for p in tmp_path.rglob("*"):
+        if p.is_file():
+            blob += p.read_bytes()
+    assert b"topsecretvalue" in blob
+
+
+def test_wrong_or_missing_key_refused(tmp_path):
+    _populate(_cfg(tmp_path, "cluster-key-1"))
+    with pytest.raises(TdeError, match="no storage.encryption_key"):
+        cb.Session(_cfg(tmp_path)).sql("select * from sec")
+    with pytest.raises(TdeError, match="wrong encryption key"):
+        cb.Session(_cfg(tmp_path, "not-the-key")).sql("select * from sec")
