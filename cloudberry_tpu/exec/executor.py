@@ -170,6 +170,47 @@ def make_batch(plan: N.PlanNode, cols, sel) -> ColumnBatch:
                        np.asarray(sel), dicts, validity=validity)
 
 
+def _rmq_extreme(ks, cs, va, lo, hi, cap: int, mx: bool):
+    """Per-row range extreme over [lo, hi] via a sparse table: O(n log n)
+    build (static level count — XLA unrolls it), two gathers per query.
+    Lanes compare by (valid desc, sort rank, code): an invalid (NULL)
+    lane never beats a valid one, and string ranks follow collation, not
+    code order. Empty/all-NULL frames return an arbitrary code — the
+    caller's masks nullify them."""
+    import jax.lax as lax
+
+    def better(a, b):
+        v1, r1, c1 = a
+        v2, r2, c2 = b
+        if mx:
+            by_rank = (r2 > r1) | ((r2 == r1) & (c2 > c1))
+        else:
+            by_rank = (r2 < r1) | ((r2 == r1) & (c2 < c1))
+        take2 = (v2 & ~v1) | ((v2 == v1) & by_rank)
+        return (v1 | v2, jnp.where(take2, r2, r1),
+                jnp.where(take2, c2, c1))
+
+    levels = [(va, ks, cs)]
+    step = 1
+    pos = jnp.arange(cap)
+    n_levels = max(1, int(cap).bit_length())
+    for _ in range(1, n_levels):
+        pv, pr, pc = levels[-1]
+        j2 = jnp.minimum(pos + step, cap - 1)
+        levels.append(better((pv, pr, pc), (pv[j2], pr[j2], pc[j2])))
+        step *= 2
+    V = jnp.stack([v for v, _, _ in levels])
+    R = jnp.stack([r for _, r, _ in levels])
+    C = jnp.stack([c for _, _, c in levels])
+    w = jnp.maximum(hi - lo + 1, 1).astype(jnp.int32)
+    k = (jnp.int32(31) - lax.clz(w)).astype(jnp.int32)
+    p1 = jnp.clip(lo, 0, cap - 1)
+    p2 = jnp.clip(hi - (jnp.int32(1) << k) + 1, 0, cap - 1)
+    _, _, out = better((V[k, p1], R[k, p1], C[k, p1]),
+                       (V[k, p2], R[k, p2], C[k, p2]))
+    return out
+
+
 def all_nodes(plan: N.PlanNode):
     """Every node in the plan, including scalar-subquery plans and runtime
     filters' shared build subtrees (via their joins)."""
@@ -487,6 +528,25 @@ class Lowerer:
             return jnp.concatenate(
                 [jnp.zeros((1,), dtype=csum.dtype), csum])
 
+        # explicit frame (node.frame): per-row [flo, fhi] bounds in sorted
+        # coordinates. The SQL default keeps the peer-inclusive RANGE
+        # semantics (run_end); ROWS frames are purely positional and can
+        # be EMPTY at partition edges (fempty)
+        if node.frame is None:
+            flo = seg_start
+            fhi = run_end if node.order_keys else seg_end
+            fempty = None
+        elif node.frame[0] == "whole":
+            flo, fhi = seg_start, seg_end
+            fempty = None
+        else:
+            _, lo_off, hi_off = node.frame
+            flo = seg_start if lo_off is None \
+                else jnp.maximum(idx + lo_off, seg_start)
+            fhi = seg_end if hi_off is None \
+                else jnp.minimum(idx + hi_off, seg_end)
+            fempty = flo > fhi
+
         out_cols = dict(cols)
         valids = node.valids or [None] * len(node.calls)
         params_list = node.params or [None] * len(node.calls)
@@ -524,18 +584,19 @@ class Lowerer:
                     src = idx + k if base == "lead" else idx - k
                     inrange = (src >= seg_start) & (src <= seg_end)
                 elif base == "first_value":
-                    # default frame starts at the partition head
-                    src, inrange = seg_start, None
+                    # frame start (the partition head under the default)
+                    src = flo
+                    inrange = None if fempty is None else ~fempty
                 else:
-                    # last_value under the default frame ends at the
+                    # last_value: frame end — under the default frame the
                     # current row's peer group, not the partition tail
-                    src = run_end if node.order_keys else seg_end
-                    inrange = None
+                    src = fhi
+                    inrange = None if fempty is None else ~fempty
                 srcc = jnp.clip(src, 0, cap - 1)
                 if func.endswith("@mask"):
                     o = va[srcc]
                     if inrange is not None:
-                        if params.get("default") is not None:
+                        if (params or {}).get("default") is not None:
                             # out-of-range rows take the (non-NULL) default
                             o = jnp.where(inrange, o, True)
                         else:
@@ -544,7 +605,7 @@ class Lowerer:
                     v = self.expr(arg, cols)[perm]
                     o = v[srcc]
                     if inrange is not None:
-                        dflt = params.get("default")
+                        dflt = (params or {}).get("default")
                         fill = self.expr(dflt, cols).astype(v.dtype) \
                             if dflt is not None \
                             else jnp.zeros((), v.dtype)
@@ -559,17 +620,34 @@ class Lowerer:
                 else:
                     v = jnp.where(va, self.expr(arg, cols)[perm], 0)
                 S = pref(v)
-                hi = (run_end if node.order_keys else seg_end)
-                o = S[hi + 1] - S[seg_start]
+                hip = jnp.clip(fhi + 1, 0, cap)
+                lop = jnp.clip(flo, 0, cap)
+                o = S[hip] - S[lop]
+                if fempty is not None:
+                    o = jnp.where(fempty, jnp.zeros((), o.dtype), o)
                 if func == "avg":
                     C = pref(va.astype(jnp.int64))
-                    cnt = C[hi + 1] - C[seg_start]
+                    cnt = C[hip] - C[lop]
+                    if fempty is not None:
+                        cnt = jnp.where(fempty, 0, cnt)
                     o = o.astype(jnp.float64) / jnp.maximum(cnt, 1)
                     if arg is not None and arg.dtype.base == DType.DECIMAL:
                         o = o / (10.0 ** arg.dtype.scale)
                 elif func == "anyvalid":
                     o = o > 0
-            elif func in ("min", "max") and node.order_keys:
+            elif func in ("min", "max") and node.frame is not None \
+                    and node.frame[0] == "rows":
+                # ROWS-frame extreme: sparse-table range query — the
+                # prefix-sum trick does not invert for min/max, and the
+                # running scan only covers suffix-anchored frames
+                ks = _sortable(arg, node.child, cols)[perm]
+                cs = self.expr(arg, cols)[perm]
+                o = _rmq_extreme(ks, cs, va, flo, fhi, cap,
+                                 mx=(func == "max"))
+                if fempty is not None:
+                    o = jnp.where(fempty, jnp.zeros((), o.dtype), o)
+            elif func in ("min", "max") and node.frame is None \
+                    and node.order_keys:
                 # running extreme (RANGE UNBOUNDED PRECEDING..CURRENT ROW,
                 # peers included via run_end): segmented scan over sorted
                 # rows. The combine is the standard segmented-scan operator

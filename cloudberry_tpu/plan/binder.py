@@ -1101,11 +1101,13 @@ class Binder:
             if isinstance(node, ast.WindowExpr):
                 if node.func not in self.WINDOW_FUNCS:
                     raise BindError(f"unknown window function {node.func!r}")
+                frame = _normalize_frame(node.frame)
                 key = _ast_key(ast.Select(
                     items=[], group_by=list(node.partition_by),
-                    order_by=list(node.order_by)))
+                    order_by=list(node.order_by))) + f"|{frame}"
                 if key not in specs:
-                    specs[key] = (node.partition_by, node.order_by, [])
+                    specs[key] = (node.partition_by, node.order_by, [],
+                                  frame)
                 name = self.gensym("win")
                 specs[key][2].append((name, node.func, list(node.args)))
                 return ast.Name((name,))
@@ -1126,7 +1128,7 @@ class Binder:
 
         new_items = [ast.SelectItem(replace(i.expr), i.alias)
                      for i in sel.items]
-        for part_asts, order_asts, calls in specs.values():
+        for part_asts, order_asts, calls, frame in specs.values():
             pk = []
             for a in part_asts:
                 bound = self.bind_scalar(a, scope)
@@ -1155,6 +1157,13 @@ class Binder:
             call_params = []
             new_fields = []
             mask_by_valid: dict[str, str] = {}
+            # a ROWS frame that can exclude the current row can be EMPTY
+            # at partition edges: aggregates over it are NULL, so their
+            # outputs need masks even over non-null arguments
+            frame_may_empty = (frame is not None and frame[0] == "rows"
+                               and ((frame[1] is not None and frame[1] > 0)
+                                    or (frame[2] is not None
+                                        and frame[2] < 0)))
             for name, func, arg_asts in calls:
                 params = None
                 if func == "ntile":
@@ -1241,7 +1250,9 @@ class Binder:
                 if func in self.POSITIONAL_WINDOW_FUNCS and (
                         valid is not None
                         or (func in ("lead", "lag")
-                            and params["default"] is None)):
+                            and params["default"] is None)
+                        or (func in ("first_value", "last_value")
+                            and frame_may_empty)):
                     # per-row null mask: the source row may fall outside
                     # the partition (lead/lag without a default) or hold
                     # an invalid value — both positional facts only the
@@ -1254,8 +1265,8 @@ class Binder:
                     new_fields.append(N.PlanField(mname, T.BOOL, None))
                     new_fields.append(
                         N.PlanField(name, t, sd, null_mask=(mname,)))
-                elif valid is not None and func in ("sum", "min", "max",
-                                                    "avg"):
+                elif (valid is not None or frame_may_empty) \
+                        and func in ("sum", "min", "max", "avg"):
                     # agg over an all-NULL frame is NULL — materialize the
                     # frame's any-valid as this output's hidden null mask
                     # (one mask per distinct validity expr, shared by every
@@ -1273,7 +1284,7 @@ class Binder:
                 else:
                     new_fields.append(N.PlanField(name, t, sd))
             w = N.PWindow(plan, pk, okeys, bound_calls, call_valids,
-                          call_params)
+                          call_params, frame)
             w.fields = list(plan.fields) + new_fields
             plan = w
         # window outputs resolve by exact generated name; rebind existing
@@ -2487,6 +2498,39 @@ def _has_window(node: ast.ExprNode) -> bool:
                 if isinstance(x, ast.ExprNode) and _has_window(x):
                     return True
     return False
+
+
+def _normalize_frame(frame):
+    """Validate + canonicalize a frame clause.
+
+    Returns None (the SQL default), ("whole",) (the whole partition), or
+    ("rows", lo, hi) with row offsets (None = unbounded on that side).
+    ROWS frames support arbitrary bounds; RANGE supports only the two
+    whole/default shapes — value-distance RANGE offsets would need
+    per-partition binary search over unsorted global keys, which the
+    one-XLA-program model does not do yet (tracked in DESIGN.md)."""
+    if frame is None:
+        return None
+    kind, lo, hi = frame
+    if lo == ("unbounded", 1):
+        raise BindError("frame cannot start at UNBOUNDED FOLLOWING")
+    if hi == ("unbounded", -1):
+        raise BindError("frame cannot end at UNBOUNDED PRECEDING")
+    if kind == "range":
+        if lo == ("unbounded", -1) and hi == ("unbounded", 1):
+            return ("whole",)
+        if lo == ("unbounded", -1) and hi == ("current", 0):
+            return None  # exactly the SQL default frame
+        raise BindError(
+            "RANGE frames support only UNBOUNDED PRECEDING to "
+            "CURRENT ROW / UNBOUNDED FOLLOWING; use ROWS for offsets")
+    if lo == ("unbounded", -1) and hi == ("unbounded", 1):
+        return ("whole",)
+    lo_off = None if lo[0] == "unbounded" else int(lo[1])
+    hi_off = None if hi[0] == "unbounded" else int(hi[1])
+    if lo_off is not None and hi_off is not None and lo_off > hi_off:
+        raise BindError("frame start is after frame end")
+    return ("rows", lo_off, hi_off)
 
 
 def _one_row_guaranteed(sel: ast.Select) -> bool:

@@ -240,6 +240,12 @@ class PWindow(PlanNode):
     # data-dependent offsets would force recompiles per row; the reference
     # accepts expressions but constant offsets are the only common case.
     params: Optional[list] = None
+    # explicit frame (binder._normalize_frame): None = SQL default;
+    # ("whole",) = whole partition; ("rows", lo, hi) = row offsets with
+    # None meaning unbounded on that side. Applies to aggregates and
+    # first_value/last_value; positional lead/lag and ranks ignore frames
+    # (SQL semantics).
+    frame: Optional[tuple] = None
 
     def children(self):
         return [self.child]

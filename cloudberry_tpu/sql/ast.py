@@ -149,6 +149,10 @@ class WindowExpr(ExprNode):
     args: list[ExprNode]
     partition_by: list[ExprNode]
     order_by: list["OrderItem"]
+    # frame clause: (kind, lo, hi) where kind is 'rows'|'range' and each
+    # bound is ('unbounded'|'offset'|'current', signed row/peer offset);
+    # None = the SQL default frame
+    frame: Optional[tuple] = None
 
 
 @dataclass

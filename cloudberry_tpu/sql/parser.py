@@ -856,8 +856,39 @@ class Parser:
             order.append(self.parse_order_item())
             while self.accept_op(","):
                 order.append(self.parse_order_item())
+        frame = None
+        kind = self.accept_kw("rows", "range")
+        if kind:
+            if self.accept_kw("between"):
+                lo = self._parse_frame_bound()
+                self.expect_kw("and")
+                hi = self._parse_frame_bound()
+            else:
+                lo, hi = self._parse_frame_bound(), ("current", 0)
+            frame = (kind, lo, hi)
         self.expect_op(")")
-        return ast.WindowExpr(fname, args, partition, order)
+        return ast.WindowExpr(fname, args, partition, order, frame)
+
+    def _parse_frame_bound(self):
+        """UNBOUNDED PRECEDING|FOLLOWING | <n> PRECEDING|FOLLOWING |
+        CURRENT ROW -> ('unbounded'|'offset'|'current', signed rows)"""
+        if self.accept_kw("unbounded"):
+            d = self.accept_kw("preceding", "following")
+            if not d:
+                raise ParseError("UNBOUNDED needs PRECEDING or FOLLOWING")
+            return ("unbounded", -1 if d == "preceding" else 1)
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ("current", 0)
+        n = self._signed_int()
+        if n < 0:
+            # PG: "frame starting offset must not be negative" — a
+            # negative n would silently flip PRECEDING into FOLLOWING
+            raise ParseError("frame offset must not be negative")
+        d = self.accept_kw("preceding", "following")
+        if not d:
+            raise ParseError("frame offset needs PRECEDING or FOLLOWING")
+        return ("offset", -n if d == "preceding" else n)
 
     def parse_case(self) -> ast.CaseExpr:
         self.expect_kw("case")

@@ -194,6 +194,127 @@ def test_positional_mixed_with_aggregates(s):
     assert df["nt"].tolist() == [1, 1, 2, 1, 2, 1]
 
 
+# -------------------------------------------------------- explicit frames
+
+
+def test_rows_frame_moving_sum_avg(s):
+    out = col(s, "select sum(o) over (partition by g order by o "
+                 "rows between 1 preceding and current row) as x "
+                 "from w order by g, o", "x")
+    assert out == [1, 3, 5, 1, 3, 1]
+    out = col(s, "select avg(o) over (partition by g order by o "
+                 "rows between 1 preceding and 1 following) as x "
+                 "from w order by g, o", "x")
+    assert out == [1.5, 2.0, 2.5, 1.5, 1.5, 1.0]
+
+
+def test_rows_frame_min_max(s):
+    out = col(s, "select max(o) over (partition by g order by o "
+                 "rows between 1 preceding and current row) as x "
+                 "from w order by g, o", "x")
+    assert out == [1, 2, 3, 1, 2, 1]
+    out = col(s, "select min(o) over (partition by g order by o "
+                 "rows between current row and 1 following) as x "
+                 "from w order by g, o", "x")
+    assert out == [1, 2, 3, 1, 2, 1]
+    # sliding max over values that DECREASE then increase: v column
+    out = col(s, "select max(v) over (partition by g order by o "
+                 "rows between 1 preceding and 1 following) as x "
+                 "from w order by g, o", "x")
+    # partition a: v = 10, NULL, 30 -> windows: (10,N)=10 (N,30 incl
+    # 10)=30, (N,30)=30; b: (100,200)=200 twice; c: single NULL -> NULL
+    assert out == [10, 30, 30, 200, 200, None]
+
+
+def test_rows_frame_can_be_empty(s):
+    # frame entirely BEFORE the first row of the partition -> NULL (sum)
+    out = col(s, "select sum(o) over (partition by g order by o "
+                 "rows between 2 preceding and 1 preceding) as x "
+                 "from w order by g, o", "x")
+    assert out == [None, 1, 3, None, 1, None]
+    # count over an empty frame is 0, not NULL
+    out = col(s, "select count(o) over (partition by g order by o "
+                 "rows between 2 preceding and 1 preceding) as x "
+                 "from w order by g, o", "x")
+    assert out == [0, 1, 2, 0, 1, 0]
+
+
+def test_rows_frame_first_last_value(s):
+    out = col(s, "select last_value(o) over (partition by g order by o "
+                 "rows between unbounded preceding and unbounded "
+                 "following) as x from w order by g, o", "x")
+    assert out == [3, 3, 3, 2, 2, 1]  # the classic fix for last_value
+    out = col(s, "select first_value(o) over (partition by g order by o "
+                 "rows between 1 following and 2 following) as x "
+                 "from w order by g, o", "x")
+    assert out == [2, 3, None, 2, None, None]
+
+
+def test_range_frame_whole_partition(s):
+    out = col(s, "select max(o) over (partition by g order by o "
+                 "range between unbounded preceding and unbounded "
+                 "following) as x from w order by g, o", "x")
+    assert out == [3, 3, 3, 2, 2, 1]
+    # the default-equivalent RANGE spelling keeps peer semantics
+    out = col(s, "select sum(o) over (partition by g order by o "
+                 "range between unbounded preceding and current row) "
+                 "as x from w order by g, o", "x")
+    assert out == [1, 3, 6, 1, 3, 1]
+
+
+def test_range_offset_frames_rejected(s):
+    from cloudberry_tpu.sql.parser import ParseError
+
+    with pytest.raises(BindError, match="RANGE frames"):
+        s.sql("select sum(o) over (order by o range between 1 preceding "
+              "and current row) from w")
+    with pytest.raises(BindError, match="start is after"):
+        s.sql("select sum(o) over (order by o rows between 1 following "
+              "and 1 preceding) from w")
+    # negative offsets are invalid SQL, never a silent direction flip
+    with pytest.raises(ParseError, match="must not be negative"):
+        s.sql("select sum(o) over (order by o rows between -2 following "
+              "and current row) from w")
+
+
+def test_rows_frame_oracle_random():
+    """Moving aggregates vs a pandas rolling oracle on 2k random rows."""
+    import pandas as pd
+
+    rng = np.random.default_rng(21)
+    n = 2000
+    g = rng.integers(0, 7, n)
+    o = np.arange(n)
+    v = rng.integers(-50, 50, n)
+    s2 = cb.Session()
+    s2.sql("create table r (g bigint, o bigint, v bigint) "
+           "distributed by (o)")
+    s2.catalog.table("r").set_data(
+        {"g": g.astype(np.int64), "o": o.astype(np.int64),
+         "v": v.astype(np.int64)})
+    df = s2.sql(
+        "select g, o, "
+        "sum(v) over (partition by g order by o rows between 3 preceding "
+        "and current row) as ms, "
+        "min(v) over (partition by g order by o rows between 3 preceding "
+        "and current row) as mn, "
+        "max(v) over (partition by g order by o rows between 2 preceding "
+        "and 1 following) as mx "
+        "from r order by g, o").to_pandas()
+    pdf = pd.DataFrame({"g": g, "o": o, "v": v}).sort_values(["g", "o"])
+    grp = pdf.groupby("g")["v"]
+    assert df["ms"].tolist() == \
+        grp.rolling(4, min_periods=1).sum().astype(int).tolist()
+    assert df["mn"].tolist() == \
+        grp.rolling(4, min_periods=1).min().astype(int).tolist()
+    want_mx = []
+    for _, s_ in grp:
+        a = s_.to_numpy()
+        want_mx += [int(a[max(0, i - 2):i + 2].max())
+                    for i in range(len(a))]
+    assert df["mx"].tolist() == want_mx
+
+
 # ------------------------------------------------- scalar subquery rows
 
 
