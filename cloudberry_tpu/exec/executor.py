@@ -170,6 +170,20 @@ def make_batch(plan: N.PlanNode, cols, sel) -> ColumnBatch:
                        np.asarray(sel), dicts, validity=validity)
 
 
+def _rank_better(mx: bool, v1, r1, c1, v2, r2, c2):
+    """True where lane 2 beats lane 1 by (valid desc, sort rank, code) —
+    THE extreme comparator: an invalid (NULL) lane never beats a valid
+    one, strings compare by collation rank with code as the
+    associativity tie-break. Shared by the running-extreme segmented
+    scan and the ROWS-frame sparse-table query so the two min/max paths
+    cannot diverge."""
+    if mx:
+        by_rank = (r2 > r1) | ((r2 == r1) & (c2 > c1))
+    else:
+        by_rank = (r2 < r1) | ((r2 == r1) & (c2 < c1))
+    return (v2 & ~v1) | ((v2 == v1) & by_rank)
+
+
 def _rmq_extreme(ks, cs, va, lo, hi, cap: int, mx: bool):
     """Per-row range extreme over [lo, hi] via a sparse table: O(n log n)
     build (static level count — XLA unrolls it), two gathers per query.
@@ -182,11 +196,7 @@ def _rmq_extreme(ks, cs, va, lo, hi, cap: int, mx: bool):
     def better(a, b):
         v1, r1, c1 = a
         v2, r2, c2 = b
-        if mx:
-            by_rank = (r2 > r1) | ((r2 == r1) & (c2 > c1))
-        else:
-            by_rank = (r2 < r1) | ((r2 == r1) & (c2 < c1))
-        take2 = (v2 & ~v1) | ((v2 == v1) & by_rank)
+        take2 = _rank_better(mx, v1, r1, c1, v2, r2, c2)
         return (v1 | v2, jnp.where(take2, r2, r1),
                 jnp.where(take2, c2, c1))
 
@@ -665,12 +675,10 @@ class Lowerer:
                 def comb(a, b, mx=mx):
                     f1, w1, r1, c1 = a
                     f2, w2, r2, c2 = b
-                    if mx:
-                        by_rank = (r2 > r1) | ((r2 == r1) & (c2 > c1))
-                    else:
-                        by_rank = (r2 < r1) | ((r2 == r1) & (c2 < c1))
-                    better = (w2 & ~w1) | ((w2 == w1) & by_rank)
-                    take2 = f2 | better
+                    # segment reset flag ? right : extreme (the shared
+                    # comparator keeps this path and the ROWS-frame RMQ
+                    # ordering identical)
+                    take2 = f2 | _rank_better(mx, w1, r1, c1, w2, r2, c2)
                     return (f1 | f2, jnp.where(take2, w2, w1),
                             jnp.where(take2, r2, r1),
                             jnp.where(take2, c2, c1))

@@ -987,8 +987,13 @@ class Binder:
                 if isinstance(v, ast.ExprNode):
                     setattr(out, fname, extract(v))
                 elif isinstance(v, list):
+                    # OrderItem is a Node, not an ExprNode: recurse into
+                    # its expr too, or aggregates inside a window's
+                    # OVER(ORDER BY sum(x)) never fold to $agg refs
                     setattr(out, fname, [
                         extract(x) if isinstance(x, ast.ExprNode) else
+                        ast.OrderItem(extract(x.expr), x.ascending)
+                        if isinstance(x, ast.OrderItem) else
                         tuple(extract(y) if isinstance(y, ast.ExprNode) else y
                               for y in x) if isinstance(x, tuple) else x
                         for x in v])
@@ -1022,6 +1027,20 @@ class Binder:
         if rewritten_having is not None:
             plan = self._filter(plan, self.bind_scalar(rewritten_having,
                                                        agg_scope))
+
+        if any(_has_window(rw) for _, rw in rewritten_items):
+            # windows OVER aggregate outputs (the TPC-DS q98 ratio shape:
+            # sum(x) * 100 / sum(sum(x)) over (partition by cls)) — the
+            # agg rewrite above already folded inner aggregates to $agg
+            # column refs, so the standard extraction runs on top of the
+            # aggregation plan with the agg scope
+            wsel = ast.Select(items=[ast.SelectItem(rw, i.alias)
+                                     for i, rw in rewritten_items])
+            plan, wsel = self._extract_windows(wsel, plan, agg_scope)
+            agg_scope = self._win_scope
+            rewritten_items = [(orig, wi.expr)
+                               for (orig, _), wi in zip(rewritten_items,
+                                                        wsel.items)]
 
         exprs: list[tuple[str, ex.Expr]] = []
         fields: list[N.PlanField] = []

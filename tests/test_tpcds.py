@@ -91,7 +91,76 @@ def oracle_q29(t):
                           "s_store_name"]).head(100).reset_index(drop=True)
 
 
-ORACLES = {"q17": oracle_q17, "q25": oracle_q25, "q29": oracle_q29}
+def _star(t):
+    """store_sales ⋈ date_dim ⋈ item — the single-fact star join the
+    reporting subset (q3/q42/q52/q55/q98) shares."""
+    return (t["store_sales"]
+            .merge(t["date_dim"], left_on="ss_sold_date_sk",
+                   right_on="d_date_sk")
+            .merge(t["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+
+
+def oracle_q3(t):
+    j = _star(t)
+    j = j[(j.i_manufact_id == 7) & (j.d_moy == 11)]
+    g = j.groupby(["d_year", "i_brand_id", "i_brand"],
+                  as_index=False).agg(sum_agg=("ss_net_profit", "sum"))
+    return g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                         ascending=[True, False, True]) \
+        .head(100).reset_index(drop=True)
+
+
+def oracle_q42(t):
+    j = _star(t)
+    j = j[(j.d_moy == 11) & (j.d_year == 2000)]
+    g = j.groupby(["d_year", "i_category"], as_index=False) \
+        .agg(total=("ss_ext_sales_price", "sum"))
+    return g[["d_year", "i_category", "total"]] \
+        .sort_values(["total", "d_year", "i_category"],
+                     ascending=[False, True, True]) \
+        .head(100).reset_index(drop=True)
+
+
+def oracle_q52(t):
+    j = _star(t)
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 12) & (j.d_year == 2000)]
+    g = j.groupby(["d_year", "i_brand_id", "i_brand"],
+                  as_index=False).agg(ext_price=("ss_ext_sales_price",
+                                                 "sum"))
+    return g.sort_values(["d_year", "ext_price", "i_brand_id"],
+                         ascending=[True, False, True]) \
+        .head(100).reset_index(drop=True)
+
+
+def oracle_q55(t):
+    j = _star(t)
+    j = j[(j.i_manager_id == 3) & (j.d_moy == 11) & (j.d_year == 1999)]
+    g = j.groupby(["i_brand_id", "i_brand"], as_index=False) \
+        .agg(ext_price=("ss_ext_sales_price", "sum"))
+    return g.sort_values(["ext_price", "i_brand_id"],
+                         ascending=[False, True]) \
+        .head(100).reset_index(drop=True)
+
+
+def oracle_q98(t):
+    j = _star(t)
+    j = j[j.i_category.isin(["Books", "Music"])
+          & (j.d_date >= pd.Timestamp(2000, 2, 1))
+          & (j.d_date <= pd.Timestamp(2000, 3, 1))]
+    g = j.groupby(["i_item_id", "i_item_desc", "i_category", "i_class",
+                   "i_current_price"], as_index=False) \
+        .agg(itemrevenue=("ss_ext_sales_price", "sum"))
+    g["revenueratio"] = (g.itemrevenue * 100.0
+                         / g.groupby("i_class")
+                         .itemrevenue.transform("sum"))
+    return g.sort_values(["i_category", "i_class", "i_item_id",
+                          "i_item_desc", "revenueratio"]) \
+        .head(100).reset_index(drop=True)
+
+
+ORACLES = {"q17": oracle_q17, "q25": oracle_q25, "q29": oracle_q29,
+           "q3": oracle_q3, "q42": oracle_q42, "q52": oracle_q52,
+           "q55": oracle_q55, "q98": oracle_q98}
 
 
 @pytest.mark.parametrize("qname", sorted(DS_QUERIES))

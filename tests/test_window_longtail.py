@@ -183,6 +183,19 @@ def test_first_value_strings(s):
     assert out == ["x", "x", "x", "p", "p", "z"]
 
 
+def test_window_over_aggregate(s):
+    """Windows OVER grouped-aggregate outputs — both the q98 ratio shape
+    (partition by) and the rank-by-aggregate shape (OVER(ORDER BY
+    sum(x))), whose inner aggregate folds via OrderItem recursion."""
+    df = s.sql("""select g, sum(o) as t,
+                  sum(sum(o)) over () as grand,
+                  rank() over (order by sum(o) desc) as rk
+                  from w group by g order by g""").to_pandas()
+    assert df["t"].tolist() == [6, 3, 1]
+    assert df["grand"].tolist() == [10, 10, 10]
+    assert df["rk"].tolist() == [1, 2, 3]
+
+
 def test_positional_mixed_with_aggregates(s):
     df = s.sql("""select g, o,
                   lead(o) over (partition by g order by o) as nxt,

@@ -97,3 +97,62 @@ group by i_item_id, i_item_desc, s_store_id, s_store_name
 order by i_item_id, i_item_desc, s_store_id, s_store_name
 limit 100
 """
+
+# -------- star-schema reporting subset (round 4): q3/q42/q52/q55/q98 —
+# single-fact joins over brand/category/manager dimensions; q98 adds the
+# revenue-ratio window over a grouped aggregate.
+
+DS_QUERIES["q3"] = """
+select d_year, i_brand_id, i_brand, sum(ss_net_profit) as sum_agg
+from date_dim dt join store_sales on dt.d_date_sk = ss_sold_date_sk
+     join item on ss_item_sk = i_item_sk
+where i_manufact_id = 7 and dt.d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, i_brand_id
+limit 100
+"""
+
+DS_QUERIES["q42"] = """
+select d_year, i_category, sum(ss_ext_sales_price) as total
+from date_dim dt join store_sales on dt.d_date_sk = ss_sold_date_sk
+     join item on ss_item_sk = i_item_sk
+where d_moy = 11 and d_year = 2000
+group by d_year, i_category
+order by total desc, d_year, i_category
+limit 100
+"""
+
+DS_QUERIES["q52"] = """
+select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) as ext_price
+from date_dim dt join store_sales on dt.d_date_sk = ss_sold_date_sk
+     join item on ss_item_sk = i_item_sk
+where i_manager_id = 1 and d_moy = 12 and d_year = 2000
+group by d_year, i_brand_id, i_brand
+order by d_year, ext_price desc, i_brand_id
+limit 100
+"""
+
+DS_QUERIES["q55"] = """
+select i_brand_id, i_brand, sum(ss_ext_sales_price) as ext_price
+from date_dim join store_sales on d_date_sk = ss_sold_date_sk
+     join item on ss_item_sk = i_item_sk
+where i_manager_id = 3 and d_moy = 11 and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, i_brand_id
+limit 100
+"""
+
+DS_QUERIES["q98"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100.0
+           / sum(sum(ss_ext_sales_price)) over (partition by i_class)
+           as revenueratio
+from store_sales join item on ss_item_sk = i_item_sk
+     join date_dim on ss_sold_date_sk = d_date_sk
+where i_category in ('Books', 'Music')
+  and d_date between date '2000-02-01' and date '2000-03-01'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""

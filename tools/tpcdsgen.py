@@ -16,13 +16,17 @@ SCHEMAS: dict[str, Schema] = {
     "date_dim": Schema.of(d_date_sk=T.INT64, d_date=T.DATE, d_year=T.INT32,
                           d_moy=T.INT32, d_quarter_name=T.STRING),
     "item": Schema.of(i_item_sk=T.INT64, i_item_id=T.STRING,
-                      i_item_desc=T.STRING, i_current_price=T.DECIMAL(2)),
+                      i_item_desc=T.STRING, i_current_price=T.DECIMAL(2),
+                      i_brand_id=T.INT32, i_brand=T.STRING,
+                      i_class=T.STRING, i_category=T.STRING,
+                      i_manufact_id=T.INT32, i_manager_id=T.INT32),
     "store": Schema.of(s_store_sk=T.INT64, s_store_id=T.STRING,
                        s_store_name=T.STRING, s_state=T.STRING),
     "customer": Schema.of(c_customer_sk=T.INT64),
     "store_sales": Schema.of(ss_sold_date_sk=T.INT64, ss_item_sk=T.INT64,
                              ss_customer_sk=T.INT64, ss_ticket_number=T.INT64,
                              ss_store_sk=T.INT64, ss_quantity=T.INT32,
+                             ss_ext_sales_price=T.DECIMAL(2),
                              ss_net_profit=T.DECIMAL(2)),
     "store_returns": Schema.of(sr_returned_date_sk=T.INT64,
                                sr_item_sk=T.INT64, sr_customer_sk=T.INT64,
@@ -77,12 +81,26 @@ def generate(scale: float = 1.0, seed: int = 0):
 
     ik = np.arange(1, n_item + 1, dtype=np.int64)
     w = np.asarray(_WORDS, dtype=object)
+    # round-4 reporting columns draw from their OWN stream: consuming the
+    # shared rng here would shift every later table's draws and silently
+    # re-tune the q17/q25/q29 filter selectivities
+    rng2 = np.random.default_rng(seed + 104729)
+    brand_id = rng2.integers(1, 12, n_item).astype(np.int32)
+    classes = np.asarray(["alpha", "beta", "gamma", "delta"], dtype=object)
+    cats = np.asarray(["Books", "Music", "Sports"], dtype=object)
     data["item"] = {
         "i_item_sk": ik,
         "i_item_id": np.asarray([f"ITEM{i:08d}" for i in ik], dtype=object),
         "i_item_desc": (w[rng.integers(0, 10, n_item)] + " "
                         + w[rng.integers(0, 10, n_item)]),
         "i_current_price": rng.integers(100, 10_000, n_item) / 100.0,
+        "i_brand_id": brand_id,
+        "i_brand": np.asarray([f"Brand#{b}" for b in brand_id],
+                              dtype=object),
+        "i_class": classes[rng2.integers(0, len(classes), n_item)],
+        "i_category": cats[rng2.integers(0, len(cats), n_item)],
+        "i_manufact_id": rng2.integers(1, 20, n_item).astype(np.int32),
+        "i_manager_id": rng2.integers(1, 8, n_item).astype(np.int32),
     }
 
     sk = np.arange(1, n_store + 1, dtype=np.int64)
@@ -105,6 +123,7 @@ def generate(scale: float = 1.0, seed: int = 0):
         "ss_ticket_number": np.arange(1, n_ss + 1, dtype=np.int64),
         "ss_store_sk": rng.integers(1, n_store + 1, n_ss).astype(np.int64),
         "ss_quantity": rng.integers(1, 100, n_ss).astype(np.int32),
+        "ss_ext_sales_price": rng2.integers(100, 50_000, n_ss) / 100.0,
         "ss_net_profit": rng.integers(-5_000, 20_000, n_ss) / 100.0,
     }
 
