@@ -26,6 +26,11 @@ class InterconnectConfig:
     # interconnect's capacity-based flow control (ic_udpifc.c:3018-3040):
     # rows over capacity are detected and reported, not silently dropped.
     capacity_factor: float = 2.0
+    # Motion transport (the ic_modules.c vtable selection): "xla" lets the
+    # compiler schedule native collectives; "ring" composes them from
+    # neighbor ppermutes (parallel/transport.py) — the ICI-friendly
+    # systolic formulation, and an independent cross-check of the first.
+    backend: str = "xla"
 
 
 @dataclass(frozen=True)

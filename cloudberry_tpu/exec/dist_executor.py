@@ -62,21 +62,23 @@ def compile_distributed(plan: N.PlanNode, session):
     """Build the jitted SPMD program once; reusable across calls (the
     prepared-statement analog — inputs are re-prepared per call from the
     session's sharded-table cache)."""
+    from cloudberry_tpu.parallel.transport import make_transport
+
     nseg = session.config.n_segments
     mesh = segment_mesh(nseg,
                         getattr(session, "_live_device_ids", None))
+    tx = make_transport(session.config.interconnect.backend, nseg)
     _, in_specs = prepare_dist_inputs(plan, session)
 
     def seg_fn(tables):
-        low = DistLowerer(tables, nseg)
+        low = DistLowerer(tables, nseg, tx=tx)
         cols, sel = low.lower(plan)
         out = {f.name: cols[f.name][None] for f in plan.fields}
         # reduce checks to replicated scalars (any segment tripped) so
         # every HOST can read them — per-seg shards are not addressable
         # across processes on a multi-host mesh
         checks = {
-            k: jax.lax.psum(jnp.asarray(v).astype(jnp.int32),
-                            SEG_AXIS) > 0
+            k: tx.psum(jnp.asarray(v).astype(jnp.int32), SEG_AXIS) > 0
             for k, v in low.checks.items()}
         return out, sel[None], checks
 
@@ -134,9 +136,16 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 class DistLowerer(X.Lowerer):
     def __init__(self, tables, nseg: int, platform: str | None = None,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, tx=None):
         super().__init__(tables, platform=platform, use_pallas=use_pallas)
         self.nseg = nseg
+        # motion transport (ic_modules.c vtable analog): XLA-native
+        # collectives or ppermute ring compositions
+        if tx is None:
+            from cloudberry_tpu.parallel.transport import XlaCollectives
+
+            tx = XlaCollectives()
+        self.tx = tx
 
     def scan(self, node: N.PScan):
         if node.table_name == "$dual":
@@ -157,7 +166,7 @@ class DistLowerer(X.Lowerer):
 
     def global_any(self, x):
         local = jnp.any(x).astype(jnp.int32)
-        return jax.lax.psum(local, SEG_AXIS) > 0
+        return self.tx.psum(local, SEG_AXIS) > 0
 
     def runtime_filter(self, node):
         """Exact semi-join pushdown (nodeRuntimeFilter.c analog): all-gather
@@ -174,8 +183,8 @@ class DistLowerer(X.Lowerer):
             u = K.sort_key_u64(k)
             lo = jnp.min(jnp.where(bsel, u, K._U64_MAX))
             hi = jnp.max(jnp.where(bsel, u, jnp.uint64(0)))
-            lo = jnp.min(jax.lax.all_gather(lo, SEG_AXIS))
-            hi = jnp.max(jax.lax.all_gather(hi, SEG_AXIS))
+            lo = jnp.min(self.tx.all_gather(lo[None], SEG_AXIS))
+            hi = jnp.max(self.tx.all_gather(hi[None], SEG_AXIS))
             span = jnp.maximum(hi - lo, jnp.uint64(0)) + jnp.uint64(1)
             ranges.append((lo, span))
         kb = jnp.where(bsel, K.pack_with_ranges(bkeys, ranges), K._U64_MAX)
@@ -184,7 +193,7 @@ class DistLowerer(X.Lowerer):
         if node.pack_bits == 32:
             # stats-proven narrow keys halve the all-gathered bytes too
             kb, kp, big = K.downcast32(kb), K.downcast32(kp), K._U32_MAX
-        kb_all = jax.lax.all_gather(kb, SEG_AXIS, axis=0, tiled=True)
+        kb_all = self.tx.all_gather(kb, SEG_AXIS)
         kb_sorted = jnp.sort(kb_all)
         pos = jnp.clip(jnp.searchsorted(kb_sorted, kp), 0,
                        kb_sorted.shape[0] - 1)
@@ -200,9 +209,9 @@ class DistLowerer(X.Lowerer):
                 "local top-N emitted more than its limit"] = \
                 n > node.pre_compact
         if node.kind in ("gather", "broadcast"):
-            out = {n: jax.lax.all_gather(c, SEG_AXIS, axis=0, tiled=True)
+            out = {n: self.tx.all_gather(c, SEG_AXIS)
                    for n, c in cols.items()}
-            osel = jax.lax.all_gather(sel, SEG_AXIS, axis=0, tiled=True)
+            osel = self.tx.all_gather(sel, SEG_AXIS)
             return out, osel
         if node.kind == "redistribute":
             return self._redistribute(node, cols, sel)
@@ -235,11 +244,10 @@ class DistLowerer(X.Lowerer):
             buf = jnp.zeros((nseg * B,), dtype=c.dtype)
             buf = buf.at[slot].set(c[order], mode="drop")
             shaped = buf.reshape(nseg, B)
-            recv = jax.lax.all_to_all(shaped, SEG_AXIS,
-                                      split_axis=0, concat_axis=0)
+            recv = self.tx.all_to_all(shaped, SEG_AXIS)
             out[name] = recv.reshape(nseg * B)
         selbuf = jnp.zeros((nseg * B,), dtype=jnp.bool_)
         selbuf = selbuf.at[slot].set(valid, mode="drop")
-        recv_sel = jax.lax.all_to_all(selbuf.reshape(nseg, B), SEG_AXIS,
-                                      split_axis=0, concat_axis=0)
+        recv_sel = self.tx.all_to_all(selbuf.reshape(nseg, B),
+                                      SEG_AXIS)
         return out, recv_sel.reshape(nseg * B)

@@ -24,6 +24,58 @@ import numpy as np
 from cloudberry_tpu.plan import nodes as N
 
 
+class StatementLog:
+    """Per-engine statement history + active registry — the
+    pg_stat_activity / log-collector analog. One instance is shared by
+    every connection session of a server (like the admission gate), so
+    "who is running what" spans backends. Ring-buffered: observability
+    must never grow without bound."""
+
+    def __init__(self, capacity: int = 256):
+        import collections
+        import itertools
+        import threading
+
+        self._recent = collections.deque(maxlen=capacity)
+        self._active: dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+
+    def begin(self, sql: str, session_id: int = 0) -> int:
+        sid = next(self._ids)
+        with self._lock:
+            self._active[sid] = {
+                "id": sid, "session": session_id,
+                "sql": sql[:500], "started": time.time()}
+        return sid
+
+    def finish(self, sid: int, status: str, rows: int = -1,
+               error: str | None = None) -> None:
+        with self._lock:
+            entry = self._active.pop(sid, None)
+            if entry is None:
+                return
+            entry["wall_s"] = round(time.time() - entry["started"], 4)
+            entry["status"] = status
+            entry["rows"] = rows
+            if error:
+                entry["error"] = error[:500]
+            self._recent.append(entry)
+
+    def activity(self) -> list[dict]:
+        """Currently-executing statements (pg_stat_activity role)."""
+        now = time.time()
+        with self._lock:
+            return [{**e, "elapsed_s": round(now - e["started"], 4)}
+                    for e in self._active.values()]
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        """Most recent completed statements, newest first."""
+        with self._lock:
+            out = list(self._recent)[-limit:]
+        return out[::-1]
+
+
 @dataclass
 class QueryMetrics:
     """One executed statement's stats (the metrics-collector payload)."""
@@ -136,9 +188,13 @@ def _run_instrumented_dist(plan: N.PlanNode, session, query: str):
                            getattr(session, "_live_device_ids", None))
     inputs, in_specs = DX.prepare_dist_inputs(plan, session)
 
+    from cloudberry_tpu.parallel.transport import make_transport
+
+    tx = make_transport(session.config.interconnect.backend, nseg)
+
     class InstrDistLowerer(InstrumentingMixin, DX.DistLowerer):
         def __init__(self, tables, nseg):
-            DX.DistLowerer.__init__(self, tables, nseg)
+            DX.DistLowerer.__init__(self, tables, nseg, tx=tx)
             self.__init_instrument__()
 
     def seg_fn(tables):

@@ -420,11 +420,15 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         nseg = self.nseg
         mesh = segment_mesh(nseg, getattr(self.session,
                                           "_live_device_ids", None))
+        from cloudberry_tpu.parallel.transport import make_transport
+
+        tx = make_transport(self.session.config.interconnect.backend, nseg)
         names = self._resident_names()
         _, res_specs = prepare_dist_inputs(None, self.session, names=names)
 
         def prelude_seg(tables):
-            low = DistLowerer(tables, nseg, use_pallas=self._use_pallas)
+            low = DistLowerer(tables, nseg, use_pallas=self._use_pallas,
+                              tx=tx)
             outs = [_add_seg(low.lower_shared(b)) for b in shape.builds]
             return outs, _reduce_checks(low.checks)
 
@@ -442,7 +446,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                        for i, b in enumerate(shape.builds)}
             low = _DistTileLowerer(tables, nseg, shape.stream,
                                    tile_n.reshape(()), replace,
-                                   use_pallas=self._use_pallas)
+                                   use_pallas=self._use_pallas, tx=tx)
             pcols, psel = low.lower(shape.partial_plan)
             checks = dict(low.checks)
             acc_cols, acc_sel = _strip_seg(tuple(acc))
@@ -482,7 +486,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             acc_cols, acc_sel = _strip_seg(tuple(acc))
             low = _DistReplacingLowerer(
                 {}, nseg, {id(shape.replace_node): (acc_cols, acc_sel)},
-                use_pallas=self._use_pallas)
+                use_pallas=self._use_pallas, tx=tx)
             cols, sel = low.lower(shape.root)
             out = {f.name: cols[f.name][None] for f in shape.root.fields}
             return out, sel[None], _reduce_checks(low.checks)

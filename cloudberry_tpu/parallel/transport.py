@@ -1,0 +1,110 @@
+"""Swappable motion transports — the ic_modules.c vtable analog.
+
+The reference selects an interconnect implementation through a vtable
+(contrib/interconnect/ic_modules.c:26-160: UDP / TCP / proxy share one
+motion API). Under the one-XLA-program model every transport must still
+be XLA collectives — but WHICH collective formulation is a real choice
+on TPU hardware:
+
+- ``xla``: the compiler's native ``all_gather`` / ``all_to_all`` /
+  ``psum`` — XLA picks the algorithm (default).
+- ``ring``: ``ppermute``-composed collectives. all_gather and psum are
+  true rings — N−1 nearest-neighbor shift-and-accumulate steps, the
+  systolic pattern that rides ICI links on torus topologies (and the
+  building block of ring attention). all_to_all uses one distance-k
+  ppermute per round (minimal data motion; the hardware routes each
+  rotation), not strictly neighbor hops. Either way it is a second
+  independent implementation that cross-checks the first (tests assert
+  bit-identical results against XLA's).
+
+Both implement one interface, chosen by ``interconnect.backend``; the
+interconnect bench (tools/ic_bench.py) measures either, so the
+backends can be compared on real hardware without the executor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class XlaCollectives:
+    """XLA's native collectives (the compiler schedules the algorithm)."""
+
+    name = "xla"
+
+    def all_gather(self, x, axis):
+        return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+    def all_to_all(self, x, axis):
+        """x: (nseg, ...) per-destination blocks -> (nseg, ...) received."""
+        return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+
+    def psum(self, x, axis):
+        return jax.lax.psum(x, axis)
+
+
+class RingCollectives:
+    """ppermute-composed collectives (see module docstring: all_gather
+    and psum are true neighbor rings; all_to_all rotates by k)."""
+
+    name = "ring"
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def _shift(self, x, axis, by: int = 1):
+        perm = [(i, (i + by) % self.n) for i in range(self.n)]
+        return jax.lax.ppermute(x, axis, perm)
+
+    def all_gather(self, x, axis):
+        # accumulate blocks while rotating: after k hops this segment
+        # holds the block of segment (i - k) mod n; place each into its
+        # global slot so the result matches all_gather(tiled=True)
+        idx = jax.lax.axis_index(axis)
+        n = self.n
+        rows = x.shape[0]
+        out = jnp.zeros((n * rows,) + x.shape[1:], dtype=x.dtype)
+        cur = x
+        for k in range(n):
+            src = (idx - k) % n
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, cur, src * rows, axis=0)
+            if k + 1 < n:
+                cur = self._shift(cur, axis)
+        return out
+
+    def all_to_all(self, x, axis):
+        # x[(dest, ...)]: send block d to segment d. Rotate k hops so
+        # each segment receives the block addressed to it from the
+        # segment k behind it on the ring.
+        idx = jax.lax.axis_index(axis)
+        n = self.n
+        out = jnp.zeros_like(x)
+        for k in range(n):
+            # after shifting by k, this segment sees the block that
+            # segment (idx - k) addressed to destination idx... select
+            # our destination slot BEFORE shifting to move one block
+            src = (idx - k) % n
+            block = jnp.take(x, (idx + k) % n, axis=0)  # dest = idx + k
+            moved = self._shift(block, axis, by=k) if k else block
+            out = out.at[src].set(moved)
+        return out
+
+    def psum(self, x, axis):
+        acc = x
+        cur = x
+        for _ in range(self.n - 1):
+            cur = self._shift(cur, axis)
+            acc = acc + cur
+        return acc
+
+
+def make_transport(backend: str, n_segments: int):
+    if backend == "xla":
+        return XlaCollectives()
+    if backend == "ring":
+        return RingCollectives(n_segments)
+    raise ValueError(f"unknown interconnect backend {backend!r} "
+                     "(known: xla, ring)")

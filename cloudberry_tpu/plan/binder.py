@@ -2573,6 +2573,11 @@ def _has_agg(node: ast.ExprNode) -> bool:
             return True
         if isinstance(v, (list, tuple)):
             for x in v:
+                # OrderItem wraps an expr (OVER(ORDER BY sum(x)) must
+                # route through the aggregation path — same recursion
+                # the agg extract() applies)
+                if isinstance(x, ast.OrderItem) and _has_agg(x.expr):
+                    return True
                 if isinstance(x, ast.ExprNode) and _has_agg(x):
                     return True
                 if isinstance(x, tuple) and any(

@@ -132,6 +132,10 @@ TOOLS = [
           {"sql": _STR}, ["sql"]),
     _tool("list_large_tables", "Largest tables by row count",
           {"limit": _INT}, []),
+    _tool("get_activity",
+          "Running and recently-completed statements across all "
+          "connections (the pg_stat_activity role)",
+          {"limit": _INT}, []),
 ]
 
 RESOURCES = [
@@ -228,6 +232,8 @@ class McpServer:
         if name == "explain_query":
             _check_read_only(args["sql"])
             return {"plan": eng.explain(args["sql"])}
+        if name == "get_activity":
+            return eng.meta("activity", args.get("limit"))
         if name == "list_large_tables":
             tables = eng.meta("tables")
             tables.sort(key=lambda t: -t["rows"])

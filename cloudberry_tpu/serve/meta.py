@@ -76,6 +76,12 @@ def describe(session, kind: str, arg=None):
             "views": len(cat.views),
             "matviews": len(cat.matviews),
         }
+    if kind == "activity":
+        # pg_stat_activity role: running + recent statements across every
+        # backend of this server (one shared StatementLog)
+        return {"active": session.stmt_log.activity(),
+                "recent": session.stmt_log.recent(
+                    int(arg) if arg else 50)}
     if kind == "summary":
         return {n: {"rows": int(t.num_rows),
                     "columns": [f.name for f in t.schema.fields]}

@@ -238,6 +238,9 @@ class Server:
         s._gate = self.session._gate
         s._queues = self.session._queues
         s._vmem = self.session._vmem
+        # one activity/history log across ALL backends: "who runs what"
+        # must span connections (pg_stat_activity is cluster-wide)
+        s.stmt_log = self.session.stmt_log
         return s
 
     def _end_connection(self, sess) -> None:

@@ -94,6 +94,13 @@ class Session:
         # recoveries, exec/executor.py:grow_expansion) — observability for
         # skew tests and EXPLAIN ANALYZE consumers
         self.growth_events = 0
+        # statement history + active registry (pg_stat_activity / log
+        # collector analog); a server shares ONE across its connection
+        # sessions (serve/server.py:_connection_session)
+        from cloudberry_tpu.exec.instrument import StatementLog
+
+        self.stmt_log = StatementLog()
+        self._session_id = id(self) & 0xFFFF
         # COPY ... LOG ERRORS row rejects, per table (the error-log /
         # gp_read_error_log analog, cdbsreh.c)
         self.copy_errors: dict[str, list] = {}
@@ -142,17 +149,32 @@ class Session:
         from cloudberry_tpu.parallel.health import run_with_retry
 
         h = self.config.health
-        if h.retries <= 0 or not _read_only(query):
-            # DML/DDL/COPY are NOT retried: a device failure striking
-            # after the host-side mutation would re-apply the statement
-            # on retry (re-execution is only safe when re-running cannot
-            # change state — the reference's FTS likewise lets in-flight
-            # write transactions abort rather than replay them)
-            return self._sql_once(query, **params)
-        return run_with_retry(
-            lambda: self._sql_once(query, **params),
-            retries=h.retries, backoff_s=h.backoff_s,
-            on_retry=self._recover_mesh if h.probe_on_error else None)
+        log_id = self.stmt_log.begin(query, self._session_id)
+        try:
+            if h.retries <= 0 or not _read_only(query):
+                # DML/DDL/COPY are NOT retried: a device failure striking
+                # after the host-side mutation would re-apply the statement
+                # on retry (re-execution is only safe when re-running cannot
+                # change state — the reference's FTS likewise lets in-flight
+                # write transactions abort rather than replay them)
+                out = self._sql_once(query, **params)
+            else:
+                out = run_with_retry(
+                    lambda: self._sql_once(query, **params),
+                    retries=h.retries, backoff_s=h.backoff_s,
+                    on_retry=self._recover_mesh if h.probe_on_error
+                    else None)
+        except BaseException as e:
+            # BaseException too: a Ctrl-C mid-statement must not leave a
+            # phantom "running" entry in the shared active registry
+            self.stmt_log.finish(log_id, "error",
+                                 error=f"{type(e).__name__}: {e}")
+            raise
+        is_batch = hasattr(out, "num_rows")
+        self.stmt_log.finish(
+            log_id, "ok" if is_batch else str(out)[:80],
+            rows=out.num_rows() if is_batch else -1)
+        return out
 
     def _recover_mesh(self, e: Exception) -> None:
         """Between-retry hook: probe every device; when any are gone,

@@ -1,0 +1,97 @@
+"""Statement log + activity views (exec/instrument.py StatementLog) —
+the pg_stat_activity / log-collector analog."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.serve.client import Client
+from cloudberry_tpu.serve.server import Server
+from cloudberry_tpu.utils import faultinject as FI
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    FI.reset_fault()
+    yield
+    FI.reset_fault()
+
+
+def test_statement_log_records_history():
+    s = cb.Session()
+    s.sql("create table a (x bigint)")
+    s.sql("insert into a values (1),(2)")
+    df = s.sql("select * from a")
+    assert df.num_rows() == 2
+    rec = s.stmt_log.recent()
+    assert [r["sql"] for r in rec[:3]] == [
+        "select * from a", "insert into a values (1),(2)",
+        "create table a (x bigint)"]
+    assert rec[0]["status"] == "ok" and rec[0]["rows"] == 2
+    assert rec[1]["status"].startswith("INSERT")
+    assert all(r["wall_s"] >= 0 for r in rec)
+
+
+def test_statement_log_records_errors():
+    s = cb.Session()
+    with pytest.raises(Exception):
+        s.sql("select * from nope")
+    rec = s.stmt_log.recent()
+    assert rec[0]["status"] == "error" and "nope" in rec[0]["error"]
+
+
+def test_activity_shows_running_statement():
+    s = cb.Session()
+    s.sql("create table b (x bigint)")
+    s.catalog.table("b").set_data({"x": np.arange(64, dtype=np.int64)})
+    FI.inject_fault("dispatch_start", "sleep", sleep_s=1.5)
+    seen = []
+
+    def run():
+        s.sql("select sum(x) from b")
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        act = s.stmt_log.activity()
+        if act:
+            seen = act
+            break
+        time.sleep(0.05)
+    t.join()
+    assert seen and seen[0]["sql"] == "select sum(x) from b"
+    assert seen[0]["elapsed_s"] >= 0
+    assert s.stmt_log.activity() == []  # drained after completion
+
+
+def test_activity_spans_server_connections(tmp_path):
+    cfg = get_config().with_overrides(**{"storage.root": str(tmp_path)})
+    boot = cb.Session(cfg)
+    boot.sql("create table w (x bigint)")
+    boot.sql("insert into w values (1)")
+    with Server(config=cfg, port=0) as srv:
+        with Client(srv.host, srv.port) as c1, \
+                Client(srv.host, srv.port) as c2:
+            c1.sql("select count(*) from w")
+            c2.sql("select sum(x) from w")
+            act = c1.meta("activity")
+            sqls = [r["sql"] for r in act["recent"]]
+            # BOTH connections' statements in one log, newest first
+            assert "select sum(x) from w" in sqls
+            assert "select count(*) from w" in sqls
+
+
+def test_ring_buffer_bounded():
+    from cloudberry_tpu.exec.instrument import StatementLog
+
+    log = StatementLog(capacity=8)
+    for i in range(50):
+        sid = log.begin(f"q{i}")
+        log.finish(sid, "ok")
+    rec = log.recent(100)
+    assert len(rec) == 8 and rec[0]["sql"] == "q49"

@@ -194,6 +194,11 @@ def test_window_over_aggregate(s):
     assert df["t"].tolist() == [6, 3, 1]
     assert df["grand"].tolist() == [10, 10, 10]
     assert df["rk"].tolist() == [1, 2, 3]
+    # no GROUP BY: the aggregate lives ONLY inside OVER(ORDER BY ...) —
+    # _has_agg must still route through the aggregation path
+    df = s.sql("select rank() over (order by sum(o)) as rk "
+               "from w").to_pandas()
+    assert df["rk"].tolist() == [1]
 
 
 def test_positional_mixed_with_aggregates(s):

@@ -34,6 +34,9 @@ def main() -> None:
                     help="segments (default: all visible devices)")
     ap.add_argument("--sizes", type=str, default="65536,1048576,16777216",
                     help="per-segment payload bytes, comma-separated")
+    ap.add_argument("--backend", default="xla",
+                    help="motion transport: xla | ring "
+                         "(parallel/transport.py)")
     ap.add_argument("--reps", type=int, default=5)
     args = ap.parse_args()
 
@@ -73,23 +76,25 @@ def main() -> None:
         }), flush=True)
         return out
 
+    from cloudberry_tpu.parallel.transport import make_transport
+
+    tx = make_transport(args.backend, nseg)
+
     for size in (int(s) for s in args.sizes.split(",") if s.strip()):
         n = max(size // 4, nseg)           # f32 lanes per segment
         n += (-n) % nseg                   # all_to_all splits evenly
         x = np.arange(nseg * n, dtype=np.float32).reshape(nseg, n)
 
         def ag(v):
-            return jax.lax.all_gather(v[0], SEG_AXIS, axis=0, tiled=True)
+            return tx.all_gather(v[0], SEG_AXIS)
 
         def a2a(v):
-            return jax.lax.all_to_all(
-                v[0].reshape(nseg, n // nseg), SEG_AXIS,
-                split_axis=0, concat_axis=0)
+            return tx.all_to_all(v[0].reshape(nseg, n // nseg), SEG_AXIS)
 
         def ps(v):
             # reduce the FULL payload so the reported bytes really cross
             # the interconnect (a scalar psum would move 4 bytes)
-            return jax.lax.psum(v[0], SEG_AXIS)
+            return tx.psum(v[0], SEG_AXIS)
 
         for label, fn, spec in (("all_gather", ag, P(SEG_AXIS)),
                                 ("all_to_all", a2a, P(SEG_AXIS)),
