@@ -299,6 +299,27 @@ class Binder:
             re_: ex.Expr = _colref(rf)
             if lf.type.base == DType.STRING or rf.type.base == DType.STRING:
                 if lf.type.base != rf.type.base:
+                    # a NULL-literal column takes the string side's type:
+                    # code 0 under an always-False mask (grouping-set
+                    # branches project NULL for omitted keys)
+                    if getattr(rf, "_is_null_col", False)                             and lf.type.base == DType.STRING:
+                        lex.append((lf.name, le))
+                        rex.append((lf.name, ex.Literal(0, lf.type)))
+                        lfields.append(N.PlanField(lf.name, lf.type,
+                                                   lf.sdict))
+                        rfields.append(N.PlanField(lf.name, lf.type,
+                                                   lf.sdict))
+                        changed_r = True
+                        continue
+                    if getattr(lf, "_is_null_col", False)                             and rf.type.base == DType.STRING:
+                        lex.append((lf.name, ex.Literal(0, rf.type)))
+                        rex.append((lf.name, re_))
+                        lfields.append(N.PlanField(lf.name, rf.type,
+                                                   rf.sdict))
+                        rfields.append(N.PlanField(lf.name, rf.type,
+                                                   rf.sdict))
+                        changed_l = True
+                        continue
                     raise BindError("set operation mixes string and "
                                     "non-string columns")
                 ld, rd = lf.sdict, rf.sdict
@@ -364,6 +385,8 @@ class Binder:
         return left, right, lfields
 
     def bind_select(self, sel: ast.Select) -> N.PlanNode:
+        if getattr(sel, "grouping_sets", None):
+            return self.bind_query(_expand_grouping_sets(sel))
         scope = Scope()
         plans: dict[str, N.PlanNode] = {}
         post_join_filters: list[ast.ExprNode] = []
@@ -1050,7 +1073,7 @@ class Binder:
             name = item.alias or _default_name(item.expr) or self.gensym("col")
             name = _uniquify(name, taken)
             exprs.append((name, bound))
-            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound)))
+            fields.append(_field_for(name, bound))
         exprs, fields = _attach_validity_outputs(self, exprs, fields)
         proj = N.PProject(plan, exprs)
         proj.fields = fields
@@ -1091,7 +1114,7 @@ class Binder:
             name = item.alias or _default_name(item.expr) or self.gensym("col")
             name = _uniquify(name, taken)
             exprs.append((name, bound))
-            fields.append(N.PlanField(name, bound.dtype, _expr_dict(bound)))
+            fields.append(_field_for(name, bound))
         # nullable outputs: project their validity masks as hidden columns
         # ("$vm..."), so NULLs render correctly at the result surface
         exprs, fields = _attach_validity_outputs(self, exprs, fields)
@@ -2290,6 +2313,16 @@ def _and_valid(*vs):
     return out
 
 
+def _field_for(name: str, bound: ex.Expr) -> N.PlanField:
+    """Projection output field; NULL-literal columns carry a marker so
+    set-op alignment can type them from the OTHER side (grouping-set
+    branches project NULL for omitted string keys)."""
+    f = N.PlanField(name, bound.dtype, _expr_dict(bound))
+    if _is_null_literal(bound):
+        object.__setattr__(f, "_is_null_col", True)
+    return f
+
+
 def _is_null_literal(e: ex.Expr) -> bool:
     return bool(getattr(e, "_is_null_lit", False))
 
@@ -2519,6 +2552,79 @@ def _has_window(node: ast.ExprNode) -> bool:
     return False
 
 
+def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
+    """GROUPING SETS / ROLLUP / CUBE → UNION ALL of per-set aggregations
+    (the nodeAgg.c grouping-sets role translated to plan algebra): each
+    set aggregates with its own GROUP BY, keys a set omits project as
+    NULL (the set-op column alignment coerces them to the key's type),
+    and ORDER BY/LIMIT apply to the whole union. Re-aggregating the base
+    per set matches the reference's multi-phase grouping-sets plan shape;
+    the shared scan dedups through the statement-level plan, not here."""
+    all_keys = list(sel.group_by)
+
+    def _same_key(a, b) -> bool:
+        # qualified and bare references to one column are the same key
+        # (group by rollup(t.region) with a bare 'region' item — binding
+        # would have rejected an ambiguous bare name anyway)
+        if repr(a) == repr(b):
+            return True
+        if isinstance(a, ast.Name) and isinstance(b, ast.Name):
+            return a.parts[-1] == b.parts[-1] \
+                and (len(a.parts) == 1 or len(b.parts) == 1)
+        return False
+
+    branches = []
+    for gset in sel.grouping_sets:
+        omitted = [k for k in all_keys
+                   if not any(_same_key(k, g) for g in gset)]
+
+        def repl(e, omitted=omitted):
+            if any(_same_key(e, o) for o in omitted):
+                return ast.NullLit()
+            if not isinstance(e, ast.Node) or isinstance(
+                    e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+                return e
+            if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+                # aggregate ARGUMENTS stay intact: count(region) in the
+                # grand-total row counts all non-NULL regions — the key
+                # is NULL only as a GROUP LABEL, never inside aggregation
+                return e
+            out = e.__class__(**vars(e))
+            for k, v in vars(e).items():
+                if isinstance(v, ast.ExprNode):
+                    setattr(out, k, repl(v))
+                elif isinstance(v, list):
+                    setattr(out, k, [
+                        repl(x) if isinstance(x, ast.ExprNode)
+                        else ast.OrderItem(repl(x.expr), x.ascending)
+                        if isinstance(x, ast.OrderItem) else x
+                        for x in v])
+            return out
+
+        branches.append(ast.Select(
+            # keep the ORIGINAL output name on NULL-replaced items (the
+            # union's column names come from the left branch, and ORDER
+            # BY must resolve them)
+            items=[ast.SelectItem(repl(i.expr),
+                                  i.alias or _default_name(i.expr))
+                   for i in sel.items],
+            from_refs=sel.from_refs,
+            where=sel.where,
+            group_by=list(gset),
+            having=repl(sel.having) if sel.having is not None else None))
+    out: ast.Node = branches[0]
+    if len(branches) == 1:
+        out.distinct = sel.distinct
+    for b in branches[1:]:
+        # SELECT DISTINCT over grouping sets dedups the COMBINED result:
+        # plain UNION (not ALL) chains do exactly that
+        out = ast.SetOp("union", not sel.distinct, out, b)
+    out.order_by = list(sel.order_by)
+    out.limit = sel.limit
+    out.offset = sel.offset
+    return out
+
+
 def _normalize_frame(frame):
     """Validate + canonicalize a frame clause.
 
@@ -2622,7 +2728,10 @@ def _attach_validity_outputs(binder, exprs, fields):
     for (name, bound), f in zip(list(exprs), fields):
         v = _valid_of(bound)
         if v is None:
-            new_fields.append(N.PlanField(f.name, f.type, f.sdict))
+            nf = N.PlanField(f.name, f.type, f.sdict)
+            if getattr(f, "_is_null_col", False):
+                object.__setattr__(nf, "_is_null_col", True)
+            new_fields.append(nf)
             continue
         key = (("iv", v.mask_names, v.negate)
                if isinstance(v, ex.IsValid) else id(v))
@@ -2631,8 +2740,10 @@ def _attach_validity_outputs(binder, exprs, fields):
             hidden = binder.gensym("vm")
             mask_out[key] = hidden
             exprs.append((hidden, v))
-        new_fields.append(N.PlanField(f.name, f.type, f.sdict,
-                                      null_mask=(hidden,)))
+        nf = N.PlanField(f.name, f.type, f.sdict, null_mask=(hidden,))
+        if getattr(f, "_is_null_col", False):
+            object.__setattr__(nf, "_is_null_col", True)
+        new_fields.append(nf)
     for hidden in mask_out.values():
         new_fields.append(N.PlanField(hidden, T.BOOL, None))
     return exprs, new_fields

@@ -226,6 +226,10 @@ class Select(Node):
     limit: Optional[int] = None
     offset: int = 0
     distinct: bool = False
+    # GROUPING SETS / ROLLUP / CUBE: list of grouping-key subsets; the
+    # binder rewrites to a UNION ALL of per-set aggregations with NULLs
+    # for the keys a set omits (nodeAgg.c grouping-sets role)
+    grouping_sets: Optional[list] = None
 
 
 @dataclass

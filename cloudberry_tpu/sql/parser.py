@@ -563,9 +563,61 @@ class Parser:
             sel.where = self.parse_expr()
         if self.accept_kw("group"):
             self.expect_kw("by")
-            sel.group_by = [self.parse_expr()]
-            while self.accept_op(","):
-                sel.group_by.append(self.parse_expr())
+            nxt = self.toks[self.i + 1]
+            # lookahead: a column literally named rollup/cube/grouping
+            # must still parse as a plain GROUP BY key
+            kind = self.accept_kw("rollup", "cube") \
+                if nxt.kind == "op" and nxt.text == "(" else None
+            if kind:
+                # ROLLUP(a,b) / CUBE(a,b) — expanded to grouping sets
+                self.expect_op("(")
+                cols = [self.parse_expr()]
+                while self.accept_op(","):
+                    cols.append(self.parse_expr())
+                self.expect_op(")")
+                sel.group_by = list(cols)
+                if kind == "rollup":
+                    sel.grouping_sets = [cols[:k]
+                                         for k in range(len(cols), -1, -1)]
+                else:
+                    import itertools as _it
+
+                    sel.grouping_sets = [
+                        [c for i, c in enumerate(cols) if mask[i]]
+                        for mask in _it.product(
+                            (True, False), repeat=len(cols))]
+            elif self.at_kw("grouping") and nxt.kind == "ident" \
+                    and nxt.text == "sets":
+                self.advance()
+                self.expect_kw("sets")
+                self.expect_op("(")
+                sets = []
+                while True:
+                    if self.accept_op("("):
+                        g = []
+                        if not self.at_op(")"):
+                            g.append(self.parse_expr())
+                            while self.accept_op(","):
+                                g.append(self.parse_expr())
+                        self.expect_op(")")
+                    else:
+                        # bare expression = a one-column grouping set
+                        g = [self.parse_expr()]
+                    sets.append(g)
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                seen: list = []
+                for g in sets:
+                    for e in g:
+                        if not any(repr(e) == repr(s) for s in seen):
+                            seen.append(e)
+                sel.group_by = seen
+                sel.grouping_sets = sets
+            else:
+                sel.group_by = [self.parse_expr()]
+                while self.accept_op(","):
+                    sel.group_by.append(self.parse_expr())
         if self.accept_kw("having"):
             sel.having = self.parse_expr()
         if allow_tail:

@@ -1,0 +1,153 @@
+"""GROUPING SETS / ROLLUP / CUBE (nodeAgg.c grouping-sets role).
+
+Bound as a UNION ALL of per-set aggregations: omitted keys project as
+typed NULLs (the set-op alignment types NULL columns from the string
+side), ORDER BY/LIMIT apply to the whole union. Validated against a
+pandas oracle on both 1 and 8 segments.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+
+
+def _mk(nseg=1):
+    s = cb.Session(get_config().with_overrides(**{"n_segments": nseg}))
+    s.sql("create table sales (region text, product text, qty bigint, "
+          "amount bigint) distributed by (qty)")
+    s.sql("""insert into sales values
+        ('east','a',1,10),('east','b',2,20),('east','a',3,15),
+        ('west','a',4,30),('west','b',5,40),('west','b',6,25)""")
+    return s
+
+
+@pytest.fixture(scope="module", params=[1, 8], ids=["single", "dist8"])
+def s(request):
+    return _mk(request.param)
+
+
+def _norm(df):
+    return [[None if (isinstance(v, float) and np.isnan(v)) or v is None
+             or v is pd.NA else v for v in row]
+            for row in df.values.tolist()]
+
+
+def test_rollup(s):
+    df = s.sql("""select region, product, sum(amount) as total
+                  from sales group by rollup (region, product)
+                  order by region, product""").to_pandas()
+    assert _norm(df) == [
+        ["east", "a", 25], ["east", "b", 20], ["east", None, 45],
+        ["west", "a", 30], ["west", "b", 65], ["west", None, 95],
+        [None, None, 140]]
+
+
+def test_cube(s):
+    df = s.sql("""select region, product, count(*) as c from sales
+                  group by cube (region, product)
+                  order by region, product""").to_pandas()
+    assert _norm(df) == [
+        ["east", "a", 2], ["east", "b", 1], ["east", None, 3],
+        ["west", "a", 1], ["west", "b", 2], ["west", None, 3],
+        [None, "a", 3], [None, "b", 3], [None, None, 6]]
+
+
+def test_grouping_sets_explicit(s):
+    df = s.sql("""select region, product, sum(qty) as q from sales
+                  group by grouping sets ((region), (product), ())
+                  order by region, product""").to_pandas()
+    assert _norm(df) == [
+        ["east", None, 6], ["west", None, 15],
+        [None, "a", 8], [None, "b", 13], [None, None, 21]]
+
+
+def test_rollup_numeric_keys(s):
+    # NULL-filled numeric keys align by type coercion, not the
+    # string-side machinery
+    df = s.sql("""select qty, sum(amount) as t from sales
+                  where qty <= 2 group by rollup (qty)
+                  order by qty""").to_pandas()
+    assert _norm(df) == [[1, 10], [2, 20], [None, 30]]
+
+
+def test_rollup_with_having_and_limit(s):
+    df = s.sql("""select region, product, sum(amount) as total
+                  from sales group by rollup (region, product)
+                  having sum(amount) > 40
+                  order by total desc limit 3""").to_pandas()
+    assert _norm(df) == [[None, None, 140], ["west", None, 95],
+                         ["west", "b", 65]]
+
+
+def test_aggregate_over_grouping_key(s):
+    """count(region) in the grand-total row counts ALL non-NULL regions
+    — the key is NULL only as a group label, never inside aggregation."""
+    df = s.sql("""select region, count(region) as c from sales
+                  group by rollup (region) order by region""").to_pandas()
+    assert _norm(df) == [["east", 3], ["west", 3], [None, 6]]
+
+
+def test_qualified_key_matches_bare_item(s):
+    df = s.sql("""select region, sum(amount) as t from sales
+                  group by rollup (sales.region)
+                  order by region""").to_pandas()
+    assert _norm(df) == [["east", 45], ["west", 95], [None, 140]]
+
+
+def test_distinct_over_grouping_sets(s):
+    df = s.sql("""select distinct region from sales
+                  group by grouping sets ((region), (region, product))
+                  order by region""").to_pandas()
+    assert _norm(df) == [["east"], ["west"]]
+
+
+def test_bare_expression_grouping_set(s):
+    df = s.sql("""select region, product, sum(qty) as q from sales
+                  group by grouping sets (region, (region, product))
+                  order by region, product""").to_pandas()
+    assert _norm(df)[0] == ["east", "a", 4]
+    assert ["east", None, 6] in _norm(df)
+
+
+def test_column_named_rollup_still_groups(s):
+    s2 = cb.Session()
+    s2.sql("create table odd (rollup bigint, v bigint)")
+    s2.sql("insert into odd values (1, 10), (1, 20), (2, 5)")
+    df = s2.sql("select rollup, sum(v) as t from odd group by rollup "
+                "order by rollup").to_pandas()
+    assert df.values.tolist() == [[1, 30], [2, 5]]
+
+
+def test_rollup_matches_pandas_oracle():
+    rng = np.random.default_rng(23)
+    n = 5000
+    g1 = rng.integers(0, 7, n)
+    g2 = rng.integers(0, 5, n)
+    v = rng.integers(0, 1000, n)
+    s2 = cb.Session(get_config().with_overrides(**{"n_segments": 8}))
+    s2.sql("create table r (a bigint, b bigint, v bigint) "
+           "distributed by (v)")
+    s2.catalog.table("r").set_data(
+        {"a": g1.astype(np.int64), "b": g2.astype(np.int64),
+         "v": v.astype(np.int64)})
+    df = s2.sql("select a, b, sum(v) as s, count(*) as c from r "
+                "group by rollup (a, b) order by a, b").to_pandas()
+    pdf = pd.DataFrame({"a": g1, "b": g2, "v": v})
+    lvl2 = pdf.groupby(["a", "b"], as_index=False).agg(
+        s=("v", "sum"), c=("v", "size"))
+    lvl1 = pdf.groupby(["a"], as_index=False).agg(
+        s=("v", "sum"), c=("v", "size"))
+    lvl1["b"] = None
+    lvl0 = pd.DataFrame([{"a": None, "b": None,
+                          "s": v.sum(), "c": n}])
+    want = pd.concat([lvl2, lvl1[["a", "b", "s", "c"]],
+                      lvl0])
+    want = want.sort_values(["a", "b"],
+                            na_position="last").reset_index(drop=True)
+    got = _norm(df)
+    exp = [[None if pd.isna(x) else int(x) for x in row]
+           for row in want.values.tolist()]
+    assert got == exp
