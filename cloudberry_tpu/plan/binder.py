@@ -280,7 +280,8 @@ class Binder:
                      [(f.name, _canonical_ref(f)) for f in plan.fields], [],
                      capacity=_plan_capacity(plan))
         agg.fields = [N.PlanField(f.name, f.type, f.sdict,
-                                  null_mask=f.null_mask)
+                                  null_mask=f.null_mask,
+                                  _is_null_col=f._is_null_col)
                       for f in plan.fields]
         return agg
 
@@ -2317,10 +2318,8 @@ def _field_for(name: str, bound: ex.Expr) -> N.PlanField:
     """Projection output field; NULL-literal columns carry a marker so
     set-op alignment can type them from the OTHER side (grouping-set
     branches project NULL for omitted string keys)."""
-    f = N.PlanField(name, bound.dtype, _expr_dict(bound))
-    if _is_null_literal(bound):
-        object.__setattr__(f, "_is_null_col", True)
-    return f
+    return N.PlanField(name, bound.dtype, _expr_dict(bound),
+                       _is_null_col=_is_null_literal(bound))
 
 
 def _is_null_literal(e: ex.Expr) -> bool:
@@ -2584,6 +2583,15 @@ def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
             if not isinstance(e, ast.Node) or isinstance(
                     e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
                 return e
+            if isinstance(e, ast.FuncCall) and e.name == "grouping":
+                # grouping(a, b) -> bitmask: bit i set where arg i is
+                # NOT part of this branch's grouping set — a per-branch
+                # CONSTANT, which is the whole point of the rewrite
+                bits = 0
+                for a in e.args:
+                    bits = (bits << 1) | int(
+                        any(_same_key(a, o) for o in omitted))
+                return ast.NumberLit(str(bits))
             if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
                 # aggregate ARGUMENTS stay intact: count(region) in the
                 # grand-total row counts all non-NULL regions — the key
@@ -2594,27 +2602,41 @@ def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
                 if isinstance(v, ast.ExprNode):
                     setattr(out, k, repl(v))
                 elif isinstance(v, list):
+                    # tuples inside lists = CaseExpr.whens pairs
                     setattr(out, k, [
                         repl(x) if isinstance(x, ast.ExprNode)
                         else ast.OrderItem(repl(x.expr), x.ascending)
-                        if isinstance(x, ast.OrderItem) else x
+                        if isinstance(x, ast.OrderItem)
+                        else tuple(repl(y) if isinstance(y, ast.ExprNode)
+                                   else y for y in x)
+                        if isinstance(x, tuple) else x
                         for x in v])
             return out
 
-        branches.append(ast.Select(
+        items = [ast.SelectItem(repl(i.expr),
+                                i.alias or _default_name(i.expr))
+                 for i in sel.items]
+        having = repl(sel.having) if sel.having is not None else None
+        b = ast.Select(
             # keep the ORIGINAL output name on NULL-replaced items (the
             # union's column names come from the left branch, and ORDER
             # BY must resolve them)
-            items=[ast.SelectItem(repl(i.expr),
-                                  i.alias or _default_name(i.expr))
-                   for i in sel.items],
+            items=items,
             from_refs=sel.from_refs,
             where=sel.where,
             group_by=list(gset),
-            having=repl(sel.having) if sel.having is not None else None))
+            having=having)
+        if not gset and not any(_has_agg(i.expr) for i in items) \
+                and (having is None or not _has_agg(having)):
+            # the () branch with no aggregates selected: every item is a
+            # constant label — GROUP BY () means ONE group, which
+            # DISTINCT over constants reproduces
+            b.distinct = True
+        branches.append(b)
     out: ast.Node = branches[0]
     if len(branches) == 1:
-        out.distinct = sel.distinct
+        # never CLEAR the one-group distinct a constant () branch set
+        out.distinct = out.distinct or sel.distinct
     for b in branches[1:]:
         # SELECT DISTINCT over grouping sets dedups the COMBINED result:
         # plain UNION (not ALL) chains do exactly that
@@ -2728,10 +2750,8 @@ def _attach_validity_outputs(binder, exprs, fields):
     for (name, bound), f in zip(list(exprs), fields):
         v = _valid_of(bound)
         if v is None:
-            nf = N.PlanField(f.name, f.type, f.sdict)
-            if getattr(f, "_is_null_col", False):
-                object.__setattr__(nf, "_is_null_col", True)
-            new_fields.append(nf)
+            new_fields.append(N.PlanField(f.name, f.type, f.sdict,
+                                          _is_null_col=f._is_null_col))
             continue
         key = (("iv", v.mask_names, v.negate)
                if isinstance(v, ex.IsValid) else id(v))
@@ -2740,10 +2760,9 @@ def _attach_validity_outputs(binder, exprs, fields):
             hidden = binder.gensym("vm")
             mask_out[key] = hidden
             exprs.append((hidden, v))
-        nf = N.PlanField(f.name, f.type, f.sdict, null_mask=(hidden,))
-        if getattr(f, "_is_null_col", False):
-            object.__setattr__(nf, "_is_null_col", True)
-        new_fields.append(nf)
+        new_fields.append(N.PlanField(f.name, f.type, f.sdict,
+                                      null_mask=(hidden,),
+                                      _is_null_col=f._is_null_col))
     for hidden in mask_out.values():
         new_fields.append(N.PlanField(hidden, T.BOOL, None))
     return exprs, new_fields

@@ -31,6 +31,10 @@ class PlanField:
     # named bool columns are True. A column nullable through several outer
     # joins / a nullable base column carries one name per source.
     null_mask: Optional[str | tuple[str, ...]] = None
+    # the column is a NULL literal (a grouping-set branch's omitted-key
+    # label): set-op alignment may type it from the OTHER side — a real
+    # field so every copy site propagates it by construction
+    _is_null_col: bool = False
 
     @property
     def masks(self) -> tuple[str, ...]:

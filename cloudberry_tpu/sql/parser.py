@@ -563,7 +563,8 @@ class Parser:
             sel.where = self.parse_expr()
         if self.accept_kw("group"):
             self.expect_kw("by")
-            nxt = self.toks[self.i + 1]
+            nxt = self.toks[self.i + 1] \
+                if self.i + 1 < len(self.toks) else self.cur
             # lookahead: a column literally named rollup/cube/grouping
             # must still parse as a plain GROUP BY key
             kind = self.accept_kw("rollup", "cube") \

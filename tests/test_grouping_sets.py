@@ -121,6 +121,45 @@ def test_column_named_rollup_still_groups(s):
     assert df.values.tolist() == [[1, 30], [2, 5]]
 
 
+def test_grouping_function(s):
+    """grouping(a, b) bitmask distinguishes subtotal levels — the SQL
+    disambiguator for real NULL keys vs rollup NULL labels."""
+    df = s.sql("""select region, product, grouping(region, product) as g,
+                  sum(amount) as t from sales
+                  group by rollup (region, product)
+                  order by g, region, product""").to_pandas()
+    rows = _norm(df)
+    assert [r[2] for r in rows] == [0, 0, 0, 0, 1, 1, 3]
+    assert rows[-1] == [None, None, 3, 140]
+    # single-arg form
+    df = s.sql("""select region, grouping(region) as g from sales
+                  group by rollup (region) order by g, region""").to_pandas()
+    assert [r[1] for r in _norm(df)] == [0, 0, 1]
+
+
+def test_rollup_key_inside_case(s):
+    """Omitted keys replace inside CASE WHEN tuples too — the grand
+    total's CASE sees NULL and takes the ELSE branch."""
+    df = s.sql("""select case when region = 'east' then 'E' else 'O' end
+                  as r, sum(amount) as t from sales
+                  group by rollup (region) order by t""").to_pandas()
+    assert _norm(df) == [["E", 45], ["O", 95], ["O", 140]]
+
+
+def test_empty_grouping_set_is_one_group(s):
+    # GROUP BY () = one group even with no aggregates selected
+    df = s.sql("select 1 as one from sales "
+               "group by grouping sets (())").to_pandas()
+    assert len(df) == 1
+
+
+def test_trailing_group_by_is_parse_error(s):
+    from cloudberry_tpu.sql.parser import ParseError, parse_sql
+
+    with pytest.raises(ParseError):
+        parse_sql("select 1 from sales group by")
+
+
 def test_rollup_matches_pandas_oracle():
     rng = np.random.default_rng(23)
     n = 5000
