@@ -158,9 +158,38 @@ def oracle_q98(t):
         .head(100).reset_index(drop=True)
 
 
+def oracle_q27(t):
+    j = _star(t).merge(t["store"], left_on="ss_store_sk",
+                       right_on="s_store_sk")
+    j = j[j.d_year == 2000]
+
+    def agg(g, keys):
+        out = g.groupby(keys, as_index=False).agg(
+            agg1=("ss_quantity", "mean"),
+            agg2=("ss_ext_sales_price", "mean"),
+            agg3=("ss_net_profit", "mean"))
+        return out
+
+    lvl2 = agg(j, ["i_item_id", "s_state"])
+    lvl2["g_state"] = 0
+    lvl1 = agg(j, ["i_item_id"])
+    lvl1["s_state"] = None
+    lvl1["g_state"] = 1
+    lvl0 = pd.DataFrame([{"i_item_id": None, "s_state": None,
+                          "g_state": 1,
+                          "agg1": j.ss_quantity.mean(),
+                          "agg2": j.ss_ext_sales_price.mean(),
+                          "agg3": j.ss_net_profit.mean()}])
+    cols = ["i_item_id", "s_state", "g_state", "agg1", "agg2", "agg3"]
+    out = pd.concat([lvl2[cols], lvl1[cols], lvl0[cols]])
+    return out.sort_values(["i_item_id", "s_state"],
+                           na_position="last") \
+        .head(100).reset_index(drop=True)
+
+
 ORACLES = {"q17": oracle_q17, "q25": oracle_q25, "q29": oracle_q29,
            "q3": oracle_q3, "q42": oracle_q42, "q52": oracle_q52,
-           "q55": oracle_q55, "q98": oracle_q98}
+           "q55": oracle_q55, "q98": oracle_q98, "q27": oracle_q27}
 
 
 @pytest.mark.parametrize("qname", sorted(DS_QUERIES))

@@ -31,6 +31,12 @@ def assert_frames_match(got: pd.DataFrame, exp: pd.DataFrame, name: str):
             np.testing.assert_allclose(
                 g.astype(np.float64), e.astype(np.float64),
                 rtol=1e-9, atol=1e-2, err_msg=f"{name}.{gcol}")
+        elif g.dtype == object or e.dtype == object:
+            gn, en = pd.isna(g), pd.isna(e)
+            np.testing.assert_array_equal(
+                gn, en, err_msg=f"{name}.{gcol} (null mask)")
+            np.testing.assert_array_equal(
+                g[~gn], e[~en], err_msg=f"{name}.{gcol}")
         else:
             np.testing.assert_array_equal(g, e, err_msg=f"{name}.{gcol}")
 

@@ -156,3 +156,21 @@ group by i_item_id, i_item_desc, i_category, i_class, i_current_price
 order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 limit 100
 """
+
+# q27 (adapted: the official query filters on customer_demographics,
+# which tpcds-lite does not generate — the grouping shape, the rollup,
+# and grouping() are the point here; avgs run over the generated
+# measure columns)
+DS_QUERIES["q27"] = """
+select i_item_id, s_state, grouping(s_state) as g_state,
+       avg(ss_quantity) as agg1,
+       avg(ss_ext_sales_price) as agg2,
+       avg(ss_net_profit) as agg3
+from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+     join store on ss_store_sk = s_store_sk
+     join item on ss_item_sk = i_item_sk
+where d_year = 2000
+group by rollup (i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+"""
