@@ -184,6 +184,25 @@ def _rank_better(mx: bool, v1, r1, c1, v2, r2, c2):
     return (v2 & ~v1) | ((v2 == v1) & by_rank)
 
 
+def _vsearch(s, target, lo, hi, cap: int, lower: bool):
+    """Vectorized per-row binary search over the (partition-wise sorted)
+    array s restricted to per-row inclusive bounds [lo, hi]: returns the
+    insertion point — first index j with s[j] >= target (lower) or
+    s[j] > target (upper); hi+1 when every bounded element is smaller.
+    O(log cap) unrolled lock-step halvings (no data-dependent trip
+    counts, so the whole thing stays inside the one XLA program)."""
+    l = jnp.asarray(lo)
+    h = jnp.asarray(hi) + 1
+    for _ in range(max(1, int(cap).bit_length()) + 1):
+        active = l < h
+        m = (l + h) // 2
+        mv = s[jnp.clip(m, 0, cap - 1)]
+        go_right = (mv < target) if lower else (mv <= target)
+        l = jnp.where(active & go_right, m + 1, l)
+        h = jnp.where(active & ~go_right, m, h)
+    return l
+
+
 def _rmq_extreme(ks, cs, va, lo, hi, cap: int, mx: bool):
     """Per-row range extreme over [lo, hi] via a sparse table: O(n log n)
     build (static level count — XLA unrolls it), two gathers per query.
@@ -549,6 +568,53 @@ class Lowerer:
         elif node.frame[0] == "whole":
             flo, fhi = seg_start, seg_end
             fempty = None
+        elif node.frame[0] == "rangepos":
+            # positional RANGE (CURRENT ROW / UNBOUNDED bounds only):
+            # peer-group or partition edges, never empty; the start is
+            # always the peer-group head (UNBOUNDED-lo shapes reduced
+            # to the default/whole frames at bind time). Without ORDER
+            # BY every row is a peer (run_* == seg_*), the SQL rule.
+            flo = run_start
+            fhi = run_end if node.frame[2] == "peer" else seg_end
+            fempty = None
+        elif node.frame[0] == "rangeoff":
+            # value-distance frame: per-row binary search for the key
+            # interval [k+lo, k+hi] inside the partition's non-NULL span.
+            # NULL-key rows frame exactly their peer group (the SQL rule:
+            # NULL ± offset stays NULL, NULLs are peers of NULLs), while
+            # UNBOUNDED sides keep the positional partition edge — which
+            # includes NULL rows, matching nodeWindowAgg.c.
+            _, lo_off, hi_off, knull = node.frame
+            asc = node.order_keys[-1][1]
+            kv_s = ok[-1][perm]
+            if knull:
+                keyvalid = (ok[0][perm] == 0) & s_sel
+                # NULLs sort last ASC / first DESC (PSort's rule), so
+                # valid keys are a prefix (asc) or suffix (desc) of the
+                # partition
+                C = pref(keyvalid.astype(jnp.int32))
+                nv = C[jnp.clip(seg_end + 1, 0, cap)] - \
+                    C[jnp.clip(seg_start, 0, cap)]
+                vlo = seg_start if asc else seg_end - nv + 1
+                vhi = seg_start + nv - 1 if asc else seg_end
+            else:
+                keyvalid = s_sel
+                vlo, vhi = seg_start, seg_end
+            # search in frame direction: DESC negates so "PRECEDING"
+            # stays the -offset side of a nondecreasing array
+            s = kv_s if asc else -kv_s
+            knullrow = s_sel & ~keyvalid
+            if lo_off is None:
+                flo = seg_start
+            else:
+                f = _vsearch(s, s + lo_off, vlo, vhi, cap, lower=True)
+                flo = jnp.where(knullrow, run_start, f)
+            if hi_off is None:
+                fhi = seg_end
+            else:
+                f = _vsearch(s, s + hi_off, vlo, vhi, cap, lower=False) - 1
+                fhi = jnp.where(knullrow, run_end, f)
+            fempty = flo > fhi
         else:
             _, lo_off, hi_off = node.frame
             flo = seg_start if lo_off is None \
@@ -646,10 +712,11 @@ class Lowerer:
                 elif func == "anyvalid":
                     o = o > 0
             elif func in ("min", "max") and node.frame is not None \
-                    and node.frame[0] == "rows":
-                # ROWS-frame extreme: sparse-table range query — the
-                # prefix-sum trick does not invert for min/max, and the
-                # running scan only covers suffix-anchored frames
+                    and node.frame[0] in ("rows", "rangeoff", "rangepos"):
+                # ROWS/RANGE-offset-frame extreme: sparse-table range
+                # query over [flo, fhi] — the prefix-sum trick does not
+                # invert for min/max, and the running scan only covers
+                # suffix-anchored frames
                 ks = _sortable(arg, node.child, cols)[perm]
                 cs = self.expr(arg, cols)[perm]
                 o = _rmq_extreme(ks, cs, va, flo, fhi, cap,

@@ -20,6 +20,7 @@ resolution and logical planning:
 from __future__ import annotations
 
 import datetime
+import decimal
 from dataclasses import dataclass, field as dc_field
 from typing import Optional
 
@@ -1195,15 +1196,18 @@ class Binder:
                     okeys.append((_masked_key(bound, v), o.ascending))
                 else:
                     okeys.append((bound, o.ascending))
+            if frame is not None and frame[0] == "rangeoff":
+                frame = _check_rangeoff(frame, order_asts, okeys)
             bound_calls = []
             call_valids = []
             call_params = []
             new_fields = []
             mask_by_valid: dict[str, str] = {}
-            # a ROWS frame that can exclude the current row can be EMPTY
-            # at partition edges: aggregates over it are NULL, so their
+            # a ROWS/RANGE-offset frame that can exclude the current row
+            # can be EMPTY: aggregates over it are NULL, so their
             # outputs need masks even over non-null arguments
-            frame_may_empty = (frame is not None and frame[0] == "rows"
+            frame_may_empty = (frame is not None
+                               and frame[0] in ("rows", "rangeoff")
                                and ((frame[1] is not None and frame[1] > 0)
                                     or (frame[2] is not None
                                         and frame[2] < 0)))
@@ -2650,12 +2654,12 @@ def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
 def _normalize_frame(frame):
     """Validate + canonicalize a frame clause.
 
-    Returns None (the SQL default), ("whole",) (the whole partition), or
-    ("rows", lo, hi) with row offsets (None = unbounded on that side).
-    ROWS frames support arbitrary bounds; RANGE supports only the two
-    whole/default shapes — value-distance RANGE offsets would need
-    per-partition binary search over unsorted global keys, which the
-    one-XLA-program model does not do yet (tracked in DESIGN.md)."""
+    Returns None (the SQL default), ("whole",) (the whole partition),
+    ("rows", lo, hi) with row offsets, or ("rangeoff", lo, hi) with
+    value-distance offsets (None = unbounded on that side; CURRENT ROW
+    in RANGE mode is exactly offset 0 — the search lands on the peer
+    group's boundary either way). The key-count/type checks rangeoff
+    needs happen at PWindow construction where the ORDER BY is bound."""
     if frame is None:
         return None
     kind, lo, hi = frame
@@ -2663,21 +2667,78 @@ def _normalize_frame(frame):
         raise BindError("frame cannot start at UNBOUNDED FOLLOWING")
     if hi == ("unbounded", -1):
         raise BindError("frame cannot end at UNBOUNDED PRECEDING")
-    if kind == "range":
-        if lo == ("unbounded", -1) and hi == ("unbounded", 1):
-            return ("whole",)
-        if lo == ("unbounded", -1) and hi == ("current", 0):
-            return None  # exactly the SQL default frame
-        raise BindError(
-            "RANGE frames support only UNBOUNDED PRECEDING to "
-            "CURRENT ROW / UNBOUNDED FOLLOWING; use ROWS for offsets")
     if lo == ("unbounded", -1) and hi == ("unbounded", 1):
         return ("whole",)
+    if kind == "range":
+        if lo == ("unbounded", -1) and hi == ("current", 0):
+            return None  # exactly the SQL default frame
+        if lo[0] != "offset" and hi[0] != "offset":
+            # positional shapes: CURRENT ROW bounds are peer-group
+            # edges, needing no key search — PG restricts RANGE to one
+            # numeric ORDER BY key only when an offset bound appears.
+            # lo is always CURRENT ROW here (the UNBOUNDED-lo shapes
+            # reduced to None/whole above)
+            return ("rangepos", "peer",
+                    "peer" if hi[0] == "current" else "end")
+        lo_off = None if lo[0] == "unbounded" else lo[1]
+        hi_off = None if hi[0] == "unbounded" else hi[1]
+        if lo_off is not None and hi_off is not None and lo_off > hi_off:
+            raise BindError("frame start is after frame end")
+        return ("rangeoff", lo_off, hi_off)
+    for b in (lo, hi):
+        if b[0] != "unbounded" and b[1] != int(b[1]):
+            raise BindError("ROWS frame offsets must be integers")
     lo_off = None if lo[0] == "unbounded" else int(lo[1])
     hi_off = None if hi[0] == "unbounded" else int(hi[1])
     if lo_off is not None and hi_off is not None and lo_off > hi_off:
         raise BindError("frame start is after frame end")
     return ("rows", lo_off, hi_off)
+
+
+def _check_rangeoff(frame, order_asts, okeys):
+    """RANGE offset frames need exactly one numeric ORDER BY key (PG:
+    "RANGE with offset PRECEDING/FOLLOWING requires exactly one ORDER BY
+    column", nodeWindowAgg.c frame validation). DECIMAL keys scale the
+    offset into their fixed-point representation; integer/date keys
+    require integral offsets (a fractional distance on a discrete domain
+    would silently truncate). Returns the executable 4-tuple
+    ("rangeoff", lo, hi, key_is_nullable) — the nullable flag tells the
+    executor the ORDER BY lowered to a (validity, masked-value) pair."""
+    if len(order_asts) != 1:
+        raise BindError(
+            "RANGE with offset PRECEDING/FOLLOWING requires exactly "
+            "one ORDER BY column")
+    kb = okeys[-1][0]
+    if _expr_dict(kb) is not None or kb.dtype.base not in (
+            DType.INT32, DType.INT64, DType.FLOAT64, DType.DECIMAL,
+            DType.DATE):
+        raise BindError(
+            "RANGE offsets need a numeric or date ORDER BY key")
+
+    def scale(o):
+        if o is None:
+            return None
+        raw = o
+        if kb.dtype.base == DType.DECIMAL:
+            # exact fixed-point scaling: 0.07 on a scale-2 key must
+            # become 7, not 7.000000000000001 (binary float multiply)
+            o = decimal.Decimal(str(o)).scaleb(kb.dtype.scale)
+            if o != int(o):
+                raise BindError(
+                    f"RANGE offset {raw} is not representable at "
+                    f"scale {kb.dtype.scale} of the decimal ORDER BY "
+                    "key")
+            return int(o)
+        if kb.dtype.base != DType.FLOAT64:
+            if o != int(o):
+                raise BindError(
+                    f"RANGE offset {raw} must be an integer for "
+                    f"{kb.dtype.base.value} ORDER BY keys")
+            return int(o)
+        return float(o)
+
+    return ("rangeoff", scale(frame[1]), scale(frame[2]),
+            len(okeys) == 2)
 
 
 def _one_row_guaranteed(sel: ast.Select) -> bool:

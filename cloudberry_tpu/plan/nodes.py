@@ -245,8 +245,13 @@ class PWindow(PlanNode):
     # accepts expressions but constant offsets are the only common case.
     params: Optional[list] = None
     # explicit frame (binder._normalize_frame): None = SQL default;
-    # ("whole",) = whole partition; ("rows", lo, hi) = row offsets with
-    # None meaning unbounded on that side. Applies to aggregates and
+    # ("whole",) = whole partition; ("rows", lo, hi) = row offsets;
+    # ("rangepos", lo, hi) = positional RANGE with only CURRENT ROW /
+    # UNBOUNDED bounds (lo: "peer"|"start", hi: "peer"|"end");
+    # ("rangeoff", lo, hi, key_nullable) = value-distance offsets over
+    # the single numeric ORDER BY key (offsets pre-scaled for DECIMAL
+    # keys; key_nullable marks the (validity, masked-value) lowering).
+    # None means unbounded on that side. Applies to aggregates and
     # first_value/last_value; positional lead/lag and ranks ignore frames
     # (SQL semantics).
     frame: Optional[tuple] = None

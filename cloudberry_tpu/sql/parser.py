@@ -9,6 +9,7 @@ GROUP BY/HAVING/ORDER BY/LIMIT), CREATE/DROP TABLE, INSERT … VALUES, EXPLAIN.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 from cloudberry_tpu.sql import ast
@@ -381,6 +382,24 @@ class Parser:
         except ValueError:
             raise ParseError(
                 f"expected an integer, got {tok.text!r}")
+        return -v if neg else v
+
+    def _signed_number(self):
+        """int when the literal is integral, float otherwise (RANGE frame
+        offsets may be fractional on float ORDER BY keys)."""
+        neg = bool(self.accept_op("-"))
+        tok = self.advance()
+        try:
+            v = int(tok.text)
+        except ValueError:
+            try:
+                v = float(tok.text)
+            except ValueError:
+                raise ParseError(f"expected a number, got {tok.text!r}")
+            if not math.isfinite(v):
+                # float() happily parses 'nan'/'inf'/1e400 — as a frame
+                # offset NaN would silently make every comparison False
+                raise ParseError(f"expected a number, got {tok.text!r}")
         return -v if neg else v
 
     def _parse_distribution(self):
@@ -933,7 +952,7 @@ class Parser:
         if self.accept_kw("current"):
             self.expect_kw("row")
             return ("current", 0)
-        n = self._signed_int()
+        n = self._signed_number()
         if n < 0:
             # PG: "frame starting offset must not be negative" — a
             # negative n would silently flip PRECEDING into FOLLOWING

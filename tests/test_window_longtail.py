@@ -280,19 +280,194 @@ def test_range_frame_whole_partition(s):
     assert out == [1, 3, 6, 1, 3, 1]
 
 
-def test_range_offset_frames_rejected(s):
+def test_frame_bound_validation(s):
     from cloudberry_tpu.sql.parser import ParseError
 
-    with pytest.raises(BindError, match="RANGE frames"):
-        s.sql("select sum(o) over (order by o range between 1 preceding "
-              "and current row) from w")
     with pytest.raises(BindError, match="start is after"):
         s.sql("select sum(o) over (order by o rows between 1 following "
+              "and 1 preceding) from w")
+    with pytest.raises(BindError, match="start is after"):
+        s.sql("select sum(o) over (order by o range between 1 following "
               "and 1 preceding) from w")
     # negative offsets are invalid SQL, never a silent direction flip
     with pytest.raises(ParseError, match="must not be negative"):
         s.sql("select sum(o) over (order by o rows between -2 following "
               "and current row) from w")
+    with pytest.raises(BindError, match="ROWS frame offsets"):
+        s.sql("select sum(o) over (order by o rows between 1.5 preceding "
+              "and current row) from w")
+    with pytest.raises(BindError, match="exactly one ORDER BY"):
+        s.sql("select sum(o) over (order by g, o range between "
+              "1 preceding and current row) from w")
+    with pytest.raises(BindError, match="exactly one ORDER BY"):
+        s.sql("select sum(o) over (range between 1 preceding "
+              "and current row) from w")
+    with pytest.raises(BindError, match="numeric or date"):
+        s.sql("select sum(o) over (order by g range between 1 preceding "
+              "and current row) from w")
+    with pytest.raises(BindError, match="must be an integer"):
+        s.sql("select sum(o) over (order by o range between "
+              "0.5 preceding and current row) from w")
+    # float() parses 'nan'/'inf' — as offsets they'd silently break
+    # every comparison, so they must be rejected at parse time
+    with pytest.raises(ParseError, match="expected a number"):
+        s.sql("select sum(o) over (order by o range between "
+              "nan preceding and current row) from w")
+
+
+# --------------------------------------------- RANGE offset frames
+
+
+def _mk_range(nseg=1):
+    s = cb.Session(Config(n_segments=nseg)) if nseg > 1 else cb.Session()
+    s.sql("create table rw (g text, k int, v int) distributed by (v)")
+    # duplicate keys (peers), gaps, and NULL keys in one partition
+    s.sql("insert into rw values "
+          "('a', 1, 1), ('a', 2, 2), ('a', 2, 3), ('a', 5, 4), "
+          "('b', 10, 5), ('b', 11, 6), "
+          "('c', 3, 9), ('c', null, 7), ('c', null, 8)")
+    s.sql("create table rf (k double, v int) distributed by (v)")
+    s.sql("insert into rf values (0.5, 1), (1.0, 2), (1.4, 3), (3.0, 4)")
+    s.sql("create table rd (k decimal(8,2), v int) distributed by (v)")
+    s.sql("insert into rd values (1.00, 1), (1.25, 2), (1.50, 3), "
+          "(3.00, 4)")
+    return s
+
+
+@pytest.fixture(scope="module", params=[1, 8], ids=["single", "dist8"])
+def rs(request):
+    return _mk_range(request.param)
+
+
+def test_range_offset_sum(rs):
+    out = col(rs, "select sum(v) over (partition by g order by k "
+                  "range between 1 preceding and 1 following) as x "
+                  "from rw order by g, k", "x")
+    # a: k=1 sees keys 0..2 -> 1+2+3; k=2 (both peers) sees 1..3 -> 6;
+    # k=5 sees only itself. c: NULL keys frame exactly their peer group.
+    assert out == [6, 6, 6, 4, 11, 11, 9, 15, 15]
+
+
+def test_range_offset_desc(rs):
+    # DESC: PRECEDING means larger keys
+    out = col(rs, "select sum(v) over (partition by g order by k desc "
+                  "range between 1 preceding and current row) as x "
+                  "from rw order by g, k", "x")
+    assert out == [6, 5, 5, 4, 11, 6, 9, 15, 15]
+
+
+def test_range_offset_can_be_empty(rs):
+    out = col(rs, "select sum(v) over (partition by g order by k "
+                  "range between 3 preceding and 2 preceding) as x "
+                  "from rw order by g, k", "x")
+    # only a:k=5 has keys in [k-3, k-2] (the k=2 peers); NULL-key rows
+    # still frame their peer group (NULL ± offset is NULL)
+    assert out == [None, None, None, 5, None, None, None, 15, 15]
+    out = col(rs, "select count(v) over (partition by g order by k "
+                  "range between 3 preceding and 2 preceding) as x "
+                  "from rw order by g, k", "x")
+    assert out == [0, 0, 0, 2, 0, 0, 0, 2, 2]
+
+
+def test_range_offset_min_max(rs):
+    out = col(rs, "select max(v) over (partition by g order by k "
+                  "range between 1 preceding and 1 following) as x "
+                  "from rw order by g, k", "x")
+    assert out == [3, 3, 3, 4, 6, 6, 9, 8, 8]
+    out = col(rs, "select min(v) over (partition by g order by k "
+                  "range between 1 preceding and current row) as x "
+                  "from rw order by g, k", "x")
+    # CURRENT ROW as frame end = last peer (RANGE keeps peer semantics)
+    assert out == [1, 1, 1, 4, 5, 5, 9, 7, 7]
+
+
+def test_range_offset_first_last_value(rs):
+    out = col(rs, "select first_value(v) over (partition by g order by k "
+                  "range between 1 following and 2 following) as x "
+                  "from rw where g = 'b' order by k", "x")
+    assert out == [6, None]
+    out = col(rs, "select last_value(v) over (partition by g order by k "
+                  "range between current row and unbounded following) "
+                  "as x from rw where g = 'b' order by k", "x")
+    assert out == [6, 6]
+
+
+def test_range_offset_float_key(rs):
+    out = col(rs, "select sum(v) over (order by k range between "
+                  "0.5 preceding and 0.5 following) as x "
+                  "from rf order by k", "x")
+    assert out == [3, 6, 5, 4]
+
+
+def test_range_offset_decimal_key(rs):
+    # the 0.25 offset scales into the DECIMAL(8,2) fixed-point domain
+    out = col(rs, "select sum(v) over (order by k range between "
+                  "0.25 preceding and 0.25 following) as x "
+                  "from rd order by k", "x")
+    assert out == [3, 6, 5, 4]
+    # 0.07 * 100 is inexact in binary floats — scaling must stay exact
+    out = col(rs, "select count(v) over (order by k range between "
+                  "0.07 preceding and 0.07 following) as x "
+                  "from rd order by k", "x")
+    assert out == [1, 1, 1, 1]
+
+
+def test_range_positional_shapes(rs):
+    # CURRENT ROW bounds without offsets are positional peer-group
+    # edges: no single-numeric-key restriction (multi-key, string keys)
+    out = col(rs, "select sum(v) over (order by g, k range between "
+                  "current row and unbounded following) as x "
+                  "from rw order by g, k, v", "x")
+    assert out == [45, 44, 44, 39, 35, 30, 24, 15, 15]
+    out = col(rs, "select sum(v) over (order by g range between "
+                  "current row and current row) as x "
+                  "from rw order by g, k", "x")
+    assert out == [10, 10, 10, 10, 11, 11, 24, 24, 24]
+
+
+def test_range_offset_mixed_unbounded(rs):
+    out = col(rs, "select sum(v) over (partition by g order by k "
+                  "range between unbounded preceding and 1 preceding) "
+                  "as x from rw order by g, k", "x")
+    # unbounded start is positional (partition head); the offset end at a
+    # NULL row is its last null peer — so c's NULL rows span the whole
+    # partition (9+7+8), while its k=3 row has an empty frame
+    assert out == [None, 1, 1, 6, None, 5, None, 24, 24]
+
+
+def test_range_frame_oracle_random():
+    """RANGE moving sums vs an O(n log n) searchsorted oracle."""
+    import pandas as pd
+
+    rng = np.random.default_rng(23)
+    n = 2000
+    g = rng.integers(0, 7, n)
+    k = rng.integers(0, 300, n)
+    v = rng.integers(-50, 50, n)
+    s2 = cb.Session()
+    s2.sql("create table rr (g bigint, k bigint, v bigint) "
+           "distributed by (v)")
+    s2.catalog.table("rr").set_data(
+        {"g": g.astype(np.int64), "k": k.astype(np.int64),
+         "v": v.astype(np.int64)})
+    df = s2.sql(
+        "select g, k, "
+        "sum(v) over (partition by g order by k range between "
+        "5 preceding and 3 following) as ms, "
+        "count(v) over (partition by g order by k range between "
+        "5 preceding and 3 following) as mc "
+        "from rr order by g, k, v").to_pandas()
+    pdf = pd.DataFrame({"g": g, "k": k, "v": v}).sort_values(["g", "k", "v"])
+    want_s, want_c = [], []
+    for _, grp in pdf.groupby("g"):
+        ks, vs = grp["k"].to_numpy(), grp["v"].to_numpy()
+        lo = np.searchsorted(ks, ks - 5, side="left")
+        hi = np.searchsorted(ks, ks + 3, side="right")
+        cs = np.concatenate([[0], np.cumsum(vs)])
+        want_s += (cs[hi] - cs[lo]).tolist()
+        want_c += (hi - lo).tolist()
+    assert df["ms"].tolist() == want_s
+    assert df["mc"].tolist() == want_c
 
 
 def test_rows_frame_oracle_random():
