@@ -19,6 +19,7 @@ resolution and logical planning:
 
 from __future__ import annotations
 
+import copy
 import datetime
 import decimal
 from dataclasses import dataclass, field as dc_field
@@ -389,6 +390,11 @@ class Binder:
     def bind_select(self, sel: ast.Select) -> N.PlanNode:
         if getattr(sel, "grouping_sets", None):
             return self.bind_query(_expand_grouping_sets(sel))
+        if any(_contains_grouping(i.expr) for i in sel.items) \
+                or (sel.having is not None
+                    and _contains_grouping(sel.having)) \
+                or any(_contains_grouping(o.expr) for o in sel.order_by):
+            sel = _fold_plain_grouping(sel)
         scope = Scope()
         plans: dict[str, N.PlanNode] = {}
         post_join_filters: list[ast.ExprNode] = []
@@ -1252,10 +1258,15 @@ class Binder:
                             raise BindError(
                                 f"{func}: default must be a constant")
                         elif _expr_dict(arg) is not None:
-                            raise BindError(
-                                f"{func}: defaults on string arguments "
-                                "are not supported (the default is not "
-                                "in the column's dictionary)")
+                            if db.dtype.base != DType.STRING \
+                                    or not isinstance(db.value, str):
+                                raise BindError(
+                                    f"{func}: default for a string "
+                                    "argument must be a string")
+                            # encode into the argument's dictionary
+                            # (append-only: existing codes unchanged)
+                            db = ex.Literal(
+                                _expr_dict(arg).add(db.value), T.STRING)
                         elif db.dtype.base != arg.dtype.base:
                             db = ex.Cast(db, arg.dtype)
                         dflt = db
@@ -2555,6 +2566,124 @@ def _has_window(node: ast.ExprNode) -> bool:
     return False
 
 
+def _same_key(a, b) -> bool:
+    # qualified and bare references to one column are the same key
+    # (group by rollup(t.region) with a bare 'region' item — binding
+    # would have rejected an ambiguous bare name anyway)
+    if repr(a) == repr(b):
+        return True
+    if isinstance(a, ast.Name) and isinstance(b, ast.Name):
+        return a.parts[-1] == b.parts[-1] \
+            and (len(a.parts) == 1 or len(b.parts) == 1)
+    return False
+
+
+def _rewrite_ast(e, leaf):
+    """Generic expression rewriter: leaf(e) returns a replacement node
+    (possibly e itself, stopping descent) or None to recurse into
+    children. Subqueries are opaque — their grouping context is their
+    own. Shared by the grouping-sets expansion and the plain-GROUP-BY
+    grouping() fold so the child dispatch cannot diverge."""
+    r = leaf(e)
+    if r is not None:
+        return r
+    if not isinstance(e, ast.Node) or isinstance(
+            e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+        return e
+    out = e.__class__(**vars(e))
+    for k, v in vars(e).items():
+        if isinstance(v, ast.ExprNode):
+            setattr(out, k, _rewrite_ast(v, leaf))
+        elif isinstance(v, list):
+            # tuples inside lists = CaseExpr.whens pairs
+            setattr(out, k, [
+                _rewrite_ast(x, leaf) if isinstance(x, ast.ExprNode)
+                else ast.OrderItem(_rewrite_ast(x.expr, leaf),
+                                   x.ascending)
+                if isinstance(x, ast.OrderItem)
+                else tuple(_rewrite_ast(y, leaf)
+                           if isinstance(y, ast.ExprNode) else y
+                           for y in x)
+                if isinstance(x, tuple) else x
+                for x in v])
+    return out
+
+
+def _grouping_key_set(sel: ast.Select) -> list:
+    """The query's grouping expressions: GROUP BY keys plus their
+    select-alias resolutions (GROUP BY r where r aliases region makes
+    region a grouping expression too — the alias path _bind_agg takes)."""
+    alias_map = {i.alias: i.expr for i in sel.items if i.alias}
+    keys = list(sel.group_by)
+    for k in sel.group_by:
+        if isinstance(k, ast.Name) and len(k.parts) == 1 \
+                and k.parts[0] in alias_map:
+            keys.append(alias_map[k.parts[0]])
+    return keys
+
+
+def _check_grouping_args(call, keys):
+    for a in call.args:
+        if not any(_same_key(a, k) for k in keys):
+            raise BindError("arguments to grouping() must be grouping "
+                            "expressions of the query")
+
+
+def _contains_grouping(e) -> bool:
+    if isinstance(e, ast.FuncCall) and e.name == "grouping":
+        return True
+    if not isinstance(e, ast.Node) or isinstance(
+            e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+        return False
+    for v in vars(e).values():
+        if isinstance(v, ast.ExprNode) and _contains_grouping(v):
+            return True
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ast.ExprNode) and _contains_grouping(x):
+                    return True
+                if isinstance(x, ast.OrderItem) \
+                        and _contains_grouping(x.expr):
+                    return True
+                if isinstance(x, tuple) and any(
+                        isinstance(y, ast.ExprNode)
+                        and _contains_grouping(y) for y in x):
+                    return True
+    return False
+
+
+def _fold_plain_grouping(sel: ast.Select) -> ast.Select:
+    """grouping() outside GROUPING SETS: in a plain GROUP BY query every
+    reported key is grouped, so each call folds to the constant 0 after
+    validating its arguments are grouping expressions (PG: "arguments to
+    GROUPING must be grouping expressions of the associated query
+    level", parse_agg.c check_ungrouped_columns role)."""
+    keys = _grouping_key_set(sel)
+
+    def leaf(e):
+        if isinstance(e, ast.FuncCall) and e.name == "grouping":
+            _check_grouping_args(e, keys)
+            return ast.NumberLit("0")
+        return None
+
+    def repl(e):
+        return _rewrite_ast(e, leaf)
+
+    out = copy.copy(sel)  # keeps post-init attrs (e.g. _sql_text)
+    out.items = [ast.SelectItem(repl(i.expr), i.alias) for i in sel.items]
+    if sel.having is not None:
+        out.having = repl(sel.having)
+    out.order_by = []
+    for o in sel.order_by:
+        folded = repl(o.expr)
+        if _contains_grouping(o.expr) and isinstance(folded, ast.NumberLit):
+            # a constant key cannot affect the order — and a bare number
+            # would re-parse as a positional column reference
+            continue
+        out.order_by.append(ast.OrderItem(folded, o.ascending))
+    return out
+
+
 def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
     """GROUPING SETS / ROLLUP / CUBE → UNION ALL of per-set aggregations
     (the nodeAgg.c grouping-sets role translated to plan algebra): each
@@ -2564,33 +2693,20 @@ def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
     per set matches the reference's multi-phase grouping-sets plan shape;
     the shared scan dedups through the statement-level plan, not here."""
     all_keys = list(sel.group_by)
-
-    def _same_key(a, b) -> bool:
-        # qualified and bare references to one column are the same key
-        # (group by rollup(t.region) with a bare 'region' item — binding
-        # would have rejected an ambiguous bare name anyway)
-        if repr(a) == repr(b):
-            return True
-        if isinstance(a, ast.Name) and isinstance(b, ast.Name):
-            return a.parts[-1] == b.parts[-1] \
-                and (len(a.parts) == 1 or len(b.parts) == 1)
-        return False
-
+    grouping_keys = _grouping_key_set(sel)
     branches = []
     for gset in sel.grouping_sets:
         omitted = [k for k in all_keys
                    if not any(_same_key(k, g) for g in gset)]
 
-        def repl(e, omitted=omitted):
+        def leaf(e, omitted=omitted):
             if any(_same_key(e, o) for o in omitted):
                 return ast.NullLit()
-            if not isinstance(e, ast.Node) or isinstance(
-                    e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
-                return e
             if isinstance(e, ast.FuncCall) and e.name == "grouping":
                 # grouping(a, b) -> bitmask: bit i set where arg i is
                 # NOT part of this branch's grouping set — a per-branch
                 # CONSTANT, which is the whole point of the rewrite
+                _check_grouping_args(e, grouping_keys)
                 bits = 0
                 for a in e.args:
                     bits = (bits << 1) | int(
@@ -2601,21 +2717,10 @@ def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
                 # grand-total row counts all non-NULL regions — the key
                 # is NULL only as a GROUP LABEL, never inside aggregation
                 return e
-            out = e.__class__(**vars(e))
-            for k, v in vars(e).items():
-                if isinstance(v, ast.ExprNode):
-                    setattr(out, k, repl(v))
-                elif isinstance(v, list):
-                    # tuples inside lists = CaseExpr.whens pairs
-                    setattr(out, k, [
-                        repl(x) if isinstance(x, ast.ExprNode)
-                        else ast.OrderItem(repl(x.expr), x.ascending)
-                        if isinstance(x, ast.OrderItem)
-                        else tuple(repl(y) if isinstance(y, ast.ExprNode)
-                                   else y for y in x)
-                        if isinstance(x, tuple) else x
-                        for x in v])
-            return out
+            return None
+
+        def repl(e, leaf=leaf):
+            return _rewrite_ast(e, leaf)
 
         items = [ast.SelectItem(repl(i.expr),
                                 i.alias or _default_name(i.expr))

@@ -137,6 +137,42 @@ def test_grouping_function(s):
     assert [r[1] for r in _norm(df)] == [0, 0, 1]
 
 
+def test_grouping_outside_grouping_sets(s):
+    """grouping() is valid in any grouped query (PG): in a plain GROUP
+    BY every key is grouped, so it folds to the constant 0."""
+    df = s.sql("select region, grouping(region) as g, sum(amount) as t "
+               "from sales group by region order by region").to_pandas()
+    assert _norm(df) == [["east", 0, 45], ["west", 0, 95]]
+    df = s.sql("select region, grouping(region, region) as g from sales "
+               "group by region having grouping(region) = 0 "
+               "order by grouping(region), region").to_pandas()
+    assert [r[1] for r in _norm(df)] == [0, 0]
+
+
+def test_grouping_arg_must_be_grouped(s):
+    from cloudberry_tpu.plan.binder import BindError
+
+    with pytest.raises(BindError, match="grouping expressions"):
+        s.sql("select region, grouping(amount) from sales "
+              "group by region")
+    # no GROUP BY at all: nothing is a grouping expression
+    with pytest.raises(BindError, match="grouping expressions"):
+        s.sql("select grouping(region) from sales")
+    # same rule inside GROUPING SETS (the fold would otherwise silently
+    # return a wrong constant)
+    with pytest.raises(BindError, match="grouping expressions"):
+        s.sql("select grouping(amount), sum(amount) from sales "
+              "group by rollup(region)")
+
+
+def test_grouping_through_select_alias(s):
+    # GROUP BY r where r aliases region: region IS a grouping expression
+    df = s.sql("select region as r, grouping(region) as g, "
+               "sum(amount) as t from sales group by r "
+               "order by r").to_pandas()
+    assert _norm(df) == [["east", 0, 45], ["west", 0, 95]]
+
+
 def test_rollup_key_inside_case(s):
     """Omitted keys replace inside CASE WHEN tuples too — the grand
     total's CASE sees NULL and takes the ELSE branch."""

@@ -99,6 +99,18 @@ def test_lag_zero_offset(s):
     assert out == [1, 2, 3, 1, 2, 1]
 
 
+def test_lead_lag_string_default(s):
+    # the default encodes into the argument's dictionary (append-only)
+    out = col(s, "select lead(s, 1, 'none') over (partition by g "
+                 "order by o) as x from w order by g, o", "x")
+    assert out == ["y", None, "none", "q", "none", "none"]
+    out = col(s, "select lag(s, 2, '<pad>') over (partition by g "
+                 "order by o) as x from w order by g, o", "x")
+    assert out == ["<pad>", "<pad>", "x", "<pad>", "<pad>", "<pad>"]
+    with pytest.raises(BindError, match="must be a string"):
+        s.sql("select lead(s, 1, 42) over (order by o) from w")
+
+
 def test_lead_requires_constant_offset(s):
     with pytest.raises(BindError):
         s.sql("select lead(o, o) over (order by o) from w")
