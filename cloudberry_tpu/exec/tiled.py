@@ -59,19 +59,25 @@ class _AccLeaf(N.PlanNode):
 
 @dataclass
 class _TileShape:
-    """Everything the rewrite discovered about the plan."""
+    """Everything the rewrite discovered about the plan. Two modes:
+    "agg" streams into a partial-aggregation accumulator; "topn" streams
+    into a fixed top-N row accumulator (ORDER BY + LIMIT over the spine,
+    no aggregation — the tuplesort bounded-heap analog, nodeSort.c
+    bounded mode)."""
 
-    agg: N.PAgg                       # the streamed aggregation
-    post: list[N.PlanNode]            # chain above agg, root first
+    agg: Optional[N.PAgg]             # the streamed aggregation (agg mode)
+    post: list[N.PlanNode]            # chain above agg/sort, root first
     spine: list[N.PlanNode]           # agg.child .. just above the stream
     stream: N.PScan                   # the tiled scan
     builds: list[N.PlanNode]          # spine joins' build subtrees
     stream_rows: int = 0              # whole-stream rows (floor scaling)
-    partial_plan: N.PAgg = None       # type: ignore[assignment]
+    partial_plan: N.PlanNode = None   # type: ignore[assignment]
     merge_specs: list = field(default_factory=list)
     finalize: dict = field(default_factory=dict)
     root: N.PlanNode = None           # type: ignore[assignment]
-    g_cap: int = 0                    # accumulator (merged groups) capacity
+    g_cap: int = 0                    # accumulator capacity (groups / rows)
+    mode: str = "agg"
+    sortnode: Optional[N.PSort] = None  # topn: the bounding sort
 
 
 def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
@@ -95,6 +101,8 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
     for node in shape.spine:
         if isinstance(node, N.PJoin) and hasattr(node, "_min_out_cap"):
             del node._min_out_cap
+    if shape.mode == "topn":
+        return _plan_topn(shape, session)
     try:
         partial_aggs, final_aggs, finalize = _split_aggs(shape.agg.aggs)
     except ValueError:
@@ -142,26 +150,96 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
     return TiledExecutable(shape, session, tile_rows, budget)
 
 
+def _plan_topn(shape: _TileShape, session) -> Optional["TopNTiledExecutable"]:
+    """Top-N streaming: the accumulator holds the best LIMIT+OFFSET rows
+    of the sort's child so far; each tile merges through one bounding
+    sort (tuplesort bounded-heap role, nodeSort.c). The post chain above
+    the sort (LIMIT, projections) finalizes over the sorted accumulator."""
+    sort = shape.sortnode
+    shape.partial_plan = sort.child
+    budget = session.config.resource.query_mem_bytes
+    tile_rows = _choose_tile(shape, budget)
+    if tile_rows is None:
+        return None  # LIMIT too large for a resident accumulator
+
+    # merge program plan: bounding sort over (acc ∪ tile output)
+    mleaf = _AccLeaf()
+    mleaf.fields = list(sort.child.fields)
+    msort = N.PSort(mleaf, list(sort.keys))
+    msort.fields = list(mleaf.fields)
+    shape.finalize = {"mleaf": mleaf, "msort": msort}
+
+    # finalize plan: (sorted acc leaf) -> original post chain above sort
+    fleaf = _AccLeaf()
+    fleaf.fields = list(sort.child.fields)
+    shape.post[-1].child = fleaf  # post is non-empty: the LIMIT lives there
+    shape.root = shape.post[0]
+    return TopNTiledExecutable(shape, session, tile_rows, budget)
+
+
+def _topn_bound(chain: list, skip: tuple = ()):
+    """Locate a topn-streamable post chain's bounding sort and LIMIT: the
+    LOWEST sort, fed only by projections/filters (part of the stream),
+    with a LIMIT above it separated only by projections and ``skip``
+    nodes (gather motions, distributed). An interposed SORT breaks the
+    walk — a limit above a different sort bounds THAT order, not this
+    one's — and a filter above the sort could starve the limit of rows
+    the accumulator already dropped. Returns (sortnode, limit+offset) or
+    None. Shared by the single-node and distributed analyzers so the
+    recognizers cannot drift."""
+    sort_i = next((i for i in range(len(chain) - 1, -1, -1)
+                   if isinstance(chain[i], N.PSort)), None)
+    if sort_i is None:
+        return None
+    if any(not isinstance(n, (N.PProject, N.PFilter))
+           for n in chain[sort_i + 1:]):
+        return None
+    m = None
+    for n in reversed(chain[:sort_i]):
+        if isinstance(n, (N.PProject,) + skip):
+            continue
+        if isinstance(n, N.PLimit):
+            m = n.limit + n.offset
+        break
+    if m is None or m <= 0:
+        return None
+    return chain[sort_i], m
+
+
 def _analyze(plan: N.PlanNode) -> Optional[_TileShape]:
-    """Recognize the streamable shape: post chain over one aggregation over
-    a join/filter spine whose probe path ends at a scan."""
+    """Recognize a streamable shape: a post chain over either one
+    aggregation ("agg") or one bounding ORDER BY + LIMIT ("topn"), over a
+    join/filter spine whose probe path ends at a scan."""
     for e in _all_exprs(plan):
         for sub in ex.walk(e):
             if isinstance(sub, ex.SubqueryScalar):
                 return None  # subquery plans scan outside the spine budget
 
-    post: list[N.PlanNode] = []
+    chain: list[N.PlanNode] = []
     cur = plan
     while isinstance(cur, (N.PProject, N.PSort, N.PLimit, N.PFilter)):
-        post.append(cur)
+        chain.append(cur)
         cur = cur.child
-    if not isinstance(cur, N.PAgg) or cur.mode != "single":
-        return None
-    agg = cur
+
+    agg: Optional[N.PAgg] = None
+    sortnode: Optional[N.PSort] = None
+    post: list[N.PlanNode] = []
+    m = 0
+    if isinstance(cur, N.PAgg) and cur.mode == "single":
+        agg = cur
+        post = chain
+        spine_top = agg.child
+    else:
+        hit = _topn_bound(chain)
+        if hit is None:
+            return None  # unbounded sort: no fixed accumulator exists
+        sortnode, m = hit
+        post = chain[:chain.index(sortnode)]
+        spine_top = sortnode.child
 
     spine: list[N.PlanNode] = []
     builds: list[N.PlanNode] = []
-    cur = agg.child
+    cur = spine_top
     while True:
         if isinstance(cur, (N.PFilter, N.PProject)):
             spine.append(cur)
@@ -179,8 +257,13 @@ def _analyze(plan: N.PlanNode) -> Optional[_TileShape]:
             cur = cur.probe
         elif isinstance(cur, N.PScan) and cur.table_name != "$dual":
             rows = cur.num_rows if cur.num_rows >= 0 else cur.capacity
-            return _TileShape(agg, post, spine, cur, builds,
-                              stream_rows=max(rows, 1))
+            shape = _TileShape(agg, post, spine, cur, builds,
+                               stream_rows=max(rows, 1))
+            if agg is None:
+                shape.mode = "topn"
+                shape.sortnode = sortnode
+                shape.g_cap = m
+            return shape
         else:
             return None
 
@@ -206,7 +289,8 @@ def _retile(shape: _TileShape, tile_rows: int) -> None:
             elif not node.unique_build:
                 node.out_capacity = max(bcap + cap, floor)
                 cap = node.out_capacity
-    shape.partial_plan.capacity = min(shape.g_cap, max(cap, 1))
+    if shape.agg is not None:
+        shape.partial_plan.capacity = min(shape.g_cap, max(cap, 1))
 
 
 def _out_cap(node: N.PlanNode) -> int:
@@ -229,11 +313,18 @@ def _acc_width(shape: _TileShape) -> int:
                    for f in shape.partial_plan.fields)
 
 
+def _step_out_cap(shape) -> int:
+    """Rows one tile's step can emit into the merge (shape is the single
+    or distributed tile shape — both carry mode/partial_plan)."""
+    return shape.partial_plan.capacity if shape.mode == "agg" \
+        else _out_cap(shape.partial_plan)
+
+
 def _merge_bytes(shape: _TileShape) -> int:
-    """Accumulator + merge working set: the concat of acc and partial rows
-    flowing through one sort-based group_aggregate."""
-    return 3 * (shape.g_cap + shape.partial_plan.capacity) \
-        * _acc_width(shape)
+    """Accumulator + merge working set: the concat of acc and per-tile
+    rows flowing through one sort-based group_aggregate (agg mode) or
+    one bounding sort (topn mode)."""
+    return 3 * (shape.g_cap + _step_out_cap(shape)) * _acc_width(shape)
 
 
 def _choose_tile(shape: _TileShape, budget: int) -> Optional[int]:
@@ -562,6 +653,78 @@ class TiledExecutable(AdaptiveTiledMixin):
         self.report["n_tiles"] = n_tiles
         self.session.last_tiled_report = dict(self.report)
         return X.make_batch(self.shape.root, cols, sel)
+
+
+class TopNTiledExecutable(TiledExecutable):
+    """Tiled statement whose accumulator is the best LIMIT+OFFSET rows
+    seen so far (nodeSort.c bounded-heap role): step = spine over one
+    tile, then one bounding sort over (accumulator ∪ tile rows), keeping
+    the first g_cap positions — selected rows sort first, so the slice
+    is exactly the running top-N. Finalize runs the original post chain
+    (LIMIT/projections) over the sorted accumulator."""
+
+    _what = "top-N tiled execution"
+
+    def _groups_ceiling(self) -> int:
+        return self.shape.g_cap  # fixed: LIMIT itself bounds the acc
+
+    def _init_acc(self):
+        shape = self.shape
+        cols = {f.name: jnp.zeros((shape.g_cap,), dtype=f.type.np_dtype)
+                for f in shape.partial_plan.fields}
+        return cols, jnp.zeros((shape.g_cap,), dtype=jnp.bool_)
+
+    def _refresh_report(self) -> None:
+        super()._refresh_report()
+        self.report["mode"] = "topn"
+
+    def _compile(self):
+        if self._compiled is not None:
+            return self._compiled
+        shape = self.shape
+        plat, pallas = self._platform, self._use_pallas
+        m = shape.g_cap
+        mleaf, msort = shape.finalize["mleaf"], shape.finalize["msort"]
+        names = [f.name for f in shape.partial_plan.fields]
+
+        def prelude_fn(tables):
+            low = X.Lowerer(tables, platform=plat, use_pallas=pallas)
+            outs = [low.lower_shared(b) for b in shape.builds]
+            return outs, low.checks
+
+        def step_fn(resident, prelude, tile, tile_n, acc):
+            tables = dict(resident)
+            tables["$tile"] = tile
+            replace = {id(b): prelude[i]
+                       for i, b in enumerate(shape.builds)}
+            low = _TileLowerer(tables, shape.stream, tile_n, replace,
+                               platform=plat, use_pallas=pallas)
+            pcols, psel = low.lower(shape.partial_plan)
+            checks = dict(low.checks)
+            acc_cols, acc_sel = acc
+            ccols = {n: jnp.concatenate([acc_cols[n], pcols[n]])
+                     for n in names}
+            csel = jnp.concatenate([acc_sel, psel])
+            low2 = _ReplacingLowerer({}, {id(mleaf): (ccols, csel)},
+                                     platform=plat, use_pallas=pallas)
+            scols, ssel = low2.lower(msort)
+            checks.update(low2.checks)
+            return ({n: scols[n][:m] for n in names}, ssel[:m]), checks
+
+        def finalize_fn(acc):
+            acc_cols, acc_sel = acc
+            low = _ReplacingLowerer(
+                {}, {id(_leaf_of(shape.root)): (acc_cols, acc_sel)},
+                platform=plat, use_pallas=pallas)
+            cols, sel = low.lower(shape.root)
+            out = {f.name: cols[f.name] for f in shape.root.fields}
+            return out, sel, low.checks
+
+        donate = () if self._platform == "cpu" else (4,)
+        self._compiled = (jax.jit(prelude_fn),
+                          jax.jit(step_fn, donate_argnums=donate),
+                          jax.jit(finalize_fn))
+        return self._compiled
 
 
 def _leaf_of(root: N.PlanNode) -> N.PlanNode:

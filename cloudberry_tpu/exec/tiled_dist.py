@@ -76,6 +76,9 @@ class _DistTileShape:
     group_names: list = field(default_factory=list)
     g_cap: int = 0                   # per-segment accumulator capacity
     max_groups: int = 0              # hard ceiling for g_cap growth
+    mode: str = "agg"
+    sortnode: Optional[N.PSort] = None  # topn: the bounding sort
+    post: list = field(default_factory=list)  # topn: chain above spine
 
 
 def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"]:
@@ -97,22 +100,25 @@ def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"
         if isinstance(node, N.PJoin) and hasattr(node, "_min_out_cap"):
             del node._min_out_cap
 
-    from cloudberry_tpu.plan.cost import estimate_rows
+    if shape.mode == "agg":
+        from cloudberry_tpu.plan.cost import estimate_rows
 
-    try:
-        est_groups = estimate_rows(shape.partial_plan, session.catalog)
-    except Exception:
-        est_groups = 1024
-    shape.g_cap = int(min(shape.max_groups,
-                          max(1024, 4 * int(est_groups) + 1)))
-    if not shape.group_names:
-        shape.g_cap = 1
+        try:
+            est_groups = estimate_rows(shape.partial_plan, session.catalog)
+        except Exception:
+            est_groups = 1024
+        shape.g_cap = int(min(shape.max_groups,
+                              max(1024, 4 * int(est_groups) + 1)))
+        if not shape.group_names:
+            shape.g_cap = 1
 
     budget = session.config.resource.query_mem_bytes
     tile_rows = _choose_tile_dist(shape, budget, session.config.n_segments)
     if tile_rows is None:
         return None
-    return DistTiledExecutable(shape, session, tile_rows, budget)
+    cls = DistTopNTiledExecutable if shape.mode == "topn" \
+        else DistTiledExecutable
+    return cls(shape, session, tile_rows, budget)
 
 
 def _analyze_dist(plan: N.PlanNode, session) -> Optional[_DistTileShape]:
@@ -138,7 +144,7 @@ def _analyze_dist(plan: N.PlanNode, session) -> Optional[_DistTileShape]:
         else:
             break
     if not isinstance(cur, N.PAgg):
-        return None
+        return _analyze_dist_topn(plan, post, session)
 
     if cur.mode == "final":
         final_agg = cur
@@ -205,6 +211,36 @@ def _analyze_dist(plan: N.PlanNode, session) -> Optional[_DistTileShape]:
         builds=builds, stream_rows=stream_rows, merge_specs=merge_specs,
         group_names=[n for n, _ in agg.group_keys],
         max_groups=agg.capacity)
+
+
+def _analyze_dist_topn(plan, post, session) -> Optional[_DistTileShape]:
+    """ORDER BY + LIMIT with no aggregation: per-segment bounded top-N
+    accumulators (the distributed twin of tiled.py's topn mode). Every
+    segment keeps the best LIMIT+OFFSET rows of ITS stream — the global
+    top-N is a subset of that union — and finalize re-runs the ORIGINAL
+    plan (pre-gather compaction, gather, sorts, limits) over the
+    accumulators as one SPMD program."""
+    from cloudberry_tpu.exec.tiled import _topn_bound
+
+    # motions in the chain are gathers (the walk guarantees): row-set-
+    # preserving, so the limit search may cross them
+    hit = _topn_bound(post, skip=(N.PMotion,))
+    if hit is None:
+        return None
+    sortnode, m = hit
+    spine_res = _walk_spine(sortnode.child, session)
+    if spine_res is None:
+        return None
+    spine, stream, builds, stream_rows = spine_res
+    shape = _DistTileShape(
+        root=plan, replace_node=sortnode.child,
+        partial_plan=sortnode.child, merge_motion=None, final_agg=None,
+        spine=spine, stream=stream, builds=builds,
+        stream_rows=stream_rows, mode="topn", sortnode=sortnode,
+        post=post)
+    shape.g_cap = m
+    shape.max_groups = m
+    return shape
 
 
 def _walk_spine(top: N.PlanNode, session):
@@ -275,16 +311,22 @@ def _retile_dist(shape: _DistTileShape, tile_rows: int, nseg: int) -> None:
             elif not node.unique_build:
                 node.out_capacity = max(bcap + cap, floor)
                 cap = node.out_capacity
-    shape.partial_plan.capacity = min(shape.g_cap, max(cap, 1)) \
-        if shape.group_names else 1
+    if shape.mode == "agg":
+        shape.partial_plan.capacity = min(shape.g_cap, max(cap, 1)) \
+            if shape.group_names else 1
 
 
 def _finalize_bytes(shape: _DistTileShape, nseg: int) -> int:
     """Working set of the one-shot finalize program per segment: the merge
     motion's receive buffer and final aggregation both hold up to
     nseg·g_cap accumulator rows (one g_cap block from every segment); the
-    colocated one-stage case never leaves the segment."""
-    rows = shape.g_cap * (nseg if shape.merge_motion is not None else 1)
+    colocated one-stage case never leaves the segment. topn finalize
+    gathers every segment's accumulator for the global sort."""
+    if shape.mode == "topn":
+        rows = shape.g_cap * nseg
+    else:
+        rows = shape.g_cap * (nseg if shape.merge_motion is not None
+                              else 1)
     return 3 * rows * _acc_width(shape)
 
 
@@ -435,6 +477,27 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         prelude_fn = jax.jit(_shard_map(
             prelude_seg, mesh, (res_specs,), (P(SEG_AXIS), P())))
 
+        step_fn = self._make_step(mesh, tx, res_specs)
+
+        def finalize_seg(acc):
+            acc_cols, acc_sel = _strip_seg(tuple(acc))
+            low = _DistReplacingLowerer(
+                {}, nseg, {id(shape.replace_node): (acc_cols, acc_sel)},
+                use_pallas=self._use_pallas, tx=tx)
+            cols, sel = low.lower(shape.root)
+            out = {f.name: cols[f.name][None] for f in shape.root.fields}
+            return out, sel[None], _reduce_checks(low.checks)
+
+        finalize_fn = jax.jit(_shard_map(
+            finalize_seg, mesh, (P(SEG_AXIS),),
+            (P(SEG_AXIS), P(SEG_AXIS), P())))
+
+        self._compiled = (prelude_fn, step_fn, finalize_fn)
+        return self._compiled
+
+    def _make_step(self, mesh, tx, res_specs):
+        shape = self.shape
+        nseg = self.nseg
         group_names = list(shape.group_names)
         specs = shape.merge_specs
 
@@ -473,30 +536,17 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             return _add_seg((out, jnp.ones((1,), dtype=jnp.bool_))), \
                 _reduce_checks(checks)
 
+        return self._jit_step(step_seg, mesh, res_specs)
+
+    def _jit_step(self, step_seg, mesh, res_specs):
         step_in = (res_specs, P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS),
                    P(SEG_AXIS))
         # donate the accumulator so the step updates in place on device;
         # CPU XLA can't always honor donation — skip the warning noise
         donate = () if jax.default_backend() == "cpu" else (4,)
-        step_fn = jax.jit(_shard_map(step_seg, mesh, step_in,
-                                     (P(SEG_AXIS), P())),
-                          donate_argnums=donate)
-
-        def finalize_seg(acc):
-            acc_cols, acc_sel = _strip_seg(tuple(acc))
-            low = _DistReplacingLowerer(
-                {}, nseg, {id(shape.replace_node): (acc_cols, acc_sel)},
-                use_pallas=self._use_pallas, tx=tx)
-            cols, sel = low.lower(shape.root)
-            out = {f.name: cols[f.name][None] for f in shape.root.fields}
-            return out, sel[None], _reduce_checks(low.checks)
-
-        finalize_fn = jax.jit(_shard_map(
-            finalize_seg, mesh, (P(SEG_AXIS),),
-            (P(SEG_AXIS), P(SEG_AXIS), P())))
-
-        self._compiled = (prelude_fn, step_fn, finalize_fn)
-        return self._compiled
+        return jax.jit(_shard_map(step_seg, mesh, step_in,
+                                  (P(SEG_AXIS), P())),
+                       donate_argnums=donate)
 
     def _refinalize(self) -> None:
         """Size the merge boundary for the accumulator: a segment's acc has
@@ -576,6 +626,77 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         self.session.last_tiled_report = dict(self.report)
         host_cols = {k: _local_row(v) for k, v in cols.items()}
         return X.make_batch(self.shape.root, host_cols, _local_row(sel))
+
+
+class DistTopNTiledExecutable(DistTiledExecutable):
+    """Distributed tiled statement with per-segment bounded top-N row
+    accumulators (tiled.py TopNTiledExecutable on the mesh): each
+    segment's step merges its tile through one LOCAL bounding sort — no
+    collectives beyond the spine's own motions — and finalize re-runs
+    the original plan (pre-gather compaction, gather, global sort,
+    LIMIT) over the accumulators."""
+
+    _what = "distributed top-N tiled execution"
+
+    def _groups_ceiling(self) -> int:
+        return self.shape.g_cap  # fixed: LIMIT itself bounds the acc
+
+    def _refresh_report(self) -> None:
+        super()._refresh_report()
+        self.report["mode"] = "topn"
+
+    def _refinalize(self) -> None:
+        # finalize re-runs the original post chain over m-row
+        # accumulators: gather receive buffers were sized for the full
+        # stream, shrink them to nseg·m
+        shape = self.shape
+        for node in shape.post:
+            if isinstance(node, N.PMotion):
+                node.out_capacity = shape.g_cap * self.nseg
+
+    def _init_acc(self):
+        shape = self.shape
+        cols = {f.name: np.zeros((self.nseg, shape.g_cap),
+                                 dtype=f.type.np_dtype)
+                for f in shape.partial_plan.fields}
+        return cols, np.zeros((self.nseg, shape.g_cap), dtype=np.bool_)
+
+    def _make_step(self, mesh, tx, res_specs):
+        from cloudberry_tpu.exec.tiled import _AccLeaf
+
+        shape = self.shape
+        nseg = self.nseg
+        m = shape.g_cap
+        mleaf = _AccLeaf()
+        mleaf.fields = list(shape.partial_plan.fields)
+        msort = N.PSort(mleaf, list(shape.sortnode.keys))
+        msort.fields = list(mleaf.fields)
+        names = [f.name for f in shape.partial_plan.fields]
+
+        def step_seg(resident, prelude, tile, tile_n, acc):
+            tables = dict(resident)
+            tables["$tile"] = _strip_seg(tile)
+            plocal = _strip_seg(prelude)
+            replace = {id(b): tuple(plocal[i])
+                       for i, b in enumerate(shape.builds)}
+            low = _DistTileLowerer(tables, nseg, shape.stream,
+                                   tile_n.reshape(()), replace,
+                                   use_pallas=self._use_pallas, tx=tx)
+            pcols, psel = low.lower(shape.partial_plan)
+            checks = dict(low.checks)
+            acc_cols, acc_sel = _strip_seg(tuple(acc))
+            ccols = {n: jnp.concatenate([acc_cols[n], pcols[n]])
+                     for n in names}
+            csel = jnp.concatenate([acc_sel, psel])
+            low2 = _DistReplacingLowerer(
+                {}, nseg, {id(mleaf): (ccols, csel)},
+                use_pallas=self._use_pallas, tx=tx)
+            scols, ssel = low2.lower(msort)
+            checks.update(low2.checks)
+            return _add_seg(({n: scols[n][:m] for n in names},
+                             ssel[:m])), _reduce_checks(checks)
+
+        return self._jit_step(step_seg, mesh, res_specs)
 
 
 # -------------------------------------------------------------- tile feed

@@ -161,6 +161,52 @@ def test_tiled_streams_cold_storage(tmp_path):
     assert s2.catalog.table("fact").cold
 
 
+TOPN_Q = ("SELECT fact.k AS k, v, g FROM fact JOIN dim ON fact.k = dim.k "
+          "WHERE v < 90 ORDER BY v, fact.k, g LIMIT 25")
+
+
+def test_tiled_topn_matches_in_memory():
+    """ORDER BY + LIMIT over a join spine with no aggregation: streams
+    through a bounded top-N accumulator (nodeSort.c bounded-heap role)."""
+    big = _mk()
+    _load(big)
+    exp = big.sql(TOPN_Q).to_pandas()
+    assert big.last_tiled_report is None  # in-memory baseline
+
+    s = _mk(budget=4 << 20)
+    _load(s)
+    got = s.sql(TOPN_Q).to_pandas()
+    assert exp.equals(got)
+    rep = s.last_tiled_report
+    assert rep["tiled"] and rep["n_tiles"] > 1
+    assert rep["mode"] == "topn"
+    assert rep["acc_capacity"] == 25
+    assert rep["est_step_bytes"] <= rep["budget_bytes"] == 4 << 20
+
+
+def test_tiled_topn_offset_and_desc():
+    big = _mk()
+    _load(big)
+    q = ("SELECT v, fact.k AS k FROM fact JOIN dim ON fact.k = dim.k "
+         "ORDER BY v DESC, fact.k DESC LIMIT 10 OFFSET 7")
+    exp = big.sql(q).to_pandas()
+    s = _mk(budget=4 << 20)
+    _load(s)
+    got = s.sql(q).to_pandas()
+    assert exp.equals(got)
+    rep = s.last_tiled_report
+    assert rep["mode"] == "topn" and rep["acc_capacity"] == 17
+
+
+def test_tiled_topn_empty_result():
+    s = _mk(budget=4 << 20)
+    _load(s)
+    got = s.sql("SELECT v FROM fact JOIN dim ON fact.k = dim.k "
+                "WHERE v < 0 ORDER BY v LIMIT 5").to_pandas()
+    assert len(got) == 0
+    assert s.last_tiled_report["mode"] == "topn"
+
+
 def test_tpch_q5_q9_tiled():
     """VERDICT round-1 done-criterion: TPC-H join-heavy queries complete
     under an artificially small budget with in-budget tiles."""

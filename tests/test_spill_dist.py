@@ -129,6 +129,45 @@ def test_dist_spill_disabled_refuses():
         s.sql(JOIN_GROUP_Q)
 
 
+TOPN_Q = ("SELECT fact.k AS k, fact.d AS d, v, g FROM fact JOIN dim "
+          "ON fact.d = dim.d WHERE v < 90 "
+          "ORDER BY v, fact.k, fact.d, g LIMIT 25")
+
+
+def test_dist_tiled_topn_matches_in_memory():
+    """ORDER BY + LIMIT over a redistribute-join spine with no
+    aggregation: per-segment bounded top-N accumulators, finalize through
+    the original gather + global sort."""
+    big = _mk()
+    _load(big)
+    exp = big.sql(TOPN_Q).to_pandas()
+    assert big.last_tiled_report is None
+
+    s = _mk(budget=12 << 20)
+    _load(s)
+    got = s.sql(TOPN_Q).to_pandas()
+    assert exp.equals(got)
+    rep = s.last_tiled_report
+    assert rep["tiled"] and rep["distributed"] and rep["n_tiles"] > 1
+    assert rep["mode"] == "topn"
+    assert rep["acc_capacity"] == 25
+    assert rep["est_step_bytes"] <= rep["budget_bytes"]
+
+
+def test_dist_tiled_topn_offset():
+    big = _mk()
+    _load(big)
+    q = ("SELECT v, fact.k AS k FROM fact JOIN dim ON fact.d = dim.d "
+         "ORDER BY v DESC, fact.k DESC, fact.d DESC LIMIT 10 OFFSET 5")
+    exp = big.sql(q).to_pandas()
+    s = _mk(budget=6 << 20)
+    _load(s)
+    got = s.sql(q).to_pandas()
+    assert exp.equals(got)
+    rep = s.last_tiled_report
+    assert rep["mode"] == "topn" and rep["acc_capacity"] == 15
+
+
 def test_tpch_q5_q9_tiled_distributed():
     """The round-2 done-criterion: admission-rejected Q5/Q9-shape queries
     complete on the 8-device mesh under a small per-segment budget with
