@@ -54,6 +54,11 @@ class PlannerConfig:
     # (estimated) row count is below this (reference: cdbpath_motion_for_join
     # cdbpath.c:1346 chooses broadcast vs redistribute by cost).
     broadcast_threshold: int = 100_000
+    # Cascades-lite memo exploration (plan/memo.py, the gporca role): cost
+    # and compare motion strategies over whole join trees — including the
+    # GROUP BY's final redistribute — instead of deciding greedily per
+    # join. Off falls back to the cdbpath.c-style rules alone.
+    enable_memo: bool = True
     # Prune dispatch to a single segment for point predicates on the
     # distribution key (reference: cdbtargeteddispatch.c).
     enable_direct_dispatch: bool = True

@@ -121,7 +121,19 @@ def apply_direct_dispatch(plan: N.PlanNode, session, seg: int) -> N.PlanNode:
     return plan
 
 
+def broadcast_struct_rows(thr: int) -> int:
+    """Structural ceiling on a replicated build buffer (rows × nseg) for
+    memo-chosen broadcasts: the memo may broadcast ABOVE the greedy
+    threshold when it is globally cheaper, but a misestimate must never
+    allocate an unbounded replicated buffer."""
+    return max(thr, 65536) * 16
+
+
 def distribute_plan(plan: N.PlanNode, session) -> N.PlanNode:
+    if session.config.planner.enable_memo:
+        from cloudberry_tpu.plan.memo import annotate_distribution
+
+        annotate_distribution(plan, session)
     d = Distributor(session)
     plan, cap = d.walk(plan)
     if plan.sharding.is_partitioned:
@@ -452,30 +464,52 @@ class Distributor:
             # data — cap it structurally so a misestimate can never allocate
             # an unbounded replicated buffer
             thr = self.cfg.planner.broadcast_threshold
-            if est_build_rows <= thr and bcap * self.nseg <= max(thr, 1) * 16:
-                build, bcap = self.broadcast(build, bcap)
-            else:
-                bsub = _hashed_key_positions(bsh, node.build_keys)
-                psub = _hashed_key_positions(psh, node.probe_keys)
-                if bsub is not None:
-                    probe, est = self._maybe_runtime_filter(
-                        node, build, probe, est_build_rows, est_semi_rows)
-                    probe, pcap = self.redistribute(
-                        probe, pcap, [node.probe_keys[i] for i in bsub],
-                        est_rows=est)
+            bsub = _hashed_key_positions(bsh, node.build_keys)
+            psub = _hashed_key_positions(psh, node.probe_keys)
+            # the memo explorer (plan/memo.py) may have stamped the
+            # globally cheapest strategy; honor it after re-checking its
+            # preconditions (the plan may have drifted since), else fall
+            # back to the greedy per-node rules
+            choice = getattr(node, "_dist_choice", None)
+            if choice == "broadcast" and not (
+                    thr > 0
+                    and bcap * self.nseg <= broadcast_struct_rows(thr)):
+                choice = None
+            if choice == "redist_probe" and bsub is None:
+                choice = None
+            if choice == "redist_build" and psub is None:
+                choice = None
+            if choice in (None, "colocate"):
+                if est_build_rows <= thr \
+                        and bcap * self.nseg <= max(thr, 1) * 16:
+                    choice = "broadcast"
+                elif bsub is not None:
+                    choice = "redist_probe"
                 elif psub is not None:
-                    build, bcap = self.redistribute(
-                        build, bcap, [node.build_keys[i] for i in psub])
+                    choice = "redist_build"
                 else:
-                    build_src = build
-                    build, bcap = self.redistribute(build, bcap,
-                                                    list(node.build_keys))
-                    probe, est = self._maybe_runtime_filter(
-                        node, build_src, probe, est_build_rows,
-                        est_semi_rows)
-                    probe, pcap = self.redistribute(probe, pcap,
-                                                    list(node.probe_keys),
-                                                    est_rows=est)
+                    choice = "redist_both"
+            if choice == "broadcast":
+                build, bcap = self.broadcast(build, bcap)
+            elif choice == "redist_probe":
+                probe, est = self._maybe_runtime_filter(
+                    node, build, probe, est_build_rows, est_semi_rows)
+                probe, pcap = self.redistribute(
+                    probe, pcap, [node.probe_keys[i] for i in bsub],
+                    est_rows=est)
+            elif choice == "redist_build":
+                build, bcap = self.redistribute(
+                    build, bcap, [node.build_keys[i] for i in psub])
+            else:  # redist_both
+                build_src = build
+                build, bcap = self.redistribute(build, bcap,
+                                                list(node.build_keys))
+                probe, est = self._maybe_runtime_filter(
+                    node, build_src, probe, est_build_rows,
+                    est_semi_rows)
+                probe, pcap = self.redistribute(probe, pcap,
+                                                list(node.probe_keys),
+                                                est_rows=est)
         elif b_part and not p_part:
             if node.kind in ("inner", "semi"):
                 # probe replicated/singleton, build partitioned: each segment

@@ -1,0 +1,279 @@
+"""Cascades-lite distribution exploration — the ORCA (gporca) role,
+scoped to the decision that dominates MPP cost: where the motions go.
+
+The reference ships two optimizers: the MPP-ified Postgres planner
+(greedy locus rules, cdbpath.c:1346 cdbpath_motion_for_join) and ORCA, a
+Cascades engine (src/backend/gporca) that explores alternative plans in
+a memo and costs them. This module is the memo idea translated to this
+planner's world:
+
+- groups        = join-tree subtrees (scans / filters / projections /
+                  joins — the grammar Distributor._join decides over);
+- physical
+  property      = the subtree's output Sharding (the CdbPathLocus
+                  analog; ORCA's CDistributionSpec);
+- alternatives  = per join: colocate / broadcast-build / redistribute-
+                  probe / redistribute-build / redistribute-both —
+                  exactly the moves cdbpath_motion_for_join knows, but
+                  COSTED AND COMPARED over the whole tree instead of
+                  decided greedily per node;
+- cost          = bytes over the interconnect (rows moved × row width),
+                  the dominant term on the reference's UDP fabric and on
+                  TPU ICI alike;
+- required
+  property      = the parent context: GROUP BY keys above the join tree
+                  add the final-redistribute cost each output property
+                  implies, so a locally cheap choice that forces an
+                  expensive re-shuffle later LOSES — System R's
+                  "interesting orders" insight applied to hash
+                  distribution (ORCA: derived vs required distribution
+                  specs).
+
+The winning alternative is stamped on each join (``_dist_choice``);
+``Distributor._join`` honors the stamp — re-checking its preconditions,
+falling back to the greedy rules wherever the memo abstained or the
+plan drifted — so the memo can only redirect motions the distributor
+already knows how to place.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.plan.distribute import (_hashed_key_positions,
+                                            _join_colocated,
+                                            _project_sharding,
+                                            broadcast_struct_rows)
+from cloudberry_tpu.plan.sharding import Sharding
+
+
+@dataclass(frozen=True)
+class Alt:
+    """One costed alternative for a subtree: total motion bytes below,
+    the output sharding it yields, and the per-join choices that
+    produce it."""
+
+    cost: float
+    sharding: Sharding
+    choices: tuple  # ((PJoin, choice-str), ...)
+
+
+def _width(node: N.PlanNode) -> int:
+    return max(sum(f.type.np_dtype.itemsize for f in node.fields), 1)
+
+
+def _keep_best(alts: dict, alt: Alt) -> None:
+    k = str(alt.sharding)
+    cur = alts.get(k)
+    if cur is None or alt.cost < cur.cost:
+        alts[k] = alt
+
+
+def _redist_sharding(keys) -> Sharding:
+    """Mirror Distributor.redistribute's output locus."""
+    names = tuple(k.name for k in keys if isinstance(k, ex.ColumnRef))
+    return Sharding.hashed(*names) if len(names) == len(keys) \
+        else Sharding.strewn()
+
+
+def explore(node: N.PlanNode, catalog, nseg: int,
+            thr: int) -> Optional[dict]:
+    """Alternative set {sharding-key: Alt} for a join-tree subtree; None
+    when the subtree leaves the grammar (aggs, set-ops, windows, shares,
+    subquery scalars in scope) — the greedy rules then stand alone."""
+    if isinstance(node, N.PScan):
+        return {str(sh): Alt(0.0, sh, ())
+                for sh in (_scan_sharding(node, catalog),)}
+    if isinstance(node, N.PFilter):
+        return explore(node.child, catalog, nseg, thr)
+    if isinstance(node, N.PProject):
+        sub = explore(node.child, catalog, nseg, thr)
+        if sub is None:
+            return None
+        out: dict = {}
+        for a in sub.values():
+            _keep_best(out, Alt(a.cost,
+                                _project_sharding(a.sharding, node.exprs),
+                                a.choices))
+        return out
+    if isinstance(node, N.PJoin):
+        return _explore_join(node, catalog, nseg, thr)
+    return None
+
+
+def _scan_sharding(node: N.PScan, catalog) -> Sharding:
+    """Mirror Distributor._scan's locus assignment."""
+    if node.table_name == "$dual":
+        return Sharding.general()
+    try:
+        table = catalog.table(node.table_name)
+    except KeyError:
+        return Sharding.strewn()
+    pol = table.policy
+    if pol.kind == "replicated":
+        return Sharding.replicated()
+    if pol.kind == "hashed" and all(k in node.column_map
+                                    for k in pol.keys):
+        return Sharding.hashed(*(node.column_map[k] for k in pol.keys))
+    return Sharding.strewn()
+
+
+def _explore_join(node: N.PJoin, catalog, nseg: int,
+                  thr: int) -> Optional[dict]:
+    from cloudberry_tpu.plan.cost import estimate_rows
+
+    if node.kind == "full":
+        return None  # forced shape (coloc or gather-both); greedy path
+    balts = explore(node.build, catalog, nseg, thr)
+    palts = explore(node.probe, catalog, nseg, thr)
+    if balts is None or palts is None:
+        return None
+    est_b = estimate_rows(node.build, catalog)
+    est_p = estimate_rows(node.probe, catalog)
+    wb, wp = _width(node.build), _width(node.probe)
+    move = (nseg - 1) / max(nseg, 1)  # chance a redistributed row moves
+    out: dict = {}
+    for ba in balts.values():
+        for pa in palts.values():
+            base = ba.cost + pa.cost
+            ch = ba.choices + pa.choices
+            bsh, psh = ba.sharding, pa.sharding
+            b_part, p_part = bsh.is_partitioned, psh.is_partitioned
+            if not (b_part and p_part):
+                # forced arms of Distributor._join: no choice to stamp
+                if b_part and not p_part:
+                    if node.kind in ("inner", "semi"):
+                        bsub = _hashed_key_positions(bsh, node.build_keys)
+                        if bsub is not None:
+                            names = [node.probe_keys[i].name
+                                     for i in bsub
+                                     if isinstance(node.probe_keys[i],
+                                                   ex.ColumnRef)]
+                            sh = (Sharding.hashed(*names)
+                                  if len(names) == len(bsub)
+                                  else Sharding.strewn())
+                        else:
+                            sh = Sharding.strewn()
+                        _keep_best(out, Alt(base, sh, ch))
+                    else:
+                        # left/anti: broadcast the partitioned build
+                        _keep_best(out, Alt(
+                            base + est_b * wb * (nseg - 1), psh, ch))
+                else:
+                    _keep_best(out, Alt(base, psh, ch))
+                continue
+            if _join_colocated(node, bsh, psh):
+                _keep_best(out, Alt(base, psh,
+                                    ch + ((node, "colocate"),)))
+                continue
+            # thr == 0 is the explicit "never broadcast" switch — the
+            # memo honors it like the greedy rule does
+            if thr > 0 and est_b * nseg <= broadcast_struct_rows(thr):
+                _keep_best(out, Alt(
+                    base + est_b * wb * (nseg - 1), psh,
+                    ch + ((node, "broadcast"),)))
+            bsub = _hashed_key_positions(bsh, node.build_keys)
+            psub = _hashed_key_positions(psh, node.probe_keys)
+            if bsub is not None:
+                keys = [node.probe_keys[i] for i in bsub]
+                _keep_best(out, Alt(
+                    base + est_p * wp * move, _redist_sharding(keys),
+                    ch + ((node, "redist_probe"),)))
+            if psub is not None:
+                _keep_best(out, Alt(
+                    base + est_b * wb * move, psh,
+                    ch + ((node, "redist_build"),)))
+            _keep_best(out, Alt(
+                base + (est_b * wb + est_p * wp) * move,
+                _redist_sharding(node.probe_keys),
+                ch + ((node, "redist_both"),)))
+    return out or None
+
+
+def _agg_extra(agg: N.PAgg, sharding: Sharding, catalog,
+               nseg: int) -> float:
+    """Cost the GROUP BY above the join tree adds for a given output
+    property: zero when the grouping can run one-stage colocated
+    (Distributor._agg's test), else the partial rows' redistribute."""
+    from cloudberry_tpu.plan.cost import estimate_rows
+
+    if not agg.group_keys:
+        return 0.0  # global agg gathers one partial row either way
+    key_src = {e.name for _, e in agg.group_keys
+               if isinstance(e, ex.ColumnRef)}
+    if sharding.kind == "hashed" and sharding.keys \
+            and set(sharding.keys) <= key_src:
+        return 0.0
+    est_groups = estimate_rows(agg, catalog)
+    rows = min(est_groups * nseg, estimate_rows(agg.child, catalog))
+    return rows * _width(agg) * (nseg - 1) / max(nseg, 1)
+
+
+def _joins_of(node: N.PlanNode):
+    """Every join inside the join-tree grammar region rooted here."""
+    if isinstance(node, (N.PFilter, N.PProject)):
+        yield from _joins_of(node.child)
+    elif isinstance(node, N.PJoin):
+        yield node
+        yield from _joins_of(node.build)
+        yield from _joins_of(node.probe)
+
+
+def _through_chain(node: N.PlanNode) -> N.PlanNode:
+    while isinstance(node, (N.PFilter, N.PProject)):
+        node = node.child
+    return node
+
+
+def annotate_distribution(plan: N.PlanNode, session) -> None:
+    """Explore every join-tree region of the bound plan and stamp the
+    globally cheapest motion strategy on each join (``_dist_choice``).
+    Runs BEFORE the distribution walk (estimates see bind-time
+    capacities, exactly like Distributor._join's own estimate calls)."""
+    nseg = session.config.n_segments
+    if nseg <= 1:
+        return
+    catalog = session.catalog
+    thr = session.config.planner.broadcast_threshold
+    annotated: set[int] = set()
+    seen: set[int] = set()
+
+    def region(root: N.PlanNode, agg: Optional[N.PAgg]) -> None:
+        alts = explore(root, catalog, nseg, thr)
+        if not alts:
+            # abstained (out-of-grammar node somewhere inside): leave
+            # every join unmarked — the visitor descends and in-grammar
+            # subtrees become fresh regions of their own
+            return
+        for j in _joins_of(root):
+            annotated.add(id(j))
+        best = None
+        for a in alts.values():
+            extra = _agg_extra(agg, a.sharding, catalog, nseg) \
+                if agg is not None else 0.0
+            if best is None or a.cost + extra < best[0]:
+                best = (a.cost + extra, a)
+        for jn, choice in best[1].choices:
+            jn._dist_choice = choice
+
+    def visit(node: N.PlanNode) -> None:
+        if id(node) in seen:  # PShare reuse
+            return
+        seen.add(id(node))
+        if isinstance(node, N.PAgg) and node.mode == "single":
+            j = _through_chain(node.child)
+            if isinstance(j, N.PJoin) and id(j) not in annotated:
+                # explore from the agg's child so the Filter/Project
+                # chain folds its renames into each alternative's
+                # sharding — _agg_extra must see exactly the locus
+                # Distributor._agg will test
+                region(node.child, node)
+        elif isinstance(node, N.PJoin) and id(node) not in annotated:
+            region(node, None)
+        for c in node.children():
+            visit(c)
+
+    visit(plan)

@@ -1,0 +1,135 @@
+"""Cascades-lite memo exploration (plan/memo.py — the gporca role).
+
+The contract under test: the memo compares motion strategies over the
+WHOLE join tree including the GROUP BY's final redistribute, so it can
+choose a broadcast the greedy per-join threshold would refuse when that
+keeps the fact side home and the aggregation one-stage colocated — and
+results never change, only motion placement."""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+
+# fact hashed(k) = the GROUP BY key; dim hashed on an unrelated column.
+# greedy (dim above broadcast_threshold): redistribute BOTH sides onto d,
+# then a two-stage agg re-shuffles every group — three fact-scale motions.
+# memo: broadcast dim once; fact never moves; the agg runs one-stage.
+Q = ("SELECT k, sum(v) AS sv FROM fact JOIN dim ON fact.d = dim.d "
+     "GROUP BY k ORDER BY k LIMIT 10")
+
+
+def _load(s, n_fact=400_000, n_dim=150_000):
+    rng = np.random.default_rng(5)
+    s.sql("CREATE TABLE dim (d BIGINT, payload BIGINT) "
+          "DISTRIBUTED BY (payload)")
+    s.sql("CREATE TABLE fact (k BIGINT, d BIGINT, v BIGINT) "
+          "DISTRIBUTED BY (k)")
+    s.catalog.table("dim").set_data(
+        {"d": np.arange(n_dim), "payload": np.arange(n_dim)})
+    s.catalog.table("fact").set_data(
+        {"k": np.arange(n_fact) % 1000,
+         "d": rng.integers(0, n_dim, n_fact),
+         "v": rng.integers(0, 100, n_fact)})
+    s.sql("analyze dim")
+    s.sql("analyze fact")
+
+
+def _mk(**over):
+    ov = {"n_segments": 8}
+    ov.update(over)
+    return cb.Session(get_config().with_overrides(**ov))
+
+
+def test_memo_lookahead_beats_greedy_threshold():
+    s_greedy = _mk(**{"planner.enable_memo": False})
+    _load(s_greedy)
+    s_memo = _mk()
+    _load(s_memo)
+
+    greedy_plan = s_greedy.explain(Q)
+    memo_plan = s_memo.explain(Q)
+    # dim (150k rows) is above the 100k greedy threshold: greedy
+    # redistributes and pays a two-stage agg
+    assert "Motion broadcast" not in greedy_plan
+    assert "GroupAgg final" in greedy_plan
+    # the memo sees the whole tree: broadcast once, aggregate in place
+    assert "Motion broadcast" in memo_plan
+    assert "GroupAgg single" in memo_plan
+    assert "GroupAgg final" not in memo_plan
+    # identical answers either way
+    assert s_greedy.sql(Q).to_pandas().equals(s_memo.sql(Q).to_pandas())
+
+
+def test_memo_honors_broadcast_disabled():
+    # broadcast_threshold = 0 is the explicit "never broadcast" switch;
+    # the memo must not override it
+    s = _mk(**{"planner.broadcast_threshold": 0})
+    _load(s)
+    plan = s.explain(Q)
+    assert "Motion broadcast" not in plan
+    assert len(s.sql(Q).to_pandas()) == 10
+
+
+def test_memo_sees_through_projection_renames():
+    """The Project chain between the agg and the join renames the
+    distribution key; the memo must test colocation on the RENAMED
+    locus — exactly what Distributor._agg sees."""
+    q = ("SELECT kk, sum(v) AS sv FROM "
+         "(SELECT fact.k AS kk, v FROM fact JOIN dim ON fact.d = dim.d)"
+         " x GROUP BY kk ORDER BY kk LIMIT 5")
+    s = _mk()
+    _load(s)
+    plan = s.explain(q)
+    assert "Motion broadcast" in plan and "GroupAgg single" in plan
+    s_greedy = _mk(**{"planner.enable_memo": False})
+    _load(s_greedy)
+    assert s_greedy.sql(q).to_pandas().equals(s.sql(q).to_pandas())
+
+
+def test_memo_region_survives_out_of_grammar_sibling():
+    """A FULL JOIN (out of grammar) above a clean join subtree must not
+    block that subtree's own region."""
+    s = _mk()
+    _load(s, n_fact=1_000_000, n_dim=150_000)
+    s.sql("CREATE TABLE small (sk BIGINT, t BIGINT) DISTRIBUTED BY (sk)")
+    s.catalog.table("small").set_data(
+        {"sk": np.arange(50), "t": np.arange(50)})
+    s.sql("analyze small")
+    q = ("SELECT count(*) AS c FROM small FULL JOIN "
+         "(SELECT fact.k AS jk, v FROM fact JOIN dim ON fact.d = dim.d)"
+         " j ON small.sk = j.jk")
+    plan = s.explain(q)
+    # memo broadcasts the 150k dim inside the sibling (cheaper than
+    # moving the 1M-row fact); the greedy threshold would refuse
+    assert "Motion broadcast" in plan
+    s_greedy = _mk(**{"planner.enable_memo": False})
+    _load(s_greedy, n_fact=1_000_000, n_dim=150_000)
+    s_greedy.sql("CREATE TABLE small (sk BIGINT, t BIGINT) "
+                 "DISTRIBUTED BY (sk)")
+    s_greedy.catalog.table("small").set_data(
+        {"sk": np.arange(50), "t": np.arange(50)})
+    assert "Motion broadcast" not in s_greedy.explain(q)
+    assert s_greedy.sql(q).to_pandas().equals(s.sql(q).to_pandas())
+
+
+def test_memo_equivalence_random_queries():
+    """Motion placement may differ; answers may not."""
+    queries = [
+        "SELECT count(*) AS c FROM fact JOIN dim ON fact.d = dim.d "
+        "WHERE v < 50",
+        "SELECT d.payload % 7 AS p, min(v) AS mn, max(k) AS mk "
+        "FROM fact JOIN dim d ON fact.d = d.d GROUP BY d.payload % 7 "
+        "ORDER BY p",
+        "SELECT k FROM fact JOIN dim ON fact.d = dim.d "
+        "WHERE payload < 100 ORDER BY k, v LIMIT 20",
+    ]
+    s_greedy = _mk(**{"planner.enable_memo": False})
+    _load(s_greedy, n_fact=50_000, n_dim=20_000)
+    s_memo = _mk()
+    _load(s_memo, n_fact=50_000, n_dim=20_000)
+    for q in queries:
+        exp = s_greedy.sql(q).to_pandas()
+        got = s_memo.sql(q).to_pandas()
+        assert exp.equals(got), q
