@@ -184,6 +184,13 @@ def _rank_better(mx: bool, v1, r1, c1, v2, r2, c2):
     return (v2 & ~v1) | ((v2 == v1) & by_rank)
 
 
+def _as_column(v, cap: int):
+    """Broadcast a 0-d (constant) value to column shape — constant
+    projections, sort keys, and window keys (e.g. grouping() folded to a
+    literal per grouping-sets branch) all need full columns."""
+    return jnp.broadcast_to(v, (cap,)) if v.ndim == 0 else v
+
+
 def _vsearch(s, target, lo, hi, cap: int, lower: bool):
     """Vectorized per-row binary search over the (partition-wise sorted)
     array s restricted to per-row inclusive bounds [lo, hi]: returns the
@@ -332,10 +339,7 @@ class Lowerer:
             cols, sel = self.lower(node.child)
             out = {}
             for name, e in node.exprs:
-                v = self.expr(e, cols)
-                if v.ndim == 0:  # constant expression → full column
-                    v = jnp.broadcast_to(v, sel.shape)
-                out[name] = v
+                out[name] = _as_column(self.expr(e, cols), sel.shape[0])
             return out, sel
         if isinstance(node, N.PJoin):
             return self.join(node)
@@ -345,7 +349,8 @@ class Lowerer:
             cols, sel = self.lower(node.child)
             keys, desc = [], []
             for e, asc in node.keys:
-                keys.append(_sortable(e, node.child, cols))
+                keys.append(_as_column(_sortable(e, node.child, cols),
+                                       sel.shape[0]))
                 desc.append(not asc)
             perm = K.sort_indices(keys, sel, descending=desc)
             return {n: c[perm] for n, c in cols.items()}, sel[perm]
@@ -513,10 +518,12 @@ class Lowerer:
         peers included, per the SQL default)."""
         cols, sel = self.lower(node.child)
         cap = sel.shape[0]
-        pk = [self.expr(e, cols) for e in node.partition_keys]
+        pk = [_as_column(self.expr(e, cols), cap)
+              for e in node.partition_keys]
         # ORDER BY on strings sorts by collation rank, not dictionary code
         # (same rule PSort applies via _sortable)
-        ok = [_sortable(e, node.child, cols) for e, _ in node.order_keys]
+        ok = [_as_column(_sortable(e, node.child, cols), cap)
+              for e, _ in node.order_keys]
         desc = [not asc for _, asc in node.order_keys]
         perm = K.sort_indices(pk + ok, sel,
                               descending=[False] * len(pk) + desc)

@@ -2684,6 +2684,80 @@ def _fold_plain_grouping(sel: ast.Select) -> ast.Select:
     return out
 
 
+def _const_num(e) -> Optional[float]:
+    """Constant-fold the arithmetic a folded grouping() call produces
+    (number literals, +/-/*); None = not a constant."""
+    if isinstance(e, ast.NumberLit):
+        try:
+            return float(e.text)
+        except ValueError:
+            return None
+    if isinstance(e, ast.UnaryOp) and e.op == "-":
+        v = _const_num(e.operand)
+        return -v if v is not None else None
+    if isinstance(e, ast.BinOp) and e.op in ("+", "-", "*"):
+        l, r = _const_num(e.left), _const_num(e.right)
+        if l is None or r is None:
+            return None
+        return l + r if e.op == "+" else l - r if e.op == "-" else l * r
+    return None
+
+
+def _windows_of(sel: ast.Select) -> list:
+    out = []
+
+    def walk(e):
+        if isinstance(e, ast.WindowExpr):
+            out.append(e)
+            return
+        if not isinstance(e, ast.Node) or isinstance(
+                e, (ast.ScalarSubquery, ast.InSubquery, ast.Exists)):
+            return
+        for v in vars(e).values():
+            if isinstance(v, ast.ExprNode):
+                walk(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, ast.ExprNode):
+                        walk(x)
+                    elif isinstance(x, ast.OrderItem):
+                        walk(x.expr)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, ast.ExprNode):
+                                walk(y)
+
+    for i in sel.items:
+        walk(i.expr)
+    return out
+
+
+def _check_branch_windows(branches: list) -> None:
+    """Windows inside a grouping-sets query execute per UNION-ALL branch;
+    that is sound only when the PARTITION BY pins every branch's rows to
+    their own partitions — i.e. the constant-folded partition keys (the
+    grouping() bitmasks this rewrite produced) take pairwise-distinct
+    values across branches. Anything else would silently rank over one
+    branch where SQL ranks over the combined result (nodeWindowAgg runs
+    over nodeAgg's full grouping-sets output), so reject it loudly."""
+    sels = [b for b in branches if isinstance(b, ast.Select)]
+    wins = [_windows_of(b) for b in sels]
+    if len(wins) <= 1 or not wins[0]:
+        return
+    for i in range(len(wins[0])):
+        sigs = [tuple(_const_num(pk) for pk in bw[i].partition_by)
+                for bw in wins]
+        for a in range(len(sigs)):
+            for b in range(a + 1, len(sigs)):
+                if not any(x is not None and y is not None and x != y
+                           for x, y in zip(sigs[a], sigs[b])):
+                    raise BindError(
+                        "window function partitions may span grouping "
+                        "sets; PARTITION BY needs a grouping() "
+                        "expression that distinguishes every set "
+                        "(e.g. the full grouping(k1, ..., kn) bitmask)")
+
+
 def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
     """GROUPING SETS / ROLLUP / CUBE → UNION ALL of per-set aggregations
     (the nodeAgg.c grouping-sets role translated to plan algebra): each
@@ -2742,6 +2816,7 @@ def _expand_grouping_sets(sel: ast.Select) -> ast.Node:
             # DISTINCT over constants reproduces
             b.distinct = True
         branches.append(b)
+    _check_branch_windows(branches)
     out: ast.Node = branches[0]
     if len(branches) == 1:
         # never CLEAR the one-group distinct a constant () branch set

@@ -173,6 +173,35 @@ def test_grouping_through_select_alias(s):
     assert _norm(df) == [["east", 0, 45], ["west", 0, 95]]
 
 
+def test_window_spanning_grouping_sets_rejected(s):
+    """Windows run per UNION branch of the rewrite; a PARTITION BY that
+    cannot distinguish the branches would silently rank one branch where
+    SQL ranks the combined output — it must be a loud error."""
+    from cloudberry_tpu.plan.binder import BindError
+
+    with pytest.raises(BindError, match="span grouping sets"):
+        s.sql("select region, product, rank() over "
+              "(order by sum(amount)) as r from sales "
+              "group by cube(region, product)")
+    # the grouping()-sum discriminates ROLLUP levels but NOT the two
+    # single-key CUBE branches (both fold to 1)
+    with pytest.raises(BindError, match="span grouping sets"):
+        s.sql("select region, product, rank() over "
+              "(partition by grouping(region) + grouping(product) "
+              "order by sum(amount)) as r from sales "
+              "group by cube(region, product)")
+    # the full bitmask IS injective per branch: accepted, and each
+    # level ranks only its own rows
+    df = s.sql("select region, product, grouping(region, product) as g, "
+               "rank() over (partition by grouping(region, product) "
+               "order by sum(amount)) as r from sales "
+               "group by rollup(region, product) "
+               "order by g, r, region, product").to_pandas()
+    # level 0: four (region, product) rows rank 1..4; level 1: two
+    # region subtotals rank 1..2; level 3: the grand total ranks 1
+    assert df["r"].tolist() == [1, 2, 3, 4, 1, 2, 1]
+
+
 def test_rollup_key_inside_case(s):
     """Omitted keys replace inside CASE WHEN tuples too — the grand
     total's CASE sees NULL and takes the ELSE branch."""

@@ -187,9 +187,75 @@ def oracle_q27(t):
         .head(100).reset_index(drop=True)
 
 
+def oracle_q65(t):
+    j = t["store_sales"].merge(t["date_dim"],
+                               left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j[j.d_year == 2000]
+    rev = j.groupby(["ss_store_sk", "ss_item_sk"], as_index=False).agg(
+        revenue=("ss_ext_sales_price", "sum"))
+    ave = rev.groupby("ss_store_sk", as_index=False).agg(
+        ave=("revenue", "mean"))
+    m = rev.merge(ave, on="ss_store_sk")
+    m = m[m.revenue <= 0.1 * m.ave]
+    out = (m.merge(t["store"], left_on="ss_store_sk",
+                   right_on="s_store_sk")
+           .merge(t["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    out = out[["s_store_name", "i_item_desc", "revenue",
+               "i_current_price", "i_brand"]]
+    return out.sort_values(["s_store_name", "i_item_desc", "revenue",
+                            "i_current_price", "i_brand"]) \
+        .head(100).reset_index(drop=True)
+
+
+def oracle_q36(t):
+    j = _star(t).merge(t["store"], left_on="ss_store_sk",
+                       right_on="s_store_sk")
+    j = j[(j.d_year == 2001)
+          & j.s_state.isin(["TN", "CA", "TX", "WA"])]
+
+    def agg(keys):
+        if keys:
+            g = j.groupby(keys, as_index=False).agg(
+                np_=("ss_net_profit", "sum"),
+                sp=("ss_ext_sales_price", "sum"))
+        else:
+            g = pd.DataFrame([{"np_": j.ss_net_profit.sum(),
+                               "sp": j.ss_ext_sales_price.sum()}])
+        g["gross_margin"] = g.np_ / g.sp
+        return g
+
+    lvl2 = agg(["i_category", "i_class"])
+    lvl2["lochierarchy"] = 0
+    lvl1 = agg(["i_category"])
+    lvl1["i_class"] = np.nan
+    lvl1["lochierarchy"] = 1
+    lvl0 = agg([])
+    lvl0["i_category"] = np.nan
+    lvl0["i_class"] = np.nan
+    lvl0["lochierarchy"] = 2
+    allr = pd.concat([lvl2, lvl1, lvl0], ignore_index=True)
+    # rank within parent: level-0 rows partition by their category, the
+    # higher levels each form one partition
+    allr["_parent"] = np.where(allr.lochierarchy == 0,
+                               allr.i_category, "$none")
+    allr["rank_within_parent"] = allr.groupby(
+        ["lochierarchy", "_parent"])["gross_margin"] \
+        .rank(method="min").astype(np.int64)
+    allr["_ck"] = np.where(allr.lochierarchy == 0,
+                           allr.i_category, np.nan)
+    allr = allr.sort_values(["lochierarchy", "_ck", "rank_within_parent"],
+                            ascending=[False, True, True],
+                            na_position="last")
+    cols = ["gross_margin", "i_category", "i_class", "lochierarchy",
+            "rank_within_parent"]
+    return allr[cols].head(100).reset_index(drop=True)
+
+
 ORACLES = {"q17": oracle_q17, "q25": oracle_q25, "q29": oracle_q29,
            "q3": oracle_q3, "q42": oracle_q42, "q52": oracle_q52,
-           "q55": oracle_q55, "q98": oracle_q98, "q27": oracle_q27}
+           "q55": oracle_q55, "q98": oracle_q98, "q27": oracle_q27,
+           "q65": oracle_q65, "q36": oracle_q36}
 
 
 @pytest.mark.parametrize("qname", sorted(DS_QUERIES))

@@ -157,6 +157,56 @@ order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 limit 100
 """
 
+# q65 (adapted: d_month_seq window -> d_year, ss_sales_price ->
+# ss_ext_sales_price, i_wholesale_cost dropped — tpcds-lite does not
+# generate them; the shape is the point: two aggregated derived tables
+# joined with a cross-derived-table arithmetic predicate)
+DS_QUERIES["q65"] = """
+select s_store_name, i_item_desc, sc.revenue, i_current_price, i_brand
+from store join
+     (select ss_store_sk, avg(revenue) as ave
+      from (select ss_store_sk, ss_item_sk,
+                   sum(ss_ext_sales_price) as revenue
+            from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+            where d_year = 2000
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb on s_store_sk = sb.ss_store_sk
+     join
+     (select ss_store_sk, ss_item_sk,
+             sum(ss_ext_sales_price) as revenue
+      from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+      where d_year = 2000
+      group by ss_store_sk, ss_item_sk) sc
+     on sb.ss_store_sk = sc.ss_store_sk
+     join item on i_item_sk = sc.ss_item_sk
+where sc.revenue <= 0.1 * sb.ave
+order by s_store_name, i_item_desc, revenue, i_current_price, i_brand
+limit 100
+"""
+
+# q36 (adapted: s_state list uses generated states; the shape is the
+# point — ROLLUP + grouping() driving a rank() window over aggregate
+# outputs, ordered by the grouping level)
+DS_QUERIES["q36"] = """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) as gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (
+         partition by grouping(i_category) + grouping(i_class),
+           case when grouping(i_class) = 0 then i_category end
+         order by sum(ss_net_profit) / sum(ss_ext_sales_price)
+       ) as rank_within_parent
+from store_sales join date_dim on d_date_sk = ss_sold_date_sk
+     join item on i_item_sk = ss_item_sk
+     join store on s_store_sk = ss_store_sk
+where d_year = 2001 and s_state in ('TN', 'CA', 'TX', 'WA')
+group by rollup (i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+
 # q27 (adapted: the official query filters on customer_demographics,
 # which tpcds-lite does not generate — the grouping shape, the rollup,
 # and grouping() are the point here; avgs run over the generated
