@@ -384,6 +384,19 @@ class Parser:
                 f"expected an integer, got {tok.text!r}")
         return -v if neg else v
 
+    def _parse_interval_literal(self) -> tuple:
+        """INTERVAL '<n>' <unit> (cursor on the INTERVAL keyword):
+        returns (n, singular unit)."""
+        self.advance()
+        tok = self.advance()
+        try:
+            n = int(tok.text)
+        except ValueError:
+            raise ParseError(
+                f"expected an integer interval value, got {tok.text!r} "
+                "(write the unit outside the string: interval '2' day)")
+        return n, self.expect_ident().rstrip("s")
+
     def _signed_number(self):
         """int when the literal is integral, float otherwise (RANGE frame
         offsets may be fractional on float ORDER BY keys)."""
@@ -834,10 +847,7 @@ class Parser:
             self.advance()
             return ast.DateLit(self.advance().text)
         if word == "interval" and self.toks[self.i + 1].kind == "string":
-            self.advance()
-            n = int(self.advance().text)
-            unit = self.expect_ident()
-            unit = unit.rstrip("s")
+            n, unit = self._parse_interval_literal()
             if unit not in ("year", "month", "day"):
                 raise ParseError(f"unsupported interval unit {unit!r}")
             return ast.IntervalLit(n, unit)
@@ -932,16 +942,16 @@ class Parser:
         kind = self.accept_kw("rows", "range")
         if kind:
             if self.accept_kw("between"):
-                lo = self._parse_frame_bound()
+                lo = self._parse_frame_bound(kind)
                 self.expect_kw("and")
-                hi = self._parse_frame_bound()
+                hi = self._parse_frame_bound(kind)
             else:
-                lo, hi = self._parse_frame_bound(), ("current", 0)
+                lo, hi = self._parse_frame_bound(kind), ("current", 0)
             frame = (kind, lo, hi)
         self.expect_op(")")
         return ast.WindowExpr(fname, args, partition, order, frame)
 
-    def _parse_frame_bound(self):
+    def _parse_frame_bound(self, kind: str):
         """UNBOUNDED PRECEDING|FOLLOWING | <n> PRECEDING|FOLLOWING |
         CURRENT ROW -> ('unbounded'|'offset'|'current', signed rows)"""
         if self.accept_kw("unbounded"):
@@ -952,7 +962,21 @@ class Parser:
         if self.accept_kw("current"):
             self.expect_kw("row")
             return ("current", 0)
-        n = self._signed_number()
+        if self.at_kw("interval") and self.toks[self.i + 1].kind == "string":
+            if kind != "range":
+                # PG rejects intervals in ROWS mode — silently reading
+                # one as a row count would answer a different question
+                raise ParseError("interval frame offsets need RANGE mode")
+            # INTERVAL 'n' DAY on a date ORDER BY key: days are the
+            # key's integer domain, so the offset is just n
+            n, unit = self._parse_interval_literal()
+            if unit != "day":
+                raise ParseError(
+                    "RANGE frame intervals support DAY only (date keys "
+                    "are day numbers; months/years are not fixed "
+                    "distances)")
+        else:
+            n = self._signed_number()
         if n < 0:
             # PG: "frame starting offset must not be negative" — a
             # negative n would silently flip PRECEDING into FOLLOWING

@@ -447,6 +447,22 @@ def test_range_offset_mixed_unbounded(rs):
     assert out == [None, 1, 1, 6, None, 5, None, 24, 24]
 
 
+def test_range_offset_date_interval(rs):
+    rs.sql("create table rdt (dt date, v int) distributed by (v)")
+    rs.sql("insert into rdt values (date '2024-01-01', 1), "
+           "(date '2024-01-03', 2), (date '2024-01-04', 3), "
+           "(date '2024-02-01', 4)")
+    out = col(rs, "select sum(v) over (order by dt range between "
+                  "interval '2' day preceding and current row) as x "
+                  "from rdt order by dt", "x")
+    assert out == [1, 3, 5, 4]
+    from cloudberry_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="DAY only"):
+        rs.sql("select sum(v) over (order by dt range between "
+               "interval '1' month preceding and current row) from rdt")
+
+
 def test_range_frame_oracle_random():
     """RANGE moving sums vs an O(n log n) searchsorted oracle."""
     import pandas as pd
