@@ -45,6 +45,7 @@ from cloudberry_tpu.plan import expr as ex
 from cloudberry_tpu.plan import nodes as N
 from cloudberry_tpu.plan.distribute import (_hashed_key_positions,
                                             _join_colocated,
+                                            _node_exprs,
                                             _project_sharding,
                                             broadcast_struct_rows)
 from cloudberry_tpu.plan.sharding import Sharding
@@ -121,6 +122,48 @@ def _scan_sharding(node: N.PScan, catalog) -> Sharding:
     return Sharding.strewn()
 
 
+def _hot_frac(plan: N.PlanNode, keys, catalog) -> float:
+    """Estimated fraction of rows holding the HOTTEST redistribute-key
+    value, read off the equi-depth histogram: a value spanning k of N
+    buckets holds ≈ k/N of the rows (the pg_statistic MCV-list role).
+    A compound key is at most as skewed as its least-skewed column."""
+    from cloudberry_tpu.plan.cost import _col_source
+
+    frac = 1.0
+    seen = False
+    for k in keys:
+        if not isinstance(k, ex.ColumnRef):
+            continue
+        src = _col_source(plan, k.name)
+        if src is None:
+            continue
+        try:
+            hist = catalog.table(src[0]).stats.hist.get(src[1])
+        except KeyError:
+            continue
+        if not hist or len(hist) < 3:
+            continue
+        run = best = 1
+        for a, b in zip(hist, hist[1:]):
+            run = run + 1 if a == b else 1
+            best = max(best, run)
+        frac = min(frac, (best - 1) / (len(hist) - 1))
+        seen = True
+    return frac if seen else 0.0
+
+
+def _redist_cost(est: float, width: int, frac: float, nseg: int) -> float:
+    """Bytes cost of a redistribute, skew-aware: when the hottest key
+    exceeds its fair 1/nseg share, one destination serializes the motion
+    AND the downstream compute — scale by how far it overshoots (the
+    cdbpath.c skew-sensitive motion costing role). This is what steers
+    the memo toward broadcast for hot-key probes."""
+    base = est * width * (nseg - 1) / max(nseg, 1)
+    if frac * nseg > 1.0:
+        base *= frac * nseg
+    return base
+
+
 def _explore_join(node: N.PJoin, catalog, nseg: int,
                   thr: int) -> Optional[dict]:
     from cloudberry_tpu.plan.cost import estimate_rows
@@ -134,7 +177,16 @@ def _explore_join(node: N.PJoin, catalog, nseg: int,
     est_b = estimate_rows(node.build, catalog)
     est_p = estimate_rows(node.probe, catalog)
     wb, wp = _width(node.build), _width(node.probe)
-    move = (nseg - 1) / max(nseg, 1)  # chance a redistributed row moves
+    fcache: dict = {}
+
+    def hot(side, keys):
+        # skew is a property of the ACTUAL redistribute-key subset: min
+        # over more columns can only understate a subset's hot fraction
+        ck = (id(side), tuple(k.name if isinstance(k, ex.ColumnRef)
+                              else "?" for k in keys))
+        if ck not in fcache:
+            fcache[ck] = _hot_frac(side, keys, catalog)
+        return fcache[ck]
     out: dict = {}
     for ba in balts.values():
         for pa in palts.values():
@@ -180,14 +232,22 @@ def _explore_join(node: N.PJoin, catalog, nseg: int,
             if bsub is not None:
                 keys = [node.probe_keys[i] for i in bsub]
                 _keep_best(out, Alt(
-                    base + est_p * wp * move, _redist_sharding(keys),
+                    base + _redist_cost(est_p, wp,
+                                        hot(node.probe, keys), nseg),
+                    _redist_sharding(keys),
                     ch + ((node, "redist_probe"),)))
             if psub is not None:
+                bkeys = [node.build_keys[i] for i in psub]
                 _keep_best(out, Alt(
-                    base + est_b * wb * move, psh,
-                    ch + ((node, "redist_build"),)))
+                    base + _redist_cost(est_b, wb,
+                                        hot(node.build, bkeys), nseg),
+                    psh, ch + ((node, "redist_build"),)))
             _keep_best(out, Alt(
-                base + (est_b * wb + est_p * wp) * move,
+                base + _redist_cost(est_b, wb,
+                                    hot(node.build, node.build_keys),
+                                    nseg)
+                + _redist_cost(est_p, wp,
+                               hot(node.probe, node.probe_keys), nseg),
                 _redist_sharding(node.probe_keys),
                 ch + ((node, "redist_both"),)))
     return out or None
@@ -275,5 +335,12 @@ def annotate_distribution(plan: N.PlanNode, session) -> None:
             region(node, None)
         for c in node.children():
             visit(c)
+        # uncorrelated scalar subqueries (InitPlan analog) carry their
+        # own plans inside expressions; the distributor walks them, so
+        # the memo explores them too
+        for e in _node_exprs(node):
+            for sub in ex.walk(e):
+                if isinstance(sub, ex.SubqueryScalar):
+                    visit(sub.plan)
 
     visit(plan)
