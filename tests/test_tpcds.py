@@ -142,20 +142,27 @@ def oracle_q55(t):
         .head(100).reset_index(drop=True)
 
 
-def oracle_q98(t):
-    j = _star(t)
-    j = j[j.i_category.isin(["Books", "Music"])
-          & (j.d_date >= pd.Timestamp(2000, 2, 1))
-          & (j.d_date <= pd.Timestamp(2000, 3, 1))]
+def _revenue_ratio(j, price_col, categories, lo, hi):
+    """q98/q12 pipeline: filter, group by the 5 item columns, revenue
+    ratio within class, canonical sort."""
+    j = j[j.i_category.isin(categories)
+          & (j.d_date >= lo) & (j.d_date <= hi)]
     g = j.groupby(["i_item_id", "i_item_desc", "i_category", "i_class",
                    "i_current_price"], as_index=False) \
-        .agg(itemrevenue=("ss_ext_sales_price", "sum"))
+        .agg(itemrevenue=(price_col, "sum"))
     g["revenueratio"] = (g.itemrevenue * 100.0
                          / g.groupby("i_class")
                          .itemrevenue.transform("sum"))
     return g.sort_values(["i_category", "i_class", "i_item_id",
                           "i_item_desc", "revenueratio"]) \
         .head(100).reset_index(drop=True)
+
+
+def oracle_q98(t):
+    return _revenue_ratio(_star(t), "ss_ext_sales_price",
+                          ["Books", "Music"],
+                          pd.Timestamp(2000, 2, 1),
+                          pd.Timestamp(2000, 3, 1))
 
 
 def oracle_q27(t):
@@ -208,6 +215,35 @@ def oracle_q65(t):
         .head(100).reset_index(drop=True)
 
 
+def _rollup_rank(agg, measure, ascending):
+    """q36/q86 scaffolding: ROLLUP(i_category, i_class) levels, rank
+    within parent (level-0 rows partition by their category, higher
+    levels each form one partition), canonical sort."""
+    lvl2 = agg(["i_category", "i_class"])
+    lvl2["lochierarchy"] = 0
+    lvl1 = agg(["i_category"])
+    lvl1["i_class"] = np.nan
+    lvl1["lochierarchy"] = 1
+    lvl0 = agg([])
+    lvl0["i_category"] = np.nan
+    lvl0["i_class"] = np.nan
+    lvl0["lochierarchy"] = 2
+    allr = pd.concat([lvl2, lvl1, lvl0], ignore_index=True)
+    allr["_parent"] = np.where(allr.lochierarchy == 0,
+                               allr.i_category, "$none")
+    allr["rank_within_parent"] = allr.groupby(
+        ["lochierarchy", "_parent"])[measure] \
+        .rank(method="min", ascending=ascending).astype(np.int64)
+    allr["_ck"] = np.where(allr.lochierarchy == 0,
+                           allr.i_category, np.nan)
+    allr = allr.sort_values(["lochierarchy", "_ck", "rank_within_parent"],
+                            ascending=[False, True, True],
+                            na_position="last")
+    cols = [measure, "i_category", "i_class", "lochierarchy",
+            "rank_within_parent"]
+    return allr[cols].head(100).reset_index(drop=True)
+
+
 def oracle_q36(t):
     j = _star(t).merge(t["store"], left_on="ss_store_sk",
                        right_on="s_store_sk")
@@ -225,37 +261,64 @@ def oracle_q36(t):
         g["gross_margin"] = g.np_ / g.sp
         return g
 
-    lvl2 = agg(["i_category", "i_class"])
-    lvl2["lochierarchy"] = 0
-    lvl1 = agg(["i_category"])
-    lvl1["i_class"] = np.nan
-    lvl1["lochierarchy"] = 1
-    lvl0 = agg([])
-    lvl0["i_category"] = np.nan
-    lvl0["i_class"] = np.nan
-    lvl0["lochierarchy"] = 2
-    allr = pd.concat([lvl2, lvl1, lvl0], ignore_index=True)
-    # rank within parent: level-0 rows partition by their category, the
-    # higher levels each form one partition
-    allr["_parent"] = np.where(allr.lochierarchy == 0,
-                               allr.i_category, "$none")
-    allr["rank_within_parent"] = allr.groupby(
-        ["lochierarchy", "_parent"])["gross_margin"] \
-        .rank(method="min").astype(np.int64)
-    allr["_ck"] = np.where(allr.lochierarchy == 0,
-                           allr.i_category, np.nan)
-    allr = allr.sort_values(["lochierarchy", "_ck", "rank_within_parent"],
-                            ascending=[False, True, True],
-                            na_position="last")
-    cols = ["gross_margin", "i_category", "i_class", "lochierarchy",
-            "rank_within_parent"]
-    return allr[cols].head(100).reset_index(drop=True)
+    return _rollup_rank(agg, "gross_margin", ascending=True)
+
+
+def _web_star(t):
+    return (t["web_sales"]
+            .merge(t["item"], left_on="ws_item_sk", right_on="i_item_sk")
+            .merge(t["date_dim"], left_on="ws_sold_date_sk",
+                   right_on="d_date_sk"))
+
+
+def oracle_q12(t):
+    return _revenue_ratio(_web_star(t), "ws_ext_sales_price",
+                          ["Sports", "Books"],
+                          pd.Timestamp(1999, 2, 22),
+                          pd.Timestamp(1999, 3, 24))
+
+
+def oracle_q21(t):
+    j = (t["inventory"]
+         .merge(t["warehouse"], left_on="inv_warehouse_sk",
+                right_on="w_warehouse_sk")
+         .merge(t["item"], left_on="inv_item_sk", right_on="i_item_sk")
+         .merge(t["date_dim"], left_on="inv_date_sk",
+                right_on="d_date_sk"))
+    pivot = pd.Timestamp(2000, 3, 11)
+    j = j[(j.i_current_price >= 0.99) & (j.i_current_price <= 10.00)
+          & (j.d_date >= pivot - pd.Timedelta(days=30))
+          & (j.d_date <= pivot + pd.Timedelta(days=30))]
+    j = j.assign(
+        before=np.where(j.d_date < pivot, j.inv_quantity_on_hand, 0),
+        after=np.where(j.d_date >= pivot, j.inv_quantity_on_hand, 0))
+    g = j.groupby(["w_warehouse_name", "i_item_id"], as_index=False) \
+        .agg(inv_before=("before", "sum"), inv_after=("after", "sum"))
+    ratio = np.where(g.inv_before > 0,
+                     g.inv_after / np.maximum(g.inv_before, 1), np.nan)
+    g = g[(ratio >= 2.0 / 3.0) & (ratio <= 3.0 / 2.0)]
+    return g.sort_values(["w_warehouse_name", "i_item_id"]) \
+        .head(100).reset_index(drop=True)
+
+
+def oracle_q86(t):
+    j = _web_star(t)
+    j = j[j.d_year == 2000]
+
+    def agg(keys):
+        if keys:
+            return j.groupby(keys, as_index=False).agg(
+                total_sum=("ws_net_profit", "sum"))
+        return pd.DataFrame([{"total_sum": j.ws_net_profit.sum()}])
+
+    return _rollup_rank(agg, "total_sum", ascending=False)
 
 
 ORACLES = {"q17": oracle_q17, "q25": oracle_q25, "q29": oracle_q29,
            "q3": oracle_q3, "q42": oracle_q42, "q52": oracle_q52,
            "q55": oracle_q55, "q98": oracle_q98, "q27": oracle_q27,
-           "q65": oracle_q65, "q36": oracle_q36}
+           "q65": oracle_q65, "q36": oracle_q36,
+           "q12": oracle_q12, "q21": oracle_q21, "q86": oracle_q86}
 
 
 @pytest.mark.parametrize("qname", sorted(DS_QUERIES))

@@ -157,6 +157,64 @@ order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 limit 100
 """
 
+# -------- web/inventory family (round 4): q12/q21/q86 over the
+# web_sales + inventory + warehouse tables.
+
+DS_QUERIES["q12"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) as itemrevenue,
+       sum(ws_ext_sales_price) * 100 / sum(sum(ws_ext_sales_price))
+         over (partition by i_class) as revenueratio
+from web_sales join item on ws_item_sk = i_item_sk
+     join date_dim on ws_sold_date_sk = d_date_sk
+where i_category in ('Sports', 'Books')
+  and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+
+# q21 (adapted: price band widened to the generated price range)
+DS_QUERIES["q21"] = """
+select * from (
+  select w_warehouse_name, i_item_id,
+         sum(case when d_date < date '2000-03-11'
+                  then inv_quantity_on_hand else 0 end) as inv_before,
+         sum(case when d_date >= date '2000-03-11'
+                  then inv_quantity_on_hand else 0 end) as inv_after
+  from inventory join warehouse on inv_warehouse_sk = w_warehouse_sk
+       join item on i_item_sk = inv_item_sk
+       join date_dim on inv_date_sk = d_date_sk
+  where i_current_price between 0.99 and 10.00
+    and d_date between date '2000-03-11' - interval '30' day
+                   and date '2000-03-11' + interval '30' day
+  group by w_warehouse_name, i_item_id) x
+where case when inv_before > 0
+           then 1.0 * inv_after / inv_before else null end
+      between 2.0 / 3.0 and 3.0 / 2.0
+order by w_warehouse_name, i_item_id
+limit 100
+"""
+
+# q86 (adapted: ws_net_paid -> ws_net_profit, d_month_seq -> d_year)
+DS_QUERIES["q86"] = """
+select sum(ws_net_profit) as total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (
+         partition by grouping(i_category) + grouping(i_class),
+           case when grouping(i_class) = 0 then i_category end
+         order by sum(ws_net_profit) desc
+       ) as rank_within_parent
+from web_sales join date_dim d1 on d1.d_date_sk = ws_sold_date_sk
+     join item on i_item_sk = ws_item_sk
+where d1.d_year = 2000
+group by rollup (i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+
 # q65 (adapted: d_month_seq window -> d_year, ss_sales_price ->
 # ss_ext_sales_price, i_wholesale_cost dropped — tpcds-lite does not
 # generate them; the shape is the point: two aggregated derived tables

@@ -37,14 +37,27 @@ SCHEMAS: dict[str, Schema] = {
                                cs_bill_customer_sk=T.INT64,
                                cs_quantity=T.INT32,
                                cs_net_profit=T.DECIMAL(2)),
+    "web_sales": Schema.of(ws_sold_date_sk=T.INT64, ws_item_sk=T.INT64,
+                           ws_bill_customer_sk=T.INT64,
+                           ws_quantity=T.INT32,
+                           ws_ext_sales_price=T.DECIMAL(2),
+                           ws_net_profit=T.DECIMAL(2)),
+    "warehouse": Schema.of(w_warehouse_sk=T.INT64,
+                           w_warehouse_name=T.STRING),
+    "inventory": Schema.of(inv_date_sk=T.INT64, inv_item_sk=T.INT64,
+                           inv_warehouse_sk=T.INT64,
+                           inv_quantity_on_hand=T.INT32),
 }
 
 DIST_KEYS = {
     "date_dim": None, "item": None, "store": None,      # replicated dims
+    "warehouse": None,
     "customer": ("c_customer_sk",),
     "store_sales": ("ss_ticket_number",),
     "store_returns": ("sr_ticket_number",),
     "catalog_sales": ("cs_bill_customer_sk",),
+    "web_sales": ("ws_bill_customer_sk",),
+    "inventory": ("inv_item_sk",),
 }
 
 _STATES = ["TN", "CA", "TX", "WA", "NY", "GA", "OH", "MI"]
@@ -148,6 +161,39 @@ def generate(scale: float = 1.0, seed: int = 0):
         .astype(np.int64),
         "cs_quantity": rng.integers(1, 100, n_cs).astype(np.int32),
         "cs_net_profit": rng.integers(-5_000, 20_000, n_cs) / 100.0,
+    }
+
+    # web/inventory family (q12/q21/q86): OWN rng streams — consuming the
+    # shared one would shift earlier tables' draws and silently re-tune
+    # the committed queries' filter selectivities
+    rng3 = np.random.default_rng(seed + 224737)
+    n_ws = max(int(15_000 * scale), 600)
+    data["web_sales"] = {
+        "ws_sold_date_sk": rng3.integers(1, n_dates + 1, n_ws)
+        .astype(np.int64),
+        "ws_item_sk": rng3.integers(1, n_item + 1, n_ws).astype(np.int64),
+        "ws_bill_customer_sk": rng3.integers(1, n_cust + 1, n_ws)
+        .astype(np.int64),
+        "ws_quantity": rng3.integers(1, 100, n_ws).astype(np.int32),
+        "ws_ext_sales_price": rng3.integers(100, 50_000, n_ws) / 100.0,
+        "ws_net_profit": rng3.integers(-5_000, 20_000, n_ws) / 100.0,
+    }
+    n_wh = 4
+    data["warehouse"] = {
+        "w_warehouse_sk": np.arange(1, n_wh + 1, dtype=np.int64),
+        "w_warehouse_name": np.asarray(
+            [f"Warehouse {i}" for i in range(1, n_wh + 1)], dtype=object),
+    }
+    n_inv = max(int(25_000 * scale), 1_000)
+    data["inventory"] = {
+        "inv_date_sk": rng3.integers(1, n_dates + 1, n_inv)
+        .astype(np.int64),
+        "inv_item_sk": rng3.integers(1, n_item + 1, n_inv)
+        .astype(np.int64),
+        "inv_warehouse_sk": rng3.integers(1, n_wh + 1, n_inv)
+        .astype(np.int64),
+        "inv_quantity_on_hand": rng3.integers(0, 1_000, n_inv)
+        .astype(np.int32),
     }
     return data
 
