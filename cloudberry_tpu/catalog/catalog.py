@@ -242,6 +242,11 @@ class Table:
                     self.stats.hist[f.name] = [
                         float(v) for v in np.quantile(vals, qs)]
         self.stats.analyzed_rows = int(self.stats.row_count)
+        # fresh stats change plan choices (selectivity, memo motion
+        # costing): bump the STATS version so cached compiled statements
+        # re-plan — deliberately not _version, which OCC snapshots watch
+        # (an ANALYZE must never abort a concurrent writer)
+        self._stats_version = next(_VERSION_COUNTER)
         if self.backing is not None:
             if getattr(self.backing, "autocommit", True):
                 self._store_version = \

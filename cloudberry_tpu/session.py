@@ -512,8 +512,12 @@ class Session:
     # per version, so reuse is exact, never heuristic.
 
     def _table_versions(self, names) -> tuple:
-        return tuple((n, getattr(self.catalog.table(n), "_version", 0))
-                     for n in names)
+        out = []
+        for n in names:
+            t = self.catalog.table(n)
+            out.append((n, getattr(t, "_version", 0),
+                        getattr(t, "_stats_version", 0)))
+        return tuple(out)
 
     _STMT_CACHE_MAX = 64
 

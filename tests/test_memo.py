@@ -147,6 +147,20 @@ def test_memo_skew_aware_redistribute_cost():
     assert s_greedy.sql(q).to_pandas().equals(s_hot.sql(q).to_pandas())
 
 
+def test_analyze_invalidates_statement_cache():
+    """Memo choices ride statistics: fresh stats must re-plan a cached
+    statement (the relcache-invalidation role of ANALYZE)."""
+    s = _mk()
+    _load_hot(s, hot=True)
+    q = "SELECT count(*) AS c FROM hfact JOIN hdim ON hfact.d = hdim.d"
+    s.sql(q)
+    assert s._cached_statement(q) is not None
+    s.sql("analyze hfact")
+    assert s._cached_statement(q) is None
+    s.sql(q)  # replans and re-caches cleanly
+    assert s._cached_statement(q) is not None
+
+
 def test_memo_equivalence_random_queries():
     """Motion placement may differ; answers may not."""
     queries = [
