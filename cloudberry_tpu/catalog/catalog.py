@@ -460,7 +460,7 @@ class Catalog:
                      policy: DistributionPolicy | None = None,
                      if_not_exists: bool = False,
                      partition_spec: tuple | None = None,
-                     durable: bool = True) -> Table:
+                     durable: bool = True, bump: bool = True) -> Table:
         name = name.lower()
         if name in self.tables:
             if if_not_exists:
@@ -485,7 +485,11 @@ class Catalog:
             else:
                 self.store._txn_dirty[name] = t
         self.tables[name] = t
-        self.bump_ddl()
+        if bump:
+            # bump=False: transient tables (table functions) are invisible
+            # to SQL names, so creating one must not evict every cached
+            # compiled statement via the ddl version
+            self.bump_ddl()
         return t
 
     def drop_table(self, name: str, if_exists: bool = False) -> None:

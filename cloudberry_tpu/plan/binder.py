@@ -530,9 +530,53 @@ class Binder:
             proj = self._requalify(sub, ref.alias)
             scope.entries.append(RangeEntry(ref.alias, proj))
             return ref.alias, proj
+        if isinstance(ref, ast.FuncTable):
+            return self._bind_func_table(ref, scope)
         if isinstance(ref, ast.JoinRef):
             return self._bind_join_ref(ref, scope, post_filters)
         raise BindError(f"unsupported FROM item {type(ref).__name__}")
+
+    def _bind_func_table(self, ref: ast.FuncTable,
+                         scope: Scope) -> tuple[str, N.PlanNode]:
+        """Function Scan (nodeFunctionscan.c role): evaluate host-side at
+        bind time — arguments must be constants — and scan the transient
+        replicated table exec/tablefunc.py materializes."""
+        from cloudberry_tpu.exec import tablefunc
+
+        fn = tablefunc.lookup(ref.name)
+        if fn is None:
+            raise BindError(
+                f"unknown table function {ref.name!r} (known: "
+                f"{', '.join(tablefunc.known_functions())}; register "
+                "with cloudberry_tpu.exec.tablefunc."
+                "register_table_function)")
+        vals = []
+        for a in ref.args:
+            b = self.bind_scalar(a, Scope())
+            if _is_null_literal(b):
+                vals.append(None)  # functions see NULL as None
+                continue
+            if not isinstance(b, ex.Literal):
+                raise BindError(
+                    f"{ref.name}: table function arguments must be "
+                    "constants (one XLA program per plan — no per-row "
+                    "function scans)")
+            v = b.value
+            if b.dtype.base == DType.DECIMAL:
+                # literals bind in fixed-point; the function sees the
+                # numeric VALUE (1.5, never the scaled 15)
+                v = v / 10 ** b.dtype.scale
+            vals.append(v)
+        try:
+            tname = tablefunc.materialize(self.catalog, ref.name, fn,
+                                          vals)
+        except (ValueError, TypeError) as e:
+            raise BindError(f"table function {ref.name}: {e}")
+        table = self._lookup_table(tname)
+        alias = ref.alias or ref.name
+        plan = _scan_node(table, alias)
+        scope.entries.append(RangeEntry(alias, plan))
+        return alias, plan
 
     def _requalify(self, sub: N.PlanNode, alias: str) -> N.PProject:
         """Re-qualify a subplan's output names under a derived/CTE alias

@@ -344,6 +344,7 @@ class Session:
         return any(getattr(_t(n), "external", None)
                    or getattr(_t(n), "foreign", None)
                    or getattr(_t(n), "directory", None)
+                   or getattr(_t(n), "_tablefunc", None)
                    for n in names)
 
     def _sync_store(self) -> None:

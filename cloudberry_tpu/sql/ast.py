@@ -193,6 +193,16 @@ class DerivedTable(TableRefNode):
 
 
 @dataclass
+class FuncTable(TableRefNode):
+    """Set-returning function in FROM (Function Scan analog):
+    name(args) [AS] alias."""
+
+    name: str
+    args: list[ExprNode]
+    alias: Optional[str] = None
+
+
+@dataclass
 class JoinRef(TableRefNode):
     kind: str  # 'inner' | 'left' | 'right' | 'full' | 'cross'
     left: TableRefNode

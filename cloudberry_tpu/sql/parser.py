@@ -725,12 +725,24 @@ class Parser:
             self.expect_op(")")
             return ref
         name = self.expect_ident()
-        alias = None
+        if self.at_op("("):
+            # set-returning function in FROM: name(args) [AS] alias
+            self.advance()
+            args: list[ast.ExprNode] = []
+            if not self.accept_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+                self.expect_op(")")
+            return ast.FuncTable(name, args, self._parse_alias())
+        return ast.TableName(name, self._parse_alias())
+
+    def _parse_alias(self):
         if self.accept_kw("as"):
-            alias = self.expect_ident()
-        elif self.cur.kind == "ident" and self.cur.text not in _RESERVED:
-            alias = self.advance().text
-        return ast.TableName(name, alias)
+            return self.expect_ident()
+        if self.cur.kind == "ident" and self.cur.text not in _RESERVED:
+            return self.advance().text
+        return None
 
     # ---------------------------------------------------------- expressions
 

@@ -1,0 +1,142 @@
+"""Set-returning table functions (exec/tablefunc.py) — the Function
+Scan / TableFunction node analog (nodeFunctionscan.c): host-side
+bind-time evaluation into a transient replicated table, refreshed per
+referencing statement, with register_table_function as the
+CustomScan-style extension hook."""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.exec.tablefunc import register_table_function
+from cloudberry_tpu.plan.binder import BindError
+
+
+def _mk(nseg=1):
+    return cb.Session(get_config().with_overrides(n_segments=nseg))
+
+
+@pytest.fixture(scope="module", params=[1, 8], ids=["single", "dist8"])
+def s(request):
+    return _mk(request.param)
+
+
+def test_generate_series(s):
+    out = s.sql("select * from generate_series(1, 5)").to_pandas()
+    assert out.iloc[:, 0].tolist() == [1, 2, 3, 4, 5]  # inclusive stop
+    out = s.sql("select * from generate_series(0, 10, 3)").to_pandas()
+    assert out.iloc[:, 0].tolist() == [0, 3, 6, 9]
+    out = s.sql("select * from generate_series(5, 1, -2)").to_pandas()
+    assert out.iloc[:, 0].tolist() == [5, 3, 1]
+    assert len(s.sql("select * from generate_series(5, 1)").to_pandas()) \
+        == 0
+
+
+def test_function_scan_joins_without_motion(s):
+    s.sql("create table ft (a int) distributed by (a)")
+    s.sql("insert into ft values (2), (4), (9)")
+    df = s.sql("select a from ft join generate_series(1, 5) gs "
+               "on a = gs.generate_series order by a").to_pandas()
+    assert df["a"].tolist() == [2, 4]
+    # replicated transient table: the General locus — no broadcast or
+    # redistribute needed on the function side of the join
+    plan = s.explain("select a from ft join generate_series(1, 5) gs "
+                     "on a = gs.generate_series")
+    assert "broadcast" not in plan and "redistribute" not in plan
+
+
+def test_aggregate_over_function_scan(s):
+    out = s.sql("select sum(g.generate_series) as t, count(*) as c "
+                "from generate_series(1, 100) g").to_pandas()
+    assert out["t"].iloc[0] == 5050 and out["c"].iloc[0] == 100
+
+
+def test_function_scan_in_subquery(s):
+    s.sql("create table fs (a int) distributed by (a)")
+    s.sql("insert into fs values (1), (3), (7)")
+    df = s.sql("select a from fs where a in "
+               "(select generate_series from generate_series(1, 4)) "
+               "order by a").to_pandas()
+    assert df["a"].tolist() == [1, 3]
+
+
+def test_custom_table_function(s):
+    def colors(n):
+        names = np.asarray(["red", "green", "blue"], dtype=object)
+        idx = np.arange(int(n)) % 3
+        return {"cid": np.arange(int(n), dtype=np.int64),
+                "cname": names[idx], "w": np.linspace(0.0, 1.0, int(n))}
+
+    register_table_function("colors", colors)
+    df = s.sql("select cid, cname, w from colors(4) "
+               "order by cid").to_pandas()
+    assert df["cname"].tolist() == ["red", "green", "blue", "red"]
+    assert df["w"].iloc[-1] == 1.0
+    # strings dictionary-encode: predicates work
+    df = s.sql("select count(*) as c from colors(9) "
+               "where cname = 'blue'").to_pandas()
+    assert df["c"].iloc[0] == 3
+
+
+def test_rows_refresh_per_statement(s):
+    calls = {"n": 0}
+
+    def ticker():
+        calls["n"] += 1
+        return {"tick": np.arange(calls["n"], dtype=np.int64)}
+
+    register_table_function("ticker", ticker)
+    assert len(s.sql("select * from ticker()").to_pandas()) == 1
+    # the FDW re-fetch discipline: every referencing statement re-runs
+    # the function and sees current rows (no stale cached plan/data)
+    assert len(s.sql("select * from ticker()").to_pandas()) == 2
+
+
+def test_null_args_and_caps(s):
+    # strict semantics: a NULL argument yields zero rows, not arg -> 0
+    assert len(s.sql("select * from generate_series(null, 3)")
+               .to_pandas()) == 0
+    with pytest.raises(BindError, match="integer arguments"):
+        s.sql("select * from generate_series(1.5, 3.5)")
+    with pytest.raises(BindError, match="exceeds the cap"):
+        s.sql("select * from generate_series(1, 10000000000)")
+
+
+def test_transient_tables_bounded(s):
+    from cloudberry_tpu.exec import tablefunc
+
+    for i in range(tablefunc.MAX_TRANSIENT_TABLES + 5):
+        s.sql(f"select count(*) as c from generate_series(1, {i + 200})")
+    tfs = [n for n in s.catalog.tables if n.startswith("$tf_")]
+    assert len(tfs) <= tablefunc.MAX_TRANSIENT_TABLES
+
+
+def test_reuse_refreshes_eviction_order(s):
+    """At the pool limit, a statement binding TWO function scans must not
+    evict the first one's (just reused) table while materializing the
+    second."""
+    from cloudberry_tpu.exec import tablefunc
+
+    for i in range(tablefunc.MAX_TRANSIENT_TABLES + 2):
+        s.sql(f"select count(*) as c from generate_series(1, {i + 900})")
+    # generate_series(1, 901) is now the FIFO-oldest survivor; reuse it
+    # alongside a fresh materialization in one statement
+    df = s.sql("select count(*) as c from generate_series(1, 901) a "
+               "join generate_series(1, 12345) b "
+               "on a.generate_series = b.generate_series").to_pandas()
+    assert df["c"].iloc[0] == 901
+
+
+def test_errors(s):
+    with pytest.raises(BindError, match="unknown table function"):
+        s.sql("select * from no_such_fn(1)")
+    # a column reference cannot resolve inside the function's argument
+    # scope; an embedded subquery binds but is not a constant
+    with pytest.raises(BindError, match="unknown column"):
+        s.sql("select * from generate_series(1, a) "
+              "join ft on 1 = 1")
+    with pytest.raises(BindError, match="must be constants"):
+        s.sql("select * from generate_series(1, (select 3))")
+    with pytest.raises(BindError, match="step must not be zero"):
+        s.sql("select * from generate_series(1, 5, 0)")
