@@ -278,6 +278,20 @@ def oracle_q12(t):
                           pd.Timestamp(1999, 3, 24))
 
 
+def _cat_star(t):
+    return (t["catalog_sales"]
+            .merge(t["item"], left_on="cs_item_sk", right_on="i_item_sk")
+            .merge(t["date_dim"], left_on="cs_sold_date_sk",
+                   right_on="d_date_sk"))
+
+
+def oracle_q20(t):
+    return _revenue_ratio(_cat_star(t), "cs_ext_sales_price",
+                          ["Sports", "Music"],
+                          pd.Timestamp(1999, 2, 22),
+                          pd.Timestamp(1999, 3, 24))
+
+
 def oracle_q21(t):
     j = (t["inventory"]
          .merge(t["warehouse"], left_on="inv_warehouse_sk",
@@ -318,7 +332,8 @@ ORACLES = {"q17": oracle_q17, "q25": oracle_q25, "q29": oracle_q29,
            "q3": oracle_q3, "q42": oracle_q42, "q52": oracle_q52,
            "q55": oracle_q55, "q98": oracle_q98, "q27": oracle_q27,
            "q65": oracle_q65, "q36": oracle_q36,
-           "q12": oracle_q12, "q21": oracle_q21, "q86": oracle_q86}
+           "q12": oracle_q12, "q21": oracle_q21, "q86": oracle_q86,
+           "q20": oracle_q20}
 
 
 @pytest.mark.parametrize("qname", sorted(DS_QUERIES))

@@ -174,6 +174,20 @@ order by i_category, i_class, i_item_id, i_item_desc, revenueratio
 limit 100
 """
 
+DS_QUERIES["q20"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) as itemrevenue,
+       sum(cs_ext_sales_price) * 100 / sum(sum(cs_ext_sales_price))
+         over (partition by i_class) as revenueratio
+from catalog_sales join item on cs_item_sk = i_item_sk
+     join date_dim on cs_sold_date_sk = d_date_sk
+where i_category in ('Sports', 'Music')
+  and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+limit 100
+"""
+
 # q21 (adapted: price band widened to the generated price range)
 DS_QUERIES["q21"] = """
 select * from (

@@ -36,7 +36,8 @@ SCHEMAS: dict[str, Schema] = {
     "catalog_sales": Schema.of(cs_sold_date_sk=T.INT64, cs_item_sk=T.INT64,
                                cs_bill_customer_sk=T.INT64,
                                cs_quantity=T.INT32,
-                               cs_net_profit=T.DECIMAL(2)),
+                               cs_net_profit=T.DECIMAL(2),
+                               cs_ext_sales_price=T.DECIMAL(2)),
     "web_sales": Schema.of(ws_sold_date_sk=T.INT64, ws_item_sk=T.INT64,
                            ws_bill_customer_sk=T.INT64,
                            ws_quantity=T.INT32,
@@ -161,6 +162,11 @@ def generate(scale: float = 1.0, seed: int = 0):
         .astype(np.int64),
         "cs_quantity": rng.integers(1, 100, n_cs).astype(np.int32),
         "cs_net_profit": rng.integers(-5_000, 20_000, n_cs) / 100.0,
+        # round-4 q20 column on its own stream: committed queries'
+        # selectivities are pinned to the EXISTING streams' draw
+        # sequences, so new columns never touch them
+        "cs_ext_sales_price": np.random.default_rng(seed + 424243)
+        .integers(100, 50_000, n_cs) / 100.0,
     }
 
     # web/inventory family (q12/q21/q86): OWN rng streams — consuming the
