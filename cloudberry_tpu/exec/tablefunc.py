@@ -67,12 +67,52 @@ MAX_RESULT_BYTES = 1 << 30
 MAX_TRANSIENT_TABLES = 16
 
 
+def begin_statement(catalog) -> None:
+    """Reset the CURRENT THREAD's per-statement pin set. Tables
+    materialized while one statement binds must survive until that
+    statement plans — FIFO eviction alone would drop an early function
+    table when a single query references >= MAX_TRANSIENT_TABLES
+    distinct calls, leaving a later scan pointing at a removed catalog
+    entry. Pins are keyed by thread because a shared-session server
+    binds concurrent SELECTs on one catalog under a shared read lock;
+    a global set would let statement B's reset unpin statement A's
+    tables mid-bind. Entries for finished threads are pruned here so a
+    dead thread's pins cannot exhaust the pool."""
+    import threading
+
+    pins = catalog.__dict__.setdefault("_tf_pinned", {})
+    live = {t.ident for t in threading.enumerate()}
+    for tid in list(pins):
+        if tid not in live:
+            pins.pop(tid, None)
+    pins[threading.get_ident()] = set()
+
+
+def _pin(catalog, tname: str) -> None:
+    import threading
+
+    pins = catalog.__dict__.setdefault("_tf_pinned", {})
+    pins.setdefault(threading.get_ident(), set()).add(tname)
+
+
 def _evict_transients(catalog) -> None:
-    tfs = [n for n in catalog.tables if n.startswith("$tf_")]
-    while len(tfs) >= MAX_TRANSIENT_TABLES:
+    pins = getattr(catalog, "_tf_pinned", None) or {}
+    # honor EVERY live statement's pins, not just this thread's
+    pinned = set().union(*pins.values()) if pins else frozenset()
+    total = sum(1 for n in catalog.tables if n.startswith("$tf_"))
+    tfs = [n for n in catalog.tables
+           if n.startswith("$tf_") and n not in pinned]
+    while total >= MAX_TRANSIENT_TABLES:
+        if not tfs:
+            raise ValueError(
+                "statement references more than "
+                f"{MAX_TRANSIENT_TABLES} distinct table-function "
+                "results (the transient-table pool size); raise "
+                "cloudberry_tpu.exec.tablefunc.MAX_TRANSIENT_TABLES")
         # FIFO (dict preserves insertion order). No SQL name can spell a
         # $-prefixed table, so direct removal needs no ddl bump
         del catalog.tables[tfs.pop(0)]
+        total -= 1
 
 
 def materialize(catalog, fname: str, fn: Callable, vals: list) -> str:
@@ -146,6 +186,7 @@ def materialize(catalog, fname: str, fn: Callable, vals: list) -> str:
         # (session._any_external): the function re-runs per statement,
         # like a foreign table's re-fetch
         t._tablefunc = True
+    _pin(catalog, tname)  # current statement's bind must not evict it
     t._loading = True  # ephemeral: function rows never persist
     try:
         t.set_data(data, dicts)

@@ -305,7 +305,8 @@ class Binder:
                     # a NULL-literal column takes the string side's type:
                     # code 0 under an always-False mask (grouping-set
                     # branches project NULL for omitted keys)
-                    if getattr(rf, "_is_null_col", False)                             and lf.type.base == DType.STRING:
+                    if (getattr(rf, "_is_null_col", False)
+                            and lf.type.base == DType.STRING):
                         lex.append((lf.name, le))
                         rex.append((lf.name, ex.Literal(0, lf.type)))
                         lfields.append(N.PlanField(lf.name, lf.type,
@@ -314,7 +315,8 @@ class Binder:
                                                    lf.sdict))
                         changed_r = True
                         continue
-                    if getattr(lf, "_is_null_col", False)                             and rf.type.base == DType.STRING:
+                    if (getattr(lf, "_is_null_col", False)
+                            and rf.type.base == DType.STRING):
                         lex.append((lf.name, ex.Literal(0, rf.type)))
                         rex.append((lf.name, re_))
                         lfields.append(N.PlanField(lf.name, rf.type,

@@ -31,6 +31,11 @@ class PlanResult:
 def plan_statement(stmt: ast.Node, session, params: dict,
                    explain_only: bool = False) -> PlanResult:
     catalog = session.catalog
+    # new statement: function tables it materializes while binding are
+    # pinned against transient-pool eviction until the next statement
+    from cloudberry_tpu.exec import tablefunc as _tf
+
+    _tf.begin_statement(catalog)
     _refresh_referenced_externals(session, stmt)
 
     if isinstance(stmt, ast.CreateTable):

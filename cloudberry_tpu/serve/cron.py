@@ -39,9 +39,17 @@ class Job:
 
 @dataclass
 class Scheduler:
-    """Background job runner over one session (the cron bgworker)."""
+    """Background job runner over one session (the cron bgworker).
+
+    ``execute`` (when given) replaces the raw ``session.sql`` call so the
+    owner can interpose its own statement-level locking — the Server
+    passes a callback that takes its readers-writer lock, because in
+    shared-session mode a scheduled write would otherwise race concurrent
+    client reads on the same Session (the data/stats swap the lock
+    exists to serialize)."""
 
     session: object
+    execute: Optional[object] = None
     tick_s: float = 0.5
     jobs: dict[str, Job] = field(default_factory=dict)
     _stop: threading.Event = field(default_factory=threading.Event)
@@ -112,8 +120,10 @@ class Scheduler:
         for j in due:
             j.last_started = now
             j.next_run = now + j.interval_s
+            run = self.execute if self.execute is not None \
+                else self.session.sql
             try:
-                self.session.sql(j.sql)
+                run(j.sql)
                 j.runs += 1
                 j.failures = 0
                 j.last_error = None

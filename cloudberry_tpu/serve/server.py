@@ -192,7 +192,39 @@ class Server:
         # and run in the serving process's session
         from cloudberry_tpu.serve.cron import Scheduler
 
-        self.cron = Scheduler(self.session).load()
+        self.cron = Scheduler(self.session,
+                              execute=self._cron_execute).load()
+
+    def _locked(self, write: bool = False):
+        """Statement-level lock scope: a no-op in per-connection mode
+        (each backend has its own catalog; the store's OCC arbitrates),
+        shared read/exclusive write otherwise. Every path that touches
+        the shared session — wire SQL, meta, retrieve, cron jobs — must
+        go through this one helper so the lock discipline has a single
+        home."""
+        import contextlib
+
+        if self.per_connection:
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def scope():
+            acq = self._rw.acquire_write if write else self._rw.acquire_read
+            rel = self._rw.release_write if write else self._rw.release_read
+            acq()
+            try:
+                yield
+            finally:
+                rel()
+
+        return scope()
+
+    def _cron_execute(self, sql: str):
+        """Run a cron job's statement under the same statement-level
+        locking a wire client would get: in shared-session mode a
+        scheduled write must exclude concurrent reader threads."""
+        with self._locked(write=not _is_read(sql)):
+            return self.session.sql(sql)
 
     # ----------------------------------------------------- authentication
 
@@ -208,8 +240,13 @@ class Server:
                 return ({"ok": False, "fatal": True,
                          "error": "too many failed logins; address locked "
                                   f"for {self.lockout_s:.0f}s"}, False)
+        import hmac
+
+        # bytes, not str: compare_digest on str raises for non-ASCII,
+        # which would lock out any server with a non-ASCII token
         token = req.get("auth")
-        if token == self.auth_token:
+        if hmac.compare_digest(str(token or "").encode(),
+                               str(self.auth_token).encode()):
             with self._login_lock:
                 self._login_failures.pop(addr, None)
             return ({"ok": True, "status": "authenticated"}, True)
@@ -293,15 +330,10 @@ class Server:
             # clients — the MCP analog, serve/mcp.py, is the main consumer)
             from cloudberry_tpu.serve.meta import describe
 
-            if not self.per_connection:
-                self._rw.acquire_read()
-            try:
+            with self._locked():
                 return {"ok": True,
                         "meta": describe(sess, req["meta"],
                                          req.get("arg"))}
-            finally:
-                if not self.per_connection:
-                    self._rw.release_read()
         if "cron" in req:
             # scheduled statements over the wire (cron.schedule role)
             from cloudberry_tpu.serve.cron import CronError
@@ -336,15 +368,10 @@ class Server:
             if not isinstance(r, dict) or "token" not in r:
                 return {"ok": False,
                         "error": "retrieve needs cursor/segment/token"}
-            if not self.per_connection:
-                self._rw.acquire_read()
-            try:
+            with self._locked():
                 out = sess.retrieve(
                     r.get("cursor", ""), int(r.get("segment", 0)),
                     r.get("limit"), r["token"])
-            finally:
-                if not self.per_connection:
-                    self._rw.release_read()
             out["rows"] = [[_json_safe(v) for v in row]
                            for row in out["rows"]]
             return {"ok": True, **out}
@@ -371,21 +398,12 @@ class Server:
                     "(connections share one session); start the server "
                     "with config.storage.root set, or use the in-process "
                     "API for BEGIN/COMMIT/ROLLBACK"}
-        elif _is_read(sql):
-            self._rw.acquire_read()
-            try:
-                result = sess.sql(sql)
-            finally:
-                self._rw.release_read()
         else:
-            # catalog mutation: exclusive — concurrent readers would race
-            # the data/stats swap (the OCC layer handles cross-PROCESS
-            # writers; this lock handles threads)
-            self._rw.acquire_write()
-            try:
+            # shared session: reads share, catalog mutations exclude —
+            # concurrent readers would race the data/stats swap (the OCC
+            # layer handles cross-PROCESS writers; this lock, threads)
+            with self._locked(write=not _is_read(sql)):
                 result = sess.sql(sql)
-            finally:
-                self._rw.release_write()
         if isinstance(result, dict):
             # DECLARE PARALLEL RETRIEVE CURSOR: endpoint directory + token
             return {"ok": True, **{k: _json_safe(v) if not isinstance(

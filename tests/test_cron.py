@@ -71,3 +71,25 @@ def test_cron_over_the_wire(tmp_path):
             assert jobs[0]["name"] == "w" and jobs[0]["runs"] >= 2
             c._request({"cron": {"op": "unschedule", "name": "w"}})
             assert c._request({"cron": {"op": "status"}})["jobs"] == []
+
+
+def test_cron_uses_server_statement_lock():
+    """In shared-session mode the scheduler must run job SQL through the
+    Server's readers-writer lock, not raw session.sql — a scheduled
+    write would otherwise race concurrent client reads (advisor r4)."""
+    sess = cb.Session()  # explicit session => shared (legacy) mode
+    sess.sql("create table clk (x bigint)")
+    with Server(session=sess, port=0) as srv:
+        assert srv.per_connection is False
+        assert srv.cron.execute == srv._cron_execute
+        # the executor path itself must work for both classes
+        srv._cron_execute("insert into clk values (1)")
+        assert srv._cron_execute(
+            "select count(*) from clk").to_pandas().iloc[0, 0] == 1
+        srv.cron.schedule("j", 0.05, "insert into clk values (2)")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if srv.cron.status()[0]["runs"] >= 1:
+                break
+            srv.cron.run_due(time.monotonic() + 1)
+        assert srv.cron.status()[0]["failures"] == 0

@@ -128,6 +128,31 @@ def test_reuse_refreshes_eviction_order(s):
     assert df["c"].iloc[0] == 901
 
 
+def test_statement_pins_survive_pool_pressure(s, monkeypatch):
+    """One statement binding several function scans while the pool is
+    tiny must keep EVERY table it materialized alive through the bind —
+    FIFO pressure may only evict other statements' leftovers."""
+    from cloudberry_tpu.exec import tablefunc
+
+    monkeypatch.setattr(tablefunc, "MAX_TRANSIENT_TABLES", 3)
+    for i in range(5):  # fill the pool with stale transients
+        s.sql(f"select count(*) as c from generate_series(1, {i + 50})")
+    df = s.sql(
+        "select count(*) as c from generate_series(1, 7) a "
+        "join generate_series(1, 11) b on a.generate_series = "
+        "b.generate_series join generate_series(1, 5) c "
+        "on a.generate_series = c.generate_series").to_pandas()
+    assert df["c"].iloc[0] == 5
+    # but a single statement needing MORE than the whole pool reports
+    # the pool, not a dangling catalog entry
+    monkeypatch.setattr(tablefunc, "MAX_TRANSIENT_TABLES", 2)
+    with pytest.raises(BindError, match="transient-table pool"):
+        s.sql("select count(*) as c from generate_series(1, 21) a "
+              "join generate_series(1, 22) b on a.generate_series = "
+              "b.generate_series join generate_series(1, 23) c "
+              "on a.generate_series = c.generate_series")
+
+
 def test_errors(s):
     with pytest.raises(BindError, match="unknown table function"):
         s.sql("select * from no_such_fn(1)")
