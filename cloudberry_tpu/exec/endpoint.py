@@ -78,7 +78,7 @@ def declare(session, name: str, query_ast) -> dict:
     name = name.lower()
     if name in session.parallel_cursors:
         raise CursorError(f"cursor {name!r} already exists")
-    plan = _optimize(Binder(session.catalog).bind_query(query_ast), session)
+    plan = _optimize(Binder(session.catalog, session.config).bind_query(query_ast), session)
     # the cursor's query is a statement like any other: per-query budget,
     # queue slot (MAX_COST, priority) and vmem reservation all apply
     est = check_admission(plan, session)

@@ -281,15 +281,35 @@ def grow_expansion(plan: N.PlanNode, message: str,
     """Adaptive recovery from a detected join-expansion overflow (the
     increase-nbatch-and-retry discipline of nodeHash.c): grow the named
     join's pair buffer and report success. The caller recompiles and
-    re-runs — results are never truncated."""
+    re-runs — results are never truncated. A skew-blown redistribute
+    bucket grows the same way (a hot destination received more than the
+    fair-share estimate — the Motion receive-buffer resize the
+    reference performs in the interconnect layer)."""
     node = find_expansion_node(plan, message)
-    if node is None:
-        return False
-    node.out_capacity = max(node.out_capacity * factor, 64)
-    # capacity re-derivations (e.g. tiled _retile) must never shrink a
-    # runtime-grown buffer back below what overflowed
-    node._min_out_cap = node.out_capacity
-    return True
+    if node is not None:
+        node.out_capacity = max(node.out_capacity * factor, 64)
+        # capacity re-derivations (e.g. tiled _retile) must never shrink
+        # a runtime-grown buffer back below what overflowed
+        node._min_out_cap = node.out_capacity
+        return True
+    if "redistribute overflow" in message:
+        import re
+
+        m = re.search(r"\(node (\d+)\)", message)
+        if m is not None:
+            nid = int(m.group(1))
+            for nd in all_nodes(plan):
+                if id(nd) == nid and isinstance(nd, N.PMotion):
+                    # out_capacity tracks bucket_cap × nseg; recover the
+                    # factor so memory estimates see the grown buffer
+                    nseg = max(1, (nd.out_capacity or nd.bucket_cap)
+                               // max(nd.bucket_cap, 1))
+                    nd.bucket_cap = max(nd.bucket_cap * factor, 64)
+                    nd.out_capacity = nd.bucket_cap * nseg
+                    # tiled re-derivations must never shrink it back
+                    nd._min_bucket_cap = nd.bucket_cap
+                    return True
+    return False
 
 
 def scans_of(plan: N.PlanNode):

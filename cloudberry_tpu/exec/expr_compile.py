@@ -138,6 +138,15 @@ def _compile_func(e: ex.Func):
         # decimal multiplication.
         k = int(e.args[1].value)  # type: ignore[attr-defined]
         return lambda cols: _scale_down(args[0](cols), k)
+    if name.startswith("udf:"):
+        # jit scalar UDF (exec/udf.py): the registered callable traces
+        # into the program — a TPU-native function body
+        from cloudberry_tpu.exec import udf as U
+
+        u = U.lookup(name[4:])
+        if u is not None and u.jit:
+            fn = u.fn
+            return lambda cols: fn(*[a(cols) for a in args])
     raise NotImplementedError(f"function {name}")
 
 

@@ -44,10 +44,15 @@ def sort_key_u64(col: jnp.ndarray) -> jnp.ndarray:
                          jnp.uint32(0xFFFFFFFF), jnp.uint32(1) << jnp.uint32(31))
         return (bits ^ mask).astype(jnp.uint64)
     if col.dtype == jnp.float64:
-        # f64→u64 bitcast does NOT compile on the TPU backend (see
-        # .claude/skills/verify/SKILL.md); genuine DOUBLE sort keys are
-        # CPU-only until reworked — DECIMAL (int64) is the hot-path type.
-        bits = col.view(jnp.uint64)
+        # The TPU backend cannot compile a direct f64→u64 bitcast, but it
+        # CAN bitcast f64 to two u32 words (bitcast_convert_type to a
+        # narrower type appends a minor dimension, index 0 = least
+        # significant word — XLA semantics). Reassemble the IEEE bits
+        # with u64 shifts (u64 ARITHMETIC is supported/emulated), then
+        # apply the same total-order mask as f32.
+        words = jax.lax.bitcast_convert_type(col, jnp.uint32)
+        bits = (words[..., 1].astype(jnp.uint64) << jnp.uint64(32)) \
+            | words[..., 0].astype(jnp.uint64)
         mask = jnp.where(bits >> jnp.uint64(63) != 0,
                          jnp.uint64(0xFFFFFFFFFFFFFFFF), _SIGN64)
         return bits ^ mask

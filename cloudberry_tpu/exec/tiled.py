@@ -77,7 +77,10 @@ class _TileShape:
     root: N.PlanNode = None           # type: ignore[assignment]
     g_cap: int = 0                    # accumulator capacity (groups / rows)
     mode: str = "agg"
-    sortnode: Optional[N.PSort] = None  # topn: the bounding sort
+    sortnode: Optional[N.PSort] = None  # topn/sort: the (synthetic) sort
+    winnode: Optional[N.PWindow] = None  # window mode: BOTTOM of the stack
+    wintop: Optional[N.PWindow] = None   # window mode: TOP of the stack
+    n_ckeys: int = 0                  # window mode: chunk-key count
 
 
 def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
@@ -102,7 +105,17 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
         if isinstance(node, N.PJoin) and hasattr(node, "_min_out_cap"):
             del node._min_out_cap
     if shape.mode == "topn":
-        return _plan_topn(shape, session)
+        t = _plan_topn(shape, session)
+        if t is not None:
+            return t
+        # the LIMIT+OFFSET exceeds any resident accumulator: fall back
+        # to the full external sort and apply the limit host-side
+        shape.mode = "sort"
+        shape.g_cap = 0
+    if shape.mode == "sort":
+        return _plan_sort(shape, session)
+    if shape.mode == "window":
+        return _plan_window(shape, session)
     try:
         partial_aggs, final_aggs, finalize = _split_aggs(shape.agg.aggs)
     except ValueError:
@@ -177,6 +190,91 @@ def _plan_topn(shape: _TileShape, session) -> Optional["TopNTiledExecutable"]:
     return TopNTiledExecutable(shape, session, tile_rows, budget)
 
 
+def _full_sort_shape(chain: list):
+    """Unbounded ORDER BY shape: the lowest sort, with only
+    column-pruning projections and LIMIT/OFFSET above it — the
+    external-sort path (tuplesort.c's spill-to-tape mode; here host RAM
+    is the tape: the device streams spine tiles and emits rows plus
+    their order-normalized u64 keys, the host keeps the runs and one
+    C-speed stable key sort is the merge pass). Returns the sort node,
+    or None when the chain has a different shape."""
+    sort_i = next((i for i in range(len(chain) - 1, -1, -1)
+                   if isinstance(chain[i], N.PSort)), None)
+    if sort_i is None:
+        return None
+    if any(not isinstance(n, (N.PProject, N.PFilter))
+           for n in chain[sort_i + 1:]):
+        return None
+    for n in chain[:sort_i]:
+        if isinstance(n, N.PLimit):
+            continue
+        if isinstance(n, N.PProject) and all(
+                isinstance(e, ex.ColumnRef) for _, e in n.exprs):
+            continue
+        return None  # computed outputs above the sort: not host-applicable
+    return chain[sort_i]
+
+
+def _plan_sort(shape: _TileShape,
+               session) -> Optional["SortTiledExecutable"]:
+    """Full external sort: stream the spine, keep every surviving row
+    (plus order-normalized keys) in host RAM, one stable key sort as the
+    merge pass, then apply the post chain (column pruning + LIMIT)
+    host-side. The device budget covers resident builds + one tile's
+    working set; the result itself lives host-side — the workfile."""
+    # the topn fallback arrives here WITHOUT _full_sort_shape's chain
+    # validation: re-check that everything above the sort is
+    # host-applicable (column-pruning projections and LIMIT only)
+    for nd in shape.post:
+        if isinstance(nd, N.PLimit):
+            continue
+        if isinstance(nd, N.PProject) and all(
+                isinstance(e, ex.ColumnRef) for _, e in nd.exprs):
+            continue
+        return None
+    shape.partial_plan = shape.sortnode.child
+    budget = session.config.resource.query_mem_bytes
+    tile_rows = _choose_tile(shape, budget)
+    if tile_rows is None:
+        return None
+    shape.root = shape.post[0] if shape.post else shape.sortnode
+    return SortTiledExecutable(shape, session, tile_rows, budget)
+
+
+def _plan_window(shape: _TileShape,
+                 session) -> Optional["WindowTiledExecutable"]:
+    """Window spill: phase one is the external-sort stream grouped by
+    the partition keys COMMON to every spec in the stack; phase two
+    windows whole-partition chunks on device (WindowTiledExecutable) —
+    each chunk re-sorts per spec, so only the grouping must align. A
+    stack with no common partition key is one giant partition — nothing
+    bounds its working set, so it cannot stream (the reference buffers
+    that case too)."""
+    bottom = shape.winnode
+    # common partition keys across the stack, matched structurally;
+    # expr objects come from the BOTTOM spec (they bind over its child)
+    common = {repr(pk): pk for pk in bottom.partition_keys}
+    node = shape.wintop
+    while isinstance(node, N.PWindow):
+        here = {repr(pk) for pk in node.partition_keys}
+        common = {k: v for k, v in common.items() if k in here}
+        node = node.child
+    if not common:
+        return None
+    ckeys = list(common.values())
+    srt = N.PSort(bottom.child, [(ck, True) for ck in ckeys])
+    srt.fields = list(bottom.child.fields)
+    shape.sortnode = srt
+    shape.n_ckeys = len(ckeys)
+    shape.partial_plan = bottom.child
+    budget = session.config.resource.query_mem_bytes
+    tile_rows = _choose_tile(shape, budget)
+    if tile_rows is None:
+        return None
+    shape.root = shape.post[0] if shape.post else shape.wintop
+    return WindowTiledExecutable(shape, session, tile_rows, budget)
+
+
 def _topn_bound(chain: list, skip: tuple = ()):
     """Locate a topn-streamable post chain's bounding sort and LIMIT: the
     LOWEST sort, fed only by projections/filters (part of the stream),
@@ -223,17 +321,40 @@ def _analyze(plan: N.PlanNode) -> Optional[_TileShape]:
 
     agg: Optional[N.PAgg] = None
     sortnode: Optional[N.PSort] = None
+    winnode: Optional[N.PWindow] = None
     post: list[N.PlanNode] = []
     m = 0
     if isinstance(cur, N.PAgg) and cur.mode == "single":
         agg = cur
         post = chain
         spine_top = agg.child
+    elif isinstance(cur, N.PWindow):
+        # window mode: a stack of window specs over the spine (one
+        # PWindow per distinct OVER clause); above it only
+        # column-pruning projections (the nodeWindowAgg spill shape).
+        # Chunking needs partition keys COMMON to every spec — each
+        # device chunk re-sorts per spec, so only the grouping must
+        # align (checked in _plan_window).
+        if any(not (isinstance(nd, N.PProject) and all(
+                isinstance(e, ex.ColumnRef) for _, e in nd.exprs))
+               for nd in chain):
+            return None
+        post = chain
+        wintop = cur
+        while isinstance(cur, N.PWindow):
+            winnode = cur
+            cur = cur.child
+        spine_top = cur
     else:
         hit = _topn_bound(chain)
-        if hit is None:
-            return None  # unbounded sort: no fixed accumulator exists
-        sortnode, m = hit
+        if hit is not None:
+            sortnode, m = hit
+        else:
+            # no bounding LIMIT: full external sort (host-RAM workfile)
+            sortnode = _full_sort_shape(chain)
+            if sortnode is None:
+                return None
+            m = 0
         post = chain[:chain.index(sortnode)]
         spine_top = sortnode.child
 
@@ -259,8 +380,12 @@ def _analyze(plan: N.PlanNode) -> Optional[_TileShape]:
             rows = cur.num_rows if cur.num_rows >= 0 else cur.capacity
             shape = _TileShape(agg, post, spine, cur, builds,
                                stream_rows=max(rows, 1))
-            if agg is None:
-                shape.mode = "topn"
+            if winnode is not None:
+                shape.mode = "window"
+                shape.winnode = winnode
+                shape.wintop = wintop
+            elif agg is None:
+                shape.mode = "topn" if m else "sort"
                 shape.sortnode = sortnode
                 shape.g_cap = m
             return shape
@@ -725,6 +850,248 @@ class TopNTiledExecutable(TiledExecutable):
                           jax.jit(step_fn, donate_argnums=donate),
                           jax.jit(finalize_fn))
         return self._compiled
+
+
+class SortTiledExecutable(TiledExecutable):
+    """Tiled statement whose result is a FULL ORDER BY with no bounding
+    limit — the external-merge-sort analog (tuplesort.c spill mode,
+    workfile_mgr.c's tape role played by host RAM). Per tile, the step
+    program runs the spine and emits the surviving rows together with
+    one order-normalized u64 column per sort key (same normalization
+    kernels.sort_indices uses, so device and host orders cannot
+    disagree — descending keys bit-complement, NULL ordering rides the
+    binder's is-null companion keys). The host appends each tile's rows
+    to the run store; the merge pass is one stable host key sort over
+    the collected runs, then the post chain (column pruning, LIMIT)
+    applies host-side."""
+
+    _what = "external-sort tiled execution"
+
+    def _groups_ceiling(self) -> int:
+        return 0  # no accumulator exists to grow
+
+    def _refresh_report(self) -> None:
+        shape = self.shape
+        _retile(shape, self.tile_rows)
+        est = estimate_plan_memory(shape.partial_plan).peak_bytes
+        self.report = {
+            "tiled": True,
+            "mode": "sort",
+            "stream_table": shape.stream.table_name,
+            "tile_rows": self.tile_rows,
+            "acc_capacity": 0,
+            "est_step_bytes": est + _merge_bytes(shape),
+            "budget_bytes": self.budget,
+        }
+
+    def _compile(self):
+        if self._compiled is not None:
+            return self._compiled
+        shape = self.shape
+        plat, pallas = self._platform, self._use_pallas
+        sort = shape.sortnode
+        names = [f.name for f in sort.child.fields]
+
+        def prelude_fn(tables):
+            low = X.Lowerer(tables, platform=plat, use_pallas=pallas)
+            outs = [low.lower_shared(b) for b in shape.builds]
+            return outs, low.checks
+
+        def step_fn(resident, prelude, tile, tile_n):
+            tables = dict(resident)
+            tables["$tile"] = tile
+            replace = {id(b): prelude[i]
+                       for i, b in enumerate(shape.builds)}
+            low = _TileLowerer(tables, shape.stream, tile_n, replace,
+                               platform=plat, use_pallas=pallas)
+            pcols, psel = low.lower(shape.partial_plan)
+            n = psel.shape[0]
+            keys = []
+            for e, asc in sort.keys:
+                arr = X._as_column(X._sortable(e, sort.child, pcols), n)
+                u = K.sort_key_u64(arr)
+                keys.append(u if asc else ~u)
+            out = {nm: X._as_column(pcols[nm], n) for nm in names}
+            return (out, psel, keys), low.checks
+
+        self._compiled = (jax.jit(prelude_fn), jax.jit(step_fn))
+        return self._compiled
+
+    def _stream_sorted(self):
+        """Run the tile stream and the merge pass; returns
+        (sorted child columns, sorted normalized key columns, n_tiles)
+        as host arrays."""
+        prelude_fn, step_fn = self._compile()
+        shape = self.shape
+        resident = self._resident_inputs()
+        prelude, pchecks = prelude_fn(resident)
+        X.raise_checks(pchecks)
+
+        names = [f.name for f in shape.sortnode.child.fields]
+        runs: dict[str, list] = {nm: [] for nm in names}
+        key_runs: list[list] = [[] for _ in shape.sortnode.keys]
+        n_tiles = 0
+        for tile, tile_n in _tile_feed(shape.stream, self.session,
+                                       self.tile_rows):
+            fault_point("tile_step")
+            (pcols, psel, keys), checks = step_fn(
+                resident, prelude, tile,
+                jnp.asarray(tile_n, dtype=jnp.int32))
+            _raise_tile_checks(checks, n_tiles)
+            n_tiles += 1
+            mask = np.asarray(psel)
+            for nm in names:
+                runs[nm].append(np.asarray(pcols[nm])[mask])
+            for i, k in enumerate(keys):
+                key_runs[i].append(np.asarray(k)[mask])
+
+        fault_point("tiled_finalize")
+        if n_tiles == 0 or not any(len(r) for r in runs[names[0]]):
+            cols = {nm: np.zeros(
+                (0,), dtype=shape.sortnode.child.field(nm).type.np_dtype)
+                for nm in names}
+            karr = [np.zeros((0,), dtype=np.uint64)
+                    for _ in shape.sortnode.keys]
+        else:
+            # merge pass: one stable sort over the order-normalized keys
+            # (np.lexsort: LAST key is primary — mirror sort_indices)
+            karr = [np.concatenate(kr) for kr in key_runs]
+            order = np.lexsort(tuple(reversed(karr)))
+            cols = {nm: np.concatenate(runs[nm])[order] for nm in names}
+            karr = [k[order] for k in karr]
+        return cols, karr, max(n_tiles, 1)
+
+    def _run_once(self) -> ColumnBatch:
+        shape = self.shape
+        cols, _karr, n_tiles = self._stream_sorted()
+        # post chain host-side, bottom-up: column pruning and LIMIT only
+        # (_full_sort_shape guaranteed the shape)
+        for node in reversed(shape.post):
+            if isinstance(node, N.PLimit):
+                lo = min(node.offset, len(next(iter(cols.values()))) if
+                         cols else 0)
+                hi = lo + node.limit
+                cols = {nm: a[lo:hi] for nm, a in cols.items()}
+            else:
+                cols = {out: cols[e.name] for out, e in node.exprs}
+        n_out = len(next(iter(cols.values()))) if cols else 0
+        self.report["n_tiles"] = n_tiles
+        self.session.last_tiled_report = dict(self.report)
+        out_node = shape.post[0] if shape.post else shape.sortnode
+        return X.make_batch(out_node, cols,
+                            np.ones((n_out,), dtype=bool))
+
+
+class WindowTiledExecutable(SortTiledExecutable):
+    """Tiled window functions — the nodeWindowAgg.c spill analog. Phase
+    one reuses the external-sort stream, ordered by (partition keys,
+    order keys), so the host holds every surviving spine row grouped by
+    partition. Phase two packs WHOLE partitions into fixed-capacity
+    chunks and runs the original window (+ projection chain) on device
+    once per chunk: window functions never cross partitions, so chunks
+    are independent and every frame kind stays exact — no carry state.
+    Only a single partition larger than the chunk capacity cannot
+    stream; that raises with a clear message (the reference's
+    one-partition tuplestore has the same working-set floor)."""
+
+    _what = "windowed tiled execution"
+
+    def _refresh_report(self) -> None:
+        super()._refresh_report()
+        self.report["mode"] = "window"
+
+    def _chunk_fn(self):
+        if getattr(self, "_chunk_compiled", None) is not None:
+            return self._chunk_compiled
+        shape = self.shape
+        win = shape.winnode
+        plat, pallas = self._platform, self._use_pallas
+        cap = self.tile_rows
+
+        def run_chunk(chunk_cols, n_valid):
+            sel = jnp.arange(cap) < n_valid
+            low = _ReplacingLowerer(
+                {}, {id(win.child): (chunk_cols, sel)},
+                platform=plat, use_pallas=pallas)
+            cols, osel = low.lower(shape.root)
+            out = {f.name: cols[f.name] for f in shape.root.fields}
+            return out, osel, low.checks
+
+        self._chunk_compiled = jax.jit(run_chunk)
+        return self._chunk_compiled
+
+    def _run_once(self) -> ColumnBatch:
+        shape = self.shape
+        self._chunk_compiled = None  # capacity may have changed
+        cols, karr, n_tiles = self._stream_sorted()
+        names = [f.name for f in shape.winnode.child.fields]
+        final, n_chunks = window_chunk_pass(
+            self._chunk_fn(), shape.root, names, cols, karr,
+            shape.n_ckeys, self.tile_rows)
+        n_out = len(next(iter(final.values()))) if final else 0
+        self.report["n_tiles"] = n_tiles
+        self.report["n_chunks"] = n_chunks
+        self.session.last_tiled_report = dict(self.report)
+        return X.make_batch(shape.root, final,
+                            np.ones((n_out,), dtype=bool))
+
+
+def window_chunk_pass(run, root, names, cols, karr, npk, cap):
+    """Phase two of window spill, shared by the single-node and
+    distributed executables: pack WHOLE partitions (runs of equal
+    normalized chunk keys) into fixed-capacity chunks and feed each
+    through the jitted window program ``run``. Returns (output columns,
+    chunk count)."""
+    out_fields = root.fields
+    n = len(cols[names[0]]) if names else 0
+    if n == 0:
+        return ({f.name: np.zeros((0,), dtype=f.type.np_dtype)
+                 for f in out_fields}, 0)
+    new_part = np.zeros(n, dtype=bool)
+    new_part[0] = True
+    for k in karr[:npk]:
+        new_part[1:] |= k[1:] != k[:-1]
+    starts = np.flatnonzero(new_part)
+    sizes = np.diff(np.append(starts, n))
+    if sizes.max(initial=0) > cap:
+        raise X.ExecError(
+            f"windowed tiled execution: one partition holds "
+            f"{int(sizes.max())} rows, more than the {cap}-row chunk "
+            "the memory budget allows; raise "
+            "config.resource.query_mem_bytes")
+    outs: dict[str, list] = {f.name: [] for f in out_fields}
+    n_chunks = 0
+    chunk_lo = chunk_hi = 0
+
+    def flush(lo, hi):
+        nonlocal n_chunks
+        if hi <= lo:
+            return
+        m = hi - lo
+        chunk = {}
+        for nm in names:
+            a = cols[nm][lo:hi]
+            if m < cap:
+                a = np.concatenate(
+                    [a, np.zeros((cap - m,), dtype=a.dtype)])
+            chunk[nm] = a
+        ocols, osel, checks = run(chunk, jnp.asarray(m, dtype=jnp.int32))
+        _raise_tile_checks(checks, n_chunks)
+        n_chunks += 1
+        mask = np.asarray(osel)
+        for nm in outs:
+            outs[nm].append(np.asarray(ocols[nm])[mask])
+
+    for s, size in zip(starts, sizes):
+        if chunk_hi - chunk_lo + size > cap and chunk_hi > chunk_lo:
+            flush(chunk_lo, chunk_hi)
+            chunk_lo = s
+        chunk_hi = s + size
+    flush(chunk_lo, chunk_hi)
+    final = {nm: np.concatenate(arrs) if arrs else
+             np.zeros((0,), dtype=root.field(nm).type.np_dtype)
+             for nm, arrs in outs.items()}
+    return final, n_chunks
 
 
 def _leaf_of(root: N.PlanNode) -> N.PlanNode:

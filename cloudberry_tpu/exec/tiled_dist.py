@@ -77,8 +77,11 @@ class _DistTileShape:
     g_cap: int = 0                   # per-segment accumulator capacity
     max_groups: int = 0              # hard ceiling for g_cap growth
     mode: str = "agg"
-    sortnode: Optional[N.PSort] = None  # topn: the bounding sort
+    sortnode: Optional[N.PSort] = None  # topn/sort: the (synthetic) sort
     post: list = field(default_factory=list)  # topn: chain above spine
+    post_above: list = field(default_factory=list)  # sort: above the sort
+    winnode: Optional[N.PWindow] = None  # window: BOTTOM of the stack
+    n_ckeys: int = 0                     # window: chunk-key count
 
 
 def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"]:
@@ -114,11 +117,53 @@ def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"
 
     budget = session.config.resource.query_mem_bytes
     tile_rows = _choose_tile_dist(shape, budget, session.config.n_segments)
+    if tile_rows is None and shape.mode == "topn":
+        # LIMIT+OFFSET exceeds any resident accumulator: fall back to
+        # the full external sort (host RAM is the workfile) when the
+        # chain above the sort can apply host-side
+        s2 = _to_dist_sort(shape)
+        if s2 is None:
+            return None
+        shape = s2
+        tile_rows = _choose_tile_dist(shape, budget,
+                                      session.config.n_segments)
     if tile_rows is None:
         return None
-    cls = DistTopNTiledExecutable if shape.mode == "topn" \
-        else DistTiledExecutable
+    cls = {"topn": DistTopNTiledExecutable,
+           "sort": DistSortTiledExecutable,
+           "window": DistWindowTiledExecutable,
+           "agg": DistTiledExecutable}[shape.mode]
     return cls(shape, session, tile_rows, budget)
+
+
+def _host_post_ok(post_above, sort_keys) -> bool:
+    """The chain above the sort must be host-applicable after the merge
+    pass: column-pruning projections, LIMIT/OFFSET, gather motions
+    (no-ops — the host already holds every segment's rows) and sorts on
+    the SAME keys (already satisfied by the merge order)."""
+    for nd in post_above:
+        if isinstance(nd, N.PLimit):
+            continue
+        if isinstance(nd, N.PMotion) and nd.kind == "gather":
+            continue
+        if isinstance(nd, N.PProject) and all(
+                isinstance(e, ex.ColumnRef) for _, e in nd.exprs):
+            continue
+        if isinstance(nd, N.PSort) and repr(nd.keys) == repr(sort_keys):
+            continue
+        return False
+    return True
+
+
+def _to_dist_sort(shape: _DistTileShape) -> Optional[_DistTileShape]:
+    """Re-aim a topn shape at the external-sort executable."""
+    post_above = shape.post[:shape.post.index(shape.sortnode)]
+    if not _host_post_ok(post_above, shape.sortnode.keys):
+        return None
+    shape.mode = "sort"
+    shape.g_cap = 0
+    shape.post_above = post_above
+    return shape
 
 
 def _analyze_dist(plan: N.PlanNode, session) -> Optional[_DistTileShape]:
@@ -143,6 +188,8 @@ def _analyze_dist(plan: N.PlanNode, session) -> Optional[_DistTileShape]:
             cur = cur.child
         else:
             break
+    if isinstance(cur, N.PWindow):
+        return _analyze_dist_window(plan, post, cur, session)
     if not isinstance(cur, N.PAgg):
         return _analyze_dist_topn(plan, post, session)
 
@@ -226,7 +273,7 @@ def _analyze_dist_topn(plan, post, session) -> Optional[_DistTileShape]:
     # preserving, so the limit search may cross them
     hit = _topn_bound(post, skip=(N.PMotion,))
     if hit is None:
-        return None
+        return _analyze_dist_sort(plan, post, session)
     sortnode, m = hit
     spine_res = _walk_spine(sortnode.child, session)
     if spine_res is None:
@@ -240,6 +287,81 @@ def _analyze_dist_topn(plan, post, session) -> Optional[_DistTileShape]:
         post=post)
     shape.g_cap = m
     shape.max_groups = m
+    return shape
+
+
+def _analyze_dist_sort(plan, post, session) -> Optional[_DistTileShape]:
+    """Unbounded ORDER BY, distributed: the external-sort stream runs
+    per segment (the spine's own motions execute per tile); the host
+    pools every segment's rows — the gather is subsumed by collection —
+    and the merge pass plus the chain above the sort apply host-side
+    (tiled.py SortTiledExecutable's discipline on the mesh)."""
+    sort_i = next((i for i in range(len(post) - 1, -1, -1)
+                   if isinstance(post[i], N.PSort)), None)
+    if sort_i is None:
+        return None
+    sortnode = post[sort_i]
+    post_above = post[:sort_i]
+    if not _host_post_ok(post_above, sortnode.keys):
+        return None
+    below = sortnode.child
+    while isinstance(below, N.PMotion) and below.kind == "gather":
+        below = below.child
+    spine_res = _walk_spine(below, session)
+    if spine_res is None:
+        return None
+    spine, stream, builds, stream_rows = spine_res
+    shape = _DistTileShape(
+        root=plan, replace_node=below, partial_plan=below,
+        merge_motion=None, final_agg=None, spine=spine, stream=stream,
+        builds=builds, stream_rows=stream_rows, mode="sort",
+        sortnode=sortnode, post=post)
+    shape.post_above = post_above
+    return shape
+
+
+def _analyze_dist_window(plan, post, top_window,
+                         session) -> Optional[_DistTileShape]:
+    """Window stack, distributed: phase one is the per-segment
+    external-sort stream grouped by the stack's common partition keys;
+    phase two runs whole-partition chunks through the ORIGINAL plan
+    (gathers lower as identity on pooled host rows) on one device —
+    chunks are independent, so no mesh is needed above the stream."""
+    for nd in post:
+        if isinstance(nd, N.PMotion) and nd.kind == "gather":
+            continue
+        if isinstance(nd, N.PProject) and all(
+                isinstance(e, ex.ColumnRef) for _, e in nd.exprs):
+            continue
+        return None
+    node = top_window
+    bottom = node
+    common = None
+    while isinstance(node, N.PWindow):
+        bottom = node
+        here = {repr(pk): pk for pk in node.partition_keys}
+        common = here if common is None else \
+            {k: v for k, v in common.items() if k in here}
+        node = node.child
+    if not common:
+        return None
+    below = bottom.child
+    while isinstance(below, N.PMotion) and below.kind == "gather":
+        below = below.child
+    spine_res = _walk_spine(below, session)
+    if spine_res is None:
+        return None
+    spine, stream, builds, stream_rows = spine_res
+    ckeys = list(common.values())
+    srt = N.PSort(below, [(ck, True) for ck in ckeys])
+    srt.fields = list(below.fields)
+    shape = _DistTileShape(
+        root=plan, replace_node=bottom.child, partial_plan=below,
+        merge_motion=None, final_agg=None, spine=spine, stream=stream,
+        builds=builds, stream_rows=stream_rows, mode="window",
+        sortnode=srt, post=post)
+    shape.winnode = bottom
+    shape.n_ckeys = len(ckeys)
     return shape
 
 
@@ -298,7 +420,8 @@ def _retile_dist(shape: _DistTileShape, tile_rows: int, nseg: int) -> None:
     cap = tile_rows
     for node in reversed(shape.spine):
         if isinstance(node, N.PMotion):  # redistribute (walk guarantees)
-            node.bucket_cap = max(min(node._orig_bucket_cap, cap), 8)
+            node.bucket_cap = max(min(node._orig_bucket_cap, cap), 8,
+                                  getattr(node, "_min_bucket_cap", 0))
             node.out_capacity = node.bucket_cap * nseg
             cap = node.out_capacity
         elif isinstance(node, N.PJoin):
@@ -697,6 +820,199 @@ class DistTopNTiledExecutable(DistTiledExecutable):
                              ssel[:m])), _reduce_checks(checks)
 
         return self._jit_step(step_seg, mesh, res_specs)
+
+
+class DistSortTiledExecutable(DistTiledExecutable):
+    """Distributed external sort (tiled.py SortTiledExecutable on the
+    mesh): each step is one shard_map program — every segment streams a
+    tile of ITS shard through the spine (per-tile collectives included)
+    and emits surviving rows plus order-normalized u64 keys. The host
+    pools all segments' rows (subsuming the plan's gather), one stable
+    key sort is the merge pass, and the chain above the sort applies
+    host-side."""
+
+    _what = "distributed external-sort tiled execution"
+
+    def _groups_ceiling(self) -> int:
+        return 0  # no accumulator exists to grow
+
+    def _refresh_report(self) -> None:
+        super()._refresh_report()
+        self.report["mode"] = "sort"
+
+    def _compile(self):
+        if self._compiled is not None:
+            return self._compiled
+        shape = self.shape
+        nseg = self.nseg
+        mesh = segment_mesh(nseg, getattr(self.session,
+                                          "_live_device_ids", None))
+        from cloudberry_tpu.parallel.transport import make_transport
+
+        tx = make_transport(self.session.config.interconnect.backend,
+                            nseg)
+        rnames = self._resident_names()
+        _, res_specs = prepare_dist_inputs(None, self.session,
+                                           names=rnames)
+
+        def prelude_seg(tables):
+            low = DistLowerer(tables, nseg, use_pallas=self._use_pallas,
+                              tx=tx)
+            outs = [_add_seg(low.lower_shared(b)) for b in shape.builds]
+            return outs, _reduce_checks(low.checks)
+
+        prelude_fn = jax.jit(_shard_map(
+            prelude_seg, mesh, (res_specs,), (P(SEG_AXIS), P())))
+
+        sort = shape.sortnode
+        kchild = sort.child
+        names = [f.name for f in shape.partial_plan.fields]
+
+        def step_seg(resident, prelude, tile, tile_n):
+            tables = dict(resident)
+            tables["$tile"] = _strip_seg(tile)
+            plocal = _strip_seg(prelude)
+            replace = {id(b): tuple(plocal[i])
+                       for i, b in enumerate(shape.builds)}
+            low = _DistTileLowerer(tables, nseg, shape.stream,
+                                   tile_n.reshape(()), replace,
+                                   use_pallas=self._use_pallas, tx=tx)
+            pcols, psel = low.lower(shape.partial_plan)
+            n = psel.shape[0]
+            keys = []
+            for e, asc in sort.keys:
+                arr = X._as_column(X._sortable(e, kchild, pcols), n)
+                u = K.sort_key_u64(arr)
+                keys.append(u if asc else ~u)
+            out = {nm: X._as_column(pcols[nm], n) for nm in names}
+            return _add_seg((out, psel, keys)), _reduce_checks(low.checks)
+
+        step_fn = jax.jit(_shard_map(
+            step_seg, mesh,
+            (res_specs, P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS)),
+            (P(SEG_AXIS), P())))
+        self._compiled = (prelude_fn, step_fn)
+        return self._compiled
+
+    def _stream_sorted(self):
+        """Per-segment tile stream + host merge; returns (sorted child
+        columns, sorted normalized keys, n_tiles) as host arrays."""
+        prelude_fn, step_fn = self._compile()
+        shape = self.shape
+        resident, _ = prepare_dist_inputs(
+            None, self.session, names=self._resident_names())
+        if shape.builds:
+            prelude, pchecks = prelude_fn(resident)
+            X.raise_checks(pchecks)
+        else:
+            prelude = []
+        names = [f.name for f in shape.partial_plan.fields]
+        runs: dict[str, list] = {nm: [] for nm in names}
+        key_runs: list[list] = [[] for _ in shape.sortnode.keys]
+        n_tiles = 0
+        for tile, tile_ns in _dist_tile_feed(shape.stream, self.session,
+                                             self.tile_rows):
+            fault_point("tile_step_dist")
+            (pcols, psel, keys), checks = step_fn(resident, prelude,
+                                                  tile, tile_ns)
+            _raise_tile_checks(checks, n_tiles)
+            n_tiles += 1
+            selnp = np.asarray(psel)
+            for s in range(self.nseg):
+                m = selnp[s]
+                for nm in names:
+                    runs[nm].append(np.asarray(pcols[nm][s])[m])
+                for i, k in enumerate(keys):
+                    key_runs[i].append(np.asarray(k[s])[m])
+        if n_tiles == 0 or not any(len(r) for r in runs[names[0]]):
+            cols = {nm: np.zeros(
+                (0,), dtype=shape.partial_plan.field(nm).type.np_dtype)
+                for nm in names}
+            karr = [np.zeros((0,), dtype=np.uint64)
+                    for _ in shape.sortnode.keys]
+        else:
+            karr = [np.concatenate(kr) for kr in key_runs]
+            order = np.lexsort(tuple(reversed(karr)))
+            cols = {nm: np.concatenate(runs[nm])[order] for nm in names}
+            karr = [k[order] for k in karr]
+        return cols, karr, max(n_tiles, 1)
+
+    def _run_once(self) -> ColumnBatch:
+        _retile_dist(self.shape, self.tile_rows, self.nseg)
+        shape = self.shape
+        cols, _karr, n_tiles = self._stream_sorted()
+        # chain above the sort, host-side (validated at plan time):
+        # pruning projections, LIMIT, no-op gathers, merge-order sorts
+        for node in reversed(shape.post_above):
+            if isinstance(node, N.PLimit):
+                total = len(next(iter(cols.values()))) if cols else 0
+                lo = min(node.offset, total)
+                cols = {nm: a[lo:lo + node.limit]
+                        for nm, a in cols.items()}
+            elif isinstance(node, N.PProject):
+                cols = {out: cols[e.name] for out, e in node.exprs}
+        n_out = len(next(iter(cols.values()))) if cols else 0
+        self.report["n_tiles"] = n_tiles
+        self.session.last_tiled_report = dict(self.report)
+        out_node = shape.post_above[0] if shape.post_above \
+            else shape.sortnode
+        return X.make_batch(out_node, cols,
+                            np.ones((n_out,), dtype=bool))
+
+
+class DistWindowTiledExecutable(DistSortTiledExecutable):
+    """Distributed window spill: phase one is the per-segment
+    external-sort stream grouped by the stack's common partition keys;
+    phase two packs whole partitions into fixed chunks and runs the
+    ORIGINAL plan above the stream on ONE device per chunk (gather
+    motions lower as identity over the pooled host rows; chunks are
+    independent so no mesh is needed)."""
+
+    _what = "distributed windowed tiled execution"
+
+    def _refresh_report(self) -> None:
+        super()._refresh_report()
+        self.report["mode"] = "window"
+
+    def _chunk_fn(self):
+        if getattr(self, "_chunk_compiled", None) is not None:
+            return self._chunk_compiled
+        from cloudberry_tpu.exec.tiled import _ReplacingLowerer
+
+        shape = self.shape
+        cap = self.tile_rows
+        pallas = self._use_pallas
+        plat = jax.default_backend()
+
+        def run_chunk(chunk_cols, n_valid):
+            sel = jnp.arange(cap) < n_valid
+            low = _ReplacingLowerer(
+                {}, {id(shape.replace_node): (chunk_cols, sel)},
+                platform=plat, use_pallas=pallas)
+            cols, osel = low.lower(shape.root)
+            out = {f.name: cols[f.name] for f in shape.root.fields}
+            return out, osel, low.checks
+
+        self._chunk_compiled = jax.jit(run_chunk)
+        return self._chunk_compiled
+
+    def _run_once(self) -> ColumnBatch:
+        from cloudberry_tpu.exec.tiled import window_chunk_pass
+
+        _retile_dist(self.shape, self.tile_rows, self.nseg)
+        shape = self.shape
+        self._chunk_compiled = None  # capacity may have changed
+        cols, karr, n_tiles = self._stream_sorted()
+        names = [f.name for f in shape.partial_plan.fields]
+        final, n_chunks = window_chunk_pass(
+            self._chunk_fn(), shape.root, names, cols, karr,
+            shape.n_ckeys, self.tile_rows)
+        n_out = len(next(iter(final.values()))) if final else 0
+        self.report["n_tiles"] = n_tiles
+        self.report["n_chunks"] = n_chunks
+        self.session.last_tiled_report = dict(self.report)
+        return X.make_batch(shape.root, final,
+                            np.ones((n_out,), dtype=bool))
 
 
 # -------------------------------------------------------------- tile feed

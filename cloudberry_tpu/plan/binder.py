@@ -167,8 +167,12 @@ def _build_is_unique(plan: N.PlanNode, keys: list[ex.Expr],
 
 
 class Binder:
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, config=None):
         self.catalog = catalog
+        # session config (None = single-node defaults): the joint
+        # join-order search needs n_segments / memo switches at BIND
+        # time, because join ORDER is decided here
+        self.config = config
         self._counter = 0
         # CTE name -> bound plan; references share the plan via PShare
         self._ctes: dict[str, N.PlanNode] = {}
@@ -423,7 +427,8 @@ class Binder:
                     p = self._filter(p, self.bind_scalar(pred, scope))
                 plans[alias] = p
                 _rebind_scope(scope, alias, p)
-            plan = self._join_tree(plans, edges, scope)
+            plan = self._join_tree(plans, edges, scope,
+                                   groupby=sel.group_by)
             for pred in residual:
                 plan = self._filter(plan, self.bind_scalar(pred, scope))
             for pred in subq_preds:
@@ -719,8 +724,8 @@ class Binder:
                 residual.append(c)
         return edges, per_alias, residual
 
-    def _join_tree(self, plans: dict[str, N.PlanNode], edges, scope: Scope
-                   ) -> N.PlanNode:
+    def _join_tree(self, plans: dict[str, N.PlanNode], edges, scope: Scope,
+                   groupby=()) -> N.PlanNode:
         # group aliases by current plan object (explicit joins may share)
         groups: dict[int, set[str]] = {}
         plan_of: dict[int, N.PlanNode] = {}
@@ -758,9 +763,61 @@ class Binder:
         if len(plan_of) == 1:
             return next(iter(plan_of.values()))
         gids = list(plan_of)
+        joint = self._join_tree_joint(groups, plan_of, gids, edges, scope,
+                                      groupby)
+        if joint is not None:
+            return joint
         if len(gids) <= 10:
             return self._join_tree_dp(groups, plan_of, gids, edges, scope)
         return self._join_tree_greedy(groups, plan_of, edges, scope)
+
+    def _join_tree_joint(self, groups, plan_of, gids, edges, scope: Scope,
+                         groupby) -> Optional[N.PlanNode]:
+        """Joint join-order + motion search (plan/memo.joint_search — the
+        CJoinOrderDPv2/CMemo marriage): only meaningful distributed with
+        the memo enabled; the plain DP remains the fallback whenever the
+        search abstains."""
+        cfg = self.config
+        if cfg is None or cfg.n_segments <= 1 \
+                or not cfg.planner.enable_memo:
+            return None
+        from cloudberry_tpu.plan import memo
+
+        idx_of = {g: i for i, g in enumerate(gids)}
+        alias_idx = {a: idx_of[gid] for gid, aliases in groups.items()
+                     if gid in idx_of for a in aliases}
+        atoms = []
+        for g in gids:
+            p = plan_of[g]
+            atoms.append((p, max(sum(f.type.np_dtype.itemsize
+                                     for f in p.fields), 1)))
+        bedges = []
+        for (a, lx, b, rx) in edges:
+            ia, ib = alias_idx.get(a), alias_idx.get(b)
+            if ia is None or ib is None or ia == ib:
+                continue
+            bedges.append((ia, ib, self.bind_scalar(lx, scope),
+                           self.bind_scalar(rx, scope)))
+        gb_names = set()
+        for g in groupby or ():
+            try:
+                bound = self.bind_scalar(g, scope)
+            except BindError:
+                continue
+            if isinstance(bound, ex.ColumnRef):
+                gb_names.add(bound.name)
+        final = memo.joint_search(
+            atoms, bedges, cfg.n_segments,
+            cfg.planner.broadcast_threshold, self.catalog,
+            frozenset(gb_names), self._make_join,
+            is_unique=lambda i, keys: _build_is_unique(
+                atoms[i][0], keys, self.catalog))
+        if final is None:
+            return None
+        for e in scope.entries:
+            if e.alias in alias_set_of(groups):
+                e.plan = final
+        return final
 
     def _join_tree_dp(self, groups, plan_of, gids, edges, scope: Scope
                       ) -> N.PlanNode:
@@ -1037,21 +1094,31 @@ class Binder:
                     else:
                         arg = self.bind_scalar(node.args[0], scope)
                         func = node.name
-                        if func == "count" and node.distinct:
-                            func = "count_distinct"
+                        # DISTINCT is a no-op for min/max; for count it
+                        # renames the func; for sum/avg the flag survives
+                        # on the AggCall and _plan_dqa splits it (the
+                        # TupleSplit-analog rewrite)
+                        distinct = node.distinct and func not in ("min",
+                                                                  "max")
+                        if func == "count" and distinct:
+                            func, distinct = "count_distinct", False
                         if func == "avg" and _valid_of(arg) is not None:
                             # avg over a nullable arg: sum(valid)/count(valid)
                             # — NULL when no valid rows (mask rides on the
-                            # sum's companion)
+                            # sum's companion). avg(DISTINCT x) = sum over
+                            # the distinct set / count of the distinct set:
+                            # both halves carry the flag into the DQA split
                             s = self.gensym("agg")
                             c2 = self.gensym("agg")
-                            aggs.append((s, ex.AggCall("sum", arg)))
-                            aggs.append((c2, ex.AggCall("count", arg)))
+                            aggs.append((s, ex.AggCall(
+                                "sum", arg, distinct=distinct)))
+                            aggs.append((c2, ex.AggCall(
+                                "count", arg, distinct=distinct)))
                             agg_names[key] = ("avg2", s, c2)
                         else:
                             agg_names[key] = self.gensym("agg")
                             aggs.append((agg_names[key], ex.AggCall(
-                                func, arg, distinct=node.distinct)))
+                                func, arg, distinct=distinct)))
                 entry = agg_names[key]
                 if isinstance(entry, tuple) and entry[0] == "avg2":
                     return ast.BinOp("/", ast.Name((entry[1],)),
@@ -1081,22 +1148,22 @@ class Binder:
         rewritten_order = [(extract(o.expr), o.ascending)
                            for o in sel.order_by]
 
-        if any(c.func == "count_distinct" for _, c in aggs):
-            plan, group_keys, aggs = self._rewrite_count_distinct(
-                plan, group_keys, aggs)
-
-        aggs, agg_masks = self._mask_nullable_aggs(
-            aggs, global_agg=not group_keys)
-        agg = N.PAgg(plan, group_keys, aggs,
-                     capacity=_agg_capacity(plan, group_keys))
-        agg.fields = [
-            N.PlanField(n, e.dtype, _expr_dict(e),
-                        null_mask=((key_mask[n],) if n in key_mask else None))
-            for n, e in group_keys
-        ] + [N.PlanField(n, c.dtype, None,
-                         null_mask=((agg_masks[n],)
-                                    if n in agg_masks else None))
-             for n, c in aggs]
+        if any(c.distinct or c.func == "count_distinct" for _, c in aggs):
+            agg = self._plan_dqa(plan, group_keys, key_mask, aggs)
+        else:
+            aggs, agg_masks = self._mask_nullable_aggs(
+                aggs, global_agg=not group_keys)
+            agg = N.PAgg(plan, group_keys, aggs,
+                         capacity=_agg_capacity(plan, group_keys))
+            agg.fields = [
+                N.PlanField(n, e.dtype, _expr_dict(e),
+                            null_mask=((key_mask[n],)
+                                       if n in key_mask else None))
+                for n, e in group_keys
+            ] + [N.PlanField(n, c.dtype, None,
+                             null_mask=((agg_masks[n],)
+                                        if n in agg_masks else None))
+                 for n, c in aggs]
         plan = agg
 
         agg_scope = Scope([RangeEntry("$agg", agg)])
@@ -1555,7 +1622,15 @@ class Binder:
                                   _valid_of(arg))
             if node.name in AGG_FUNCS:
                 raise BindError(f"aggregate {node.name}() not allowed here")
-            raise BindError(f"unknown function {node.name!r}")
+            from cloudberry_tpu.exec import udf as U
+
+            u = U.lookup(node.name)
+            if u is not None:
+                return self._bind_udf(u, node, scope)
+            raise BindError(
+                f"unknown function {node.name!r} (register scalar "
+                "functions with cloudberry_tpu.exec.udf."
+                "register_function)")
 
         raise BindError(f"unsupported expression {type(node).__name__}")
 
@@ -1716,41 +1791,133 @@ class Binder:
             out.append((name, call))
         return out, masks
 
-    def _rewrite_count_distinct(self, plan, group_keys, aggs):
-        """DQA split (cdbgroupingpaths.c / TupleSplit analog): rewrite
-        count(distinct x) group by k as a distinct-on-(k,x) inner aggregation
-        followed by count per k. A nullable x becomes (canonical value,
-        validity) key pair; the outer count then skips the NULL group."""
-        if not all(c.func == "count_distinct" for _, c in aggs):
-            raise BindError("count(distinct) mixed with other aggregates "
-                            "is not supported yet")
-        inner_keys = list(group_keys)
-        arg_of: list[tuple[str, str, Optional[tuple]]] = []
-        for name, call in aggs:
-            assert call.arg is not None
+    def _plan_dqa(self, plan, group_keys, key_mask, aggs):
+        """Distinct-qualified aggregates — the TupleSplit / multi-DQA
+        analog (reference: src/backend/executor/nodeTupleSplit.c:1-281
+        tuple routing, src/backend/cdb/cdbgroupingpaths.c 2/3-stage DQA
+        plans). The reference replicates every input tuple once per DQA
+        and routes each copy through its own distinct-ification; the
+        one-XLA-program redesign instead plans one aggregation subplan
+        per distinct ARGUMENT class — inner distinct-on-(group keys,
+        arg), then the outer aggregate over the deduplicated rows —
+        plus one subplan for the plain aggregates, all over a
+        materialize-once shared input (PShare), and zips the
+        per-subplan results with 1:1 unique-build joins on the
+        canonicalized group keys. Every subplan emits exactly one row
+        per group (and global aggregates exactly one row total), so the
+        zip is loss-free; NULL group keys join exactly because keys
+        ride as (canonical value, validity) pairs — the discipline
+        GROUP BY itself uses. A nullable DQA argument becomes a
+        (canonical value, validity) inner key pair; the outer aggregate
+        then NULL-masks through the standard _mask_nullable_aggs path
+        (count skips the NULL group, sum/avg identity-fill it)."""
+        def _is_dqa(c: ex.AggCall) -> bool:
+            return c.distinct or c.func == "count_distinct"
+
+        plain = [(n, c) for n, c in aggs if not _is_dqa(c)]
+        classes: dict[str, list] = {}
+        for n, c in aggs:
+            if _is_dqa(c):
+                if c.arg is None:
+                    raise BindError("DISTINCT aggregate requires an "
+                                    "argument")
+                classes.setdefault(repr(c.arg), []).append((n, c))
+        nsub = len(classes) + (1 if plain else 0)
+
+        def _src() -> N.PlanNode:
+            if nsub == 1:
+                return plan
+            sh = N.PShare(plan)  # scan once, feed every subplan
+            sh.fields = list(plan.fields)
+            return sh
+
+        def _key_fields(keys) -> list:
+            return [N.PlanField(n, e.dtype, _expr_dict(e),
+                                null_mask=((key_mask[n],)
+                                           if n in key_mask else None))
+                    for n, e in keys]
+
+        subs: list[N.PlanNode] = []
+        if plain:
+            p_aggs, p_masks = self._mask_nullable_aggs(
+                plain, global_agg=not group_keys)
+            src = _src()
+            sub = N.PAgg(src, list(group_keys), p_aggs,
+                         capacity=_agg_capacity(src, group_keys))
+            sub.fields = _key_fields(group_keys) + [
+                N.PlanField(n, c.dtype, None,
+                            null_mask=((p_masks[n],)
+                                       if n in p_masks else None))
+                for n, c in p_aggs]
+            subs.append(sub)
+        for members in classes.values():
+            arg = members[0][1].arg
+            src = _src()
             aname = self.gensym("darg")
-            v = _valid_of(call.arg)
+            inner_keys = list(group_keys)
+            mask_of: dict[str, tuple] = {}
+            v = _valid_of(arg)
             if v is None:
-                inner_keys.append((aname, call.arg))
-                arg_of.append((name, aname, None))
+                inner_keys.append((aname, arg))
             else:
                 avname = self.gensym("vmk")
-                inner_keys.append((aname, _masked_key(call.arg, v)))
+                inner_keys.append((aname, _masked_key(arg, v)))
                 inner_keys.append((avname, ex.Cast(v, T.INT32)))
-                arg_of.append((name, aname, (avname,)))
-        inner = N.PAgg(plan, inner_keys, [],
-                       capacity=_agg_capacity(plan, inner_keys))
-        inner.fields = [N.PlanField(n, e.dtype, _expr_dict(e))
-                        for n, e in inner_keys]
-        mask_of = {aname: m for _, aname, m in arg_of}
-        inner.fields = [
-            N.PlanField(f.name, f.type, f.sdict,
-                        null_mask=mask_of.get(f.name))
-            for f in inner.fields]
-        new_group = [(n, _colref(inner.field(n))) for n, _ in group_keys]
-        new_aggs = [(name, ex.AggCall("count", _colref(inner.field(aname))))
-                    for name, aname, _ in arg_of]
-        return inner, new_group, new_aggs
+                mask_of[aname] = (avname,)
+            inner = N.PAgg(src, inner_keys, [],
+                           capacity=_agg_capacity(src, inner_keys))
+            inner.fields = [N.PlanField(n, e.dtype, _expr_dict(e),
+                                        null_mask=mask_of.get(n))
+                            for n, e in inner_keys]
+            new_group = [(n, _colref(inner.field(n)))
+                         for n, _ in group_keys]
+            out_aggs = []
+            for name, c in members:
+                of = "count" if c.func == "count_distinct" else c.func
+                out_aggs.append((name, ex.AggCall(
+                    of, _colref(inner.field(aname)))))
+            out_aggs, o_masks = self._mask_nullable_aggs(
+                out_aggs, global_agg=not group_keys)
+            outer = N.PAgg(inner, new_group, out_aggs,
+                           capacity=_agg_capacity(inner, new_group))
+            outer.fields = _key_fields(new_group) + [
+                N.PlanField(n, c.dtype, None,
+                            null_mask=((o_masks[n],)
+                                       if n in o_masks else None))
+                for n, c in out_aggs]
+            subs.append(outer)
+
+        if len(subs) == 1:
+            return subs[0]
+        key_names = [n for n, _ in group_keys]
+        if not group_keys:
+            # global aggregates: each subplan emits exactly ONE row —
+            # zip them on a projected constant key
+            key_names = ["$dqaone"]
+            zipped = []
+            for sub in subs:
+                pr = N.PProject(sub, [(f.name,
+                                       ex.ColumnRef(f.name, f.type))
+                                      for f in sub.fields]
+                                + [("$dqaone", ex.Literal(1, T.INT64))])
+                pr.fields = list(sub.fields) + [
+                    N.PlanField("$dqaone", T.INT64, None)]
+                zipped.append(pr)
+            subs = zipped
+        acc = subs[0]
+        for nxt in subs[1:]:
+            bkeys = [ex.ColumnRef(n, nxt.field(n).type)
+                     for n in key_names]
+            pkeys = [ex.ColumnRef(n, acc.field(n).type)
+                     for n in key_names]
+            payload = [f.name for f in nxt.fields
+                       if f.name not in key_names]
+            j = N.PJoin("inner", nxt, acc, bkeys, pkeys, payload, None,
+                        unique_build=True)
+            j.fields = list(acc.fields) + [f for f in nxt.fields
+                                           if f.name not in key_names]
+            acc = j
+        return acc
 
     # -------------------------------------------------- subquery predicates
     # The cdbsubselect.c analog: EXISTS/IN/scalar subqueries in WHERE become
@@ -1779,7 +1946,7 @@ class Binder:
         return self._filter(plan, self.bind_scalar(pred, scope))
 
     def _bind_uncorrelated_scalar(self, node: ast.ScalarSubquery) -> ex.Expr:
-        sub = Binder(self.catalog)
+        sub = Binder(self.catalog, self.config)
         sub._counter = self._counter + 1000
         sub._ctes = self._ctes
         plan = sub.bind_select(node.select)
@@ -1822,7 +1989,7 @@ class Binder:
 
     def _scratch_inner_scope(self, sub: ast.Select) -> Scope:
         inner = Scope()
-        sb = Binder(self.catalog)
+        sb = Binder(self.catalog, self.config)
         sb._counter = self._counter + 2000
         sb._ctes = self._ctes
         dump: list = []
@@ -2059,6 +2226,124 @@ class Binder:
         out = self._filter(j, cmp)
         out.fields = list(plan.fields)  # drop subplan columns from output
         return out
+
+    def _bind_udf(self, u, node: ast.FuncCall, scope: Scope) -> ex.Expr:
+        """Scalar UDF (exec/udf.py — the PL-function seam) in one of the
+        three compilable shapes: bind-time constant folding, dictionary
+        rewrite over one string column (the LIKE machinery), or a
+        jax-traced function compiled into the program. Strict NULL
+        semantics: NULL in → NULL out; a function returning None over a
+        dictionary value NULLs exactly the rows holding that value."""
+        from cloudberry_tpu.exec import udf as U
+
+        if node.star or len(node.args) != len(u.arg_types):
+            raise BindError(f"{u.name}() takes {len(u.arg_types)} "
+                            f"argument(s), got {len(node.args)}")
+        bound = []
+        for a, at in zip(node.args, u.arg_types):
+            b = self.bind_scalar(a, scope)
+            if _is_null_literal(b):
+                bound.append(b)
+                continue
+            if at.base == DType.STRING:
+                if b.dtype.base != DType.STRING:
+                    raise BindError(
+                        f"{u.name}: expected a string argument, got "
+                        f"{b.dtype.base.name}")
+            elif b.dtype != at:
+                b = self._coerce(b, at)
+            bound.append(b)
+        if any(_is_null_literal(b) for b in bound):
+            # strict: a constant NULL argument folds to NULL
+            return _null_literal(u.ret if u.ret.base != DType.STRING
+                                 else T.INT64)
+        all_const = all(isinstance(b, ex.Literal) for b in bound)
+        if u.volatility == "immutable" and all_const:
+            vals = [U.py_value(b.value, b.dtype) for b in bound]
+            try:
+                rv = u.fn(*vals)
+            except Exception as e:  # surface the function's own error
+                raise BindError(f"{u.name}: {type(e).__name__}: {e}")
+            if rv is None:
+                return _null_literal(u.ret if u.ret.base != DType.STRING
+                                     else T.INT64)
+            ev = U.encode_result(rv, u.ret)
+            if u.ret.base == DType.STRING:
+                # folded string constant: code 0 in a one-entry output
+                # dictionary (the substring-fold convention) — a bare
+                # python-str literal only works in comparison context
+                d = StringDictionary((ev,))
+                lit = ex.Literal(0, T.STRING)
+                object.__setattr__(lit, "_out_dict", d)
+                return lit
+            return ex.Literal(ev, u.ret)
+        colargs = [(i, b) for i, b in enumerate(bound)
+                   if not isinstance(b, ex.Literal)]
+        if u.volatility == "immutable" and not u.jit \
+                and len(colargs) == 1 \
+                and colargs[0][1].dtype.base == DType.STRING \
+                and _expr_dict(colargs[0][1]) is not None:
+            return self._bind_udf_dict(u, bound, colargs[0])
+        if u.jit:
+            if any(b.dtype.base == DType.STRING for b in bound):
+                raise BindError(
+                    f"{u.name}: jit UDFs take numeric arguments "
+                    "(string columns are dictionary codes on device — "
+                    "use the non-jit dictionary rewrite)")
+            out = ex.Func("udf:" + u.name, tuple(bound), u.ret)
+            return _set_valid(out,
+                              _and_valid(*[_valid_of(b) for b in bound]))
+        raise BindError(
+            f"{u.name}: this call shape does not compile — supported: "
+            "constant arguments (bind-time fold), one dictionary-encoded "
+            "string column + constants (dictionary rewrite), or "
+            "register_function(..., jit=True) with jax-traceable numeric "
+            "code")
+
+    def _bind_udf_dict(self, u, bound, colarg) -> ex.Expr:
+        """Dictionary rewrite: run the function host-side once per
+        dictionary VALUE, compile the per-row work to a table gather."""
+        import numpy as np
+
+        from cloudberry_tpu.exec import udf as U
+
+        i0, col = colarg
+        d = _expr_dict(col)
+        vals = [U.py_value(b.value, b.dtype)
+                if isinstance(b, ex.Literal) else None for b in bound]
+        results = []
+        for v in d.values:
+            args2 = list(vals)
+            args2[i0] = v
+            try:
+                results.append(u.fn(*args2))
+            except Exception as e:
+                raise BindError(f"{u.name}({v!r}): "
+                                f"{type(e).__name__}: {e}")
+        has_null = any(r is None for r in results)
+        if u.ret.base == DType.STRING:
+            out_dict = StringDictionary()
+            codes = [(-1 if r is None
+                      else out_dict.add(U.encode_result(r, u.ret)))
+                     for r in results]
+            out: ex.Expr = ex.DictLookup(col, np.asarray(codes,
+                                                         dtype=np.int32),
+                                         T.STRING)
+            # _out_dict: the dictionary governing the RESULT codes (the
+            # substring-machinery convention _expr_dict reads)
+            object.__setattr__(out, "_out_dict", out_dict)
+        else:
+            zero = (False if u.ret.base == DType.BOOL else 0)
+            table = np.asarray(
+                [zero if r is None else U.encode_result(r, u.ret)
+                 for r in results], dtype=u.ret.np_dtype)
+            out = ex.DictLookup(col, table, u.ret)
+        valid = _valid_of(col)
+        if has_null:
+            nl = ex.DictLookup(col, np.asarray(
+                [r is not None for r in results], dtype=bool), T.BOOL)
+            valid = _and_valid(valid, nl) or nl
+        return _set_valid(out, valid)
 
     def _bind_coalesce(self, node: ast.FuncCall, scope: Scope) -> ex.Expr:
         """COALESCE: first non-NULL value wins; result is NULL only when

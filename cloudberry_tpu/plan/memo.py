@@ -253,6 +253,345 @@ def _explore_join(node: N.PJoin, catalog, nseg: int,
     return out or None
 
 
+# --------------------------------------------------------------------------
+# Joint join-order + motion search — the CJoinOrderDPv2 / CMemo marriage
+# (reference: src/backend/gporca/libgpopt/src/xforms/CJoinOrderDPv2.cpp,
+# libgpopt/src/search/CMemo.cpp). ORCA's core MPP insight: the cheapest
+# join ORDER depends on the motion strategy and vice versa — a cheaper
+# order under broadcast is not the cheapest order under redistribute — so
+# both must be explored in ONE dynamic program. State: per connected
+# relation subset, the Pareto set of (output sharding -> cheapest cost,
+# build recipe). The binder calls this BEFORE building the join tree
+# (plan/binder.py _join_tree); the plain intermediate-rows DP remains the
+# fallback when the search abstains or blows its iteration budget.
+
+# cost weights: motion bytes ride the interconnect (slower than local
+# HBM traffic), build sides pay for structure construction, every motion
+# op pays a fixed launch cost (collective + receiver re-sort — this is
+# what keeps the search from trading one broadcast of a small dim for
+# two redistributes of small intermediates), and a non-unique build side
+# pays the pair-expansion materialization _make_join would set up — the
+# relative weights steer order AND motion together, the same currency
+# memo exploration uses.
+MOTION_WEIGHT = 4.0
+BUILD_WEIGHT = 0.5
+MOTION_FIXED_BYTES = 1 << 20
+# a redistribute costs more PER BYTE than a broadcast: it bucketizes,
+# all-to-alls and re-compacts (three passes + a receiver-side resort)
+# where broadcast is one all-gather of contiguous rows. Both constants
+# grid-searched against dp+greedy on the 8-device mesh at SF0.1
+# (geomean 1.26x over q2/3/5/7/8/9/10/18/21; q8 alone 7.5x).
+REDIST_WEIGHT = 2.0
+JOINT_MAX_RELS = 10
+JOINT_ITER_BUDGET = 400_000
+JOINT_KEEP_ALTS = 6
+
+
+def _pair_sel(keys_a, keys_b, atom_a, atom_b, catalog,
+              est_a, est_b) -> float:
+    """Composite equi-join selectivity for ALL edges between one atom
+    pair: 1/max(ndv_left-tuple, ndv_right-tuple) with the tuple NDV the
+    product of column NDVs capped by the side's rows — the cost._keys_ndv
+    discipline. Treating a composite key as independent edges would
+    square its selectivity (q9's (l_partkey, l_suppkey) = partsupp key)
+    and make that intermediate look near-free."""
+    from cloudberry_tpu.plan.cost import _expr_ndv
+
+    def tup_ndv(keys, atom, est):
+        prod = 1.0
+        known = False
+        for k in keys:
+            nd = _expr_ndv(atom, k, catalog)
+            if nd is not None:
+                known = True
+                prod *= nd
+        return min(prod, max(est, 1.0)) if known else None
+
+    nd_a = tup_ndv(keys_a, atom_a, est_a)
+    nd_b = tup_ndv(keys_b, atom_b, est_b)
+    denom = max(nd_a or 1.0, nd_b or 1.0,
+                1.0 if (nd_a or nd_b) else max(est_a, est_b, 1.0))
+    return 1.0 / max(denom, 1.0)
+
+
+def _hot_frac_cols(col_atom: dict, keys, catalog) -> float:
+    """_hot_frac over pre-resolved column->atom-plan ownership (search
+    subsets have no plan node to walk)."""
+    frac = 1.0
+    seen = False
+    for k in keys:
+        if not isinstance(k, ex.ColumnRef) or k.name not in col_atom:
+            continue
+        f = _hot_frac(col_atom[k.name], [k], catalog)
+        if f > 0.0:
+            frac = min(frac, f)
+            seen = True
+    return frac if seen else 0.0
+
+
+def _join_strategies(bsh: Sharding, psh: Sharding, bkeys, pkeys,
+                     est_b, est_p, wb, wp, hotb, hotp, nseg, thr):
+    """Yield (motion_cost, n_motions, output Sharding, choice|None) for
+    one build/probe orientation — the cdbpath_motion_for_join menu,
+    shared currency with _explore_join (inner joins only: the DP never
+    builds outer joins; those pre-join into atoms)."""
+    b_part, p_part = bsh.is_partitioned, psh.is_partitioned
+    if not (b_part and p_part):
+        if b_part and not p_part:
+            bsub = _hashed_key_positions(bsh, bkeys)
+            if bsub is not None:
+                names = [pkeys[i].name for i in bsub
+                         if isinstance(pkeys[i], ex.ColumnRef)]
+                sh = (Sharding.hashed(*names) if len(names) == len(bsub)
+                      else Sharding.strewn())
+            else:
+                sh = Sharding.strewn()
+            yield (0.0, 0, sh, None)
+        else:
+            yield (0.0, 0, psh, None)
+        return
+    bpos = _hashed_key_positions(bsh, bkeys)
+    ppos = _hashed_key_positions(psh, pkeys)
+    if bpos is not None and bpos == ppos:
+        yield (0.0, 0, psh, "colocate")
+        return
+    if thr > 0 and est_b * nseg <= broadcast_struct_rows(thr):
+        yield (est_b * wb * (nseg - 1), 1, psh, "broadcast")
+    if bpos is not None:
+        keys = [pkeys[i] for i in bpos]
+        yield (REDIST_WEIGHT * _redist_cost(est_p, wp, hotp(keys), nseg),
+               1, _redist_sharding(keys), "redist_probe")
+    if ppos is not None:
+        bk = [bkeys[i] for i in ppos]
+        yield (REDIST_WEIGHT * _redist_cost(est_b, wb, hotb(bk), nseg),
+               1, psh, "redist_build")
+    yield (REDIST_WEIGHT * (_redist_cost(est_b, wb, hotb(bkeys), nseg)
+           + _redist_cost(est_p, wp, hotp(pkeys), nseg)), 2,
+           _redist_sharding(pkeys), "redist_both")
+
+
+def joint_search(atoms, edges, nseg: int, thr: int, catalog,
+                 groupby_names: frozenset, make_join, is_unique=None):
+    """One DP over join order AND motion strategy.
+
+    atoms: [(plan, width)] per base relation (any bound subtree);
+    edges: [(ia, ib, key_a, key_b)] pre-bound equi-join edges;
+    groupby_names: bound GROUP BY column names above this region (the
+    required-property context — a final sharding matching them saves
+    the regroup motion);
+    make_join(kind, build, probe, bkeys, pkeys) -> PJoin (the binder's
+    node factory, so built trees carry capacities/masks/uniqueness
+    exactly like hand-ordered ones);
+    is_unique(atom_idx, keys) -> bool: PK-side proof for an atom — a
+    build side without it pays the pair-expansion materialization
+    (and composite builds always do), which both prices the executor's
+    real expansion cost and breaks colocate-orientation ties toward
+    the unique build the sorted-build lookup wants.
+
+    Returns the built PJoin tree with ``_dist_choice`` stamps, or None
+    (abstain: too many relations, no edges, or budget blown)."""
+    n = len(atoms)
+    if n < 3 or n > JOINT_MAX_RELS or not edges:
+        return None
+
+    from cloudberry_tpu.plan.cost import estimate_rows
+
+    est_atom = [max(estimate_rows(p, catalog), 1.0) for p, _ in atoms]
+    width = [w for _, w in atoms]
+    col_atom: dict = {}
+    for (p, _w) in atoms:
+        for f in p.fields:
+            col_atom.setdefault(f.name, p)
+    # selectivity per atom PAIR (composite keys combine — never multiply
+    # a multi-edge key's selectivities independently)
+    pair_edges: dict[tuple, list] = {}
+    for (ia, ib, ka, kb) in edges:
+        lo, hi = (ia, ib) if ia < ib else (ib, ia)
+        ka2, kb2 = (ka, kb) if ia < ib else (kb, ka)
+        pair_edges.setdefault((lo, hi), []).append((ka2, kb2))
+    pair_sel = {}
+    for (lo, hi), eks in pair_edges.items():
+        pair_sel[(lo, hi)] = _pair_sel(
+            [k for k, _ in eks], [k for _, k in eks],
+            atoms[lo][0], atoms[hi][0], catalog,
+            est_atom[lo], est_atom[hi])
+
+    est_cache: dict[int, float] = {}
+
+    def est_of(mask: int) -> float:
+        got = est_cache.get(mask)
+        if got is None:
+            rows = 1.0
+            for i in range(n):
+                if mask >> i & 1:
+                    rows *= est_atom[i]
+            for (lo, hi), sel in pair_sel.items():
+                if mask >> lo & 1 and mask >> hi & 1:
+                    rows *= sel
+            got = est_cache[mask] = max(rows, 1.0)
+        return got
+
+    wid_cache: dict[int, int] = {}
+
+    def wid_of(mask: int) -> int:
+        got = wid_cache.get(mask)
+        if got is None:
+            got = wid_cache[mask] = max(
+                sum(width[i] for i in range(n) if mask >> i & 1), 1)
+        return got
+
+    def hot_fn(keys):
+        return _hot_frac_cols(col_atom, keys, catalog)
+
+    # alternatives per atom: the motion-exploration grammar where it
+    # applies, a conservative strewn property where it abstains
+    best: list[Optional[dict]] = [None] * (1 << n)
+    atom_alts: list[dict] = []
+    for i, (p, _w) in enumerate(atoms):
+        alts = explore(p, catalog, nseg, thr)
+        if alts is None:
+            alts = {"?": Alt(0.0, Sharding.strewn(), ())}
+        atom_alts.append(alts)
+        best[1 << i] = {k: (a.cost, a.sharding, ("atom", i, k))
+                        for k, a in alts.items()}
+
+    budget = JOINT_ITER_BUDGET
+    full = (1 << n) - 1
+    by_size: dict[int, list[int]] = {}
+    for m in range(1, full + 1):
+        by_size.setdefault(bin(m).count("1"), []).append(m)
+    for size in range(2, n + 1):
+        for m in by_size.get(size, ()):
+            out: dict = {}
+            s = (m - 1) & m
+            while s:
+                o = m ^ s
+                if s > o and best[s] is not None and best[o] is not None:
+                    eidx = [e for e, (ia, ib, _ka, _kb) in enumerate(edges)
+                            if (s >> ia & 1 and o >> ib & 1)
+                            or (o >> ia & 1 and s >> ib & 1)]
+                    if eidx:
+                        budget = _joint_pairs(
+                            m, s, o, eidx, best, out, edges, est_of,
+                            wid_of, hot_fn, nseg, thr, budget,
+                            is_unique)
+                        if budget <= 0:
+                            return None
+                s = (s - 1) & m
+            if out:
+                if len(out) > JOINT_KEEP_ALTS:
+                    keep = sorted(out.items(),
+                                  key=lambda kv: kv[1][0])[:JOINT_KEEP_ALTS]
+                    out = dict(keep)
+                best[m] = out
+    if best[full] is None:
+        return None
+    # required property: a final sharding already matching the GROUP BY
+    # keys saves the regroup motion above this region. The regroup moves
+    # PARTIAL rows — at most (groups × nseg), never more than the join
+    # output (the _agg_extra discipline): pricing it as the raw output
+    # would overvalue groupby-aligned shardings by orders of magnitude.
+    from cloudberry_tpu.plan.cost import _expr_ndv
+
+    groups = 1.0
+    for nm in groupby_names:
+        p = col_atom.get(nm)
+        nd = _expr_ndv(p, ex.ColumnRef(nm, None), catalog) \
+            if p is not None else None
+        groups *= nd if nd else max(est_of(full) ** 0.5, 1.0)
+    rows = min(groups * nseg, est_of(full))
+    regroup = rows * wid_of(full) * (nseg - 1) / max(nseg, 1) \
+        * MOTION_WEIGHT * REDIST_WEIGHT + MOTION_FIXED_BYTES
+    winner = None
+    for (cost, sh, desc) in best[full].values():
+        extra = 0.0
+        if groupby_names:
+            if not (sh.kind == "hashed" and sh.keys
+                    and set(sh.keys) <= groupby_names):
+                extra = regroup
+        if winner is None or cost + extra < winner[0]:
+            winner = (cost + extra, desc)
+    return _joint_build(winner[1], atoms, edges, atom_alts, make_join)
+
+
+def _joint_pairs(m, s, o, eidx, best, out, edges, est_of, wid_of,
+                 hot_fn, nseg, thr, budget, is_unique):
+    """Inner loop: cross every sharding alternative pair of the two
+    halves with both orientations and the motion menu."""
+    est_m = est_of(m)
+    compute = est_m * wid_of(m)
+    for salt in best[s].values():
+        for oalt in best[o].values():
+            for bmask, balt, pmask, palt in (
+                    (s, salt, o, oalt), (o, oalt, s, salt)):
+                budget -= 1
+                if budget <= 0:
+                    return 0
+                bkeys, pkeys = [], []
+                for e in eidx:
+                    ia, ib, ka, kb = edges[e]
+                    if bmask >> ia & 1:
+                        bkeys.append(ka)
+                        pkeys.append(kb)
+                    else:
+                        bkeys.append(kb)
+                        pkeys.append(ka)
+                est_b, est_p = est_of(bmask), est_of(pmask)
+                wb, wp = wid_of(bmask), wid_of(pmask)
+                base = balt[0] + palt[0] + compute \
+                    + BUILD_WEIGHT * est_b * wb
+                if not ((bmask & (bmask - 1)) == 0
+                        and is_unique is not None
+                        and is_unique(bmask.bit_length() - 1, bkeys)):
+                    # non-unique (or composite) build side: price the
+                    # pair-expansion buffer _make_join will allocate
+                    base += compute
+                for (mcost, nmot, sh, choice) in _join_strategies(
+                        balt[1], palt[1], bkeys, pkeys, est_b, est_p,
+                        wb, wp, hot_fn, hot_fn, nseg, thr):
+                    cost = base + MOTION_WEIGHT * mcost \
+                        + nmot * MOTION_FIXED_BYTES
+                    k = str(sh)
+                    cur = out.get(k)
+                    if cur is None or cost < cur[0]:
+                        out[k] = (cost, sh,
+                                  ("join", balt[2], palt[2], tuple(eidx),
+                                   bmask, choice))
+    return budget
+
+
+def _joint_build(desc, atoms, edges, atom_alts, make_join):
+    """Materialize the winning recipe bottom-up through the binder's
+    node factory, stamping each join's motion choice."""
+    if desc[0] == "atom":
+        _kind, i, altkey = desc
+        # joins INSIDE an atom (derived tables) carry their own choice
+        # stamps through the exploration Alt
+        for jn, choice in atom_alts[i][altkey].choices:
+            jn._dist_choice = choice
+        return atoms[i][0]
+    _kind, bdesc, pdesc, eidx, bmask, choice = desc
+    bplan = _joint_build(bdesc, atoms, edges, atom_alts, make_join)
+    pplan = _joint_build(pdesc, atoms, edges, atom_alts, make_join)
+    bkeys, pkeys = [], []
+    for e in eidx:
+        ia, ib, ka, kb = edges[e]
+        if bmask >> ia & 1:
+            bkeys.append(ka)
+            pkeys.append(kb)
+        else:
+            bkeys.append(kb)
+            pkeys.append(ka)
+    j = make_join("inner", bplan, pplan, bkeys, pkeys)
+    if choice is not None:
+        j._dist_choice = choice
+    # the joint decision is final: the post-bind motion exploration
+    # must not re-stamp joins whose order AND motion were chosen
+    # together (annotate_distribution skips _joint regions)
+    j._joint = True
+    return j
+
+
 def _agg_extra(agg: N.PAgg, sharding: Sharding, catalog,
                nseg: int) -> float:
     """Cost the GROUP BY above the join tree adds for a given output
@@ -325,13 +664,15 @@ def annotate_distribution(plan: N.PlanNode, session) -> None:
         seen.add(id(node))
         if isinstance(node, N.PAgg) and node.mode == "single":
             j = _through_chain(node.child)
-            if isinstance(j, N.PJoin) and id(j) not in annotated:
+            if isinstance(j, N.PJoin) and id(j) not in annotated \
+                    and not getattr(j, "_joint", False):
                 # explore from the agg's child so the Filter/Project
                 # chain folds its renames into each alternative's
                 # sharding — _agg_extra must see exactly the locus
                 # Distributor._agg will test
                 region(node.child, node)
-        elif isinstance(node, N.PJoin) and id(node) not in annotated:
+        elif isinstance(node, N.PJoin) and id(node) not in annotated \
+                and not getattr(node, "_joint", False):
             region(node, None)
         for c in node.children():
             visit(c)

@@ -278,7 +278,7 @@ def plan_statement(stmt: ast.Node, session, params: dict,
             from cloudberry_tpu.plan import matview as MV
 
             inner, aqumv_from = MV.aqumv_rewrite(session, inner)
-        binder = Binder(catalog)
+        binder = Binder(catalog, session.config)
         plan = binder.bind_query(inner)
         plan = _optimize(plan, session)
         if aqumv_from is not None:
@@ -302,7 +302,7 @@ def plan_statement(stmt: ast.Node, session, params: dict,
             from cloudberry_tpu.plan import matview as MV
 
             stmt, aqumv_from = MV.aqumv_rewrite(session, stmt)
-        binder = Binder(catalog)
+        binder = Binder(catalog, session.config)
         plan = binder.bind_query(stmt)
         plan = _optimize(plan, session)
         if folded:
@@ -511,7 +511,7 @@ def _run_internal(session, query: ast.Node):
     from cloudberry_tpu.exec.executor import execute
     from cloudberry_tpu.exec.resource import check_admission
 
-    binder = Binder(session.catalog)
+    binder = Binder(session.catalog, session.config)
     plan = _optimize(binder.bind_query(query), session)
     check_admission(plan, session)
     with session._gate:

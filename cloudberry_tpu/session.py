@@ -258,6 +258,23 @@ class Session:
             from cloudberry_tpu.exec.tiled import plan_tiled
 
             texe = plan_tiled(result.plan, self)
+            if texe is None and self.config.planner.enable_memo:
+                # the memo's joint order may have put a big relation on
+                # a BUILD side (cheap in memory, spill-hostile: tiling
+                # streams the probe path only). Re-plan greedy — the
+                # fact side stays the stream — and tile that instead;
+                # the reference likewise re-plans when a hash join
+                # flips to batches (nodeHash.c increase-nbatch)
+                # a shallow session clone carries the greedy config so
+                # concurrent planners (and the mesh-resize path, which
+                # also assigns self.config) never observe the override
+                import copy
+
+                clone = copy.copy(self)
+                clone.config = self.config.with_overrides(
+                    **{"planner.enable_memo": False})
+                result2 = plan_statement(stmt, clone, params)
+                texe = plan_tiled(result2.plan, clone)
             if texe is None:
                 raise
             self._dispatch_seams(fault_point)
@@ -529,9 +546,15 @@ class Session:
         entry = self._stmt_cache.get(query)
         if entry is None:
             return None
+        from cloudberry_tpu.exec.udf import registry_version
+
         names, versions, nseg, ddlv, runner, cost = entry
+        # ddlv pairs the catalog DDL version with the UDF registry
+        # version: re-registering a function must drop plans that baked
+        # its OLD results in at bind time
         stale = (nseg != self.config.n_segments
-                 or ddlv != self.catalog.ddl_version)
+                 or ddlv != (self.catalog.ddl_version,
+                             registry_version()))
         if not stale:
             try:
                 stale = self._table_versions(names) != versions
@@ -577,9 +600,12 @@ class Session:
             # FIFO eviction keeps the cache (and its pinned XLA programs)
             # bounded under literal-inlining workloads
             self._stmt_cache.pop(next(iter(self._stmt_cache)))
+        from cloudberry_tpu.exec.udf import registry_version
+
         self._stmt_cache[query] = (
             names, self._table_versions(names),
-            self.config.n_segments, self.catalog.ddl_version, runner, cost)
+            self.config.n_segments,
+            (self.catalog.ddl_version, registry_version()), runner, cost)
 
     def explain(self, query: str) -> str:
         from cloudberry_tpu.sql.parser import parse_sql

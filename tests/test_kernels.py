@@ -259,3 +259,42 @@ def test_pack_bits_rejects_float_keys():
     # and the join itself must still be correct
     assert s.sql("SELECT count(*) AS c FROM fp JOIN fb ON fp.y = fb.x"
                  ).to_pandas()["c"].tolist() == [3]
+
+
+def test_sort_key_f64_two_word_path():
+    """DOUBLE sort keys build their IEEE total-order u64 from two u32
+    bitcast words (the TPU backend compiles no direct f64->u64 bitcast);
+    the result must be bit-identical to the direct-view formulation and
+    order exactly like SQL ascending floats."""
+    import numpy as np
+
+    from cloudberry_tpu.exec.kernels import sort_key_u64
+
+    vals = np.array([0.0, -0.0, 1.5, -1.5, np.inf, -np.inf, 3.14e300,
+                     -3.14e300, 5e-324, -5e-324, 123456.789],
+                    dtype=np.float64)
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([vals, rng.standard_normal(500) *
+                           (10.0 ** rng.integers(-300, 300, 500)
+                            .astype(np.float64))])
+    got = np.asarray(jax.jit(sort_key_u64)(jnp.asarray(vals)))
+    bits = vals.view(np.uint64)
+    mask = np.where(bits >> 63 != 0, np.uint64(0xFFFFFFFFFFFFFFFF),
+                    np.uint64(1) << 63)
+    assert (got == (bits ^ mask)).all()
+    assert (vals[np.argsort(vals, kind="stable")]
+            == vals[np.argsort(got, kind="stable")]).all()
+
+
+def test_double_order_by_end_to_end():
+    """ORDER BY over a genuine DOUBLE column (the round-4 verdict's
+    platform caveat: this must not depend on a CPU-only bitcast)."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import Config
+
+    s = cb.Session(Config(n_segments=8))
+    s.sql("create table fd (k bigint, x double) distributed by (k)")
+    s.sql("insert into fd values (1, 2.5), (2, -1.5), (3, 1e300), "
+          "(4, -1e300), (5, 0.0), (6, 3.25), (7, null)")
+    df = s.sql("select k from fd order by x").to_pandas()
+    assert list(df["k"]) == [4, 2, 5, 1, 6, 3, 7]  # NULLs last

@@ -180,3 +180,90 @@ def test_memo_equivalence_random_queries():
         exp = s_greedy.sql(q).to_pandas()
         got = s_memo.sql(q).to_pandas()
         assert exp.equals(got), q
+
+
+# ---------------------------------------------------------------- joint
+# Join ORDER and motion strategy explored in ONE search (the
+# CJoinOrderDPv2/CMemo marriage, plan/memo.joint_search): the row-count
+# DP prefers joining the mildly-reducing wide dim first, which forces a
+# 26x more expensive broadcast; the joint search sees that joining the
+# colocated dim first costs zero motion and ships only the narrow
+# intermediate.
+
+def _load_joint(s):
+    rng = np.random.default_rng(11)
+    n_f, n_a, n_b = 50_000, 40_000, 40_500
+    # fact hashed on k1 (colocated with dim a); k2 joins wide dim b
+    s.sql("CREATE TABLE fact (k1 BIGINT, k2 BIGINT, v BIGINT, g BIGINT) "
+          "DISTRIBUTED BY (k1)")
+    s.sql("CREATE TABLE a (ak BIGINT, av BIGINT) DISTRIBUTED BY (ak)")
+    wide = ", ".join(f"w{i} BIGINT" for i in range(18))
+    s.sql(f"CREATE TABLE b (bk BIGINT, {wide}) DISTRIBUTED BY (bk)")
+    s.catalog.table("fact").set_data(
+        {"k1": rng.integers(0, n_a, n_f),
+         "k2": rng.integers(0, 45_000, n_f),
+         "v": rng.integers(0, 100, n_f),
+         "g": rng.integers(0, 50, n_f)})
+    s.catalog.table("a").set_data(
+        {"ak": np.arange(n_a), "av": rng.integers(0, 100, n_a)})
+    bcols = {"bk": rng.permutation(45_000)[:n_b]}
+    for i in range(18):
+        bcols[f"w{i}"] = rng.integers(0, 1000, n_b)
+    s.catalog.table("b").set_data(bcols)
+    for t in ("fact", "a", "b"):
+        s.sql(f"analyze {t}")
+
+
+JOINT_Q = ("SELECT g, sum(v) AS sv, sum(w0) AS sw FROM fact, a, b "
+           "WHERE fact.k1 = a.ak AND fact.k2 = b.bk "
+           "GROUP BY g ORDER BY g")
+
+
+def test_joint_order_beats_row_dp():
+    s_dp = _mk(**{"planner.enable_memo": False})
+    _load_joint(s_dp)
+    s_joint = _mk()
+    _load_joint(s_joint)
+    dp_plan = s_dp.explain(JOINT_Q)
+    joint_plan = s_joint.explain(JOINT_Q)
+    # row-count DP orders the wide dim b first (est 45k < 50k), and the
+    # greedy rule then broadcasts its ~45 MB under the row threshold
+    assert "Motion broadcast" in dp_plan
+    # the joint search joins the colocated dim a first (zero motion) and
+    # ships only the ~2 MB narrow intermediate to meet b
+    assert "Motion broadcast" not in joint_plan
+    assert "Motion redistribute" in joint_plan
+    # same rows either way
+    pd_dp = s_dp.sql(JOINT_Q).to_pandas()
+    pd_joint = s_joint.sql(JOINT_Q).to_pandas()
+    assert pd_dp.equals(pd_joint)
+
+
+def test_joint_search_time_bounded():
+    """An 8-relation chain-and-star mix must plan in bounded time (the
+    verdict's planning-time criterion; q8 is the TPC-H worst case)."""
+    import time
+
+    s = _mk()
+    rng = np.random.default_rng(3)
+    n = 20_000
+    s.sql("CREATE TABLE hub (x0 BIGINT, x1 BIGINT, x2 BIGINT, x3 BIGINT, "
+          "x4 BIGINT, x5 BIGINT, x6 BIGINT, m BIGINT) DISTRIBUTED BY (x0)")
+    cols = {f"x{i}": rng.integers(0, 5_000, n) for i in range(7)}
+    cols["m"] = rng.integers(0, 100, n)
+    s.catalog.table("hub").set_data(cols)
+    for i in range(7):
+        s.sql(f"CREATE TABLE d{i} (k{i} BIGINT, p{i} BIGINT) "
+              f"DISTRIBUTED BY (k{i})")
+        s.catalog.table(f"d{i}").set_data(
+            {f"k{i}": np.arange(5_000), f"p{i}": np.arange(5_000)})
+    for t in ["hub"] + [f"d{i}" for i in range(7)]:
+        s.sql(f"analyze {t}")
+    q = ("SELECT sum(m) AS sm FROM hub, " +
+         ", ".join(f"d{i}" for i in range(7)) + " WHERE " +
+         " AND ".join(f"hub.x{i} = d{i}.k{i}" for i in range(7)))
+    t0 = time.time()
+    s.explain(q)
+    assert time.time() - t0 < 5.0  # 8 relations, bounded search
+    got = s.sql(q).to_pandas()
+    assert got["sm"][0] == int(cols["m"].sum())

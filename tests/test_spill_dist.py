@@ -183,7 +183,7 @@ def test_tpch_q5_q9_tiled_distributed():
     # per-SEGMENT budgets: SF0.02 shards are ~1/8 of the single-node test's
     # working set, so each budget sits just under that query's untiled
     # estimate (q9's resident builds + accumulator need more floor than q5)
-    for qn, budget in (("q5", 2 << 20), ("q9", 3 << 20)):
+    for qn, budget in (("q5", 1 << 20), ("q9", 3 << 20)):
         s = cb.Session(get_config().with_overrides(
             n_segments=8, **{"resource.query_mem_bytes": budget}))
         load_tpch(s, sf=0.02, seed=7)

@@ -1,0 +1,143 @@
+"""Scalar UDFs (exec/udf.py) — the procedural-language seam.
+
+The reference runs PL functions per tuple (src/pl/plpgsql); here the
+three compilable shapes are pinned: bind-time constant folding,
+dictionary rewrite over a string column (the LIKE machinery), and
+jax-traced functions compiled into the program. Distributed semantics
+must match single-node exactly.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu import types as T
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.plan.binder import BindError
+from cloudberry_tpu.exec.udf import (known_functions, register_function,
+                                     unregister_function)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _funcs():
+    register_function("initials", lambda s: "".join(
+        w[0].upper() for w in s.split()), [T.STRING], T.STRING)
+    register_function("name_len", lambda s: len(s), [T.STRING], T.INT64)
+    register_function("double_it", lambda x: x * 2, [T.INT64], T.INT64,
+                      jit=True)
+    register_function("taxed", lambda x, r: x * (1.0 + r),
+                      [T.FLOAT64, T.FLOAT64], T.FLOAT64, jit=True)
+    register_function("const_ans", lambda: 42, [], T.INT64)
+    register_function("odd_null", lambda s: None if len(s) % 2 else
+                      s.upper(), [T.STRING], T.STRING)
+    register_function("suffixed", lambda s, suf: s + suf,
+                      [T.STRING, T.STRING], T.STRING)
+    yield
+    for n in ("initials", "name_len", "double_it", "taxed", "const_ans",
+              "odd_null", "suffixed"):
+        unregister_function(n)
+
+
+def _mk(nseg):
+    s = cb.Session(Config(n_segments=nseg)) if nseg > 1 else cb.Session()
+    s.sql("create table p (k bigint, name text, sal double) "
+          "distributed by (k)")
+    s.sql("insert into p values (1, 'ada lovelace', 100.0), "
+          "(2, 'alan turing', 200.0), (3, 'grace hopper', 300.0), "
+          "(4, null, 400.0)")
+    return s
+
+
+@pytest.fixture(scope="module", params=[1, 8], ids=["single", "dist8"])
+def s(request):
+    return _mk(request.param)
+
+
+def test_dictionary_rewrite_select_and_where(s):
+    df = s.sql("select k, initials(name) as ini, name_len(name) as nl "
+               "from p order by k").to_pandas()
+    assert list(df["ini"])[:3] == ["AL", "AT", "GH"]
+    assert pd.isna(df["ini"][3])
+    assert list(df["nl"])[:3] == [12, 11, 12]
+    assert pd.isna(df["nl"][3])
+    df = s.sql("select k from p where initials(name) = 'AL'").to_pandas()
+    assert list(df["k"]) == [1]
+    # UDF output feeding another expression and GROUP BY
+    df = s.sql("select name_len(name) as nl, count(*) as n from p "
+               "where name is not null group by name_len(name) "
+               "order by nl").to_pandas()
+    assert list(df["nl"]) == [11, 12] and list(df["n"]) == [1, 2]
+
+
+def test_jit_udf_compiles_into_program(s):
+    df = s.sql("select k, double_it(k) as dk, taxed(sal, 0.1) as tx "
+               "from p order by k").to_pandas()
+    assert list(df["dk"]) == [2, 4, 6, 8]
+    assert np.allclose(df["tx"], [110.0, 220.0, 330.0, 440.0])
+    df = s.sql("select k from p where double_it(k) > 4 "
+               "order by k").to_pandas()
+    assert list(df["k"]) == [3, 4]
+
+
+def test_constant_folding(s):
+    df = s.sql("select const_ans() as c, name_len('abc') as n, "
+               "initials('alan mathison turing') as i").to_pandas()
+    assert df["c"][0] == 42 and df["n"][0] == 3 and df["i"][0] == "AMT"
+
+
+def test_null_in_null_out(s):
+    df = s.sql("select name_len(null) as n from p limit 1").to_pandas()
+    assert df["n"][0] is None or pd.isna(df["n"][0])
+    # per-value None from the function NULLs exactly those rows
+    df = s.sql("select k, odd_null(name) as o from p order by k").to_pandas()
+    assert df["o"][0] == "ADA LOVELACE"
+    assert pd.isna(df["o"][1])  # 'alan turing' has odd length
+    assert df["o"][2] == "GRACE HOPPER"
+    assert pd.isna(df["o"][3])
+
+
+def test_string_with_constant_extra_arg(s):
+    df = s.sql("select suffixed(name, '!') as x from p "
+               "where k = 2").to_pandas()
+    assert df["x"][0] == "alan turing!"
+
+
+def test_errors(s):
+    with pytest.raises(BindError, match="argument"):
+        s.sql("select name_len() from p")
+    with pytest.raises(BindError, match="unknown function"):
+        s.sql("select nope(k) from p")
+    # non-jit numeric-column call has no compilable shape
+    register_function("pyonly", lambda x: x + 1, [T.INT64], T.INT64)
+    try:
+        with pytest.raises(BindError, match="does not compile"):
+            s.sql("select pyonly(k) from p")
+    finally:
+        unregister_function("pyonly")
+    assert "initials" in known_functions()
+
+
+def test_distributed_matches_single():
+    a = _mk(1)
+    b = _mk(8)
+    q = ("select initials(name) as i, name_len(name) as n, "
+         "double_it(k) as d from p order by k")
+    assert a.sql(q).to_pandas().equals(b.sql(q).to_pandas())
+
+
+def test_reregistration_invalidates_cached_statements():
+    """Re-registering a function (CREATE OR REPLACE) must drop cached
+    runners whose plans baked the OLD function's results in."""
+    s = _mk(1)
+    register_function("twist", lambda x: x + 1, [T.INT64], T.INT64,
+                      jit=True)
+    try:
+        q = "select twist(k) as t from p order by k"
+        assert list(s.sql(q).to_pandas()["t"]) == [2, 3, 4, 5]
+        assert list(s.sql(q).to_pandas()["t"]) == [2, 3, 4, 5]  # cached
+        register_function("twist", lambda x: x * 10, [T.INT64], T.INT64,
+                          jit=True)
+        assert list(s.sql(q).to_pandas()["t"]) == [10, 20, 30, 40]
+    finally:
+        unregister_function("twist")
