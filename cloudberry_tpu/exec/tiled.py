@@ -190,14 +190,66 @@ def _plan_topn(shape: _TileShape, session) -> Optional["TopNTiledExecutable"]:
     return TopNTiledExecutable(shape, session, tile_rows, budget)
 
 
+def host_post_ok(nodes, sort_keys=None) -> bool:
+    """True when a chain above a spilled sort can apply HOST-SIDE after
+    the merge pass: column-pruning projections, LIMIT/OFFSET, gather
+    motions (no-ops — the host already pools every segment's rows) and
+    sorts on the same keys (already satisfied by the merge order). One
+    predicate shared by the single-node and distributed recognizers so
+    they cannot drift from host_apply_post."""
+    for nd in nodes:
+        if isinstance(nd, N.PLimit):
+            continue
+        if isinstance(nd, N.PProject) and all(
+                isinstance(e, ex.ColumnRef) for _, e in nd.exprs):
+            continue
+        if isinstance(nd, N.PMotion) and nd.kind == "gather":
+            continue
+        if sort_keys is not None and isinstance(nd, N.PSort) \
+                and repr(nd.keys) == repr(sort_keys):
+            continue
+        return False
+    return True
+
+
+def host_apply_post(nodes, cols: dict) -> dict:
+    """Apply a host_post_ok-validated chain bottom-up over host arrays
+    (gathers and merge-order sorts are no-ops here)."""
+    for node in reversed(nodes):
+        if isinstance(node, N.PLimit):
+            total = len(next(iter(cols.values()))) if cols else 0
+            lo = min(node.offset, total)
+            cols = {nm: a[lo:lo + node.limit] for nm, a in cols.items()}
+        elif isinstance(node, N.PProject):
+            cols = {out: cols[e.name] for out, e in node.exprs}
+    return cols
+
+
+def merge_sorted_runs(runs: dict, key_runs: list, fields, nkeys: int):
+    """The external sort's merge pass, shared by the single-node and
+    distributed executables: one stable host key sort over the pooled
+    runs (np.lexsort: LAST key is primary — mirror sort_indices).
+    Returns (sorted columns, sorted normalized keys)."""
+    names = list(runs)
+    if not names or not any(len(r) for r in runs[names[0]]):
+        cols = {f.name: np.zeros((0,), dtype=f.type.np_dtype)
+                for f in fields}
+        return cols, [np.zeros((0,), dtype=np.uint64)
+                      for _ in range(nkeys)]
+    karr = [np.concatenate(kr) for kr in key_runs]
+    order = np.lexsort(tuple(reversed(karr)))
+    cols = {nm: np.concatenate(runs[nm])[order] for nm in names}
+    return cols, [k[order] for k in karr]
+
+
 def _full_sort_shape(chain: list):
-    """Unbounded ORDER BY shape: the lowest sort, with only
-    column-pruning projections and LIMIT/OFFSET above it — the
-    external-sort path (tuplesort.c's spill-to-tape mode; here host RAM
-    is the tape: the device streams spine tiles and emits rows plus
-    their order-normalized u64 keys, the host keeps the runs and one
-    C-speed stable key sort is the merge pass). Returns the sort node,
-    or None when the chain has a different shape."""
+    """Unbounded ORDER BY shape: the lowest sort, with only a
+    host-applicable chain above it — the external-sort path
+    (tuplesort.c's spill-to-tape mode; here host RAM is the tape: the
+    device streams spine tiles and emits rows plus their
+    order-normalized u64 keys, the host keeps the runs and one C-speed
+    stable key sort is the merge pass). Returns the sort node, or None
+    when the chain has a different shape."""
     sort_i = next((i for i in range(len(chain) - 1, -1, -1)
                    if isinstance(chain[i], N.PSort)), None)
     if sort_i is None:
@@ -205,13 +257,8 @@ def _full_sort_shape(chain: list):
     if any(not isinstance(n, (N.PProject, N.PFilter))
            for n in chain[sort_i + 1:]):
         return None
-    for n in chain[:sort_i]:
-        if isinstance(n, N.PLimit):
-            continue
-        if isinstance(n, N.PProject) and all(
-                isinstance(e, ex.ColumnRef) for _, e in n.exprs):
-            continue
-        return None  # computed outputs above the sort: not host-applicable
+    if not host_post_ok(chain[:sort_i], chain[sort_i].keys):
+        return None
     return chain[sort_i]
 
 
@@ -224,13 +271,8 @@ def _plan_sort(shape: _TileShape,
     working set; the result itself lives host-side — the workfile."""
     # the topn fallback arrives here WITHOUT _full_sort_shape's chain
     # validation: re-check that everything above the sort is
-    # host-applicable (column-pruning projections and LIMIT only)
-    for nd in shape.post:
-        if isinstance(nd, N.PLimit):
-            continue
-        if isinstance(nd, N.PProject) and all(
-                isinstance(e, ex.ColumnRef) for _, e in nd.exprs):
-            continue
+    # host-applicable
+    if not host_post_ok(shape.post, shape.sortnode.keys):
         return None
     shape.partial_plan = shape.sortnode.child
     budget = session.config.resource.query_mem_bytes
@@ -946,34 +988,15 @@ class SortTiledExecutable(TiledExecutable):
                 key_runs[i].append(np.asarray(k)[mask])
 
         fault_point("tiled_finalize")
-        if n_tiles == 0 or not any(len(r) for r in runs[names[0]]):
-            cols = {nm: np.zeros(
-                (0,), dtype=shape.sortnode.child.field(nm).type.np_dtype)
-                for nm in names}
-            karr = [np.zeros((0,), dtype=np.uint64)
-                    for _ in shape.sortnode.keys]
-        else:
-            # merge pass: one stable sort over the order-normalized keys
-            # (np.lexsort: LAST key is primary — mirror sort_indices)
-            karr = [np.concatenate(kr) for kr in key_runs]
-            order = np.lexsort(tuple(reversed(karr)))
-            cols = {nm: np.concatenate(runs[nm])[order] for nm in names}
-            karr = [k[order] for k in karr]
+        cols, karr = merge_sorted_runs(runs, key_runs,
+                                       shape.sortnode.child.fields,
+                                       len(shape.sortnode.keys))
         return cols, karr, max(n_tiles, 1)
 
     def _run_once(self) -> ColumnBatch:
         shape = self.shape
         cols, _karr, n_tiles = self._stream_sorted()
-        # post chain host-side, bottom-up: column pruning and LIMIT only
-        # (_full_sort_shape guaranteed the shape)
-        for node in reversed(shape.post):
-            if isinstance(node, N.PLimit):
-                lo = min(node.offset, len(next(iter(cols.values()))) if
-                         cols else 0)
-                hi = lo + node.limit
-                cols = {nm: a[lo:hi] for nm, a in cols.items()}
-            else:
-                cols = {out: cols[e.name] for out, e in node.exprs}
+        cols = host_apply_post(shape.post, cols)
         n_out = len(next(iter(cols.values()))) if cols else 0
         self.report["n_tiles"] = n_tiles
         self.session.last_tiled_report = dict(self.report)

@@ -136,29 +136,12 @@ def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"
     return cls(shape, session, tile_rows, budget)
 
 
-def _host_post_ok(post_above, sort_keys) -> bool:
-    """The chain above the sort must be host-applicable after the merge
-    pass: column-pruning projections, LIMIT/OFFSET, gather motions
-    (no-ops — the host already holds every segment's rows) and sorts on
-    the SAME keys (already satisfied by the merge order)."""
-    for nd in post_above:
-        if isinstance(nd, N.PLimit):
-            continue
-        if isinstance(nd, N.PMotion) and nd.kind == "gather":
-            continue
-        if isinstance(nd, N.PProject) and all(
-                isinstance(e, ex.ColumnRef) for _, e in nd.exprs):
-            continue
-        if isinstance(nd, N.PSort) and repr(nd.keys) == repr(sort_keys):
-            continue
-        return False
-    return True
-
-
 def _to_dist_sort(shape: _DistTileShape) -> Optional[_DistTileShape]:
     """Re-aim a topn shape at the external-sort executable."""
+    from cloudberry_tpu.exec.tiled import host_post_ok
+
     post_above = shape.post[:shape.post.index(shape.sortnode)]
-    if not _host_post_ok(post_above, shape.sortnode.keys):
+    if not host_post_ok(post_above, shape.sortnode.keys):
         return None
     shape.mode = "sort"
     shape.g_cap = 0
@@ -300,9 +283,11 @@ def _analyze_dist_sort(plan, post, session) -> Optional[_DistTileShape]:
                    if isinstance(post[i], N.PSort)), None)
     if sort_i is None:
         return None
+    from cloudberry_tpu.exec.tiled import host_post_ok
+
     sortnode = post[sort_i]
     post_above = post[:sort_i]
-    if not _host_post_ok(post_above, sortnode.keys):
+    if not host_post_ok(post_above, sortnode.keys):
         return None
     below = sortnode.child
     while isinstance(below, N.PMotion) and below.kind == "gather":
@@ -924,33 +909,20 @@ class DistSortTiledExecutable(DistTiledExecutable):
                     runs[nm].append(np.asarray(pcols[nm][s])[m])
                 for i, k in enumerate(keys):
                     key_runs[i].append(np.asarray(k[s])[m])
-        if n_tiles == 0 or not any(len(r) for r in runs[names[0]]):
-            cols = {nm: np.zeros(
-                (0,), dtype=shape.partial_plan.field(nm).type.np_dtype)
-                for nm in names}
-            karr = [np.zeros((0,), dtype=np.uint64)
-                    for _ in shape.sortnode.keys]
-        else:
-            karr = [np.concatenate(kr) for kr in key_runs]
-            order = np.lexsort(tuple(reversed(karr)))
-            cols = {nm: np.concatenate(runs[nm])[order] for nm in names}
-            karr = [k[order] for k in karr]
+        from cloudberry_tpu.exec.tiled import merge_sorted_runs
+
+        cols, karr = merge_sorted_runs(runs, key_runs,
+                                       shape.partial_plan.fields,
+                                       len(shape.sortnode.keys))
         return cols, karr, max(n_tiles, 1)
 
     def _run_once(self) -> ColumnBatch:
         _retile_dist(self.shape, self.tile_rows, self.nseg)
         shape = self.shape
         cols, _karr, n_tiles = self._stream_sorted()
-        # chain above the sort, host-side (validated at plan time):
-        # pruning projections, LIMIT, no-op gathers, merge-order sorts
-        for node in reversed(shape.post_above):
-            if isinstance(node, N.PLimit):
-                total = len(next(iter(cols.values()))) if cols else 0
-                lo = min(node.offset, total)
-                cols = {nm: a[lo:lo + node.limit]
-                        for nm, a in cols.items()}
-            elif isinstance(node, N.PProject):
-                cols = {out: cols[e.name] for out, e in node.exprs}
+        from cloudberry_tpu.exec.tiled import host_apply_post
+
+        cols = host_apply_post(shape.post_above, cols)
         n_out = len(next(iter(cols.values()))) if cols else 0
         self.report["n_tiles"] = n_tiles
         self.session.last_tiled_report = dict(self.report)

@@ -423,10 +423,20 @@ class Binder:
                     residual.extend(preds)
                     continue
                 p = plans[alias]
+                old = p
                 for pred in preds:
                     p = self._filter(p, self.bind_scalar(pred, scope))
                 plans[alias] = p
-                _rebind_scope(scope, alias, p)
+                # rebind EVERY entry (and plan) that shared the old
+                # object: an explicit JOIN's aliases all point at one
+                # merged plan, and a stale sibling would make suffix
+                # resolution see two distinct sources for one column
+                for e in scope.entries:
+                    if e.alias == alias or e.plan is old:
+                        e.plan = p
+                for a2, pv in list(plans.items()):
+                    if pv is old:
+                        plans[a2] = p
             plan = self._join_tree(plans, edges, scope,
                                    groupby=sel.group_by)
             for pred in residual:

@@ -566,9 +566,12 @@ def _joint_build(desc, atoms, edges, atom_alts, make_join):
     if desc[0] == "atom":
         _kind, i, altkey = desc
         # joins INSIDE an atom (derived tables) carry their own choice
-        # stamps through the exploration Alt
+        # stamps through the exploration Alt — and must be final too,
+        # or the post-bind exploration re-stamps a locally-cheapest
+        # choice whose sharding the parent's motions were not priced for
         for jn, choice in atom_alts[i][altkey].choices:
             jn._dist_choice = choice
+            jn._joint = True
         return atoms[i][0]
     _kind, bdesc, pdesc, eidx, bmask, choice = desc
     bplan = _joint_build(bdesc, atoms, edges, atom_alts, make_join)

@@ -275,6 +275,10 @@ class Session:
                     **{"planner.enable_memo": False})
                 result2 = plan_statement(stmt, clone, params)
                 texe = plan_tiled(result2.plan, clone)
+                if texe is not None:
+                    # the clone only existed to plan greedy: runs must
+                    # report (last_tiled_report) to the REAL session
+                    texe.session = self
             if texe is None:
                 raise
             self._dispatch_seams(fault_point)

@@ -715,8 +715,17 @@ class Parser:
 
     def parse_table_primary(self) -> ast.TableRefNode:
         if self.accept_op("("):
-            if self.at_kw("select"):
-                sub = self.parse_select()
+            # a derived table holds a full QUERY expression: plain
+            # SELECT, WITH, or a set-op chain whose operands may
+            # themselves be parenthesized ("(sel) intersect (sel)" —
+            # the q38-class shape). "(" followed by SELECT/WITH/"("
+            # distinguishes it from a parenthesized join ref.
+            if self.at_kw("select", "with") \
+                    or (self.at_op("(")
+                        and self.toks[self.i + 1].kind == "ident"
+                        and self.toks[self.i + 1].text
+                        in ("select", "with")):
+                sub = self.parse_query()
                 self.expect_op(")")
                 self.accept_kw("as")
                 alias = self.expect_ident()

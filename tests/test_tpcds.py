@@ -336,7 +336,7 @@ ORACLES = {"q17": oracle_q17, "q25": oracle_q25, "q29": oracle_q29,
            "q20": oracle_q20}
 
 
-@pytest.mark.parametrize("qname", sorted(DS_QUERIES))
+@pytest.mark.parametrize("qname", sorted(ORACLES))
 def test_tpcds_query(ds_session, qname):
     session, tables = ds_session
     got = session.sql(DS_QUERIES[qname]).to_pandas()
@@ -353,7 +353,7 @@ def ds_dist_session():
     return s, tables
 
 
-@pytest.mark.parametrize("qname", sorted(DS_QUERIES))
+@pytest.mark.parametrize("qname", sorted(ORACLES))
 def test_tpcds_distributed(ds_dist_session, qname):
     s, tables = ds_dist_session
     got = s.sql(DS_QUERIES[qname]).to_pandas()

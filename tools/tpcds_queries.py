@@ -296,3 +296,400 @@ group by rollup (i_item_id, s_state)
 order by i_item_id, s_state
 limit 100
 """
+
+# -------- round 5: families that force NEW binder/executor surface —
+# mixed distinct aggregates + EXISTS/NOT EXISTS (q16/q94), INTERSECT
+# count (q38), CASE day-of-week pivots (q43/q59), cross-channel CTE
+# unions with IN-subqueries (q33/q56/q60), year-over-year CTE self-joins
+# (q74), DQA-in-scalar-subquery ratio (q90), LEFT-join actual-sales
+# (q93), FULL-join channel overlap (q97), ship-delay buckets (q99),
+# correlated-average item filter (q6), zip/state OR filters (q15).
+# Adaptations from the official text (columns tpcds-lite does not
+# generate: call centers, ship modes, web sites, demographics, gmt
+# offsets; d_month_seq windows -> d_year) are noted per query.
+
+# q6 (adapted: month filter via d_year/d_moy; the correlated average
+# is compared as "avg < price / 1.2" — same predicate, in the shape the
+# decorrelator recognizes)
+DS_QUERIES["q6"] = """
+select a.ca_state as state, count(*) as cnt
+from customer_address a join customer c
+       on a.ca_address_sk = c.c_current_addr_sk
+     join store_sales s on c.c_customer_sk = s.ss_customer_sk
+     join date_dim d on s.ss_sold_date_sk = d.d_date_sk
+     join item i on s.ss_item_sk = i.i_item_sk
+where d.d_year = 2000 and d.d_moy = 5
+  and (select avg(j.i_current_price) from item j
+       where j.i_category = i.i_category) < i.i_current_price / 1.2
+group by a.ca_state
+having count(*) >= 10
+order by cnt, a.ca_state
+limit 100
+"""
+
+# q15 (adapted: qoy -> d_moy, sales-price threshold over generated range)
+DS_QUERIES["q15"] = """
+select ca_zip, sum(cs_ext_sales_price) as total
+from catalog_sales join customer on cs_bill_customer_sk = c_customer_sk
+     join customer_address on c_current_addr_sk = ca_address_sk
+     join date_dim on cs_sold_date_sk = d_date_sk
+where (substring(ca_zip, 1, 3) in ('850', '856', '859', '834')
+       or ca_state in ('CA', 'WA', 'GA')
+       or cs_ext_sales_price > 480)
+  and d_year = 2001 and d_moy = 1
+group by ca_zip
+order by ca_zip
+limit 100
+"""
+
+# q16 (adapted: no call-center dimension; ship-date window via d_date)
+DS_QUERIES["q16"] = """
+select count(distinct cs_order_number) as order_count,
+       sum(cs_ext_ship_cost) as total_shipping_cost,
+       sum(cs_net_profit) as total_net_profit
+from catalog_sales cs1
+     join date_dim on cs1.cs_ship_date_sk = d_date_sk
+     join warehouse on cs1.cs_warehouse_sk = w_warehouse_sk
+where d_date between date '1999-02-01'
+                 and date '1999-02-01' + interval '60' day
+  and exists (select 1 from catalog_sales cs2
+              where cs1.cs_order_number = cs2.cs_order_number
+                and cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  and not exists (select 1 from catalog_returns cr1
+                  where cs1.cs_order_number = cr1.cr_order_number)
+limit 100
+"""
+
+# q33 (adapted: no ca_gmt_offset; manufacturer set from the Books
+# category, May 1998)
+DS_QUERIES["q33"] = """
+with ss as (
+  select i_manufact_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+       join item on ss_item_sk = i_item_sk
+  where i_manufact_id in (select it2.i_manufact_id from item it2
+                          where it2.i_category = 'Books')
+    and d_year = 1998 and d_moy = 5
+  group by i_manufact_id),
+cs as (
+  select i_manufact_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales join date_dim on cs_sold_date_sk = d_date_sk
+       join item on cs_item_sk = i_item_sk
+  where i_manufact_id in (select it2.i_manufact_id from item it2
+                          where it2.i_category = 'Books')
+    and d_year = 1998 and d_moy = 5
+  group by i_manufact_id),
+ws as (
+  select i_manufact_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales join date_dim on ws_sold_date_sk = d_date_sk
+       join item on ws_item_sk = i_item_sk
+  where i_manufact_id in (select it2.i_manufact_id from item it2
+                          where it2.i_category = 'Books')
+    and d_year = 1998 and d_moy = 5
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) as total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_manufact_id
+order by total_sales, i_manufact_id
+limit 100
+"""
+
+# q38 (adapted: d_month_seq window -> d_year)
+DS_QUERIES["q38"] = """
+select count(*) as cnt from (
+  (select distinct c_last_name, c_first_name, d_date
+   from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+        join customer on ss_customer_sk = c_customer_sk
+   where d_year = 1999)
+  intersect
+  (select distinct c_last_name, c_first_name, d_date
+   from catalog_sales join date_dim on cs_sold_date_sk = d_date_sk
+        join customer on cs_bill_customer_sk = c_customer_sk
+   where d_year = 1999)
+  intersect
+  (select distinct c_last_name, c_first_name, d_date
+   from web_sales join date_dim on ws_sold_date_sk = d_date_sk
+        join customer on ws_bill_customer_sk = c_customer_sk
+   where d_year = 1999)
+) hot_cust
+limit 100
+"""
+
+# q43 (adapted: gmt offset dropped; measure is ss_ext_sales_price)
+DS_QUERIES["q43"] = """
+select s_store_name, s_store_id,
+  sum(case when d_day_name = 'Sunday' then ss_ext_sales_price
+           else null end) as sun_sales,
+  sum(case when d_day_name = 'Monday' then ss_ext_sales_price
+           else null end) as mon_sales,
+  sum(case when d_day_name = 'Tuesday' then ss_ext_sales_price
+           else null end) as tue_sales,
+  sum(case when d_day_name = 'Wednesday' then ss_ext_sales_price
+           else null end) as wed_sales,
+  sum(case when d_day_name = 'Thursday' then ss_ext_sales_price
+           else null end) as thu_sales,
+  sum(case when d_day_name = 'Friday' then ss_ext_sales_price
+           else null end) as fri_sales,
+  sum(case when d_day_name = 'Saturday' then ss_ext_sales_price
+           else null end) as sat_sales
+from date_dim join store_sales on d_date_sk = ss_sold_date_sk
+     join store on s_store_sk = ss_store_sk
+where d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id
+limit 100
+"""
+
+# q56 (adapted: i_color -> i_class filter; September 2000)
+DS_QUERIES["q56"] = """
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+       join item on ss_item_sk = i_item_sk
+  where i_item_id in (select it2.i_item_id from item it2
+                      where it2.i_class in ('alpha', 'beta'))
+    and d_year = 2000 and d_moy = 9
+  group by i_item_id),
+cs as (
+  select i_item_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales join date_dim on cs_sold_date_sk = d_date_sk
+       join item on cs_item_sk = i_item_sk
+  where i_item_id in (select it2.i_item_id from item it2
+                      where it2.i_class in ('alpha', 'beta'))
+    and d_year = 2000 and d_moy = 9
+  group by i_item_id),
+ws as (
+  select i_item_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales join date_dim on ws_sold_date_sk = d_date_sk
+       join item on ws_item_sk = i_item_sk
+  where i_item_id in (select it2.i_item_id from item it2
+                      where it2.i_class in ('alpha', 'beta'))
+    and d_year = 2000 and d_moy = 9
+  group by i_item_id)
+select i_item_id, sum(total_sales) as total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_item_id
+order by total_sales, i_item_id
+limit 100
+"""
+
+# q59 (adapted: the d_month_seq windows become explicit week ranges and
+# the year-over-year match is d_week_seq = d_week_seq2 - 52; measure is
+# ss_ext_sales_price)
+DS_QUERIES["q59"] = """
+with wss as (
+  select d_week_seq, ss_store_sk,
+    sum(case when d_day_name = 'Sunday' then ss_ext_sales_price
+             else null end) as sun_sales,
+    sum(case when d_day_name = 'Monday' then ss_ext_sales_price
+             else null end) as mon_sales,
+    sum(case when d_day_name = 'Friday' then ss_ext_sales_price
+             else null end) as fri_sales,
+    sum(case when d_day_name = 'Saturday' then ss_ext_sales_price
+             else null end) as sat_sales
+  from store_sales join date_dim on d_date_sk = ss_sold_date_sk
+  group by d_week_seq, ss_store_sk)
+select y.s_store_name1, y.s_store_id1, y.d_week_seq1,
+       y.sun_sales1 / x.sun_sales2 as sun_r,
+       y.mon_sales1 / x.mon_sales2 as mon_r,
+       y.fri_sales1 / x.fri_sales2 as fri_r,
+       y.sat_sales1 / x.sat_sales2 as sat_r
+from (select s_store_name as s_store_name1, wss.d_week_seq as d_week_seq1,
+             s_store_id as s_store_id1, sun_sales as sun_sales1,
+             mon_sales as mon_sales1, fri_sales as fri_sales1,
+             sat_sales as sat_sales1
+      from wss join store on ss_store_sk = s_store_sk
+      where d_week_seq between 27 and 52) y
+     join
+     (select s_store_name as s_store_name2, wss.d_week_seq as d_week_seq2,
+             s_store_id as s_store_id2, sun_sales as sun_sales2,
+             mon_sales as mon_sales2, fri_sales as fri_sales2,
+             sat_sales as sat_sales2
+      from wss join store on ss_store_sk = s_store_sk
+      where d_week_seq between 79 and 104) x
+     on y.s_store_id1 = x.s_store_id2
+    and y.d_week_seq1 = x.d_week_seq2 - 52
+order by y.s_store_name1, y.s_store_id1, y.d_week_seq1
+limit 100
+"""
+
+# q60 (adapted: no gmt offset; Music category, September 1999)
+DS_QUERIES["q60"] = """
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+       join item on ss_item_sk = i_item_sk
+  where i_item_id in (select it2.i_item_id from item it2
+                      where it2.i_category = 'Music')
+    and d_year = 1999 and d_moy = 9
+  group by i_item_id),
+cs as (
+  select i_item_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales join date_dim on cs_sold_date_sk = d_date_sk
+       join item on cs_item_sk = i_item_sk
+  where i_item_id in (select it2.i_item_id from item it2
+                      where it2.i_category = 'Music')
+    and d_year = 1999 and d_moy = 9
+  group by i_item_id),
+ws as (
+  select i_item_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales join date_dim on ws_sold_date_sk = d_date_sk
+       join item on ws_item_sk = i_item_sk
+  where i_item_id in (select it2.i_item_id from item it2
+                      where it2.i_category = 'Music')
+    and d_year = 1999 and d_moy = 9
+  group by i_item_id)
+select i_item_id, sum(total_sales) as total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) tmp1
+group by i_item_id
+order by i_item_id, total_sales
+limit 100
+"""
+
+# q74 (adapted: the sale-type discriminator is numeric (1 = store,
+# 2 = web) — the shape under test is the 4-instance CTE self-join with
+# the guarded ratio comparison)
+DS_QUERIES["q74"] = """
+with year_total as (
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         d_year as year_, sum(ss_ext_sales_price) as year_total,
+         1 as sale_type
+  from customer join store_sales on c_customer_sk = ss_customer_sk
+       join date_dim on ss_sold_date_sk = d_date_sk
+  where d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, d_year
+  union all
+  select c_customer_id as customer_id, c_first_name, c_last_name,
+         d_year as year_, sum(ws_ext_sales_price) as year_total,
+         2 as sale_type
+  from customer join web_sales on c_customer_sk = ws_bill_customer_sk
+       join date_dim on ws_sold_date_sk = d_date_sk
+  where d_year in (1999, 2000)
+  group by c_customer_id, c_first_name, c_last_name, d_year)
+select t_s_secyear.customer_id, t_s_secyear.c_first_name,
+       t_s_secyear.c_last_name
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.sale_type = 1 and t_w_firstyear.sale_type = 2
+  and t_s_secyear.sale_type = 1 and t_w_secyear.sale_type = 2
+  and t_s_firstyear.year_ = 1999 and t_s_secyear.year_ = 2000
+  and t_w_firstyear.year_ = 1999 and t_w_secyear.year_ = 2000
+  and t_s_firstyear.year_total > 0 and t_w_firstyear.year_total > 0
+  and case when t_w_firstyear.year_total > 0
+           then t_w_secyear.year_total / t_w_firstyear.year_total
+           else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else null end
+order by t_s_secyear.customer_id, t_s_secyear.c_first_name,
+         t_s_secyear.c_last_name
+limit 100
+"""
+
+# q90 (adapted: the am/pm ratio is expressed through uncorrelated
+# scalar subqueries — the cross join of two one-row derived tables is
+# the same computation)
+DS_QUERIES["q90"] = """
+select (select count(distinct ws_order_number)
+        from web_sales join time_dim on ws_sold_time_sk = t_time_sk
+             join web_page on ws_web_page_sk = wp_web_page_sk
+        where t_hour between 8 and 9
+          and wp_char_count between 2000 and 5000)
+       / (select count(distinct ws_order_number)
+          from web_sales join time_dim on ws_sold_time_sk = t_time_sk
+               join web_page on ws_web_page_sk = wp_web_page_sk
+          where t_hour between 19 and 20
+            and wp_char_count between 2000 and 5000) as am_pm_ratio
+"""
+
+# q93 (adapted: no reason dimension — returned lines subtract their
+# returned quantity; measure is ss_ext_sales_price as the unit price
+# proxy)
+DS_QUERIES["q93"] = """
+select ss_customer_sk, sum(act_sales) as sumsales
+from (select ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity)
+                       * ss_ext_sales_price
+                  else ss_quantity * ss_ext_sales_price end as act_sales
+      from store_sales left join store_returns
+           on sr_item_sk = ss_item_sk
+          and sr_ticket_number = ss_ticket_number) t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk
+limit 100
+"""
+
+# q94 (adapted: no web_site dimension; ship-date window via d_date)
+DS_QUERIES["q94"] = """
+select count(distinct ws_order_number) as order_count,
+       sum(ws_ext_ship_cost) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from web_sales ws1
+     join date_dim on ws1.ws_ship_date_sk = d_date_sk
+     join warehouse on ws1.ws_warehouse_sk = w_warehouse_sk
+where d_date between date '1999-02-01'
+                 and date '1999-02-01' + interval '60' day
+  and exists (select 1 from web_sales ws2
+              where ws1.ws_order_number = ws2.ws_order_number
+                and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  and not exists (select 1 from web_returns wr1
+                  where ws1.ws_order_number = wr1.wr_order_number)
+limit 100
+"""
+
+# q97
+DS_QUERIES["q97"] = """
+with ssci as (
+  select ss_customer_sk as customer_sk, ss_item_sk as item_sk
+  from store_sales join date_dim on ss_sold_date_sk = d_date_sk
+  where d_year = 2000
+  group by ss_customer_sk, ss_item_sk),
+csci as (
+  select cs_bill_customer_sk as customer_sk, cs_item_sk as item_sk
+  from catalog_sales join date_dim on cs_sold_date_sk = d_date_sk
+  where d_year = 2000
+  group by cs_bill_customer_sk, cs_item_sk)
+select sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is null then 1 else 0 end)
+         as store_only,
+       sum(case when ssci.customer_sk is null
+                 and csci.customer_sk is not null then 1 else 0 end)
+         as catalog_only,
+       sum(case when ssci.customer_sk is not null
+                 and csci.customer_sk is not null then 1 else 0 end)
+         as store_and_catalog
+from ssci full join csci
+     on ssci.customer_sk = csci.customer_sk
+    and ssci.item_sk = csci.item_sk
+limit 100
+"""
+
+# q99 (adapted: warehouse replaces the call-center/ship-mode grouping;
+# the delay buckets are the official 30/60/90/120-day CASE pivot)
+DS_QUERIES["q99"] = """
+select w_warehouse_name,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk <= 30
+           then 1 else 0 end) as d30,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 30
+            and cs_ship_date_sk - cs_sold_date_sk <= 60
+           then 1 else 0 end) as d60,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 60
+            and cs_ship_date_sk - cs_sold_date_sk <= 90
+           then 1 else 0 end) as d90,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 90
+            and cs_ship_date_sk - cs_sold_date_sk <= 120
+           then 1 else 0 end) as d120,
+  sum(case when cs_ship_date_sk - cs_sold_date_sk > 120
+           then 1 else 0 end) as dmore
+from catalog_sales join warehouse on cs_warehouse_sk = w_warehouse_sk
+group by w_warehouse_name
+order by w_warehouse_name
+limit 100
+"""

@@ -14,7 +14,8 @@ from cloudberry_tpu.types import Schema, date_to_days
 
 SCHEMAS: dict[str, Schema] = {
     "date_dim": Schema.of(d_date_sk=T.INT64, d_date=T.DATE, d_year=T.INT32,
-                          d_moy=T.INT32, d_quarter_name=T.STRING),
+                          d_moy=T.INT32, d_quarter_name=T.STRING,
+                          d_week_seq=T.INT32, d_day_name=T.STRING),
     "item": Schema.of(i_item_sk=T.INT64, i_item_id=T.STRING,
                       i_item_desc=T.STRING, i_current_price=T.DECIMAL(2),
                       i_brand_id=T.INT32, i_brand=T.STRING,
@@ -22,7 +23,18 @@ SCHEMAS: dict[str, Schema] = {
                       i_manufact_id=T.INT32, i_manager_id=T.INT32),
     "store": Schema.of(s_store_sk=T.INT64, s_store_id=T.STRING,
                        s_store_name=T.STRING, s_state=T.STRING),
-    "customer": Schema.of(c_customer_sk=T.INT64),
+    "customer": Schema.of(c_customer_sk=T.INT64, c_customer_id=T.STRING,
+                          c_first_name=T.STRING, c_last_name=T.STRING,
+                          c_current_addr_sk=T.INT64),
+    "customer_address": Schema.of(ca_address_sk=T.INT64,
+                                  ca_state=T.STRING, ca_zip=T.STRING),
+    "time_dim": Schema.of(t_time_sk=T.INT64, t_hour=T.INT32),
+    "web_page": Schema.of(wp_web_page_sk=T.INT64,
+                          wp_char_count=T.INT32),
+    "catalog_returns": Schema.of(cr_order_number=T.INT64,
+                                 cr_return_amount=T.DECIMAL(2)),
+    "web_returns": Schema.of(wr_order_number=T.INT64,
+                             wr_return_amt=T.DECIMAL(2)),
     "store_sales": Schema.of(ss_sold_date_sk=T.INT64, ss_item_sk=T.INT64,
                              ss_customer_sk=T.INT64, ss_ticket_number=T.INT64,
                              ss_store_sk=T.INT64, ss_quantity=T.INT32,
@@ -37,12 +49,22 @@ SCHEMAS: dict[str, Schema] = {
                                cs_bill_customer_sk=T.INT64,
                                cs_quantity=T.INT32,
                                cs_net_profit=T.DECIMAL(2),
-                               cs_ext_sales_price=T.DECIMAL(2)),
+                               cs_ext_sales_price=T.DECIMAL(2),
+                               cs_order_number=T.INT64,
+                               cs_warehouse_sk=T.INT64,
+                               cs_ship_date_sk=T.INT64,
+                               cs_ext_ship_cost=T.DECIMAL(2)),
     "web_sales": Schema.of(ws_sold_date_sk=T.INT64, ws_item_sk=T.INT64,
                            ws_bill_customer_sk=T.INT64,
                            ws_quantity=T.INT32,
                            ws_ext_sales_price=T.DECIMAL(2),
-                           ws_net_profit=T.DECIMAL(2)),
+                           ws_net_profit=T.DECIMAL(2),
+                           ws_order_number=T.INT64,
+                           ws_warehouse_sk=T.INT64,
+                           ws_ship_date_sk=T.INT64,
+                           ws_ext_ship_cost=T.DECIMAL(2),
+                           ws_web_page_sk=T.INT64,
+                           ws_sold_time_sk=T.INT64),
     "warehouse": Schema.of(w_warehouse_sk=T.INT64,
                            w_warehouse_name=T.STRING),
     "inventory": Schema.of(inv_date_sk=T.INT64, inv_item_sk=T.INT64,
@@ -52,12 +74,15 @@ SCHEMAS: dict[str, Schema] = {
 
 DIST_KEYS = {
     "date_dim": None, "item": None, "store": None,      # replicated dims
-    "warehouse": None,
+    "warehouse": None, "customer_address": None, "time_dim": None,
+    "web_page": None,
     "customer": ("c_customer_sk",),
     "store_sales": ("ss_ticket_number",),
     "store_returns": ("sr_ticket_number",),
     "catalog_sales": ("cs_bill_customer_sk",),
+    "catalog_returns": ("cr_order_number",),
     "web_sales": ("ws_bill_customer_sk",),
+    "web_returns": ("wr_order_number",),
     "inventory": ("inv_item_sk",),
 }
 
@@ -83,6 +108,9 @@ def generate(scale: float = 1.0, seed: int = 0):
     years = 1998 + days // 365
     moy = (days % 365) // 31 + 1
     moy = np.clip(moy, 1, 12)
+    _DAYNAMES = np.asarray(["Sunday", "Monday", "Tuesday", "Wednesday",
+                            "Thursday", "Friday", "Saturday"],
+                           dtype=object)
     data["date_dim"] = {
         "d_date_sk": days + 1,
         "d_date": dates,
@@ -91,6 +119,10 @@ def generate(scale: float = 1.0, seed: int = 0):
         "d_quarter_name": np.asarray(
             [f"{y}Q{(m - 1) // 3 + 1}" for y, m in zip(years, moy)],
             dtype=object),
+        # round-5 weekly columns (q43/q59): derived, no rng consumed.
+        # 1998-01-01 was a Thursday; (dates + 4) % 7 == 0 on Sundays.
+        "d_week_seq": ((days + 4) // 7 + 1).astype(np.int32),
+        "d_day_name": _DAYNAMES[(dates + 4) % 7],
     }
 
     ik = np.arange(1, n_item + 1, dtype=np.int64)
@@ -126,8 +158,42 @@ def generate(scale: float = 1.0, seed: int = 0):
             rng.integers(0, len(_STATES), n_store)],
     }
 
+    # round-5 customer identity + address columns on their OWN stream
+    # (rng5): committed queries' selectivities are pinned to the existing
+    # streams' draw sequences
+    rng5 = np.random.default_rng(seed + 331337)
+    n_ca = max(int(800 * scale), 80)
+    firsts = np.asarray([f"First{i:02d}" for i in range(40)], dtype=object)
+    lasts = np.asarray([f"Last{i:02d}" for i in range(60)], dtype=object)
+    csk = np.arange(1, n_cust + 1, dtype=np.int64)
     data["customer"] = {
-        "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64)}
+        "c_customer_sk": csk,
+        "c_customer_id": np.asarray([f"CUST{i:09d}" for i in csk],
+                                    dtype=object),
+        "c_first_name": firsts[rng5.integers(0, len(firsts), n_cust)],
+        "c_last_name": lasts[rng5.integers(0, len(lasts), n_cust)],
+        "c_current_addr_sk": rng5.integers(1, n_ca + 1, n_cust)
+        .astype(np.int64),
+    }
+    zips = np.asarray(
+        [f"{p}{s:02d}" for p in ("850", "856", "859", "834", "772",
+                                 "601", "331", "443")
+         for s in range(25)], dtype=object)
+    data["customer_address"] = {
+        "ca_address_sk": np.arange(1, n_ca + 1, dtype=np.int64),
+        "ca_state": np.asarray(_STATES, dtype=object)[
+            rng5.integers(0, len(_STATES), n_ca)],
+        "ca_zip": zips[rng5.integers(0, len(zips), n_ca)],
+    }
+    data["time_dim"] = {
+        "t_time_sk": np.arange(1, 25, dtype=np.int64),
+        "t_hour": np.arange(0, 24, dtype=np.int32),
+    }
+    n_wp = 10
+    data["web_page"] = {
+        "wp_web_page_sk": np.arange(1, n_wp + 1, dtype=np.int64),
+        "wp_char_count": rng5.integers(1000, 9000, n_wp).astype(np.int32),
+    }
 
     ss_date = rng.integers(1, n_dates + 1, n_ss)
     data["store_sales"] = {
@@ -168,6 +234,29 @@ def generate(scale: float = 1.0, seed: int = 0):
         "cs_ext_sales_price": np.random.default_rng(seed + 424243)
         .integers(100, 50_000, n_cs) / 100.0,
     }
+    # round-5 fulfillment columns (q16/q99) on their own stream: orders
+    # group ~3 lines; ~20% of lines ship from a second warehouse
+    rng6 = np.random.default_rng(seed + 550551)
+    n_ords = max(n_cs // 3, 1)
+    cs_ord = rng6.integers(1, n_ords + 1, n_cs).astype(np.int64)
+    data["catalog_sales"]["cs_order_number"] = cs_ord
+    wh_of_order = rng6.integers(1, 5, n_ords + 1)
+    cs_wh = wh_of_order[cs_ord]
+    flip = rng6.random(n_cs) < 0.2
+    cs_wh = np.where(flip, cs_wh % 4 + 1, cs_wh)
+    data["catalog_sales"]["cs_warehouse_sk"] = cs_wh.astype(np.int64)
+    data["catalog_sales"]["cs_ship_date_sk"] = np.minimum(
+        data["catalog_sales"]["cs_sold_date_sk"]
+        + rng6.integers(1, 150, n_cs), n_dates).astype(np.int64)
+    data["catalog_sales"]["cs_ext_ship_cost"] = \
+        rng6.integers(50, 5_000, n_cs) / 100.0
+    ret_orders = rng6.choice(np.arange(1, n_ords + 1),
+                             size=max(n_ords // 5, 1), replace=False)
+    data["catalog_returns"] = {
+        "cr_order_number": np.sort(ret_orders).astype(np.int64),
+        "cr_return_amount": rng6.integers(100, 20_000,
+                                          len(ret_orders)) / 100.0,
+    }
 
     # web/inventory family (q12/q21/q86): OWN rng streams — consuming the
     # shared one would shift earlier tables' draws and silently re-tune
@@ -183,6 +272,31 @@ def generate(scale: float = 1.0, seed: int = 0):
         "ws_quantity": rng3.integers(1, 100, n_ws).astype(np.int32),
         "ws_ext_sales_price": rng3.integers(100, 50_000, n_ws) / 100.0,
         "ws_net_profit": rng3.integers(-5_000, 20_000, n_ws) / 100.0,
+    }
+    # round-5 web fulfillment columns (q90/q94) on their own stream
+    rng7 = np.random.default_rng(seed + 770771)
+    n_words = max(n_ws // 3, 1)
+    ws_ord = rng7.integers(1, n_words + 1, n_ws).astype(np.int64)
+    data["web_sales"]["ws_order_number"] = ws_ord
+    wwh = rng7.integers(1, 5, n_words + 1)
+    ws_wh = wwh[ws_ord]
+    wflip = rng7.random(n_ws) < 0.2
+    data["web_sales"]["ws_warehouse_sk"] = np.where(
+        wflip, ws_wh % 4 + 1, ws_wh).astype(np.int64)
+    data["web_sales"]["ws_ship_date_sk"] = np.minimum(
+        data["web_sales"]["ws_sold_date_sk"]
+        + rng7.integers(1, 150, n_ws), n_dates).astype(np.int64)
+    data["web_sales"]["ws_ext_ship_cost"] = \
+        rng7.integers(50, 5_000, n_ws) / 100.0
+    data["web_sales"]["ws_web_page_sk"] = \
+        rng7.integers(1, 11, n_ws).astype(np.int64)
+    data["web_sales"]["ws_sold_time_sk"] = \
+        rng7.integers(1, 25, n_ws).astype(np.int64)
+    wret = rng7.choice(np.arange(1, n_words + 1),
+                       size=max(n_words // 5, 1), replace=False)
+    data["web_returns"] = {
+        "wr_order_number": np.sort(wret).astype(np.int64),
+        "wr_return_amt": rng7.integers(100, 20_000, len(wret)) / 100.0,
     }
     n_wh = 4
     data["warehouse"] = {
