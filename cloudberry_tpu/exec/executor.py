@@ -631,15 +631,32 @@ class Lowerer:
             # stays the -offset side of a nondecreasing array
             s = kv_s if asc else -kv_s
             knullrow = s_sel & ~keyvalid
+
+            def _target(off):
+                # numeric offsets are same-domain distances; a
+                # ("months", n) offset is a CALENDAR shift of each
+                # row's civil date (timestamp.c interval_pl: month
+                # arithmetic with the day-of-month clamped), computed
+                # in-program via the Hinnant civil<->days round trip.
+                # DESC negates the search domain, so the month count
+                # must flip too (s + off ≡ -(v - off) there): PRECEDING
+                # under DESC reaches LATER dates.
+                if isinstance(off, tuple):
+                    sh = _shift_months_days(kv_s.astype(jnp.int64),
+                                            off[1] if asc else -off[1])
+                    return sh if asc else -sh
+                return s + off
             if lo_off is None:
                 flo = seg_start
             else:
-                f = _vsearch(s, s + lo_off, vlo, vhi, cap, lower=True)
+                f = _vsearch(s, _target(lo_off), vlo, vhi, cap,
+                             lower=True)
                 flo = jnp.where(knullrow, run_start, f)
             if hi_off is None:
                 fhi = seg_end
             else:
-                f = _vsearch(s, s + hi_off, vlo, vhi, cap, lower=False) - 1
+                f = _vsearch(s, _target(hi_off), vlo, vhi, cap,
+                             lower=False) - 1
                 fhi = jnp.where(knullrow, run_end, f)
             fempty = flo > fhi
         else:
@@ -1032,6 +1049,37 @@ def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:
             safe = jnp.clip(arr, 0, rank.shape[0] - 1)
             return jnp.where(arr >= 0, jnp.take(rank, safe), -1)
     return arr
+
+
+def _days_from_civil(y, m, d):
+    """(year, month, day) → days since 1970-01-01; Howard Hinnant's
+    branchless days-from-civil (the inverse of
+    expr_compile._civil_from_days)."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _shift_months_days(days, n_months: int):
+    """Shift day-numbers by n calendar months, clamping the day of month
+    (Mar 31 - 1 month = Feb 28) — PG's date + interval 'n months'
+    semantics (src/backend/utils/adt/timestamp.c interval_pl role),
+    vectorized for the RANGE frame search."""
+    from cloudberry_tpu.exec.expr_compile import _civil_from_days
+
+    y, m, d = _civil_from_days(days)
+    mm = m.astype(jnp.int64) - 1 + n_months
+    y2 = y.astype(jnp.int64) + jnp.floor_divide(mm, 12)
+    m2 = jnp.mod(mm, 12) + 1
+    leap = ((y2 % 4 == 0) & ((y2 % 100 != 0) | (y2 % 400 == 0)))
+    dim = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                      dtype=jnp.int64)[m2 - 1]
+    dim = jnp.where((m2 == 2) & leap, 29, dim)
+    d2 = jnp.minimum(d.astype(jnp.int64), dim)
+    return _days_from_civil(y2, m2, d2)
 
 
 def _pallas_pad(a, tile):

@@ -1334,12 +1334,19 @@ class Binder:
             mask_by_valid: dict[str, str] = {}
             # a ROWS/RANGE-offset frame that can exclude the current row
             # can be EMPTY: aggregates over it are NULL, so their
-            # outputs need masks even over non-null arguments
+            # outputs need masks even over non-null arguments. A
+            # ("months", n) calendar offset unwraps to its signed month
+            # count for this test (shifting by +n months excludes the
+            # current row exactly when n > 0).
+            def _off_sign(o):
+                return o[1] if isinstance(o, tuple) else o
+
             frame_may_empty = (frame is not None
                                and frame[0] in ("rows", "rangeoff")
-                               and ((frame[1] is not None and frame[1] > 0)
+                               and ((frame[1] is not None
+                                     and _off_sign(frame[1]) > 0)
                                     or (frame[2] is not None
-                                        and frame[2] < 0)))
+                                        and _off_sign(frame[2]) < 0)))
             for name, func, arg_asts in calls:
                 params = None
                 if func == "ntile":
@@ -3203,7 +3210,11 @@ def _normalize_frame(frame):
                     "peer" if hi[0] == "current" else "end")
         lo_off = None if lo[0] == "unbounded" else lo[1]
         hi_off = None if hi[0] == "unbounded" else hi[1]
-        if lo_off is not None and hi_off is not None and lo_off > hi_off:
+        # calendar ("months", n) offsets skip the static ordering check
+        # (mixed-unit bounds have no static comparison; an inverted
+        # frame just produces empty frames at runtime, PG semantics)
+        if isinstance(lo_off, (int, float)) \
+                and isinstance(hi_off, (int, float)) and lo_off > hi_off:
             raise BindError("frame start is after frame end")
         return ("rangeoff", lo_off, hi_off)
     for b in (lo, hi):
@@ -3239,6 +3250,12 @@ def _check_rangeoff(frame, order_asts, okeys):
     def scale(o):
         if o is None:
             return None
+        if isinstance(o, tuple):  # ("months", n): calendar distance
+            if kb.dtype.base != DType.DATE:
+                raise BindError(
+                    "INTERVAL MONTH/YEAR frame offsets need a date "
+                    "ORDER BY key")
+            return o
         raw = o
         if kb.dtype.base == DType.DECIMAL:
             # exact fixed-point scaling: 0.07 on a scale-2 key must

@@ -289,8 +289,8 @@ def maintain_on_append(session, table_name: str, n_new: int) -> None:
 
 
 def maintain_full(session, table_name: str) -> None:
-    """UPDATE/DELETE hook: re-materialize INCREMENTAL views (correct for
-    any DML), mark plain views stale."""
+    """UPDATE/DELETE hook without captured deltas: re-materialize
+    INCREMENTAL views (correct for any DML), mark plain views stale."""
     for d in list(session.catalog.matviews.values()):
         if d.base_table != table_name.lower():
             continue
@@ -298,6 +298,126 @@ def maintain_full(session, table_name: str) -> None:
             refresh_matview(session, d.name)
         else:
             d.fresh_token = None
+
+
+def delta_columns(session, table_name: str):
+    """Union of key/argument columns the INCREMENTAL views on this base
+    need for a DML delta, or None when none watch it (the DML paths then
+    skip the capture entirely)."""
+    need: set = set()
+    found = False
+    for d in session.catalog.matviews.values():
+        if d.base_table == table_name.lower() and d.incremental:
+            found = True
+            need.update(c for _, c in d.keys)
+            need.update(c for _, _, c in d.aggs if c is not None)
+    return sorted(need) if found else None
+
+
+def maintain_on_dml(session, table_name: str, sub, add) -> None:
+    """UPDATE/DELETE hook WITH captured delta frames — the IMMV delta
+    discipline (reference: src/backend/commands/matview.c:594-640,
+    IVM_immediate_maintenance's old/new transition tables): subtract the
+    old rows' contribution, add the new rows'. A view falls back to a
+    full re-materialization when its aggregates are not invertible
+    under deletion (min/max), when a sum runs on floats (subtraction
+    would break the bit-exact discipline int64/decimal deltas keep), or
+    when it carries no count (an emptied group would be undetectable) —
+    correctness always wins over incrementality."""
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    fault_point("matview_maintain")
+    changed = False
+    for d in list(session.catalog.matviews.values()):
+        if d.base_table != table_name.lower():
+            continue
+        if not d.incremental:
+            d.fresh_token = None
+            continue
+        if _delta_invertible(session, d) \
+                and _merge_dml_delta(session, d, sub, add):
+            d.fresh_token = _base_token(session, d)
+            if session.store is not None:
+                d.base_store_version = session.store.current_version(
+                    d.base_table)
+                changed = True
+        else:
+            refresh_matview(session, d.name)
+    if changed:
+        _persist_defs(session)
+
+
+def _delta_invertible(session, d: MatViewDef) -> bool:
+    from cloudberry_tpu.types import DType
+
+    if any(f in ("min", "max") for _, f, _ in d.aggs):
+        return False  # deletion cannot un-take an extreme
+    if not any(f == "count" for _, f, _ in d.aggs):
+        return False  # emptied groups would be undetectable
+    base = session.catalog.table(d.base_table)
+    for _, f, c in d.aggs:
+        if f == "sum" and c is not None:
+            fld = next(x for x in base.schema.fields if x.name == c)
+            if fld.dtype == DType.FLOAT64:
+                return False  # float subtraction is not bit-exact
+    return True
+
+
+def _merge_dml_delta(session, d: MatViewDef, sub, add) -> bool:
+    """Signed delta merge: every affected row contributes ±1 to counts
+    and ±value to sums, grouped by the view keys; groups whose count
+    reaches zero leave the view. False = the delta cannot express the
+    result (a keyless view emptied out: its sums become SQL NULL, which
+    only a re-materialization produces) — the caller refreshes."""
+    import pandas as pd
+
+    from cloudberry_tpu.columnar.batch import encode_column
+    from cloudberry_tpu.types import DType
+
+    key_aliases = [a for a, _ in d.keys]
+    key_cols = [c for _, c in d.keys]
+    parts = []
+    for df, sign in ((sub, -1), (add, 1)):
+        if df is None or not len(df):
+            continue
+        p = pd.DataFrame({a: df[c].to_numpy()
+                          for a, c in zip(key_aliases, key_cols)})
+        for alias, func, col in d.aggs:
+            p[alias] = sign if func == "count" \
+                else sign * df[col].to_numpy()
+        parts.append(p)
+    mv = session.catalog.table(d.name)
+    mv.ensure_loaded()
+    if not parts:
+        return True  # zero affected rows: the view already matches
+    delta = pd.concat(parts, ignore_index=True)
+    agg_aliases = [a for a, _, _ in d.aggs]
+    if key_aliases:
+        dagg = delta.groupby(key_aliases, sort=False)[agg_aliases] \
+            .sum().reset_index()
+    else:
+        dagg = delta[agg_aliases].sum().to_frame().T
+
+    mv_df = _frame(mv, [f.name for f in mv.schema.fields], 0, mv.num_rows)
+    merged = pd.concat([mv_df, dagg], ignore_index=True)
+    if key_aliases:
+        merged = merged.groupby(key_aliases, sort=False)[agg_aliases] \
+            .sum().reset_index()
+    else:
+        merged = merged[agg_aliases].sum().to_frame().T
+    count_alias = next(a for a, f, _ in d.aggs if f == "count")
+    if key_aliases:
+        merged = merged[merged[count_alias] > 0]
+    elif int(merged[count_alias].iloc[0]) == 0:
+        return False  # emptied keyless view: sums must become NULL
+
+    data = {}
+    for f in mv.schema.fields:
+        arr = merged[f.name].to_numpy()
+        data[f.name] = encode_column(arr, f, mv.dicts) \
+            if f.dtype == DType.STRING else arr.astype(f.type.np_dtype)
+    mv.set_data(data, mv.dicts)
+    return True
 
 
 def invalidate_all(session) -> None:

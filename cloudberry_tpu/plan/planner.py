@@ -347,14 +347,14 @@ def plan_statement(stmt: ast.Node, session, params: dict,
 
     if isinstance(stmt, ast.Delete):
         _reject_matview_dml(catalog, stmt.table)
-        res = _delete(session, stmt)
-        _maintain(session, stmt.table, appended=None)
+        res, delta = _delete(session, stmt)
+        _maintain(session, stmt.table, appended=None, delta=delta)
         return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.Update):
         _reject_matview_dml(catalog, stmt.table)
-        res = _update(session, stmt)
-        _maintain(session, stmt.table, appended=None)
+        res, delta = _update(session, stmt)
+        _maintain(session, stmt.table, appended=None, delta=delta)
         return PlanResult(is_ddl=True, ddl_result=res)
 
     if isinstance(stmt, ast.InsertSelect):
@@ -467,11 +467,13 @@ def _cluster(session, stmt: ast.Cluster) -> str:
     return f"CLUSTER {stmt.table} ({t.num_rows} rows)"
 
 
-def _maintain(session, table_name: str, appended) -> None:
+def _maintain(session, table_name: str, appended, delta=None) -> None:
     """Post-DML materialized-view maintenance (the IMMV trigger analog):
-    appends merge incrementally; other DML forces refresh/staleness.
-    Also the autostats trigger point (autostats.c:283 — the reference
-    likewise hooks ANALYZE off DML completion)."""
+    appends merge incrementally; UPDATE/DELETE merge their captured
+    (subtract, add) delta frames when the DML path could capture them,
+    else force refresh/staleness. Also the autostats trigger point
+    (autostats.c:283 — the reference likewise hooks ANALYZE off DML
+    completion)."""
     _maybe_autostats(session, table_name)
     if not session.catalog.matviews:
         return
@@ -479,8 +481,39 @@ def _maintain(session, table_name: str, appended) -> None:
 
     if appended is not None:
         MV.maintain_on_append(session, table_name, appended)
+    elif delta is not None:
+        MV.maintain_on_dml(session, table_name, delta[0], delta[1])
     else:
         MV.maintain_full(session, table_name)
+
+
+def _ivm_frames(session, table_name: str, table, mask,
+                new_data=None, new_dicts=None):
+    """Decoded delta frames of the DML-affected rows for incremental
+    views: (sub, add), or None when no incremental view watches the
+    table (the frames then never materialize). ``mask`` selects the
+    affected rows in the PRE-DML arrays; ``new_data`` (UPDATE) holds
+    the post-DML arrays the add-side reads."""
+    from cloudberry_tpu.plan import matview as MV
+
+    need = MV.delta_columns(session, table_name)
+    if need is None:
+        return None
+    import pandas as pd
+
+    def frame(data, dicts):
+        out = {}
+        for c in need:
+            arr = np.asarray(data[c])[mask]
+            d = dicts.get(c)
+            if d is not None:
+                arr = np.asarray(d.values, dtype=object)[arr]
+            out[c] = arr
+        return pd.DataFrame(out)
+
+    sub = frame(table.data, table.dicts)
+    add = None if new_data is None else frame(new_data, new_dicts)
+    return (sub, add)
 
 
 def _maybe_autostats(session, table_name: str) -> None:
@@ -927,7 +960,7 @@ def _unpermute(arr: np.ndarray, order: np.ndarray) -> np.ndarray:
     return out
 
 
-def _delete(session, stmt: ast.Delete) -> str:
+def _delete(session, stmt: ast.Delete) -> tuple:
     """DELETE = keep the complement (delete-and-rewrite over immutable
     columns — the visimap-style store path lives in storage/table_store).
     Only the PREDICATE flows through the executor (nodeSplitUpdate.c's
@@ -941,9 +974,11 @@ def _delete(session, stmt: ast.Delete) -> str:
     table.ensure_loaded()
     before = table.num_rows
     if stmt.where is None:
+        delta = _ivm_frames(session, stmt.table, table,
+                            np.ones(before, dtype=bool))
         table.set_data({f.name: np.zeros(0, dtype=f.type.np_dtype)
                         for f in table.schema.fields}, table.dicts)
-        return f"DELETE {before}"
+        return f"DELETE {before}", delta
     # DELETE removes rows where the predicate is TRUE; a NULL predicate
     # KEEPS the row (3VL) — so keep NOT pred OR pred IS NULL
     keep_expr = ast.BinOp("or", ast.UnaryOp("not", stmt.where),
@@ -951,12 +986,15 @@ def _delete(session, stmt: ast.Delete) -> str:
     cols, _, _ = _eval_aligned(session, stmt.table,
                                [ast.SelectItem(keep_expr, "keep")])
     keep = cols["keep"].astype(np.bool_)
+    # capture the deleted rows' key/arg columns BEFORE the rewrite:
+    # incremental views subtract exactly this contribution
+    delta = _ivm_frames(session, stmt.table, table, ~keep)
     new_data = {f.name: table.data[f.name][keep]
                 for f in table.schema.fields}
     new_valid = {c: np.asarray(v)[keep]
                  for c, v in table.validity.items()}
     table.set_data(new_data, table.dicts, validity=new_valid)
-    return f"DELETE {before - int(keep.sum())}"
+    return f"DELETE {before - int(keep.sum())}", delta
 
 
 _TYPE_NAME = {T.DType.BOOL: ("boolean", None), T.DType.INT32: ("integer", None),
@@ -965,7 +1003,7 @@ _TYPE_NAME = {T.DType.BOOL: ("boolean", None), T.DType.INT32: ("integer", None),
               T.DType.DATE: ("date", None), T.DType.STRING: ("text", None)}
 
 
-def _update(session, stmt: ast.Update) -> str:
+def _update(session, stmt: ast.Update) -> tuple:
     """UPDATE col = CASE WHEN pred THEN expr ELSE col END — but ONLY the
     SET columns (plus the predicate) flow through the executor; untouched
     columns pass to set_data as the SAME host arrays, copy-free (the
@@ -1027,8 +1065,13 @@ def _update(session, stmt: ast.Update) -> str:
             new_valid[f.name] = vm
         else:
             new_valid.pop(f.name, None)  # column is now fully valid
+    # incremental views: subtract the affected rows' OLD contribution,
+    # add their NEW one — captured before set_data swaps the arrays
+    mask = upd if stmt.where is not None else np.ones(n, dtype=bool)
+    delta = _ivm_frames(session, stmt.table, table, mask,
+                        new_data=new_data, new_dicts=dicts)
     table.set_data(new_data, dicts, validity=new_valid)
-    return f"UPDATE {n_upd}"
+    return f"UPDATE {n_upd}", delta
 
 
 def _ctas(session, stmt: ast.CreateTableAs) -> str:

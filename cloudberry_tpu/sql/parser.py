@@ -718,18 +718,28 @@ class Parser:
             # a derived table holds a full QUERY expression: plain
             # SELECT, WITH, or a set-op chain whose operands may
             # themselves be parenthesized ("(sel) intersect (sel)" —
-            # the q38-class shape). "(" followed by SELECT/WITH/"("
-            # distinguishes it from a parenthesized join ref.
+            # the q38-class shape). The lookahead alone cannot separate
+            # that from a parenthesized JOIN whose first element is a
+            # derived table ("((select ...) a join b on ...)"), so try
+            # the query parse and BACKTRACK to the join-ref grammar
+            # unless it consumed exactly up to the closing paren.
             if self.at_kw("select", "with") \
                     or (self.at_op("(")
                         and self.toks[self.i + 1].kind == "ident"
                         and self.toks[self.i + 1].text
                         in ("select", "with")):
-                sub = self.parse_query()
-                self.expect_op(")")
-                self.accept_kw("as")
-                alias = self.expect_ident()
-                return ast.DerivedTable(sub, alias)
+                save = self.i
+                try:
+                    sub = self.parse_query()
+                    done = self.at_op(")")
+                except ParseError:
+                    done = False
+                if done:
+                    self.advance()
+                    self.accept_kw("as")
+                    alias = self.expect_ident()
+                    return ast.DerivedTable(sub, alias)
+                self.i = save
             ref = self.parse_table_ref()
             self.expect_op(")")
             return ref
@@ -989,23 +999,30 @@ class Parser:
                 # one as a row count would answer a different question
                 raise ParseError("interval frame offsets need RANGE mode")
             # INTERVAL 'n' DAY on a date ORDER BY key: days are the
-            # key's integer domain, so the offset is just n
+            # key's integer domain, so the offset is just n.
+            # MONTH/YEAR are calendar distances — they ride as a
+            # ("months", n) marker and the executor shifts each row's
+            # civil date in-program (timestamp.c interval_pl semantics:
+            # month shift, day-of-month clamped).
             n, unit = self._parse_interval_literal()
-            if unit != "day":
+            if unit in ("month", "year"):
+                n = ("months", n * (12 if unit == "year" else 1))
+            elif unit != "day":
                 raise ParseError(
-                    "RANGE frame intervals support DAY only (date keys "
-                    "are day numbers; months/years are not fixed "
-                    "distances)")
+                    "RANGE frame intervals support DAY, MONTH and YEAR")
         else:
             n = self._signed_number()
-        if n < 0:
+        months = isinstance(n, tuple)
+        nv = n[1] if months else n
+        if nv < 0:
             # PG: "frame starting offset must not be negative" — a
             # negative n would silently flip PRECEDING into FOLLOWING
             raise ParseError("frame offset must not be negative")
         d = self.accept_kw("preceding", "following")
         if not d:
             raise ParseError("frame offset needs PRECEDING or FOLLOWING")
-        return ("offset", -n if d == "preceding" else n)
+        signed = -nv if d == "preceding" else nv
+        return ("offset", ("months", signed) if months else signed)
 
     def parse_case(self) -> ast.CaseExpr:
         self.expect_kw("case")

@@ -136,14 +136,6 @@ def oracle_q43(t):
         .head(100).reset_index(drop=True)
 
 
-def _chan_total(t, sales, datecol, itemcol, price, item_mask):
-    it = t["item"]
-    keep = it[item_mask(it)]
-    j = t[sales].merge(t["date_dim"], left_on=datecol,
-                       right_on="d_date_sk")
-    return j, keep
-
-
 def _union_family(t, key, item_mask, year, moy):
     frames = []
     for sales, datecol, itemcol, price in (

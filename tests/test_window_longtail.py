@@ -456,11 +456,68 @@ def test_range_offset_date_interval(rs):
                   "interval '2' day preceding and current row) as x "
                   "from rdt order by dt", "x")
     assert out == [1, 3, 5, 4]
-    from cloudberry_tpu.sql.parser import ParseError
 
-    with pytest.raises(ParseError, match="DAY only"):
-        rs.sql("select sum(v) over (order by dt range between "
-               "interval '1' month preceding and current row) from rdt")
+
+def test_range_offset_month_year_interval(rs):
+    """Calendar RANGE offsets (timestamp.c interval_pl semantics): the
+    executor shifts each row's civil date in-program with day-of-month
+    clamping — Mar 31 - 1 month = Feb 28/29."""
+    rs.sql("create table rmy (dt date, v int) distributed by (v)")
+    rs.sql("insert into rmy values (date '2000-02-29', 1), "
+           "(date '2000-03-31', 2), (date '2001-02-28', 4), "
+           "(date '2001-03-01', 8), (date '2002-02-28', 16)")
+    out = col(rs, "select sum(v) over (order by dt range between "
+                  "interval '1' month preceding and current row) as x "
+                  "from rmy order by dt", "x")
+    # 2000-03-31: lo = 2000-02-29 (clamped) -> includes the leap day
+    assert out == [1, 3, 4, 12, 16]
+    out = col(rs, "select sum(v) over (order by dt range between "
+                  "interval '1' year preceding and current row) as x "
+                  "from rmy order by dt", "x")
+    # 2001-02-28: lo = 2000-02-28 -> covers both 2000 rows
+    assert out == [1, 3, 7, 14, 28]
+    from cloudberry_tpu.plan.binder import BindError
+
+    with pytest.raises(BindError, match="date ORDER BY"):
+        rs.sql("select sum(v) over (order by v range between "
+               "interval '1' month preceding and current row) from rmy")
+
+
+def test_range_month_offset_oracle_random(rs):
+    import calendar
+    import datetime
+
+    import pandas as pd
+
+    rng = np.random.default_rng(31)
+    base = datetime.date(1999, 6, 15)
+    data = [(int(rng.integers(0, 3)),
+             base + datetime.timedelta(days=int(rng.integers(0, 900))),
+             int(rng.integers(1, 40))) for _ in range(300)]
+    rs.sql("create table rmo (g bigint, dt date, v int) "
+           "distributed by (g)")
+    rs.sql("insert into rmo values " + ", ".join(
+        f"({g}, date '{d}', {v})" for g, d, v in data))
+    df = rs.sql("select g, dt, sum(v) over (partition by g order by dt "
+                "range between interval '2' month preceding and "
+                "current row) as s from rmo").to_pandas()
+
+    def mshift(d, n):
+        m = d.month - 1 + n
+        y = d.year + m // 12
+        m = m % 12 + 1
+        return datetime.date(y, m, min(d.day,
+                                       calendar.monthrange(y, m)[1]))
+
+    exp = [(g, d, sum(vv for gg, dd, vv in data
+                      if gg == g and mshift(d, -2) <= dd <= d))
+           for g, d, v in data]
+    edf = pd.DataFrame(exp, columns=["g", "dt", "s"]).sort_values(
+        ["g", "dt", "s"]).reset_index(drop=True)
+    gdf = df.copy()
+    gdf["dt"] = pd.to_datetime(gdf["dt"]).dt.date
+    gdf = gdf.sort_values(["g", "dt", "s"]).reset_index(drop=True)
+    assert (gdf["s"].to_numpy() == edf["s"].to_numpy()).all()
 
 
 def test_range_frame_oracle_random():
