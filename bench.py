@@ -181,10 +181,17 @@ def measure() -> None:
         sys.exit(NO_TPU_RC)
     cpu = jax.devices("cpu")[0]
 
-    def bench_on(plan, device) -> float:
+    def bench_on(plan, device, use_pallas: bool = False) -> float:
         # compile per executing platform so each backend gets its best
         # kernel formulation (honest baseline: best-CPU vs best-TPU)
-        exe = compile_plan(plan, session, platform=device.platform)
+        sess = session
+        if use_pallas:
+            import copy
+
+            sess = copy.copy(session)
+            sess.config = session.config.with_overrides(
+                **{"exec.use_pallas": True})
+        exe = compile_plan(plan, sess, platform=device.platform)
         with jax.default_device(device):
             tables = {
                 name: {c: jax.device_put(v, device)
@@ -199,17 +206,60 @@ def measure() -> None:
                 out = exe.fn(tables)
                 jax.block_until_ready(out)
                 best = min(best, time.time() - t)
-        return best
+        return best, out
 
+    def outputs_match(a, b) -> bool:
+        # selected lanes only: unselected lanes legitimately hold
+        # path-dependent garbage
+        import numpy as np
+
+        acols, asel, _ = a
+        bcols, bsel, _ = b
+        m = np.asarray(asel)
+        if set(acols) != set(bcols)                 or not np.array_equal(m, np.asarray(bsel)):
+            return False
+        for k in acols:
+            x, y = np.asarray(acols[k])[m], np.asarray(bcols[k])[m]
+            if x.dtype.kind == "f" or y.dtype.kind == "f":
+                if not np.allclose(x.astype(np.float64),
+                                   y.astype(np.float64),
+                                   rtol=1e-5, atol=1e-6, equal_nan=True):
+                    return False
+            elif not np.array_equal(x, y):
+                return False
+        return True
+
+    # data-driven Pallas choice: A/B each query's TPU run with the fused
+    # kernels (dense agg + probe join) and keep whichever is faster —
+    # BENCH_PALLAS=off skips the B side, =on forces it
+    pallas_mode = os.environ.get("BENCH_PALLAS", "ab")
+    pallas_won = []
     speedups = {}
     for qn in qnames:
         # the full optimizer path (pruning, pack-bits proof) — the same
         # plan a session would execute, minus admission/dispatch
         plan = plan_statement(parse_sql(QUERIES[qn]), session, {}).plan
-        cpu_t = bench_on(plan, cpu)
+        cpu_t, _ = bench_on(plan, cpu)
         log(f"{qn} cpu executor: {cpu_t*1000:.1f} ms")
-        tpu_t = bench_on(plan, tpu_devices[0])
+        tpu_t, tpu_out = bench_on(plan, tpu_devices[0],
+                                  use_pallas=(pallas_mode == "on"))
         log(f"{qn} tpu executor: {tpu_t*1000:.1f} ms")
+        if pallas_mode == "ab":
+            try:
+                tp, p_out = bench_on(plan, tpu_devices[0],
+                                     use_pallas=True)
+                log(f"{qn} tpu executor (pallas): {tp*1000:.1f} ms")
+                # a fast-but-wrong kernel must never win: only a
+                # result-identical Pallas run can replace the XLA time
+                if not outputs_match(tpu_out, p_out):
+                    log(f"{qn} PALLAS PARITY FAILURE — results differ "
+                        "from the XLA path; pallas time discarded")
+                elif tp < tpu_t:
+                    tpu_t = tp
+                    pallas_won.append(qn)
+            except Exception as e:  # never fail the bench on the B side
+                log(f"{qn} pallas path failed on hardware "
+                    f"({type(e).__name__}: {e}); XLA path kept")
         speedups[qn] = cpu_t / tpu_t
 
     geo = 1.0
@@ -217,6 +267,8 @@ def measure() -> None:
         geo *= s
     geo = geo ** (1.0 / len(speedups))
     per_q = ", ".join(f"{q}={s:.2f}x" for q, s in speedups.items())
+    if pallas_won:
+        per_q += f"; pallas won: {','.join(pallas_won)}"
     emit({
         "metric": metric_name(),
         "value": round(geo, 3),

@@ -499,17 +499,23 @@ class Lowerer:
             return self._join_expand(node, bcols, bsel, bselm, bkeys,
                                      pcols, psel, pselm, pkeys)
 
-        idx, matched, has_dup = K.join_lookup(bkeys, bselm, pkeys, pselm,
-                                              bits=node.pack_bits)
+        fused = self._probe_join_pallas(node, bcols, bselm, bkeys,
+                                        pselm, pkeys)
+        if fused is not None:
+            matched, payload, has_dup = fused
+        else:
+            idx, matched, has_dup = K.join_lookup(
+                bkeys, bselm, pkeys, pselm, bits=node.pack_bits)
+            payload = K.gather_payload(
+                {n: bcols[n] for n in node.build_payload}, idx, matched)
         if node.kind in ("inner", "left"):
             # semi/anti only test membership; inner/left rely on the
             # planner's uniqueness proof — verify it at runtime (free:
-            # adjacent-equal test on the join's own sorted build keys)
+            # adjacent-equal test on the join's own sorted build keys —
+            # or a >1 one-hot column sum on the fused path)
             self.checks[
                 f"join build side has duplicate keys (node {id(node)}) but "
                 "the planner assumed a unique (PK) build side"] = has_dup
-        payload = K.gather_payload({n: bcols[n] for n in node.build_payload},
-                                   idx, matched)
         cols = {**pcols, **payload}
         if node.match_name:
             cols[node.match_name] = matched
@@ -973,6 +979,52 @@ class Lowerer:
             if s.func == "count":
                 out[s.out_name] = counts.astype(jnp.int64)
         return out, counts > 0
+
+    _PALLAS_PROBE_MAX_BUILD = 2048
+
+    def _probe_join_pallas(self, node: N.PJoin, bcols, bselm, bkeys,
+                           pselm, pkeys):
+        """Fused probe join (config.exec.use_pallas): for a SMALL unique
+        build whose keys pack to 32 bits, stream probe tiles once —
+        compare-all match on the VPU, payload gather as ONE one-hot
+        matmul on the MXU, integer payloads transported exactly through
+        21/21/22-bit f32 limbs (pallas_kernels.probe_join_pallas).
+        Returns (matched, payload cols, has_dup) or None → XLA path."""
+        if not self.use_pallas or node.pack_bits != 32:
+            return None
+        b = int(bselm.shape[0])
+        if b > self._PALLAS_PROBE_MAX_BUILD:
+            return None
+        for nm in node.build_payload:
+            if not (jnp.issubdtype(bcols[nm].dtype, jnp.integer)
+                    or bcols[nm].dtype == jnp.bool_):
+                return None  # float payload: exactness needs the XLA path
+        from cloudberry_tpu.exec import pallas_kernels as PK
+
+        ranges = K.key_ranges(bkeys, bselm)
+        bp = K.downcast32(K.pack_with_ranges(bkeys, ranges))
+        pp = K.downcast32(K.pack_with_ranges(pkeys, ranges))
+        rows = []
+        for nm in node.build_payload:
+            rows.extend(PK.int64_to_limbs(bcols[nm]))
+        if not rows:  # membership-only joins still fuse the match
+            rows = [jnp.zeros((b,), jnp.float32)]
+        tile = 1024
+        n = int(pselm.shape[0])
+        match_f, gathered = PK.probe_join_pallas(
+            _pallas_pad(bp, 256), _pallas_pad(bselm, 256),
+            _pallas_pad(pp, tile), _pallas_pad(pselm, tile),
+            _pallas_pad(jnp.stack(rows), 256), tile=tile,
+            interpret=(self.platform == "cpu"))
+        matched = match_f[:n] > 0.5
+        has_dup = jnp.any(match_f > 1.5)
+        payload = {}
+        for i, nm in enumerate(node.build_payload):
+            v = PK.limbs_to_int64(gathered[3 * i, :n],
+                                  gathered[3 * i + 1, :n],
+                                  gathered[3 * i + 2, :n])
+            payload[nm] = v.astype(bcols[nm].dtype)
+        return matched, payload, has_dup
 
     def _dense_agg(self, node: N.PAgg, cols, sel, agg_specs, agg_values,
                    post_scale):

@@ -1,9 +1,9 @@
-"""Pallas TPU kernels for the hottest executor op.
+"""Pallas TPU kernels for the two hottest executor ops.
 
-The single hottest loop in the engine is grouped aggregation over a scan
-(Q1's shape: 6M rows → 6 cells × ~8 aggregates). The XLA formulation
-(exec/kernels.group_aggregate_dense) is a chain of masked reductions; this
-Pallas kernel fuses the whole thing into ONE pass over HBM:
+1. Grouped aggregation over a scan (Q1's shape: 6M rows → 6 cells ×
+~8 aggregates). The XLA formulation (exec/kernels.group_aggregate_dense)
+is a chain of masked reductions; ``dense_agg_pallas`` fuses the whole
+thing into ONE pass over HBM:
 
   per row-tile (grid is sequential on TPU, so accumulating into the output
   block is safe):
@@ -12,10 +12,30 @@ Pallas kernel fuses the whole thing into ONE pass over HBM:
       sums   += values @ onehot.T               # (K, cells) on the MXU
 
 The matmul accumulates in float32 on the MXU; exact int64-cent money sums
-keep the XLA path. Gated by ``config.exec.use_pallas`` (wired through
-Lowerer._dense_agg_pallas), default off until re-measured on hardware — the
-dev TPU tunnel died mid-session. Decimal sums through this path round to
-float32: acceptable for approximate analytics, not for money reconciliation.
+keep the XLA path for the AGG (decimal sums through this kernel round to
+float32 — approximate analytics, not money reconciliation).
+
+2. Probe-side join against a SMALL unique build (the nodeHash.c probe
+loop's role; every dim join in TPC-H's star shapes). The XLA
+formulation sorts the build and binary-searches every probe key;
+``probe_join_pallas`` instead streams probe tiles once and, per tile,
+compare-alls the (whole, VMEM-resident) build keys on the VPU and
+gathers the payload with ONE one-hot matmul on the MXU:
+
+      eq = (bkeys[:, None] == pkeys[None, :]) & bsel & psel  # (B, TILE)
+      matched = sum(eq, axis=0)            # 0/1 (unique build); >1 = dup
+      gathered = payload @ eq              # (P, TILE) on the MXU
+
+Payload transport is EXACT for integers: the caller splits each int64
+column into three 21/21/22-bit limbs, each an integer ≤ 2^22 that f32
+represents exactly; a matched row gathers exactly one source, so limb
+recombination reproduces the original bits (two's complement via the
+uint64 round trip). That is the TPU-native answer to "hash-join gather"
+— no scatter, no pointer chase, the MXU does the routing.
+
+Both kernels are gated by ``config.exec.use_pallas`` (wired through
+Lowerer), default off until re-measured on hardware (the dev TPU relay
+has been wedged; see bench.py's BENCH_PALLAS env knob for the A/B harness).
 """
 
 from __future__ import annotations
@@ -42,7 +62,8 @@ def _dense_agg_kernel(gid_ref, vals_ref, sel_ref, out_ref, *, n_cells: int):
     oh_f = onehot.astype(jnp.float32)
     counts = jnp.sum(oh_f, axis=1)                       # (cells,)
     sums = jnp.dot(v, oh_f.T,
-                   preferred_element_type=jnp.float32)   # (K, cells) on MXU
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)  # (K, cells) on MXU
     out_ref[0, :] += counts
     out_ref[1:, :] += sums
 
@@ -73,3 +94,83 @@ def dense_agg_pallas(gid: jnp.ndarray, vals: jnp.ndarray, sel: jnp.ndarray,
         interpret=interpret,
     )(gid, vals, sel)
     return out[0], out[1:]
+
+def _probe_join_kernel(bkeys_ref, bsel_ref, pkeys_ref, psel_ref, pay_ref,
+                       out_ref):
+    bk = bkeys_ref[:]                       # (B,)
+    bs = bsel_ref[:]                        # (B,)
+    pk = pkeys_ref[:]                       # (TILE,)
+    ps = psel_ref[:]                        # (TILE,)
+    pay = pay_ref[:]                        # (P, B)
+    eq = (bk[:, None] == pk[None, :]) & bs[:, None] & ps[None, :]
+    eqf = eq.astype(jnp.float32)            # (B, TILE)
+    matched = jnp.sum(eqf, axis=0)          # 0/1; >1 flags a dup build
+    # HIGHEST precision is REQUIRED for exactness: default MXU matmul
+    # decomposes f32 into bf16 passes, which would truncate 21/22-bit
+    # limbs before the gather
+    gathered = jnp.dot(pay, eqf,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)  # MXU
+    out_ref[0, :] = matched
+    out_ref[1:, :] = gathered
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile", "interpret"))
+def probe_join_pallas(bkeys: jnp.ndarray, bsel: jnp.ndarray,
+                      pkeys: jnp.ndarray, psel: jnp.ndarray,
+                      payload: jnp.ndarray, tile: int = 1024,
+                      interpret: bool = False):
+    """Fused probe join against a small unique build.
+
+    bkeys: u32[B] packed build keys (B caller-padded; bsel masks pads);
+    pkeys: u32[N] packed probe keys (N a multiple of ``tile``);
+    payload: f32[P, B] limb-encoded build payload.
+    Returns (match f32[N] — 0/1, >1 ⇒ duplicate build keys;
+    gathered f32[P, N])."""
+    p, b = payload.shape
+    n = pkeys.shape[0]
+    assert n % tile == 0, "pad probe rows to a tile multiple"
+    grid = (n // tile,)
+    out = pl.pallas_call(
+        _probe_join_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b,), lambda i: (0,)),
+            pl.BlockSpec((b,), lambda i: (0,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((p, b), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((p + 1, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((p + 1, n), jnp.float32),
+        interpret=interpret,
+    )(bkeys, bsel, pkeys, psel, payload)
+    return out[0], out[1:]
+
+
+# 21/21/22-bit limb split: every limb is an integer < 2^22, exactly
+# representable in float32 — the one-hot matmul then transports int64
+# payloads losslessly (exactly one source row per matched column).
+_LIMB_BITS = (21, 21, 22)
+_LIMB_SHIFTS = (0, 21, 42)
+
+
+def int64_to_limbs(col: jnp.ndarray) -> list:
+    """int64 → three f32 limb rows (two's complement via uint64)."""
+    u = col.astype(jnp.int64).view(jnp.uint64)
+    out = []
+    for bits, shift in zip(_LIMB_BITS, _LIMB_SHIFTS):
+        mask = jnp.uint64((1 << bits) - 1)
+        out.append(((u >> jnp.uint64(shift)) & mask).astype(jnp.float32))
+    return out
+
+
+def limbs_to_int64(l0: jnp.ndarray, l1: jnp.ndarray,
+                   l2: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of int64_to_limbs (rounding to nearest undoes the f32
+    transport exactly because every limb is an integer < 2^24)."""
+    u = (jnp.round(l2).astype(jnp.uint64) << jnp.uint64(42)) \
+        | (jnp.round(l1).astype(jnp.uint64) << jnp.uint64(21)) \
+        | jnp.round(l0).astype(jnp.uint64)
+    return u.view(jnp.int64)

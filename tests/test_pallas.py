@@ -52,3 +52,108 @@ def test_use_pallas_config_end_to_end():
     assert df["sv"].tolist() == [5.0, 10.5]
     assert df["n"].tolist() == [3, 2]
     np.testing.assert_allclose(df["a"].to_numpy(), [5.0 / 3, 5.25], rtol=1e-6)
+
+
+def test_limb_round_trip_exact():
+    from cloudberry_tpu.exec.pallas_kernels import (int64_to_limbs,
+                                                    limbs_to_int64)
+
+    rng = np.random.default_rng(1)
+    vals = np.concatenate([
+        rng.integers(-2**62, 2**62, 1000),
+        np.array([0, 1, -1, 2**62, -2**62, 2**21, 2**42, -2**42])])
+    l0, l1, l2 = int64_to_limbs(jnp.asarray(vals))
+    back = np.asarray(limbs_to_int64(l0, l1, l2))
+    assert (back == vals).all()
+
+
+def test_probe_join_pallas_matches_numpy():
+    from cloudberry_tpu.exec.pallas_kernels import (int64_to_limbs,
+                                                    limbs_to_int64,
+                                                    probe_join_pallas)
+
+    rng = np.random.default_rng(2)
+    b, n, tile = 256, 4096, 1024
+    bkeys = rng.permutation(10_000)[:b].astype(np.uint32)
+    bsel = rng.random(b) > 0.1
+    pkeys = rng.choice(bkeys, n).astype(np.uint32)
+    miss = rng.random(n) < 0.3
+    pkeys[miss] = (pkeys[miss] + 1_000_000).astype(np.uint32)
+    psel = rng.random(n) > 0.2
+    payload = rng.integers(-10**12, 10**12, b)
+
+    rows = int64_to_limbs(jnp.asarray(payload))
+    match_f, gathered = probe_join_pallas(
+        jnp.asarray(bkeys), jnp.asarray(bsel), jnp.asarray(pkeys),
+        jnp.asarray(psel), jnp.stack(rows), tile=tile, interpret=True)
+    got_match = np.asarray(match_f) > 0.5
+    got_pay = np.asarray(limbs_to_int64(gathered[0], gathered[1],
+                                        gathered[2]))
+
+    lookup = {k: v for k, v, s in zip(bkeys, payload, bsel) if s}
+    exp_match = np.array([s and (k in lookup)
+                          for k, s in zip(pkeys, psel)])
+    np.testing.assert_array_equal(got_match, exp_match)
+    for i in range(n):
+        if exp_match[i]:
+            assert got_pay[i] == lookup[pkeys[i]], i
+
+
+def test_probe_join_pallas_detects_duplicate_build():
+    from cloudberry_tpu.exec.pallas_kernels import probe_join_pallas
+
+    bkeys = jnp.asarray(np.array([5, 5, 7, 9], dtype=np.uint32))
+    bsel = jnp.ones(4, bool)
+    pkeys = jnp.asarray(np.full(1024, 5, dtype=np.uint32))
+    psel = jnp.ones(1024, bool)
+    pay = jnp.zeros((1, 4), jnp.float32)
+    match_f, _ = probe_join_pallas(bkeys, bsel, pkeys, psel, pay,
+                                   tile=1024, interpret=True)
+    assert float(np.asarray(match_f).max()) > 1.5
+
+
+def test_fused_probe_join_end_to_end_parity():
+    """The whole q3-class star join, use_pallas on vs off — identical
+    rows (integer payloads ride the limb transport exactly)."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+
+    from cloudberry_tpu.exec import pallas_kernels as PK
+
+    calls = []
+    orig = PK.probe_join_pallas
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    PK.probe_join_pallas = spy
+
+    def run(use_pallas):
+        s = cb.Session(get_config().with_overrides(
+            **{"exec.use_pallas": use_pallas}))
+        rng = np.random.default_rng(4)
+        s.sql("create table dim (k bigint, name text, grp bigint) "
+              "distributed by (k)")
+        s.sql("create table fact (k bigint, v bigint, amt decimal(12,2)) "
+              "distributed by (k)")
+        nd, nf = 500, 40_000
+        s.sql("insert into dim values " + ", ".join(
+            f"({i}, 'n{i % 37}', {int(rng.integers(0, 9))})"
+            for i in range(nd)))
+        s.catalog.table("fact").set_data({
+            "k": rng.integers(0, nd + 50, nf),  # some misses
+            "v": rng.integers(0, 1000, nf),
+            "amt": rng.integers(0, 10**6, nf)})
+        return s.sql(
+            "select grp, name, sum(v) as sv, sum(amt) as sa, count(*) "
+            "as n from fact join dim on fact.k = dim.k "
+            "group by grp, name order by grp, name").to_pandas()
+
+    try:
+        a = run(False)
+        b = run(True)
+    finally:
+        PK.probe_join_pallas = orig
+    assert calls, "the fused probe-join path never fired"
+    assert a.equals(b)
