@@ -181,7 +181,7 @@ def measure() -> None:
         sys.exit(NO_TPU_RC)
     cpu = jax.devices("cpu")[0]
 
-    def bench_on(plan, device, use_pallas: bool = False) -> float:
+    def bench_on(plan, device, use_pallas: bool = False) -> tuple:
         # compile per executing platform so each backend gets its best
         # kernel formulation (honest baseline: best-CPU vs best-TPU)
         sess = session
@@ -235,6 +235,7 @@ def measure() -> None:
     pallas_mode = os.environ.get("BENCH_PALLAS", "ab")
     pallas_won = []
     speedups = {}
+    rows_s = {}
     for qn in qnames:
         # the full optimizer path (pruning, pack-bits proof) — the same
         # plan a session would execute, minus admission/dispatch
@@ -261,12 +262,19 @@ def measure() -> None:
                 log(f"{qn} pallas path failed on hardware "
                     f"({type(e).__name__}: {e}); XLA path kept")
         speedups[qn] = cpu_t / tpu_t
+        # rows/sec/chip (BASELINE.md's second metric): the biggest
+        # scanned table's rows over the TPU executor time
+        big = max(QUERY_TABLES.get(qn, ["lineitem"]),
+                  key=lambda t: session.catalog.table(t).num_rows)
+        rows_s[qn] = session.catalog.table(big).num_rows / tpu_t
 
     geo = 1.0
     for s in speedups.values():
         geo *= s
     geo = geo ** (1.0 / len(speedups))
-    per_q = ", ".join(f"{q}={s:.2f}x" for q, s in speedups.items())
+    per_q = ", ".join(
+        f"{q}={s:.2f}x/{rows_s[q]/1e6:.0f}Mrows_s_chip"
+        for q, s in speedups.items())
     if pallas_won:
         per_q += f"; pallas won: {','.join(pallas_won)}"
     emit({

@@ -510,9 +510,11 @@ class Lowerer:
                 {n: bcols[n] for n in node.build_payload}, idx, matched)
         if node.kind in ("inner", "left"):
             # semi/anti only test membership; inner/left rely on the
-            # planner's uniqueness proof — verify it at runtime (free:
-            # adjacent-equal test on the join's own sorted build keys —
-            # or a >1 one-hot column sum on the fused path)
+            # planner's uniqueness proof — verify it at runtime. The XLA
+            # path checks the build side itself (adjacent-equal on its
+            # sorted keys); the fused path's >1 one-hot column sum is
+            # weaker — it fires only when a probe row actually HITS the
+            # duplicated key, i.e. exactly when results would be wrong
             self.checks[
                 f"join build side has duplicate keys (node {id(node)}) but "
                 "the planner assumed a unique (PK) build side"] = has_dup
