@@ -192,11 +192,13 @@ def measure() -> None:
             sess.config = session.config.with_overrides(
                 **{"exec.use_pallas": True})
         exe = compile_plan(plan, sess, platform=device.platform)
+        from cloudberry_tpu.exec.executor import prepare_inputs
+
         with jax.default_device(device):
             tables = {
-                name: {c: jax.device_put(v, device)
-                       for c, v in session.catalog.table(name).data.items()}
-                for name in exe.table_names
+                key: {c: jax.device_put(v, device)
+                      for c, v in cols.items()}
+                for key, cols in prepare_inputs(exe, sess).items()
             }
             out = exe.fn(tables)  # warmup/compile
             jax.block_until_ready(out)

@@ -59,6 +59,9 @@ class PlannerConfig:
     # GROUP BY's final redistribute — instead of deciding greedily per
     # join. Off falls back to the cdbpath.c-style rules alone.
     enable_memo: bool = True
+    # sorted-sidecar point lookups for WHERE col = const on big RAM
+    # tables (plan/pointlookup.py — the index/block-directory analog)
+    enable_point_lookup: bool = True
     # Prune dispatch to a single segment for point predicates on the
     # distribution key (reference: cdbtargeteddispatch.c).
     enable_direct_dispatch: bool = True

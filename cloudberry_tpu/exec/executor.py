@@ -51,12 +51,18 @@ def execute(plan: N.PlanNode, session) -> ColumnBatch:
     return run_executable(exe, prepare_inputs(exe, session, segment=seg))
 
 
+def keyed_scan(s: N.PScan) -> bool:
+    """Scans whose input rides under a per-scan key instead of the
+    table name: pruned store reads and point-lookup slices."""
+    return hasattr(s, "_store_parts") or hasattr(s, "_point_rows")
+
+
 def compile_plan(plan: N.PlanNode, session,
                  platform: str | None = None) -> Executable:
     scans = list(scans_of(plan))
-    store_scans = [s for s in scans if hasattr(s, "_store_parts")]
+    store_scans = [s for s in scans if keyed_scan(s)]
     table_names = sorted({s.table_name for s in scans
-                          if not hasattr(s, "_store_parts")})
+                          if not keyed_scan(s)})
     platform = platform or jax.default_backend()
     use_pallas = session.config.exec.use_pallas
 
@@ -102,17 +108,39 @@ def prepare_plan_inputs(plan: N.PlanNode, session,
     """Same input assembly from a bare plan (instrumented execution)."""
     scans = list(scans_of(plan))
     return _assemble_inputs(
-        sorted({s.table_name for s in scans
-                if not hasattr(s, "_store_parts")}),
-        [s for s in scans if hasattr(s, "_store_parts")],
+        sorted({s.table_name for s in scans if not keyed_scan(s)}),
+        [s for s in scans if keyed_scan(s)],
         session, segment)
 
 
 def _assemble_inputs(table_names, store_scans, session, segment) -> dict:
     tables = prepare_tables(table_names, session, segment=segment)
     for s in store_scans:
-        tables[s._input_key] = _load_store_scan(s, session)
+        if hasattr(s, "_point_rows"):
+            tables[s._input_key] = _load_point_scan(s, session, segment)
+        else:
+            tables[s._input_key] = _load_store_scan(s, session)
     return tables
+
+
+def _load_point_scan(scan: N.PScan, session, segment) -> dict:
+    """Slice exactly the sidecar-matched rows (plan/pointlookup.py) out
+    of the table — or its direct-dispatched shard — as the scan input."""
+    rows = scan._point_rows
+    t = session.catalog.table(scan.table_name)
+    t.ensure_loaded()
+    out = {}
+    if segment is None or t.policy.kind == "replicated":
+        for c, v in t.data.items():
+            out[c] = jnp.asarray(np.asarray(v)[rows])
+        for c, vm in t.validity.items():
+            out[f"$nn:{c}"] = jnp.asarray(
+                np.asarray(vm, dtype=np.bool_)[rows])
+    else:
+        st = session.sharded_table(scan.table_name)
+        for c, v in st.columns.items():
+            out[c] = jnp.asarray(np.asarray(v[segment])[rows])
+    return out
 
 
 _STORE_SCAN_CACHE_MAX = 16

@@ -94,6 +94,11 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
         return plan_tiled_dist(plan, session)
     if getattr(plan, "_direct_segment", None) is not None:
         return None
+    from cloudberry_tpu.plan.pointlookup import unbind_point_lookups
+
+    # the tile stream and resident loads key inputs by TABLE NAME: a
+    # point-sliced scan would miss its $pt input — restore full scans
+    unbind_point_lookups(plan)
     shape = _analyze(plan)
     if shape is None:
         return None

@@ -95,6 +95,11 @@ class PScan(PlanNode):
 
     def title(self):
         base = f"Scan {self.table_name} [{self.capacity}]"
+        pc = getattr(self, "_point_col", None)
+        if pc is not None:
+            # sorted-sidecar point lookup (plan/pointlookup.py): the
+            # scan reads only the matched rows
+            base += f" point-lookup({pc})"
         rep = getattr(self, "_prune_report", None)
         if rep is not None:
             kept = len(getattr(self, "_store_parts", ()))

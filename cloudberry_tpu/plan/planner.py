@@ -1219,6 +1219,8 @@ def _optimize(plan: N.PlanNode, session) -> N.PlanNode:
     from cloudberry_tpu.plan.cost import annotate_pack_bits
 
     annotate_pack_bits(plan, session.catalog)
+    from cloudberry_tpu.plan.pointlookup import optimize_point_lookups
+
     if session.config.n_segments > 1 \
             and session.config.planner.enable_direct_dispatch:
         from cloudberry_tpu.plan.distribute import (apply_direct_dispatch,
@@ -1226,8 +1228,15 @@ def _optimize(plan: N.PlanNode, session) -> N.PlanNode:
 
         seg = direct_dispatch_segment(plan, session)
         if seg is not None:
-            return apply_direct_dispatch(plan, session, seg)
-    return _distribute(plan, session)
+            plan = apply_direct_dispatch(plan, session, seg)
+            # routed to ONE shard: the sorted sidecar then narrows the
+            # scan to the matching rows (index/block-directory analog)
+            optimize_point_lookups(plan, session)
+            return plan
+    plan = _distribute(plan, session)
+    if session.config.n_segments <= 1:
+        optimize_point_lookups(plan, session)
+    return plan
 
 
 def _distribute(plan: N.PlanNode, session) -> N.PlanNode:
