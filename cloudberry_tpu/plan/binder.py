@@ -821,7 +821,8 @@ class Binder:
             cfg.planner.broadcast_threshold, self.catalog,
             frozenset(gb_names), self._make_join,
             is_unique=lambda i, keys: _build_is_unique(
-                atoms[i][0], keys, self.catalog))
+                atoms[i][0], keys, self.catalog),
+            gst=cfg.planner.gather_single_threshold)
         if final is None:
             return None
         for e in scope.entries:

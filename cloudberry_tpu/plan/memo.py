@@ -81,17 +81,21 @@ def _redist_sharding(keys) -> Sharding:
 
 
 def explore(node: N.PlanNode, catalog, nseg: int,
-            thr: int) -> Optional[dict]:
+            thr: int, gst: int = 0) -> Optional[dict]:
     """Alternative set {sharding-key: Alt} for a join-tree subtree; None
-    when the subtree leaves the grammar (aggs, set-ops, windows, shares,
-    subquery scalars in scope) — the greedy rules then stand alone."""
+    when the subtree leaves the grammar (set-ops, windows, shares,
+    subquery scalars in scope) — the greedy rules then stand alone.
+    Single-mode aggregations ARE in the grammar (aggregated derived
+    tables, the q65-class multi-block shape); ``gst`` is the
+    gather_single_threshold the distributor's two-stage arm applies, so
+    explored output shardings match what it actually produces."""
     if isinstance(node, N.PScan):
         return {str(sh): Alt(0.0, sh, ())
                 for sh in (_scan_sharding(node, catalog),)}
     if isinstance(node, N.PFilter):
-        return explore(node.child, catalog, nseg, thr)
+        return explore(node.child, catalog, nseg, thr, gst)
     if isinstance(node, N.PProject):
-        sub = explore(node.child, catalog, nseg, thr)
+        sub = explore(node.child, catalog, nseg, thr, gst)
         if sub is None:
             return None
         out: dict = {}
@@ -101,7 +105,38 @@ def explore(node: N.PlanNode, catalog, nseg: int,
                                 a.choices))
         return out
     if isinstance(node, N.PJoin):
-        return _explore_join(node, catalog, nseg, thr)
+        return _explore_join(node, catalog, nseg, thr, gst)
+    if isinstance(node, N.PAgg) and node.mode == "single":
+        # mirror Distributor._agg's arms — colocated grouping is free
+        # and keeps the (renamed) child sharding (_agg_extra prices the
+        # move, 0 when colocated); anything else pays the partial rows'
+        # move and lands where the distributor will actually put it:
+        # singleton under the GATHER_SINGLE threshold, hashed-on-keys
+        # above it
+        sub = explore(node.child, catalog, nseg, thr, gst)
+        if sub is None:
+            return None
+        from cloudberry_tpu.plan.distribute import _rename_sharding
+
+        out = {}
+        for a in sub.values():
+            sh = a.sharding
+            if not sh.is_partitioned:
+                _keep_best(out, Alt(a.cost, sh, a.choices))
+                continue
+            extra = _agg_extra(node, sh, catalog, nseg)
+            if node.group_keys and extra == 0.0:
+                _keep_best(out, Alt(
+                    a.cost, _rename_sharding(sh, node.group_keys),
+                    a.choices))
+                continue
+            if node.group_keys and not (0 < node.capacity <= gst):
+                out_sh = Sharding.hashed(
+                    *(n for n, _ in node.group_keys))
+            else:
+                out_sh = Sharding.singleton()
+            _keep_best(out, Alt(a.cost + extra, out_sh, a.choices))
+        return out
     return None
 
 
@@ -165,13 +200,13 @@ def _redist_cost(est: float, width: int, frac: float, nseg: int) -> float:
 
 
 def _explore_join(node: N.PJoin, catalog, nseg: int,
-                  thr: int) -> Optional[dict]:
+                  thr: int, gst: int = 0) -> Optional[dict]:
     from cloudberry_tpu.plan.cost import estimate_rows
 
     if node.kind == "full":
         return None  # forced shape (coloc or gather-both); greedy path
-    balts = explore(node.build, catalog, nseg, thr)
-    palts = explore(node.probe, catalog, nseg, thr)
+    balts = explore(node.build, catalog, nseg, thr, gst)
+    palts = explore(node.probe, catalog, nseg, thr, gst)
     if balts is None or palts is None:
         return None
     est_b = estimate_rows(node.build, catalog)
@@ -371,7 +406,8 @@ def _join_strategies(bsh: Sharding, psh: Sharding, bkeys, pkeys,
 
 
 def joint_search(atoms, edges, nseg: int, thr: int, catalog,
-                 groupby_names: frozenset, make_join, is_unique=None):
+                 groupby_names: frozenset, make_join, is_unique=None,
+                 gst: int = 0):
     """One DP over join order AND motion strategy.
 
     atoms: [(plan, width)] per base relation (any bound subtree);
@@ -448,7 +484,7 @@ def joint_search(atoms, edges, nseg: int, thr: int, catalog,
     best: list[Optional[dict]] = [None] * (1 << n)
     atom_alts: list[dict] = []
     for i, (p, _w) in enumerate(atoms):
-        alts = explore(p, catalog, nseg, thr)
+        alts = explore(p, catalog, nseg, thr, gst)
         if alts is None:
             alts = {"?": Alt(0.0, Sharding.strewn(), ())}
         atom_alts.append(alts)
@@ -615,8 +651,13 @@ def _agg_extra(agg: N.PAgg, sharding: Sharding, catalog,
 
 
 def _joins_of(node: N.PlanNode):
-    """Every join inside the join-tree grammar region rooted here."""
+    """Every join inside the join-tree grammar region rooted here —
+    through single-mode aggs, which the grammar now includes: an outer
+    region's stamps on sub-agg joins are final and must not be
+    re-explored by the visitor."""
     if isinstance(node, (N.PFilter, N.PProject)):
+        yield from _joins_of(node.child)
+    elif isinstance(node, N.PAgg) and node.mode == "single":
         yield from _joins_of(node.child)
     elif isinstance(node, N.PJoin):
         yield node
@@ -640,11 +681,12 @@ def annotate_distribution(plan: N.PlanNode, session) -> None:
         return
     catalog = session.catalog
     thr = session.config.planner.broadcast_threshold
+    gst = session.config.planner.gather_single_threshold
     annotated: set[int] = set()
     seen: set[int] = set()
 
     def region(root: N.PlanNode, agg: Optional[N.PAgg]) -> None:
-        alts = explore(root, catalog, nseg, thr)
+        alts = explore(root, catalog, nseg, thr, gst)
         if not alts:
             # abstained (out-of-grammar node somewhere inside): leave
             # every join unmarked — the visitor descends and in-grammar
