@@ -46,6 +46,67 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# Roofline context (VERDICT r5 item 8): every speedup ships with its
+# denominator — bytes the query scans ÷ best TPU wall time, as a fraction
+# of nominal HBM bandwidth — so "10.58×" is readable as near-roofline or
+# 10× off. Nominal bandwidth defaults to a TPU v4 chip (1228 GB/s);
+# override with BENCH_HBM_GBPS for other parts.
+HBM_GBPS_NOMINAL = float(os.environ.get("BENCH_HBM_GBPS", "1228"))
+
+# Static per-row scanned-byte widths (the columns the engine's projected
+# scans actually read; dtype widths from cloudberry_tpu.types: int64/
+# decimal 8B, date/string-code/int32 4B). Used when no live catalog is
+# available (REPLAY mode); live runs measure the real loaded arrays.
+_TPCH_SF1_ROWS = {
+    "lineitem": 6_001_215, "orders": 1_500_000, "customer": 150_000,
+    "part": 200_000, "partsupp": 800_000, "supplier": 10_000,
+    "nation": 25, "region": 5,
+}
+_FIXED_TABLES = {"nation", "region"}  # size does not scale with SF
+_QUERY_SCAN_WIDTHS = {
+    # q1: returnflag+linestatus (4+4) + 4 decimals (32) + shipdate (4)
+    "q1": {"lineitem": 44},
+    "q3": {"customer": 12, "orders": 24, "lineitem": 28},
+    "q6": {"lineitem": 28},
+    "q9": {"part": 12, "supplier": 16, "lineitem": 48, "partsupp": 24,
+           "orders": 12, "nation": 12},
+}
+
+
+def static_scan_bytes(qname: str, sf: float):
+    """Schema-derived bytes-scanned estimate for REPLAY mode (no data
+    generated, no device touched); None for queries without a width
+    table."""
+    widths = _QUERY_SCAN_WIDTHS.get(qname)
+    if not widths:
+        return None
+    return int(sum(
+        _TPCH_SF1_ROWS[t] * (1.0 if t in _FIXED_TABLES else sf) * w
+        for t, w in widths.items()))
+
+
+def roofline_context(qnames, sf: float, bytes_by_q: dict | None = None,
+                     wall_by_q: dict | None = None) -> dict:
+    """The roofline record: scanned bytes per query (measured when given,
+    else static estimate) + the nominal-bandwidth denominator; live runs
+    add achieved GB/s and the HBM fraction."""
+    out = {"hbm_gbps_nominal": HBM_GBPS_NOMINAL, "per_query": {}}
+    for qn in qnames:
+        b = (bytes_by_q or {}).get(qn)
+        if b is None:
+            b = static_scan_bytes(qn, sf)
+        if b is None:
+            continue
+        rec = {"bytes_scanned": int(b)}
+        w = (wall_by_q or {}).get(qn)
+        if w:
+            gbps = b / w / 1e9
+            rec["scan_gbps"] = round(gbps, 1)
+            rec["hbm_frac"] = round(gbps / HBM_GBPS_NOMINAL, 4)
+        out["per_query"][qn] = rec
+    return out
+
+
 # tables each bench query touches (generation cost scales with SF — load
 # only what the selected queries scan)
 QUERY_TABLES = {
@@ -120,16 +181,31 @@ def emit(record: dict) -> None:
 
 def replay_last_good(reason: str) -> None:
     """No live measurement possible — replay the last committed one with its
-    provenance in the unit string, or report an unambiguous zero."""
+    provenance in the unit string, or report an unambiguous zero. The
+    roofline denominator (bytes scanned, nominal HBM GB/s) is schema-
+    derived, so the replayed speedup still carries its MFU-style context."""
     try:
         with open(LAST_GOOD) as f:
             lg = json.load(f)
+        # the denominator must describe the REPLAYED measurement, not the
+        # current env: recover its SF and query set from the metric name
+        # (current BENCH_SF/BENCH_QUERIES may differ from the last-good's)
+        import re
+
+        m = re.match(r"tpch_sf([0-9.]+)_(.+)_geomean", lg["metric"])
+        lg_sf = float(m.group(1)) if m else 1.0
+        lg_queries = m.group(2).split("_") if m else bench_queries()
         emit({
             "metric": lg["metric"],
             "value": lg["value"],
             "unit": (f"x (REPLAY of {lg['provenance']}; "
-                     f"no live measurement: {reason})"),
+                     f"no live measurement: {reason}; roofline denominator "
+                     f"vs {HBM_GBPS_NOMINAL:g} GB/s HBM nominal)"),
             "vs_baseline": round(lg["value"] / 5.0, 3),
+            "roofline": roofline_context(
+                lg_queries, lg_sf,
+                bytes_by_q=lg.get("scan_bytes"),
+                wall_by_q=lg.get("tpu_wall_s")),
         })
     except Exception:
         emit({
@@ -137,6 +213,8 @@ def replay_last_good(reason: str) -> None:
             "value": 0.0,
             "unit": f"x (NO MEASUREMENT: {reason}; no committed last-good)",
             "vs_baseline": 0.0,
+            "roofline": roofline_context(
+                bench_queries(), float(os.environ.get("BENCH_SF", "1.0"))),
         })
 
 
@@ -234,14 +312,32 @@ def measure() -> None:
     # data-driven Pallas choice: A/B each query's TPU run with the fused
     # kernels (dense agg + probe join) and keep whichever is faster —
     # BENCH_PALLAS=off skips the B side, =on forces it
+    def plan_scan_bytes(plan) -> int:
+        """Bytes the plan's projected scans read — the roofline numerator,
+        measured off the actual loaded arrays."""
+        from cloudberry_tpu.exec.executor import scans_of
+        import numpy as np
+
+        total = 0
+        for s in scans_of(plan):
+            t = session.catalog.table(s.table_name)
+            for phys in set(s.column_map) | set(s.mask_map):
+                arr = t.data.get(phys)
+                if arr is not None:
+                    total += np.asarray(arr).nbytes
+        return total
+
     pallas_mode = os.environ.get("BENCH_PALLAS", "ab")
     pallas_won = []
     speedups = {}
     rows_s = {}
+    scan_bytes = {}
+    tpu_wall = {}
     for qn in qnames:
         # the full optimizer path (pruning, pack-bits proof) — the same
         # plan a session would execute, minus admission/dispatch
         plan = plan_statement(parse_sql(QUERIES[qn]), session, {}).plan
+        scan_bytes[qn] = plan_scan_bytes(plan)
         cpu_t, _ = bench_on(plan, cpu)
         log(f"{qn} cpu executor: {cpu_t*1000:.1f} ms")
         tpu_t, tpu_out = bench_on(plan, tpu_devices[0],
@@ -264,6 +360,7 @@ def measure() -> None:
                 log(f"{qn} pallas path failed on hardware "
                     f"({type(e).__name__}: {e}); XLA path kept")
         speedups[qn] = cpu_t / tpu_t
+        tpu_wall[qn] = tpu_t
         # rows/sec/chip (BASELINE.md's second metric): the biggest
         # scanned table's rows over the TPU executor time
         big = max(QUERY_TABLES.get(qn, ["lineitem"]),
@@ -274,16 +371,23 @@ def measure() -> None:
     for s in speedups.values():
         geo *= s
     geo = geo ** (1.0 / len(speedups))
+    roofline = roofline_context(qnames, sf, bytes_by_q=scan_bytes,
+                                wall_by_q=tpu_wall)
     per_q = ", ".join(
         f"{q}={s:.2f}x/{rows_s[q]/1e6:.0f}Mrows_s_chip"
+        f"/{roofline['per_query'].get(q, {}).get('hbm_frac', 0):.3f}HBM"
         for q, s in speedups.items())
     if pallas_won:
         per_q += f"; pallas won: {','.join(pallas_won)}"
     emit({
         "metric": metric_name(),
         "value": round(geo, 3),
-        "unit": f"x ({per_q})",
+        "unit": (f"x ({per_q}; roofline vs "
+                 f"{HBM_GBPS_NOMINAL:g} GB/s HBM nominal)"),
         "vs_baseline": round(geo / 5.0, 3),
+        "roofline": roofline,
+        "scan_bytes": scan_bytes,
+        "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })
 
 
@@ -332,14 +436,20 @@ def main() -> None:
         return
     # a genuine live measurement: record it as the new last-good
     try:
+        lg = {
+            "metric": rec["metric"],
+            "value": rec["value"],
+            "provenance": (
+                f"live driver measurement "
+                f"{time.strftime('%Y-%m-%d', time.gmtime())}"),
+        }
+        # measured roofline inputs ride along so a later REPLAY can
+        # attach the real denominator instead of the schema estimate
+        for k in ("scan_bytes", "tpu_wall_s"):
+            if k in rec:
+                lg[k] = rec[k]
         with open(LAST_GOOD, "w") as f:
-            json.dump({
-                "metric": rec["metric"],
-                "value": rec["value"],
-                "provenance": (
-                    f"live driver measurement "
-                    f"{time.strftime('%Y-%m-%d', time.gmtime())}"),
-            }, f, indent=1)
+            json.dump(lg, f, indent=1)
             f.write("\n")
     except Exception as e:
         log(f"could not persist last-good: {e}")

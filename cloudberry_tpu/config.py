@@ -40,9 +40,11 @@ class ExecConfig:
     Planned-but-unwired knobs live in docs/DESIGN.md's gap list, not here —
     every field below is read by the engine."""
 
-    # Fused Pallas dense-aggregation kernel (exec/pallas_kernels.py):
-    # float32 MXU accumulation; off by default until re-measured on hardware
-    # (exact int64 money sums need the XLA path).
+    # Fused Pallas aggregation/join kernels (exec/pallas_kernels.py):
+    # dense one-hot agg (int64/DECIMAL sums EXACT via 13-bit f32 limbs),
+    # sorted-segment mid-cardinality agg (exact via 8-bit int32 limbs),
+    # and the probe join. Off by default until re-measured on hardware;
+    # bench.py BENCH_PALLAS=ab A/Bs per query and keeps the winner.
     use_pallas: bool = False
 
 

@@ -155,15 +155,26 @@ def _load_store_scan(scan: N.PScan, session) -> dict:
            tuple(p["file"] for p in scan._store_parts),
            tuple(sorted(scan.column_map)), tuple(sorted(scan.mask_map)))
     cache = session._store_scan_cache
-    hit = cache.get(key)
-    if hit is None:
-        cols, validity = store.read_partitions(
-            scan.table_name, scan._store_parts,
-            sorted(set(scan.column_map) | set(scan.mask_map)))
-        hit = {c: jnp.asarray(v) for c, v in cols.items()}
-        for c, v in validity.items():
-            hit[f"$nn:{c}"] = jnp.asarray(np.asarray(v, dtype=np.bool_))
-        if len(cache) >= _STORE_SCAN_CACHE_MAX:
+    # LRU, not FIFO: pop-and-reinsert moves a hit to the dict's end so a
+    # hot table's scan survives a burst of one-off queries; eviction
+    # takes the true least-recently-used head. Hits now MUTATE the dict,
+    # and shared-session server mode runs concurrent readers — the lock
+    # keeps reorder/evict/insert atomic (the store read itself runs
+    # unlocked; two simultaneous misses read twice, harmlessly).
+    lock = session._store_scan_lock
+    with lock:
+        hit = cache.pop(key, None)
+        if hit is not None:
+            cache[key] = hit
+            return hit
+    cols, validity = store.read_partitions(
+        scan.table_name, scan._store_parts,
+        sorted(set(scan.column_map) | set(scan.mask_map)))
+    hit = {c: jnp.asarray(v) for c, v in cols.items()}
+    for c, v in validity.items():
+        hit[f"$nn:{c}"] = jnp.asarray(np.asarray(v, dtype=np.bool_))
+    with lock:
+        while len(cache) >= _STORE_SCAN_CACHE_MAX:
             cache.pop(next(iter(cache)))
         cache[key] = hit
     return hit
@@ -971,8 +982,9 @@ class Lowerer:
 
         key_cols = {name: self.expr(e, cols)
                     for name, e in node.group_keys}
-        out_keys, out_aggs, out_sel, n_groups = K.group_aggregate(
-            key_cols, agg_values, agg_specs, sel, node.capacity)
+        out_keys, out_aggs, out_sel, n_groups = merge_group_aggregate(
+            key_cols, agg_values, agg_specs, sel, node.capacity,
+            self.use_pallas, self.platform)
         self.checks[
             f"aggregation overflow: more groups than capacity "
             f"{node.capacity} (node {id(node)})"] = n_groups > node.capacity
@@ -982,32 +994,61 @@ class Lowerer:
 
 
     def _dense_agg_pallas(self, gid, n_cells, agg_specs, agg_values, sel):
-        """Fused one-pass Pallas path (config.exec.use_pallas): float32 MXU
-        accumulation for sum/count/avg over a small cell domain. Returns
-        None when ineligible (exact int64 sums, min/max) → XLA path."""
+        """Fused one-pass Pallas path (config.exec.use_pallas) for
+        sum/count/avg over a small cell domain. Integer-carried values
+        (BIGINT, DECIMAL cents) ride 13-bit f32 limbs through the MXU
+        one-hot matmul and recombine EXACTLY in int64 — bit-identical to
+        the XLA path, so Q1's money sums are A/B-eligible. Float values
+        keep the single-f32-row transport (approximate analytics).
+        Returns None when ineligible (min/max) → XLA path."""
         if not self.use_pallas:
             return None
         if any(s.func not in ("sum", "count", "avg") for s in agg_specs):
             return None
-        from cloudberry_tpu.exec.pallas_kernels import dense_agg_pallas
+        from cloudberry_tpu.exec import pallas_kernels as PK
 
         tile = 2048
         sum_specs = [s for s in agg_specs if s.func in ("sum", "avg")]
-        vals = [agg_values[s.out_name].astype(jnp.float32)
-                for s in sum_specs]
-        stacked = jnp.stack(vals) if vals else             jnp.zeros((0, gid.shape[0]), jnp.float32)
-        counts, sums = dense_agg_pallas(
+        rows: list = []
+        layout = []  # (spec, first row, "int"|"float", value dtype)
+        for s in sum_specs:
+            v = agg_values[s.out_name]
+            if jnp.issubdtype(v.dtype, jnp.integer):
+                layout.append((s, len(rows), "int", v.dtype))
+                rows.extend(PK.int64_to_agg_limbs(v))
+            else:
+                layout.append((s, len(rows), "float", v.dtype))
+                rows.append(v.astype(jnp.float32))
+        stacked = jnp.stack(rows) if rows else \
+            jnp.zeros((0, gid.shape[0]), jnp.float32)
+        tiles = PK.dense_agg_tiles_pallas(
             _pallas_pad(gid.astype(jnp.int32), tile),
             _pallas_pad(stacked, tile),
             _pallas_pad(sel, tile),
             n_cells=n_cells, tile=tile,
             interpret=(self.platform == "cpu"))
+        # per-tile counts are exact integers in f32 (≤ tile < 2^24);
+        # the cross-tile combine runs in int64, exact for any N
+        counts = jnp.sum(jnp.round(tiles[:, 0]).astype(jnp.int64), axis=0)
         out = {}
-        for i, s in enumerate(sum_specs):
-            out[s.out_name] = sums[i] if s.func == "sum" else                 sums[i] / jnp.maximum(counts, 1.0)
+        n_limbs = len(PK.AGG_LIMB_BITS)
+        for s, row0, kind, dt in layout:
+            if kind == "int":
+                totals = [jnp.sum(jnp.round(tiles[:, 1 + row0 + i])
+                                  .astype(jnp.int64), axis=0)
+                          for i in range(n_limbs)]
+                ssum = PK.agg_limbs_to_int64(totals)
+                out[s.out_name] = ssum.astype(jnp.float64) \
+                    / jnp.maximum(counts, 1) if s.func == "avg" \
+                    else ssum.astype(dt)
+            else:
+                ssum = jnp.sum(tiles[:, 1 + row0].astype(jnp.float64),
+                               axis=0)
+                out[s.out_name] = ssum / jnp.maximum(counts, 1) \
+                    if s.func == "avg" else ssum.astype(dt)
         for s in agg_specs:
             if s.func == "count":
-                out[s.out_name] = counts.astype(jnp.int64)
+                out[s.out_name] = counts
         return out, counts > 0
 
     _PALLAS_PROBE_MAX_BUILD = 2048
@@ -1112,6 +1153,26 @@ class Lowerer:
             out_aggs = {n: jnp.pad(c, (0, pad)) for n, c in out_aggs.items()}
             occupied = jnp.pad(occupied, (0, pad))
         return {**out_keys, **out_aggs}, occupied
+
+
+def merge_group_aggregate(key_cols, agg_values, specs, sel, capacity: int,
+                          use_pallas: bool, platform: str):
+    """Grouped-aggregation dispatch shared by the one-shot Lowerer and
+    the tiled/tiled-dist merge steps: the fused sorted-segment Pallas
+    kernel when eligible (sum/avg over integer-carried values + count,
+    ≤ 2^23 rows — pallas_kernels.sorted_segment_eligible), else the XLA
+    sort path. The two produce BIT-IDENTICAL results for eligible aggs
+    (int sums exact in both), so per-tile partials and one-shot runs
+    agree exactly whichever side fires."""
+    if use_pallas:
+        from cloudberry_tpu.exec import pallas_kernels as PK
+
+        if PK.sorted_segment_eligible(specs, agg_values,
+                                      int(sel.shape[0])):
+            return PK.sorted_segment_aggregate(
+                key_cols, agg_values, specs, sel, capacity,
+                interpret=(platform == "cpu"))
+    return K.group_aggregate(key_cols, agg_values, specs, sel, capacity)
 
 
 def _sortable(e: ex.Expr, child: N.PlanNode, cols) -> jnp.ndarray:

@@ -147,23 +147,29 @@ class AggSpec:
     out_name: str
 
 
-def group_aggregate(
-    key_cols: Columns,
-    agg_values: dict[str, Optional[jnp.ndarray]],
-    aggs: Sequence[AggSpec],
-    sel: jnp.ndarray,
-    out_capacity: int,
-) -> tuple[Columns, Columns, jnp.ndarray, jnp.ndarray]:
-    """Sort-based grouped aggregation (nodeAgg.c analog).
+@dataclass
+class GroupLayout:
+    """Sorted-group scaffolding shared by the XLA sort-based aggregation
+    and the fused Pallas sorted-segment kernel — ONE implementation of
+    the sort, boundary detection, and start compaction, so the two paths
+    cannot diverge on a grouping rule (their bit-identity is a contract:
+    the bench A/B gate and the tiled-merge parity both rely on it)."""
 
-    Returns (out_key_cols, out_agg_cols, out_sel, n_groups); groups are
-    emitted in ascending key order (a free ORDER BY for the common agg→sort
-    pattern). ``n_groups`` is the TRUE group count — the executor must check
-    it against out_capacity after the run: groups beyond capacity are clipped
-    into the last slot, so n_groups > out_capacity means wrong results and is
-    an error, never silent (the capacity-flow-control discipline of
-    ic_udpifc.c:3018 applied to shapes).
-    """
+    names: list
+    perm: jnp.ndarray        # sort permutation (selected rows first)
+    s_sel: jnp.ndarray       # selection in sorted order
+    s_keys: Columns          # key columns in sorted order
+    new_grp: jnp.ndarray     # group-start flags over sorted selected rows
+    n_groups: jnp.ndarray
+    n_sel: jnp.ndarray
+    starts: jnp.ndarray      # per output slot: group start row (0 pad)
+    ends: jnp.ndarray        # per output slot: group end row (0 pad)
+    valid: jnp.ndarray       # slot < n_groups
+    out_keys: Columns        # compacted key columns (zeros on pad)
+
+
+def group_layout(key_cols: Columns, sel: jnp.ndarray,
+                 out_capacity: int) -> GroupLayout:
     names = list(key_cols)
     key_list = [key_cols[n] for n in names]
     perm = sort_indices(key_list, sel)
@@ -180,10 +186,7 @@ def group_aggregate(
     n_groups = jnp.sum(new_grp.astype(jnp.int32))
     n_sel = jnp.sum(s_sel.astype(jnp.int32))
 
-    # Scatter-free segmented reduction (TPU serializes big scatters):
-    # boundary positions compact to the front via a stable bool argsort, then
-    # every per-group aggregate is a cumulative-sum DIFFERENCE between
-    # consecutive boundaries — pure sort/scan/gather, the VPU formulation.
+    # boundary positions compact to the front via a stable bool argsort
     starts_all = jnp.argsort(~new_grp, stable=True)
     g = jnp.arange(out_capacity)
     starts = starts_all[jnp.clip(g, 0, starts_all.shape[0] - 1)]
@@ -197,6 +200,37 @@ def group_aggregate(
     for n in names:
         out_keys[n] = jnp.where(valid, s_keys[n][starts],
                                 jnp.zeros((), dtype=s_keys[n].dtype))
+    return GroupLayout(names, perm, s_sel, s_keys, new_grp, n_groups,
+                       n_sel, starts, ends, valid, out_keys)
+
+
+def group_aggregate(
+    key_cols: Columns,
+    agg_values: dict[str, Optional[jnp.ndarray]],
+    aggs: Sequence[AggSpec],
+    sel: jnp.ndarray,
+    out_capacity: int,
+) -> tuple[Columns, Columns, jnp.ndarray, jnp.ndarray]:
+    """Sort-based grouped aggregation (nodeAgg.c analog).
+
+    Returns (out_key_cols, out_agg_cols, out_sel, n_groups); groups are
+    emitted in ascending key order (a free ORDER BY for the common agg→sort
+    pattern). ``n_groups`` is the TRUE group count — the executor must check
+    it against out_capacity after the run: groups beyond capacity are clipped
+    into the last slot, so n_groups > out_capacity means wrong results and is
+    an error, never silent (the capacity-flow-control discipline of
+    ic_udpifc.c:3018 applied to shapes).
+
+    Scatter-free segmented reduction (TPU serializes big scatters): every
+    per-group aggregate is a cumulative-sum DIFFERENCE between consecutive
+    group boundaries — pure sort/scan/gather, the VPU formulation.
+    """
+    lay = group_layout(key_cols, sel, out_capacity)
+    names, key_list = lay.names, [key_cols[n] for n in lay.names]
+    perm, s_sel = lay.perm, lay.s_sel
+    n_groups, n_sel = lay.n_groups, lay.n_sel
+    starts, ends, valid = lay.starts, lay.ends, lay.valid
+    out_keys = lay.out_keys
 
     def seg_sum(vals):
         csum = jnp.cumsum(vals)
@@ -235,8 +269,17 @@ def group_aggregate(
             out = jnp.where(valid & (counts > 0),
                             seg_extreme(v, want_max=True), ident)
         elif spec.func == "avg":
-            ssum = seg_sum(jnp.where(s_sel, v[perm], 0).astype(jnp.float64))
-            out = ssum / jnp.maximum(counts, 1)
+            # integer-carried values (BIGINT, DECIMAL cents) sum EXACTLY
+            # in int64 before the f64 division — an f64 cumsum rounds
+            # once prefixes pass 2^53, and the fused Pallas path (which
+            # divides the exact int64 sum) must stay bit-identical. The
+            # widen matters for INT32/DATE too: cumsum keeps the input
+            # dtype, so an un-widened int32 numerator would wrap at 2^31.
+            masked = jnp.where(s_sel, v[perm], 0).astype(
+                jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer)
+                else jnp.float64)
+            out = seg_sum(masked).astype(jnp.float64) \
+                / jnp.maximum(counts, 1)
         else:
             raise NotImplementedError(spec.func)
         out_aggs[spec.out_name] = out
@@ -291,8 +334,13 @@ def group_aggregate_dense(
             elif spec.func == "max":
                 out[spec.out_name] = smax(jnp.where(sel, v, _dtype_min(v.dtype)))
             elif spec.func == "avg":
-                s = seg(jnp.where(sel, v, 0).astype(jnp.float64))
-                out[spec.out_name] = s / jnp.maximum(counts, 1)
+                # int64 widen: segment_sum keeps the input dtype, so an
+                # int32 numerator would wrap (see group_aggregate)
+                masked = jnp.where(sel, v, 0).astype(
+                    jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer)
+                    else jnp.float64)
+                out[spec.out_name] = seg(masked).astype(jnp.float64) \
+                    / jnp.maximum(counts, 1)
             else:
                 raise NotImplementedError(spec.func)
         return out, counts > 0
@@ -317,9 +365,13 @@ def group_aggregate_dense(
             out[spec.out_name] = jnp.stack(
                 [jnp.where(m, v, small).max() for m in cell_masks])
         elif spec.func == "avg":
-            s = jnp.stack([jnp.where(m, v, 0).sum(dtype=jnp.float64)
+            # exact int64 numerator for integer values (see group_aggregate)
+            acc_dt = jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer) \
+                else jnp.float64
+            s = jnp.stack([jnp.where(m, v, 0).sum(dtype=acc_dt)
                            for m in cell_masks])
-            out[spec.out_name] = s / jnp.maximum(counts, 1)
+            out[spec.out_name] = s.astype(jnp.float64) \
+                / jnp.maximum(counts, 1)
         else:
             raise NotImplementedError(spec.func)
     return out, counts > 0
@@ -347,7 +399,11 @@ def global_aggregate(
             out[spec.out_name] = jnp.max(
                 jnp.where(sel, v, _dtype_min(v.dtype)))[None]
         elif spec.func == "avg":
-            s = jnp.sum(jnp.where(sel, v, 0).astype(jnp.float64))
+            # exact int64 numerator for integer values (see group_aggregate)
+            masked = jnp.where(sel, v, 0).astype(
+                jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer)
+                else jnp.float64)
+            s = jnp.sum(masked).astype(jnp.float64)
             c = jnp.sum(sel.astype(jnp.int64))
             out[spec.out_name] = (s / jnp.maximum(c, 1))[None]
         else:

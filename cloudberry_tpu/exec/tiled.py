@@ -730,8 +730,11 @@ class TiledExecutable(AdaptiveTiledMixin):
                     [acc_cols[s.out_name], pcols[s.out_name]])
                     for s in specs}
                 sel = jnp.concatenate([acc_sel, psel])
-                ok, oa, osel, n_groups = K.group_aggregate(
-                    key_cols, agg_vals, specs, sel, g_cap)
+                # the same fused-or-XLA dispatch the one-shot executor
+                # uses: eligible int sums are bit-identical either way,
+                # so tiled and one-shot results cannot diverge
+                ok, oa, osel, n_groups = X.merge_group_aggregate(
+                    key_cols, agg_vals, specs, sel, g_cap, pallas, plat)
                 checks["tile merge overflow: more groups than capacity "
                        f"{g_cap}; raise the aggregation capacity"] = \
                     n_groups > g_cap

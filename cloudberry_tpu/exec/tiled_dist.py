@@ -608,6 +608,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         nseg = self.nseg
         group_names = list(shape.group_names)
         specs = shape.merge_specs
+        pallas, plat = self._use_pallas, jax.default_backend()
 
         def step_seg(resident, prelude, tile, tile_n, acc):
             tables = dict(resident)
@@ -629,8 +630,10 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                     [acc_cols[s.out_name], pcols[s.out_name]])
                     for s in specs}
                 sel = jnp.concatenate([acc_sel, psel])
-                ok, oa, osel, n_groups = K.group_aggregate(
-                    key_cols, agg_vals, specs, sel, g_cap)
+                # same fused-or-XLA dispatch as the one-shot executor:
+                # eligible int sums are bit-identical on either side
+                ok, oa, osel, n_groups = X.merge_group_aggregate(
+                    key_cols, agg_vals, specs, sel, g_cap, pallas, plat)
                 checks["tile merge overflow: more groups than capacity "
                        f"{g_cap}; raise the aggregation capacity"] = \
                     n_groups > g_cap

@@ -690,7 +690,10 @@ def annotate_distribution(plan: N.PlanNode, session) -> None:
         if not alts:
             # abstained (out-of-grammar node somewhere inside): leave
             # every join unmarked — the visitor descends and in-grammar
-            # subtrees become fresh regions of their own
+            # subtrees become fresh regions of their own. The mark makes
+            # the abstention VISIBLE in EXPLAIN ("memo: abstained"), so
+            # golden plans pin which regions fall back to greedy rules.
+            root._memo_abstained = True
             return
         for j in _joins_of(root):
             annotated.add(id(j))

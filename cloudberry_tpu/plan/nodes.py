@@ -76,7 +76,13 @@ class PlanNode:
         if mv is not None and indent == 0:
             lines.append(f"AQUMV: answered from materialized view {mv}")
         lines.append(" " * indent + "-> " + self.title()
-                     + (f"  [{self.sharding}]" if self.sharding else ""))
+                     + (f"  [{self.sharding}]" if self.sharding else "")
+                     # memo exploration abstained on this region root —
+                     # its joins fell back to the greedy cdbpath rules
+                     # (plan/memo.py annotate_distribution); pinned in
+                     # plan text so golden tests catch regressions
+                     + (" memo: abstained"
+                        if getattr(self, "_memo_abstained", False) else ""))
         for c in self.children():
             lines.append(c.explain(indent + 3))
         return "\n".join(lines)

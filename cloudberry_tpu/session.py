@@ -73,6 +73,9 @@ class Session:
             load_defs(self)
         # per-query pruned store reads, keyed (table, version, parts, cols)
         self._store_scan_cache: dict = {}
+        # guards the scan cache's LRU mutations (hits reorder the dict,
+        # and shared-session server mode runs concurrent readers)
+        self._store_scan_lock = __import__("threading").Lock()
         self._sync_lock = __import__("threading").Lock()
         self._shard_cache: dict[str, ShardedTable] = {}
         # query_info_collect_hook analog: callables receiving QueryMetrics

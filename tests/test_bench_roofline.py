@@ -1,0 +1,31 @@
+"""bench.py roofline context (VERDICT r5 item 8): every emitted speedup
+carries a bytes-scanned ÷ HBM-bandwidth denominator, including REPLAY
+mode where the bytes come from the static schema estimate."""
+
+import bench
+
+
+def test_static_scan_bytes_scales_with_sf():
+    b1 = bench.static_scan_bytes("q1", 1.0)
+    b01 = bench.static_scan_bytes("q1", 0.1)
+    # q1 scans 44 bytes per lineitem row
+    assert b1 == int(6_001_215 * 44)
+    assert abs(b01 * 10 - b1) / b1 < 1e-6
+    assert bench.static_scan_bytes("q99", 1.0) is None
+
+
+def test_roofline_context_replay_and_live():
+    # replay shape: denominator only (no wall times)
+    rep = bench.roofline_context(["q1", "q3"], 1.0)
+    assert rep["hbm_gbps_nominal"] > 0
+    assert set(rep["per_query"]) == {"q1", "q3"}
+    for rec in rep["per_query"].values():
+        assert rec["bytes_scanned"] > 0
+        assert "hbm_frac" not in rec
+    # live shape: measured bytes + wall time → achieved GB/s + HBM frac
+    live = bench.roofline_context(
+        ["q1"], 1.0, bytes_by_q={"q1": 2_000_000_000},
+        wall_by_q={"q1": 0.01})
+    rec = live["per_query"]["q1"]
+    assert rec["scan_gbps"] == 200.0
+    assert 0 < rec["hbm_frac"] < 1

@@ -1,9 +1,11 @@
-"""Pallas fused dense-aggregation kernel — interpret mode on CPU (the
-hardware path compiles the same kernel; see exec/pallas_kernels.py)."""
+"""Pallas fused aggregation/join kernels — interpret mode on CPU (the
+hardware path compiles the same kernels; see exec/pallas_kernels.py)."""
 
 import jax.numpy as jnp
 import numpy as np
 
+from cloudberry_tpu.exec import kernels as K
+from cloudberry_tpu.exec import pallas_kernels as PK
 from cloudberry_tpu.exec.pallas_kernels import dense_agg_pallas
 
 
@@ -110,6 +112,256 @@ def test_probe_join_pallas_detects_duplicate_build():
     match_f, _ = probe_join_pallas(bkeys, bsel, pkeys, psel, pay,
                                    tile=1024, interpret=True)
     assert float(np.asarray(match_f).max()) > 1.5
+
+
+def test_dense_agg_limb_transport_exact():
+    """int64 sums through the 13-bit limb MXU path reproduce numpy's
+    int64 arithmetic bit for bit — values far beyond f32/f64 precision."""
+    rng = np.random.default_rng(5)
+    n, cells, tile = 8192, 6, 2048
+    gid = rng.integers(0, cells, n).astype(np.int32)
+    sel = rng.random(n) > 0.25
+    vals = rng.integers(-10**17, 10**17, n)  # |v| ≫ 2^53: f64 would round
+
+    limbs = PK.int64_to_agg_limbs(
+        jnp.where(jnp.asarray(sel), jnp.asarray(vals), 0))
+    tiles = PK.dense_agg_tiles_pallas(
+        jnp.asarray(gid), jnp.stack(limbs), jnp.asarray(sel),
+        n_cells=cells, tile=tile, interpret=True)
+    counts = jnp.sum(jnp.round(tiles[:, 0]).astype(jnp.int64), axis=0)
+    totals = [jnp.sum(jnp.round(tiles[:, 1 + i]).astype(jnp.int64), axis=0)
+              for i in range(len(PK.AGG_LIMB_BITS))]
+    sums = PK.agg_limbs_to_int64(totals)
+
+    exp_counts = np.array([((gid == c) & sel).sum() for c in range(cells)])
+    exp_sums = np.array([vals[(gid == c) & sel].sum() for c in range(cells)])
+    np.testing.assert_array_equal(np.asarray(counts), exp_counts)
+    np.testing.assert_array_equal(np.asarray(sums), exp_sums)
+
+
+def _assert_seg_parity(keys, v, sel, cap, tile=2048):
+    """sorted_segment_aggregate must match group_aggregate bit for bit
+    (keys, sums, counts, avg, selection, group count)."""
+    specs = [K.AggSpec("sum", "s"), K.AggSpec("count", "c"),
+             K.AggSpec("avg", "a")]
+    av = {"s": jnp.asarray(v), "c": None, "a": jnp.asarray(v)}
+    kc = {"k": jnp.asarray(keys)}
+    sj = jnp.asarray(sel)
+    ok1, oa1, os1, ng1 = K.group_aggregate(kc, av, specs, sj, cap)
+    ok2, oa2, os2, ng2 = PK.sorted_segment_aggregate(
+        kc, av, specs, sj, cap, tile=tile, interpret=True)
+    assert int(ng1) == int(ng2)
+    np.testing.assert_array_equal(np.asarray(os1), np.asarray(os2))
+    np.testing.assert_array_equal(np.asarray(ok1["k"]), np.asarray(ok2["k"]))
+    for name in ("s", "c", "a"):
+        x, y = np.asarray(oa1[name]), np.asarray(oa2[name])
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y, err_msg=name)
+    return int(ng1)
+
+
+def test_sorted_segment_boundary_shapes():
+    """Oracle parity at the shapes that stress the carry/flush logic:
+    group count == capacity, a single group spanning every tile, an
+    all-filtered input, and one hot group larger than several tiles.
+    All cases share one (n, cap, tile) signature so the interpret-mode
+    program compiles once and replays four times."""
+    rng = np.random.default_rng(6)
+    n, cap, tile = 1536, 512, 512
+
+    def pad(keys, sel):
+        m = n - keys.shape[0]
+        return (np.concatenate([keys, np.zeros(m, np.int64)]),
+                np.concatenate([sel, np.zeros(m, bool)]))
+
+    # group count == capacity: exactly cap distinct keys survive
+    k1, s1 = pad(np.repeat(np.arange(cap, dtype=np.int64), 3),
+                 np.ones(cap * 3, bool))
+    # a single group spanning every tile (the SMEM carry path)
+    k2, s2 = np.zeros(n, np.int64), np.ones(n, bool)
+    # all-filtered: zero groups, zero flushes
+    k3, s3 = rng.integers(0, 50, n).astype(np.int64), np.zeros(n, bool)
+    # one hot group (> tile rows once sorted) between smaller groups
+    k4 = np.concatenate([rng.integers(0, 40, 300), np.full(900, 40),
+                         rng.integers(41, 80, 336)]).astype(np.int64)
+    rng.shuffle(k4)
+    s4 = rng.random(n) > 0.2
+    expected = {0: cap, 1: 1, 2: 0}
+    for i, (keys, sel) in enumerate([(k1, s1), (k2, s2), (k3, s3),
+                                     (k4, s4)]):
+        v = rng.integers(-10**12, 10**12, n)
+        ng = _assert_seg_parity(keys, v, sel, cap, tile=tile)
+        if i in expected:
+            assert ng == expected[i], i
+
+
+def test_sorted_segment_beyond_dense_domain():
+    """Oracle parity at 2^16 groups — far beyond any one-hot cell domain
+    (the acceptance bar for the mid-cardinality kernel). Every group id
+    appears, so the group count is exactly 2^16."""
+    rng = np.random.default_rng(10)
+    groups = 1 << 16
+    keys0 = np.concatenate([np.arange(groups, dtype=np.int64),
+                            rng.integers(0, groups, groups)])
+    sel0 = np.ones(keys0.shape[0], bool)
+    # filter only duplicate-half rows: every group keeps one survivor
+    sel0[groups:] = rng.random(groups) > 0.25
+    perm = rng.permutation(keys0.shape[0])
+    keys, sel = keys0[perm], sel0[perm]  # 2^17 rows
+    v = rng.integers(-10**13, 10**13, keys.shape[0])
+    ng = _assert_seg_parity(keys, v, sel, 1 << 17)
+    assert ng == groups
+
+
+def test_sorted_segment_end_to_end_sql():
+    """Mid-cardinality GROUP BY through the session takes the fused path
+    (spied) and matches the XLA path exactly."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+
+    calls = []
+    orig = PK.sorted_segment_aggregate
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    PK.sorted_segment_aggregate = spy
+
+    def run(up):
+        s = cb.Session(get_config().with_overrides(
+            **{"exec.use_pallas": up}))
+        rng = np.random.default_rng(11)
+        s.sql("create table f (k bigint, v bigint, amt decimal(12,2))")
+        s.catalog.table("f").set_data({
+            "k": rng.integers(0, 8_000, 30_000),
+            "v": rng.integers(-10**12, 10**12, 30_000),
+            "amt": rng.integers(0, 10**8, 30_000)})
+        return s.sql(
+            "select k, sum(v) as sv, sum(amt) as sa, avg(v) as av, "
+            "count(*) as n from f group by k order by k").to_pandas()
+
+    try:
+        a = run(False)
+        n0 = len(calls)
+        b = run(True)
+    finally:
+        PK.sorted_segment_aggregate = orig
+    assert len(calls) > n0, "the sorted-segment path never fired"
+    assert a.equals(b)
+
+
+def test_q1_money_sums_fused_parity():
+    """TPC-H Q1 end to end in interpret mode: the money sums take the
+    fused dense path (spied) and every column — int64-cent SUMs and the
+    f64 AVGs alike — is bit-identical to the XLA path."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+    from tools.tpch_queries import QUERIES
+    from tools.tpchgen import load_tpch
+
+    calls = []
+    orig = PK.dense_agg_tiles_pallas
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    PK.dense_agg_tiles_pallas = spy
+
+    def run(up):
+        s = cb.Session(get_config().with_overrides(
+            **{"exec.use_pallas": up}))
+        load_tpch(s, sf=0.01, seed=1, tables=["lineitem"])
+        return s.sql(QUERIES["q1"]).to_pandas()
+
+    try:
+        a = run(False)
+        n0 = len(calls)
+        b = run(True)
+    finally:
+        PK.dense_agg_tiles_pallas = orig
+    assert len(calls) > n0, "Q1's aggregation never took the fused path"
+    assert a.equals(b)
+
+
+def test_tiled_matches_oneshot_fused():
+    """A fused-agg query produces IDENTICAL results one-shot and tiled:
+    per-tile partials flow through the same limb representation, and
+    int64 partial merges are exact on both sides."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+
+    nf = 60_000
+    q = ("select g, sum(amt) as sa, count(*) as n from f "
+         "group by g order by g")
+
+    def run(mem):
+        s = cb.Session(get_config().with_overrides(**{
+            "resource.query_mem_bytes": mem, "exec.use_pallas": True}))
+        s.sql("create table f (g bigint, amt decimal(12,2))")
+        rng = np.random.default_rng(12)
+        s.catalog.table("f").set_data({
+            "g": rng.integers(0, 1500, nf),
+            "amt": rng.integers(-10**9, 10**9, nf)})
+        return s, s.sql(q).to_pandas()
+
+    _, one = run(4 << 30)
+    s2, tiled = run(1 << 20)
+    rep = s2.last_tiled_report
+    assert rep and rep.get("n_tiles", 0) > 1, rep
+    assert one.equals(tiled)
+
+
+def test_tiled_dist_matches_xla_fused():
+    """The DISTRIBUTED tiled merge also dispatches through
+    merge_group_aggregate: the sorted-segment kernel executing inside
+    the shard_map step must match the XLA side exactly."""
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+
+    nf = 400_000
+    q = ("select g, sum(amt) as sa, count(*) as n from f "
+         "group by g order by g limit 40")
+
+    def run(up):
+        s = cb.Session(get_config().with_overrides(**{
+            "n_segments": 8, "resource.query_mem_bytes": 2 << 20,
+            "exec.use_pallas": up}))
+        s.sql("create table f (g bigint, amt decimal(12,2)) "
+              "distributed by (g)")
+        rng = np.random.default_rng(13)
+        s.catalog.table("f").set_data({
+            "g": rng.integers(0, 1000, nf),
+            "amt": rng.integers(-10**9, 10**9, nf)})
+        return s, s.sql(q).to_pandas()
+
+    s1, fused = run(True)
+    rep = s1.last_tiled_report
+    assert rep and rep.get("tiled"), rep
+    _, xla = run(False)
+    assert fused.equals(xla)
+
+
+def test_kernel_bench_grouped_agg_smoke():
+    """The grouped-agg cardinality sweep runs on CPU in interpret mode
+    and emits both strategies per ladder point."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.kernel_bench", "grouped-agg",
+         "--rows", "4096", "--ladder", "4,4", "--reps", "1",
+         "--interpret"],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    strategies = {r["strategy"] for r in recs}
+    assert strategies == {"xla_sort", "pallas_sorted_segment"}
+    assert all(r["groups"] == 16 and r["mrows_per_s"] > 0 for r in recs)
 
 
 def test_fused_probe_join_end_to_end_parity():

@@ -282,3 +282,46 @@ def test_ctas_persists(tmp_path):
           "distributed by (a)")
     s2 = cb.Session(_cfg(tmp_path))
     assert s2.sql("select count(*) as n from t2").to_pandas().n[0] == 10
+
+
+def test_store_scan_cache_is_lru(monkeypatch):
+    """Scan-cache eviction is LRU, not FIFO: a hit moves the entry to
+    most-recently-used, so a hot table's scan survives a burst of
+    one-off queries (exec/executor.py _load_store_scan)."""
+    from cloudberry_tpu.exec import executor as X
+
+    class FakeStore:
+        def __init__(self):
+            self.reads = []
+
+        def effective_version(self, name):
+            return 1
+
+        def read_partitions(self, name, parts, cols):
+            self.reads.append(name)
+            return {c: np.zeros(4) for c in cols}, {}
+
+    class Holder:
+        pass
+
+    import threading
+
+    sess = Holder()
+    sess._store_scan_cache = {}
+    sess._store_scan_lock = threading.Lock()
+    sess.catalog = Holder()
+    sess.catalog.store = FakeStore()
+
+    def scan(name):
+        s = N.PScan(name, {"c": "c"}, 4)
+        s._store_parts = [{"file": f"{name}.part"}]
+        return s
+
+    monkeypatch.setattr(X, "_STORE_SCAN_CACHE_MAX", 2)
+    X._load_store_scan(scan("hot"), sess)    # miss
+    X._load_store_scan(scan("one"), sess)    # miss — cache full
+    X._load_store_scan(scan("hot"), sess)    # hit: hot becomes MRU
+    X._load_store_scan(scan("two"), sess)    # miss: evicts "one", not "hot"
+    X._load_store_scan(scan("hot"), sess)    # must still be a hit
+    assert sess.catalog.store.reads == ["hot", "one", "two"]
+    # FIFO would have evicted "hot" at the "two" insert and re-read it

@@ -8,7 +8,17 @@ many-to-many expansion, sort-based grouped aggregation, sort — on whatever
 backend is live (real TPU under the terminal default, CPU with
 JAX_PLATFORMS=cpu), one JSON line per measurement.
 
-Usage: python -m tools.kernel_bench [--build N] [--probe N] [--reps R]
+Usage:
+  python -m tools.kernel_bench [--build N] [--probe N] [--reps R]
+  python -m tools.kernel_bench grouped-agg [--rows N] [--ladder LO,HI]
+      [--reps R] [--interpret] [--csv PATH]
+
+``grouped-agg`` sweeps a group-cardinality ladder (2^LO … 2^HI, default
+2^4 … 2^20) through BOTH grouped-aggregation strategies — the XLA sort
+path (kernels.group_aggregate) and the fused sorted-segment Pallas
+kernel (pallas_kernels.sorted_segment_aggregate) — so the XLA-vs-Pallas
+crossover is measured, not guessed. ``--interpret`` runs the Pallas side
+in interpreter mode so the sweep smoke-runs on CPU without hardware.
 """
 
 from __future__ import annotations
@@ -19,14 +29,7 @@ import os
 import time
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--build", type=int, default=1_500_000)
-    ap.add_argument("--probe", type=int, default=6_000_000)
-    ap.add_argument("--groups", type=int, default=4_000_000)
-    ap.add_argument("--reps", type=int, default=3)
-    args = ap.parse_args()
-
+def _setup_jax():
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -34,6 +37,110 @@ def main() -> None:
         # re-assert the requested platform (tests/conftest.py note)
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def _bench_loop(jax, fn, *xs, reps: int):
+    out = jax.block_until_ready(fn(*xs))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        out = jax.block_until_ready(fn(*xs))
+        best = min(best, time.time() - t0)
+    return best, out
+
+
+def grouped_agg_sweep(args) -> None:
+    """Cardinality ladder for grouped aggregation, one JSON line (and
+    optional CSV row) per (groups, strategy) point."""
+    jax = _setup_jax()
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cloudberry_tpu.exec import kernels as K
+    from cloudberry_tpu.exec import pallas_kernels as PK
+
+    try:
+        lo, hi = (int(x) for x in args.ladder.split(","))
+        assert lo <= hi
+    except (ValueError, AssertionError):
+        raise SystemExit(
+            f"--ladder must be LO,HI with LO <= HI (got {args.ladder!r})")
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    n = args.rows
+    specs = [K.AggSpec("sum", "s"), K.AggSpec("count", "c")]
+    v = jnp.asarray(rng.integers(-10**12, 10**12, n))
+    sel = jnp.ones(n, bool)
+    rows_out = []
+    for lg in range(lo, hi + 1, args.step):
+        groups = 1 << lg
+        keys = jnp.asarray(rng.integers(0, groups, n).astype(np.int64))
+        cap = min(max(2 * groups, 1024), max(n, 1024))
+
+        def make_fn(agg_fn):
+            # specs/cap close over the trace: AggSpec is static config,
+            # not a traced argument
+            @jax.jit
+            def f(k, vv, s):
+                return agg_fn({"k": k}, {"s": vv, "c": None}, specs, s)
+            return f
+
+        strategies = {
+            "xla_sort": make_fn(functools.partial(
+                K.group_aggregate, out_capacity=cap)),
+            "pallas_sorted_segment": make_fn(functools.partial(
+                PK.sorted_segment_aggregate, out_capacity=cap,
+                interpret=args.interpret)),
+        }
+        for name, fn in strategies.items():
+            best, _ = _bench_loop(jax, fn, keys, v, sel, reps=args.reps)
+            rec = {
+                "kernel": "grouped_agg", "strategy": name,
+                "groups": groups, "rows": n, "device": str(dev),
+                "interpret": bool(args.interpret),
+                "wall_ms": round(best * 1e3, 2),
+                "mrows_per_s": round(n / best / 1e6, 1),
+            }
+            rows_out.append(rec)
+            print(json.dumps(rec), flush=True)
+    if args.csv:
+        import csv
+
+        with open(args.csv, "w", newline="") as fh:
+            w = csv.DictWriter(fh, fieldnames=list(rows_out[0]))
+            w.writeheader()
+            w.writerows(rows_out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", nargs="?", default="primitives",
+                    choices=["primitives", "grouped-agg"])
+    ap.add_argument("--build", type=int, default=1_500_000)
+    ap.add_argument("--probe", type=int, default=6_000_000)
+    ap.add_argument("--groups", type=int, default=4_000_000)
+    ap.add_argument("--reps", type=int, default=3)
+    # grouped-agg sweep knobs
+    ap.add_argument("--rows", type=int, default=2_000_000,
+                    help="grouped-agg: rows per measurement")
+    ap.add_argument("--ladder", default="4,20",
+                    help="grouped-agg: log2 group-count range LO,HI")
+    ap.add_argument("--step", type=int, default=2,
+                    help="grouped-agg: log2 ladder stride")
+    ap.add_argument("--interpret", action="store_true",
+                    help="grouped-agg: Pallas interpret mode (CPU smoke)")
+    ap.add_argument("--csv", default=None,
+                    help="grouped-agg: also write a CSV table here")
+    args = ap.parse_args()
+
+    if args.mode == "grouped-agg":
+        grouped_agg_sweep(args)
+        return
+
+    jax = _setup_jax()
 
     import jax.numpy as jnp
     import numpy as np
@@ -45,12 +152,7 @@ def main() -> None:
     NB, NP = args.build, args.probe
 
     def bench(label, fn, *xs, rows):
-        out = jax.block_until_ready(fn(*xs))  # compile + warm
-        best = float("inf")
-        for _ in range(args.reps):
-            t0 = time.time()
-            out = jax.block_until_ready(fn(*xs))
-            best = min(best, time.time() - t0)
+        best, out = _bench_loop(jax, fn, *xs, reps=args.reps)
         print(json.dumps({
             "kernel": label, "rows": rows, "device": str(dev),
             "wall_ms": round(best * 1e3, 2),
