@@ -107,6 +107,53 @@ def roofline_context(qnames, sf: float, bytes_by_q: dict | None = None,
     return out
 
 
+def interconnect_context(session, qnames, nseg: int = 8) -> dict:
+    """The interconnect denominator next to the roofline record: plan each
+    bench query as it would run on an ``nseg`` segment mesh (metadata-only
+    — the counts-only shard layout, no arrays materialized) and total
+    every Motion's wire footprint: collective launches and bytes-on-wire
+    under the packed format (exec/kernels.py wire_layout) vs the legacy
+    per-column launches, so the perf trajectory captures shuffle volume,
+    not just scan bytes."""
+    import copy
+
+    import numpy as np
+
+    from cloudberry_tpu.exec import kernels as K
+    from cloudberry_tpu.exec.executor import all_nodes
+    from cloudberry_tpu.plan import nodes as PN
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.sql.parser import parse_sql
+    from tools.tpch_queries import QUERIES
+
+    clone = copy.copy(session)
+    clone.config = session.config.with_overrides(n_segments=nseg)
+    out = {"n_segments": nseg, "per_query": {}}
+    for qn in qnames:
+        plan = plan_statement(parse_sql(QUERIES[qn]), clone, {}).plan
+        rec = {"motions": 0, "launches_packed": 0, "launches_percol": 0,
+               "wire_bytes_packed": 0, "wire_bytes_percol": 0}
+        seen: set = set()
+        for node in all_nodes(plan):
+            # shared (PShare/CTE) subtrees appear once per reference in
+            # the walk but lower — and ship — exactly once
+            if not isinstance(node, PN.PMotion) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            layout = K.wire_layout(
+                {f.name: f.type.np_dtype for f in node.fields})
+            rows = max(int(node.out_capacity), 1)
+            rec["motions"] += 1
+            rec["launches_packed"] += 1
+            rec["launches_percol"] += len(node.fields) + 1  # + sel buffer
+            rec["wire_bytes_packed"] += rows * layout.row_bytes()
+            rec["wire_bytes_percol"] += rows * (
+                sum(np.dtype(f.type.np_dtype).itemsize
+                    for f in node.fields) + 1)
+        out["per_query"][qn] = rec
+    return out
+
+
 # tables each bench query touches (generation cost scales with SF — load
 # only what the selected queries scan)
 QUERY_TABLES = {
@@ -206,6 +253,7 @@ def replay_last_good(reason: str) -> None:
                 lg_queries, lg_sf,
                 bytes_by_q=lg.get("scan_bytes"),
                 wall_by_q=lg.get("tpu_wall_s")),
+            "interconnect": lg.get("interconnect"),
         })
     except Exception:
         emit({
@@ -373,6 +421,13 @@ def measure() -> None:
     geo = geo ** (1.0 / len(speedups))
     roofline = roofline_context(qnames, sf, bytes_by_q=scan_bytes,
                                 wall_by_q=tpu_wall)
+    try:
+        # shuffle volume next to the scan denominator: launches and
+        # bytes-on-wire per query at the 8-segment plan shape
+        interconnect = interconnect_context(session, qnames)
+    except Exception as e:  # never fail the bench on the metadata pass
+        log(f"interconnect context failed: {type(e).__name__}: {e}")
+        interconnect = None
     per_q = ", ".join(
         f"{q}={s:.2f}x/{rows_s[q]/1e6:.0f}Mrows_s_chip"
         f"/{roofline['per_query'].get(q, {}).get('hbm_frac', 0):.3f}HBM"
@@ -386,6 +441,7 @@ def measure() -> None:
                  f"{HBM_GBPS_NOMINAL:g} GB/s HBM nominal)"),
         "vs_baseline": round(geo / 5.0, 3),
         "roofline": roofline,
+        "interconnect": interconnect,
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })
@@ -445,8 +501,8 @@ def main() -> None:
         }
         # measured roofline inputs ride along so a later REPLAY can
         # attach the real denominator instead of the schema estimate
-        for k in ("scan_bytes", "tpu_wall_s"):
-            if k in rec:
+        for k in ("scan_bytes", "tpu_wall_s", "interconnect"):
+            if k in rec and rec[k] is not None:
                 lg[k] = rec[k]
         with open(LAST_GOOD, "w") as f:
             json.dump(lg, f, indent=1)

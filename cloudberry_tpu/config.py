@@ -31,6 +31,17 @@ class InterconnectConfig:
     # neighbor ppermutes (parallel/transport.py) — the ICI-friendly
     # systolic formulation, and an independent cross-check of the first.
     backend: str = "xla"
+    # Packed wire format (exec/kernels.py wire_layout): every motion
+    # bitcasts ALL its columns plus the row-validity mask into one
+    # (rows, W) uint32 buffer, so gather/broadcast/redistribute each cost
+    # exactly ONE collective instead of one per column. False falls back
+    # to the per-column launches (the parity/debug path; results are
+    # bit-identical either way — tests pin it).
+    packed_wire: bool = True
+    # Ring-transport software pipelining: split each all_to_all block into
+    # this many slices, one ppermute per (hop, slice), so hop k's rotation
+    # overlaps hop k-1's placement. 1 disables (whole-block hops).
+    ring_chunks: int = 1
 
 
 @dataclass(frozen=True)

@@ -67,11 +67,13 @@ def compile_distributed(plan: N.PlanNode, session):
     nseg = session.config.n_segments
     mesh = segment_mesh(nseg,
                         getattr(session, "_live_device_ids", None))
-    tx = make_transport(session.config.interconnect.backend, nseg)
+    ic = session.config.interconnect
+    tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
+    packed = ic.packed_wire
     _, in_specs = prepare_dist_inputs(plan, session)
 
     def seg_fn(tables):
-        low = DistLowerer(tables, nseg, tx=tx)
+        low = DistLowerer(tables, nseg, tx=tx, packed=packed)
         cols, sel = low.lower(plan)
         out = {f.name: cols[f.name][None] for f in plan.fields}
         # reduce checks to replicated scalars (any segment tripped) so
@@ -80,10 +82,34 @@ def compile_distributed(plan: N.PlanNode, session):
         checks = {
             k: tx.psum(jnp.asarray(v).astype(jnp.int32), SEG_AXIS) > 0
             for k, v in low.checks.items()}
-        return out, sel[None], checks
+        # motion stats (already pmax-reduced, replicated): the observed
+        # per-destination bucket demand each redistribute actually saw —
+        # the capacity-ladder promotion reads these host-side
+        return out, sel[None], checks, dict(low.stats)
 
     return jax.jit(_shard_map(seg_fn, mesh, (in_specs,),
                               _out_specs_like(plan)))
+
+
+def record_motion_stats(plan: N.PlanNode, stats: dict) -> None:
+    """Pin each redistribute's observed global bucket demand onto its
+    motion node (``_observed_bucket``): on overflow the retry promotes
+    straight to the rung that fits instead of probing rung by rung."""
+    import re
+
+    # redistribute-only by construction; the kind filter also guards the
+    # stale-id aliasing hazard when the program came from a rung-cached
+    # executable of an equivalent, since-collected plan (same guard as
+    # grow_expansion's id-match path)
+    motions = {id(n): n for n in X.all_nodes(plan)
+               if isinstance(n, N.PMotion) and n.kind == "redistribute"}
+    for key, v in stats.items():
+        m = re.search(r"required bucket \(node (\d+)\)", key)
+        if m is None:
+            continue
+        node = motions.get(int(m.group(1)))
+        if node is not None:
+            node._observed_bucket = int(np.asarray(v))
 
 
 def execute_distributed(plan: N.PlanNode, session,
@@ -92,7 +118,8 @@ def execute_distributed(plan: N.PlanNode, session,
         fn = compile_distributed(plan, session)
     inputs, _ = prepare_dist_inputs(plan, session)
     fault_point("dist_execute_start")
-    cols, sel, checks = fn(inputs)
+    cols, sel, checks, stats = fn(inputs)
+    record_motion_stats(plan, stats)
     X.raise_checks(checks)
     # every segment computed the (gathered) final result; read the first
     # shard THIS HOST can address (on a multi-host mesh, segment 0 may
@@ -115,8 +142,9 @@ def _local_row(v) -> np.ndarray:
 
 def _out_specs_like(plan: N.PlanNode):
     cols_spec = {f.name: P(SEG_AXIS) for f in plan.fields}
-    # checks reduce to replicated scalars (P()) — readable on every host
-    return (cols_spec, P(SEG_AXIS), P())
+    # checks and motion stats reduce to replicated scalars (P()) —
+    # readable on every host
+    return (cols_spec, P(SEG_AXIS), P(), P())
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
@@ -136,7 +164,7 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 class DistLowerer(X.Lowerer):
     def __init__(self, tables, nseg: int, platform: str | None = None,
-                 use_pallas: bool = False, tx=None):
+                 use_pallas: bool = False, tx=None, packed: bool = True):
         super().__init__(tables, platform=platform, use_pallas=use_pallas)
         self.nseg = nseg
         # motion transport (ic_modules.c vtable analog): XLA-native
@@ -146,6 +174,9 @@ class DistLowerer(X.Lowerer):
 
             tx = XlaCollectives()
         self.tx = tx
+        # packed wire format (kernels.wire_layout): one collective per
+        # motion; False = legacy one-collective-per-column (parity path)
+        self.packed = packed
 
     def scan(self, node: N.PScan):
         if node.table_name == "$dual":
@@ -209,6 +240,14 @@ class DistLowerer(X.Lowerer):
                 "local top-N emitted more than its limit"] = \
                 n > node.pre_compact
         if node.kind in ("gather", "broadcast"):
+            if self.packed and cols:
+                # one collective for the whole row set: every column plus
+                # the validity mask rides ONE (cap, W) uint32 buffer
+                layout = K.wire_layout({n: c.dtype
+                                        for n, c in cols.items()})
+                buf = K.pack_wire(cols, sel, layout)
+                recv = self.tx.all_gather(buf, SEG_AXIS)
+                return K.unpack_wire(recv, layout)
             out = {n: self.tx.all_gather(c, SEG_AXIS)
                    for n, c in cols.items()}
             osel = self.tx.all_gather(sel, SEG_AXIS)
@@ -230,6 +269,11 @@ class DistLowerer(X.Lowerer):
             f"redistribute overflow: a destination bucket exceeded capacity "
             f"{B} (node {id(node)}); raise "
             f"config.interconnect.capacity_factor"] = (counts > B).any()
+        # observed global bucket demand (replicated): the host reads it
+        # after the run so an overflow promotes DIRECTLY to the capacity
+        # rung that fits — one retry, not a probe up the ladder
+        self.stats[f"required bucket (node {id(node)})"] = \
+            self.tx.pmax(jnp.max(counts), SEG_AXIS)
 
         order = jnp.argsort(dest)
         sorted_dest = dest[order]
@@ -238,6 +282,20 @@ class DistLowerer(X.Lowerer):
             jnp.clip(sorted_dest, 0, nseg - 1)]
         valid = (sorted_dest < nseg) & (rank < B)
         slot = jnp.where(valid, sorted_dest * B + rank, nseg * B)
+
+        if self.packed and cols:
+            # pack once, scatter rows into their destination buckets,
+            # ship ONE (nseg, B, W) buffer; unfilled slots stay all-zero,
+            # which unpacks as invalid — the validity mask needs no
+            # separate collective
+            layout = K.wire_layout({n: c.dtype for n, c in cols.items()})
+            pbuf = K.pack_wire(cols, sel, layout)
+            buf = jnp.zeros((nseg * B, layout.width), dtype=jnp.uint32)
+            buf = buf.at[slot].set(pbuf[order], mode="drop")
+            recv = self.tx.all_to_all(
+                buf.reshape(nseg, B, layout.width), SEG_AXIS)
+            return K.unpack_wire(recv.reshape(nseg * B, layout.width),
+                                 layout)
 
         out = {}
         for name, c in cols.items():

@@ -90,11 +90,13 @@ def declare(session, name: str, query_ast) -> dict:
             stripped = _strip_top_gather(plan)
             if stripped is not None:
                 from cloudberry_tpu.exec.dist_executor import (
-                    compile_distributed, prepare_dist_inputs)
+                    compile_distributed, prepare_dist_inputs,
+                    record_motion_stats)
 
                 fn = compile_distributed(stripped, session)
                 inputs, _ = prepare_dist_inputs(stripped, session)
-                cols, sel, checks = fn(inputs)
+                cols, sel, checks, stats = fn(inputs)
+                record_motion_stats(stripped, stats)
                 X.raise_checks(checks)
                 sel_np = np.asarray(sel)
                 for s in range(nseg):

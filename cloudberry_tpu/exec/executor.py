@@ -315,39 +315,88 @@ def find_expansion_node(plan: N.PlanNode, message: str):
     return None
 
 
-def grow_expansion(plan: N.PlanNode, message: str,
-                   factor: int = 4) -> bool:
+def _dedupe_nodes(nodes) -> list:
+    """Unique by identity, preserving order — all_nodes re-walks shared
+    (PShare) subtrees once per reference, and a buffer must be grown
+    exactly once per retry."""
+    seen: set[int] = set()
+    out = []
+    for nd in nodes:
+        if id(nd) not in seen:
+            seen.add(id(nd))
+            out.append(nd)
+    return out
+
+
+def grow_expansion(plan: N.PlanNode, message: str, factor: int = 4,
+                   allow_fallback: bool = False) -> bool:
     """Adaptive recovery from a detected join-expansion overflow (the
     increase-nbatch-and-retry discipline of nodeHash.c): grow the named
-    join's pair buffer and report success. The caller recompiles and
-    re-runs — results are never truncated. A skew-blown redistribute
-    bucket grows the same way (a hot destination received more than the
-    fair-share estimate — the Motion receive-buffer resize the
-    reference performs in the interconnect layer)."""
+    join's pair buffer by ``factor`` and report success. The caller
+    recompiles and re-runs — results are never truncated. A skew-blown
+    redistribute bucket recovers the same way, except it promotes to
+    the next CAPACITY RUNG that fits (``factor`` does not apply there —
+    rung shapes are what the session's executable cache is keyed on).
+
+    ``allow_fallback``: when the message's node id resolves nowhere in
+    ``plan``, grow every candidate buffer instead of giving up. Only
+    the statement retry loop sets this — there an unresolvable id means
+    the program came from a rung-cached executable of an equivalent,
+    since-collected plan, and blanket growth is padding at worst with
+    guaranteed progress. Tiled callers keep it off: their id miss means
+    the overflowing node is genuinely outside the plan at hand, and the
+    original error must surface, not a mutated retry."""
     node = find_expansion_node(plan, message)
-    if node is not None:
-        node.out_capacity = max(node.out_capacity * factor, 64)
-        # capacity re-derivations (e.g. tiled _retile) must never shrink
-        # a runtime-grown buffer back below what overflowed
-        node._min_out_cap = node.out_capacity
+    join_hits = [node] if node is not None else []
+    if not join_hits and allow_fallback \
+            and "expansion overflow" in message:
+        join_hits = _dedupe_nodes(
+            nd for nd in all_nodes(plan)
+            if isinstance(nd, N.PJoin)
+            and (not nd.unique_build or nd.residual is not None))
+    if join_hits:
+        for nd in join_hits:
+            nd.out_capacity = max(nd.out_capacity * factor, 64)
+            # capacity re-derivations (e.g. tiled _retile) must never
+            # shrink a runtime-grown buffer back below what overflowed
+            nd._min_out_cap = nd.out_capacity
         return True
     if "redistribute overflow" in message:
         import re
 
         m = re.search(r"\(node (\d+)\)", message)
-        if m is not None:
-            nid = int(m.group(1))
-            for nd in all_nodes(plan):
-                if id(nd) == nid and isinstance(nd, N.PMotion):
-                    # out_capacity tracks bucket_cap × nseg; recover the
-                    # factor so memory estimates see the grown buffer
-                    nseg = max(1, (nd.out_capacity or nd.bucket_cap)
-                               // max(nd.bucket_cap, 1))
-                    nd.bucket_cap = max(nd.bucket_cap * factor, 64)
-                    nd.out_capacity = nd.bucket_cap * nseg
-                    # tiled re-derivations must never shrink it back
-                    nd._min_bucket_cap = nd.bucket_cap
-                    return True
+        nid = int(m.group(1)) if m is not None else -1
+        # kind filter matters: a stale id from a rung-cached executable
+        # (compiled off an equivalent, since-collected plan) could alias
+        # ANY current node's address — never promote a gather/broadcast
+        hits = _dedupe_nodes(
+            nd for nd in all_nodes(plan)
+            if isinstance(nd, N.PMotion)
+            and nd.kind == "redistribute" and id(nd) == nid)
+        if not hits and allow_fallback:
+            # the failing program was compiled from an EQUIVALENT plan
+            # (rung-cache hit across a replan), so the embedded node id
+            # does not resolve here: promote every redistribute — extra
+            # padding at worst, and the retry is guaranteed progress
+            hits = _dedupe_nodes(
+                nd for nd in all_nodes(plan)
+                if isinstance(nd, N.PMotion)
+                and nd.kind == "redistribute")
+        for nd in hits:
+            # out_capacity tracks bucket_cap × nseg; recover the
+            # factor so memory estimates see the grown buffer
+            nseg = max(1, (nd.out_capacity or nd.bucket_cap)
+                       // max(nd.bucket_cap, 1))
+            # promote to the next capacity rung — or straight to the
+            # rung fitting the observed global bucket demand when the
+            # run reported one (dist_executor.record_motion_stats)
+            observed = getattr(nd, "_observed_bucket", 0)
+            nd.bucket_cap = K.rung_up(
+                max(nd.bucket_cap * 2, observed, 64))
+            nd.out_capacity = nd.bucket_cap * nseg
+            # tiled re-derivations must never shrink it back
+            nd._min_bucket_cap = nd.bucket_cap
+        return bool(hits)
     return False
 
 
@@ -377,6 +426,10 @@ class Lowerer:
                  use_pallas: bool = False):
         self.tables = tables
         self.checks: dict[str, jnp.ndarray] = {}
+        # replicated observability scalars (e.g. each redistribute's
+        # observed bucket demand) — the distributed executor returns
+        # them next to checks for host-side capacity-rung promotion
+        self.stats: dict[str, jnp.ndarray] = {}
         self._subcache: dict[int, jnp.ndarray] = {}
         # shared-subplan (PShare) results, keyed by child object identity
         self._sharecache: dict[int, tuple] = {}

@@ -190,11 +190,14 @@ def _run_instrumented_dist(plan: N.PlanNode, session, query: str):
 
     from cloudberry_tpu.parallel.transport import make_transport
 
-    tx = make_transport(session.config.interconnect.backend, nseg)
+    ic = session.config.interconnect
+    tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
+    packed = ic.packed_wire
 
     class InstrDistLowerer(InstrumentingMixin, DX.DistLowerer):
         def __init__(self, tables, nseg):
-            DX.DistLowerer.__init__(self, tables, nseg, tx=tx)
+            DX.DistLowerer.__init__(self, tables, nseg, tx=tx,
+                                    packed=packed)
             self.__init_instrument__()
 
     def seg_fn(tables):

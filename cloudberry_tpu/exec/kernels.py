@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Columns = dict[str, jnp.ndarray]
 
@@ -522,6 +523,132 @@ def join_expand(
     build_pos = jnp.clip(start[pi_c] + k, 0, kb_sorted.shape[0] - 1)
     build_row = order[build_pos].astype(jnp.int32)
     return pi_c.astype(jnp.int32), build_row, out_sel, matched, total
+
+
+# --------------------------------------------------------------------------
+# motion wire format: pack every column of a row set (plus the row-validity
+# mask) into ONE (rows, W) uint32 buffer, so each motion costs exactly one
+# collective instead of one per column. Restoration is bit-identical: 4-byte
+# dtypes bitcast to a u32 word, 8-byte dtypes to two words (the TPU-legal
+# formulation — a direct f64↔u64 bitcast does not compile there, u32 word
+# pairs do; see sort_key_u64), and bool columns ride as BITS of the leading
+# flag word(s) next to the validity bit, so a shuffle ships no dedicated
+# bool buffers at all.
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WireLayout:
+    """Static description of one packed wire buffer. Word 0 bit 0 is the
+    row-validity bit; bool columns occupy the following bits (spilling
+    into additional flag words past 32 bools); wider columns get 1 or 2
+    whole words each, in sorted-name order so any two sessions that agree
+    on the column dict agree on the layout."""
+
+    names: tuple          # all column names, layout order (bools first)
+    dtypes: tuple         # jnp/np dtype per name
+    flag_bits: dict       # bool column name -> (word, bit)
+    offsets: dict         # non-bool column name -> first word index
+    n_flag_words: int     # leading words carrying validity + bool bits
+    width: int            # W: total uint32 words per row
+
+    def row_bytes(self) -> int:
+        return 4 * self.width
+
+    def payload_bytes(self) -> int:
+        """Bytes of actual column data per row (excludes flag-word
+        padding) — the numerator of wire efficiency."""
+        bits = 1  # validity
+        total = 0
+        for dt in self.dtypes:
+            if np.dtype(dt) == np.bool_:
+                bits += 1
+            else:
+                total += np.dtype(dt).itemsize
+        return total + (bits + 7) // 8
+
+
+def wire_layout(col_dtypes: dict) -> WireLayout:
+    """Layout for a column dict (name -> dtype). Deterministic: bools in
+    sorted order take flag bits, then the remaining columns in sorted
+    order take whole words."""
+    bools = sorted(n for n, dt in col_dtypes.items()
+                   if np.dtype(dt) == np.bool_)
+    wides = sorted(n for n, dt in col_dtypes.items()
+                   if np.dtype(dt) != np.bool_)
+    n_flag_words = max(1, -(-(1 + len(bools)) // 32))
+    flag_bits = {}
+    for i, n in enumerate(bools):
+        flag_bits[n] = ((1 + i) // 32, (1 + i) % 32)
+    offsets = {}
+    w = n_flag_words
+    for n in wides:
+        size = np.dtype(col_dtypes[n]).itemsize
+        if size not in (4, 8):
+            raise NotImplementedError(
+                f"wire pack: column {n!r} has {size}-byte dtype "
+                f"{col_dtypes[n]}; only 4/8-byte dtypes and bool ship")
+        offsets[n] = w
+        w += size // 4
+    names = tuple(bools + wides)
+    dtypes = tuple(col_dtypes[n] for n in names)
+    return WireLayout(names, dtypes, flag_bits, offsets, n_flag_words, w)
+
+
+def pack_wire(cols: Columns, sel: jnp.ndarray,
+              layout: WireLayout) -> jnp.ndarray:
+    """(rows, W) uint32 buffer carrying every column and the validity
+    mask. An all-zero row unpacks as invalid — scattered send buffers
+    need no separate initialization for unused slots."""
+    rows = sel.shape[0]
+    words: list = [None] * layout.width
+    flags = [jnp.zeros((rows,), jnp.uint32)
+             for _ in range(layout.n_flag_words)]
+    flags[0] = sel.astype(jnp.uint32)
+    for name, (w, bit) in layout.flag_bits.items():
+        flags[w] = flags[w] | (cols[name].astype(jnp.uint32)
+                               << jnp.uint32(bit))
+    for i, f in enumerate(flags):
+        words[i] = f
+    for name, off in layout.offsets.items():
+        c = cols[name]
+        u = jax.lax.bitcast_convert_type(c, jnp.uint32)
+        if u.ndim == c.ndim:        # 4-byte dtype: one word
+            words[off] = u
+        else:                       # 8-byte dtype: two words (lo, hi)
+            words[off] = u[..., 0]
+            words[off + 1] = u[..., 1]
+    return jnp.stack(words, axis=-1)
+
+
+def unpack_wire(buf: jnp.ndarray,
+                layout: WireLayout) -> tuple[Columns, jnp.ndarray]:
+    """Inverse of pack_wire: bit-identical columns + the validity mask."""
+    sel = (buf[..., 0] & jnp.uint32(1)).astype(jnp.bool_)
+    cols: Columns = {}
+    for name, dt in zip(layout.names, layout.dtypes):
+        if np.dtype(dt) == np.bool_:
+            w, bit = layout.flag_bits[name]
+            cols[name] = ((buf[..., w] >> jnp.uint32(bit))
+                          & jnp.uint32(1)).astype(jnp.bool_)
+            continue
+        off = layout.offsets[name]
+        if np.dtype(dt).itemsize == 4:
+            cols[name] = jax.lax.bitcast_convert_type(buf[..., off], dt)
+        else:
+            pair = jnp.stack([buf[..., off], buf[..., off + 1]], axis=-1)
+            cols[name] = jax.lax.bitcast_convert_type(pair, dt)
+    return cols, sel
+
+
+def rung_up(n: int) -> int:
+    """Round a bucket capacity up to its ladder rung (the next power of
+    two, floor 8): rungs quantize motion buffer shapes so the set of
+    compiled executables per motion is small and bounded — ≤ log2 of the
+    worst-case/seed ratio — and skew promotion always lands on a cached
+    shape instead of an arbitrary new one."""
+    n = max(int(n), 8)
+    return 1 << (n - 1).bit_length()
 
 
 # --------------------------------------------------------------------------

@@ -526,6 +526,10 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         self.tile_rows = tile_rows
         self.budget = budget
         self._use_pallas = session.config.exec.use_pallas
+        # the step programs' spine motions AND the finalize merge motion
+        # share the packed wire format (kernels.wire_layout) — per-tile
+        # redistributes are one collective each too
+        self._packed = session.config.interconnect.packed_wire
         self._compiled = None
         self._run_lock = threading.Lock()
         self._refresh_report()
@@ -572,13 +576,14 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                                           "_live_device_ids", None))
         from cloudberry_tpu.parallel.transport import make_transport
 
-        tx = make_transport(self.session.config.interconnect.backend, nseg)
+        ic = self.session.config.interconnect
+        tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
         names = self._resident_names()
         _, res_specs = prepare_dist_inputs(None, self.session, names=names)
 
         def prelude_seg(tables):
             low = DistLowerer(tables, nseg, use_pallas=self._use_pallas,
-                              tx=tx)
+                              tx=tx, packed=self._packed)
             outs = [_add_seg(low.lower_shared(b)) for b in shape.builds]
             return outs, _reduce_checks(low.checks)
 
@@ -591,7 +596,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             acc_cols, acc_sel = _strip_seg(tuple(acc))
             low = _DistReplacingLowerer(
                 {}, nseg, {id(shape.replace_node): (acc_cols, acc_sel)},
-                use_pallas=self._use_pallas, tx=tx)
+                use_pallas=self._use_pallas, tx=tx, packed=self._packed)
             cols, sel = low.lower(shape.root)
             out = {f.name: cols[f.name][None] for f in shape.root.fields}
             return out, sel[None], _reduce_checks(low.checks)
@@ -618,7 +623,8 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                        for i, b in enumerate(shape.builds)}
             low = _DistTileLowerer(tables, nseg, shape.stream,
                                    tile_n.reshape(()), replace,
-                                   use_pallas=self._use_pallas, tx=tx)
+                                   use_pallas=self._use_pallas, tx=tx,
+                                   packed=self._packed)
             pcols, psel = low.lower(shape.partial_plan)
             checks = dict(low.checks)
             acc_cols, acc_sel = _strip_seg(tuple(acc))
@@ -792,7 +798,8 @@ class DistTopNTiledExecutable(DistTiledExecutable):
                        for i, b in enumerate(shape.builds)}
             low = _DistTileLowerer(tables, nseg, shape.stream,
                                    tile_n.reshape(()), replace,
-                                   use_pallas=self._use_pallas, tx=tx)
+                                   use_pallas=self._use_pallas, tx=tx,
+                                   packed=self._packed)
             pcols, psel = low.lower(shape.partial_plan)
             checks = dict(low.checks)
             acc_cols, acc_sel = _strip_seg(tuple(acc))
@@ -801,7 +808,7 @@ class DistTopNTiledExecutable(DistTiledExecutable):
             csel = jnp.concatenate([acc_sel, psel])
             low2 = _DistReplacingLowerer(
                 {}, nseg, {id(mleaf): (ccols, csel)},
-                use_pallas=self._use_pallas, tx=tx)
+                use_pallas=self._use_pallas, tx=tx, packed=self._packed)
             scols, ssel = low2.lower(msort)
             checks.update(low2.checks)
             return _add_seg(({n: scols[n][:m] for n in names},
@@ -837,15 +844,15 @@ class DistSortTiledExecutable(DistTiledExecutable):
                                           "_live_device_ids", None))
         from cloudberry_tpu.parallel.transport import make_transport
 
-        tx = make_transport(self.session.config.interconnect.backend,
-                            nseg)
+        ic = self.session.config.interconnect
+        tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
         rnames = self._resident_names()
         _, res_specs = prepare_dist_inputs(None, self.session,
                                            names=rnames)
 
         def prelude_seg(tables):
             low = DistLowerer(tables, nseg, use_pallas=self._use_pallas,
-                              tx=tx)
+                              tx=tx, packed=self._packed)
             outs = [_add_seg(low.lower_shared(b)) for b in shape.builds]
             return outs, _reduce_checks(low.checks)
 
@@ -864,7 +871,8 @@ class DistSortTiledExecutable(DistTiledExecutable):
                        for i, b in enumerate(shape.builds)}
             low = _DistTileLowerer(tables, nseg, shape.stream,
                                    tile_n.reshape(()), replace,
-                                   use_pallas=self._use_pallas, tx=tx)
+                                   use_pallas=self._use_pallas, tx=tx,
+                                   packed=self._packed)
             pcols, psel = low.lower(shape.partial_plan)
             n = psel.shape[0]
             keys = []

@@ -44,15 +44,30 @@ class XlaCollectives:
     def psum(self, x, axis):
         return jax.lax.psum(x, axis)
 
+    def pmax(self, x, axis):
+        return jax.lax.pmax(x, axis)
+
 
 class RingCollectives:
     """ppermute-composed collectives (see module docstring: all_gather
-    and psum are true neighbor rings; all_to_all rotates by k)."""
+    and psum are true neighbor rings; all_to_all rotates by k).
+
+    ``chunks`` > 1 splits each all_to_all block along its ROW axis into
+    that many independent contiguous slices — one ppermute per
+    (hop, chunk) — so the compiler can overlap hop-k's rotation of one
+    chunk with the placement of the previous chunk (the software-
+    pipelined ring; profitable on real ICI links for the large packed
+    motion buffers, a wash for the small ones). The row axis is the
+    bucket capacity, a power-of-two rung, so any pow2 chunk count
+    divides it; an indivisible count falls back to whole-block hops.
+    Chunking never changes results: the slices are disjoint and
+    reassembled in order."""
 
     name = "ring"
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, chunks: int = 1):
         self.n = n
+        self.chunks = max(int(chunks), 1)
 
     def _shift(self, x, axis, by: int = 1):
         perm = [(i, (i + by) % self.n) for i in range(self.n)]
@@ -82,13 +97,28 @@ class RingCollectives:
         idx = jax.lax.axis_index(axis)
         n = self.n
         out = jnp.zeros_like(x)
+        # a block is (rows, ...) once the destination axis is selected;
+        # chunk along the contiguous row axis (bucket capacity — a pow2
+        # rung under the capacity ladder, so pow2 chunk counts divide it)
+        nch = self.chunks if (x.ndim > 1
+                              and x.shape[1] % self.chunks == 0) else 1
         for k in range(n):
             # after shifting by k, this segment sees the block that
             # segment (idx - k) addressed to destination idx... select
             # our destination slot BEFORE shifting to move one block
             src = (idx - k) % n
             block = jnp.take(x, (idx + k) % n, axis=0)  # dest = idx + k
-            moved = self._shift(block, axis, by=k) if k else block
+            if k == 0:
+                moved = block
+            elif nch > 1:
+                # chunked hop: independent per-chunk ppermutes let the
+                # scheduler start chunk c+1's rotation while chunk c is
+                # being placed — a software pipeline over the slices
+                parts = jnp.split(block, nch, axis=0)
+                moved = jnp.concatenate(
+                    [self._shift(p, axis, by=k) for p in parts], axis=0)
+            else:
+                moved = self._shift(block, axis, by=k)
             out = out.at[src].set(moved)
         return out
 
@@ -100,11 +130,19 @@ class RingCollectives:
             acc = acc + cur
         return acc
 
+    def pmax(self, x, axis):
+        acc = x
+        cur = x
+        for _ in range(self.n - 1):
+            cur = self._shift(cur, axis)
+            acc = jnp.maximum(acc, cur)
+        return acc
 
-def make_transport(backend: str, n_segments: int):
+
+def make_transport(backend: str, n_segments: int, chunks: int = 1):
     if backend == "xla":
         return XlaCollectives()
     if backend == "ring":
-        return RingCollectives(n_segments)
+        return RingCollectives(n_segments, chunks=chunks)
     raise ValueError(f"unknown interconnect backend {backend!r} "
                      "(known: xla, ring)")

@@ -24,6 +24,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+from cloudberry_tpu.exec.kernels import rung_up
 from cloudberry_tpu.plan import expr as ex
 from cloudberry_tpu.plan import nodes as N
 from cloudberry_tpu.plan.sharding import Sharding
@@ -331,12 +332,18 @@ class Distributor:
             # and a runtime filter below only removes rows — never grows a
             # bucket past it. Estimates must not undercut it (a skewed hot
             # key would trip the overflow check the exact count prevents).
-            m.bucket_cap = max(exact, 8)
+            # Rounded up to its capacity rung (kernels.rung_up) so equal-
+            # shaped motions share compiled executables.
+            m.bucket_cap = rung_up(max(exact, 8))
             m.out_capacity = m.bucket_cap * self.nseg
             return m, m.out_capacity
         # capacity-based flow control (the ic_udpifc.c:3018 analog): each
         # destination bucket holds factor × fair share; overflow is a
-        # detected runtime error, never a silent drop
+        # detected runtime error that promotes the motion one capacity
+        # rung and retries (exec/executor.py:grow_expansion) — never a
+        # silent drop. The seed rung comes from the planner estimate, so
+        # padded bytes track expected volume, and skew climbs a BOUNDED
+        # power-of-two ladder instead of forcing worst-case buffers.
         m.bucket_cap = max(int(math.ceil(cap / self.nseg * factor)), 8)
         if est_rows is not None:
             # a runtime filter shrank the input: size buckets as if the
@@ -347,6 +354,7 @@ class Distributor:
             est_bucket = max(int(math.ceil(
                 min(est_rows, cap) / self.nseg * factor)), 64)
             m.bucket_cap = min(m.bucket_cap, est_bucket)
+        m.bucket_cap = rung_up(m.bucket_cap)
         m.out_capacity = m.bucket_cap * self.nseg
         return m, m.out_capacity
 

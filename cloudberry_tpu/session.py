@@ -90,7 +90,20 @@ class Session:
         self._vmem = VmemTracker(self.config.resource.total_mem_bytes)
         self._stmt_ids = __import__("itertools").count(1)
         # prepared-statement cache: sql text -> (tables, versions, nseg, run)
+        # LRU + lock-guarded (the store-scan cache discipline): hits
+        # reorder the dict, and shared-session server mode runs
+        # concurrent readers
         self._stmt_cache: dict = {}
+        self._stmt_lock = __import__("threading").Lock()
+        # capacity-rung executable cache: one compiled SPMD program per
+        # (statement, motion-rung signature) — skew promotion climbs a
+        # power-of-two bucket ladder, and each rung's executable compiles
+        # at most once per session (bounded recompiles, exec/dist_executor)
+        self._rung_cache: dict = {}
+        self._rung_lock = __import__("threading").Lock()
+        # counts-only shard layout (planning fast path; sharded_table
+        # materializes the actual arrays for execution)
+        self._shard_count_cache: dict = {}
         # spill diagnostics for the LAST statement (None = not tiled)
         self.last_tiled_report = None
         # adaptive-capacity growths this session (expansion-overflow
@@ -218,7 +231,11 @@ class Session:
                 return False
             self.config = self.config.with_overrides(n_segments=n)
             self._shard_cache.clear()
-            self._stmt_cache.clear()
+            self._shard_count_cache.clear()
+            with self._stmt_lock:
+                self._stmt_cache.clear()
+            with self._rung_lock:
+                self._rung_cache.clear()
             self._store_scan_cache.clear()
             return True
 
@@ -328,8 +345,13 @@ class Session:
             try:
                 return self._execute_and_cache(query, plan)
             except ExecError as e:
-                self._stmt_cache.pop(query, None)  # drop the failed runner
-                if not grow_expansion(plan, str(e)):
+                with self._stmt_lock:  # drop the failed runner
+                    self._stmt_cache.pop(query, None)
+                # allow_fallback: this loop may be retrying a program
+                # served from the rung cache, whose check messages can
+                # embed node ids from an equivalent, since-collected
+                # plan — blanket growth still guarantees progress here
+                if not grow_expansion(plan, str(e), allow_fallback=True):
                     raise
                 self.growth_events += 1
                 from cloudberry_tpu.exec.resource import RunawayError
@@ -549,8 +571,13 @@ class Session:
     def _cached_statement(self, query: str):
         """(runner, cost) from a live cache entry, else None — returned
         together so the caller never re-indexes an entry a concurrent
-        thread may have evicted."""
-        entry = self._stmt_cache.get(query)
+        thread may have evicted. LRU: a hit moves the entry to the
+        dict's end (under the lock — hits MUTATE the dict) so hot
+        prepared statements survive bursts of one-off queries."""
+        with self._stmt_lock:
+            entry = self._stmt_cache.pop(query, None)
+            if entry is not None:
+                self._stmt_cache[query] = entry  # LRU touch
         if entry is None:
             return None
         from cloudberry_tpu.exec.udf import registry_version
@@ -568,7 +595,8 @@ class Session:
             except KeyError:
                 stale = True
         if stale:
-            self._stmt_cache.pop(query, None)  # free the compiled program
+            with self._stmt_lock:  # free the compiled program
+                self._stmt_cache.pop(query, None)
             return None
         return runner, cost
 
@@ -582,10 +610,10 @@ class Session:
             runner = lambda: X.run_executable(
                 exe, X.prepare_inputs(exe, self, segment=seg))
         elif self.config.n_segments > 1:
-            from cloudberry_tpu.exec.dist_executor import (
-                compile_distributed, execute_distributed)
+            from cloudberry_tpu.exec.dist_executor import \
+                execute_distributed
 
-            fn = compile_distributed(plan, self)
+            fn = self._rung_executable(query, plan, names)
             runner = lambda: execute_distributed(plan, self, fn)
         else:
             exe = X.compile_plan(plan, self)
@@ -603,16 +631,79 @@ class Session:
 
     def _cache_statement(self, query: str, names, runner,
                          cost: int = 0) -> None:
-        if len(self._stmt_cache) >= self._STMT_CACHE_MAX:
-            # FIFO eviction keeps the cache (and its pinned XLA programs)
-            # bounded under literal-inlining workloads
-            self._stmt_cache.pop(next(iter(self._stmt_cache)))
         from cloudberry_tpu.exec.udf import registry_version
 
-        self._stmt_cache[query] = (
+        entry = (
             names, self._table_versions(names),
             self.config.n_segments,
             (self.catalog.ddl_version, registry_version()), runner, cost)
+        with self._stmt_lock:
+            self._stmt_cache.pop(query, None)  # re-insert at the tail
+            while len(self._stmt_cache) >= self._STMT_CACHE_MAX:
+                # LRU eviction (hits reorder, so the head really is the
+                # least recently used) keeps the cache and its pinned
+                # XLA programs bounded under literal-inlining workloads
+                self._stmt_cache.pop(next(iter(self._stmt_cache)))
+            self._stmt_cache[query] = entry
+
+    # ----------------------------------------------- capacity-rung cache
+    # Redistribute bucket capacities live on a power-of-two rung ladder
+    # (plan/distribute.py seeds a rung, skew overflow promotes one —
+    # exec/executor.py:grow_expansion). Each rung changes motion buffer
+    # SHAPES, hence needs its own compiled SPMD program; this cache keeps
+    # every rung's executable for the session so recompiles are bounded
+    # by the ladder height per motion shape, and re-promoted statements
+    # land on a cached program.
+
+    _RUNG_CACHE_MAX = 32
+
+    def _motion_rung_sig(self, plan) -> tuple:
+        from cloudberry_tpu.exec import executor as X
+        from cloudberry_tpu.plan import nodes as N
+
+        # joins ride in the signature too: adaptive growth also resizes
+        # PJoin.out_capacity (expansion overflow), and a retry must not
+        # be served the pre-growth executable
+        sig = []
+        for n in X.all_nodes(plan):
+            if isinstance(n, N.PMotion):
+                sig.append((n.kind, n.bucket_cap, n.out_capacity,
+                            n.pre_compact))
+            elif isinstance(n, N.PJoin):
+                sig.append(("join", n.out_capacity))
+        return tuple(sig)
+
+    def _rung_executable(self, query: str, plan, names):
+        """Compiled distributed program for this plan's motion rungs,
+        from the session cache when an equivalent (same statement, same
+        table versions, same rung signature) program already exists."""
+        from cloudberry_tpu.exec.dist_executor import compile_distributed
+        from cloudberry_tpu.exec.udf import registry_version
+
+        # plans that bake per-execution state into the program (folded
+        # sequence nextval literals) or read outside the version system
+        # (external tables) must compile fresh every time — reusing the
+        # executable would replay the baked values
+        if getattr(plan, "_no_stmt_cache", False) \
+                or self._any_external(names):
+            return compile_distributed(plan, self)
+        try:
+            versions = self._table_versions(names)
+        except KeyError:
+            return compile_distributed(plan, self)
+        key = (query, self.config.n_segments, self.catalog.ddl_version,
+               registry_version(), versions, self._motion_rung_sig(plan))
+        with self._rung_lock:
+            fn = self._rung_cache.pop(key, None)
+            if fn is not None:
+                self._rung_cache[key] = fn  # LRU touch
+                return fn
+        fn = compile_distributed(plan, self)
+        with self._rung_lock:
+            while len(self._rung_cache) >= self._RUNG_CACHE_MAX:
+                self._rung_cache.pop(next(iter(self._rung_cache)))
+            self._rung_cache[key] = fn
+        return fn
 
     def explain(self, query: str) -> str:
         from cloudberry_tpu.sql.parser import parse_sql
@@ -663,13 +754,14 @@ class Session:
         for cname, vm in t.validity.items():
             phys_cols[f"$nn:{cname}"] = np.asarray(vm, dtype=np.bool_)
         if t.policy.kind == "replicated":
-            st = ShardedTable(phys_cols,
-                              np.full(nseg, t.num_rows, dtype=np.int64),
+            st = ShardedTable(phys_cols, self.shard_counts(name),
                               max(t.num_rows, 1), True, version)
         else:
             assign = t.shard_assignment(nseg)
-            counts = np.bincount(assign, minlength=nseg).astype(np.int64) \
-                if len(assign) else np.zeros(nseg, dtype=np.int64)
+            # ONE derivation of per-segment counts (shard_counts) feeds
+            # both the planner's capacities and this materialization —
+            # reusing this call's assignment so rows hash exactly once
+            counts = self.shard_counts(name, _assign=assign)
             cap = max(int(counts.max()) if len(counts) else 0, 1)
             cols = {}
             order = np.argsort(assign, kind="stable") if len(assign) else assign
@@ -685,5 +777,33 @@ class Session:
         self._shard_cache[key] = st
         return st
 
+    def shard_counts(self, name: str, _assign=None) -> np.ndarray:
+        """Per-segment row counts WITHOUT materializing the (nseg, cap)
+        shard arrays — the planner (shard capacities, motion sizing)
+        only needs the counts; execution materializes via
+        sharded_table, which passes its already-computed row assignment
+        through ``_assign`` so the per-row hash runs once. ONE
+        derivation either way, so the two always agree."""
+        t = self.catalog.table(name)
+        t.ensure_loaded()
+        nseg = self.config.n_segments
+        version = getattr(t, "_version", t.stats.row_count)
+        key = (name, nseg)
+        hit = self._shard_count_cache.get(key)
+        if hit is not None and hit[0] == version:
+            return hit[1]
+        st = self._shard_cache.get(f"{name}@{nseg}")
+        if st is not None and st.version == version:
+            counts = st.counts  # a materialized layout already knows
+        elif t.policy.kind == "replicated":
+            counts = np.full(nseg, t.num_rows, dtype=np.int64)
+        else:
+            assign = t.shard_assignment(nseg) if _assign is None \
+                else _assign
+            counts = np.bincount(assign, minlength=nseg).astype(np.int64)\
+                if len(assign) else np.zeros(nseg, dtype=np.int64)
+        self._shard_count_cache[key] = (version, counts)
+        return counts
+
     def shard_capacity(self, name: str) -> int:
-        return self.sharded_table(name).capacity
+        return max(int(self.shard_counts(name).max()), 1)

@@ -1,6 +1,8 @@
 """bench.py roofline context (VERDICT r5 item 8): every emitted speedup
 carries a bytes-scanned ÷ HBM-bandwidth denominator, including REPLAY
-mode where the bytes come from the static schema estimate."""
+mode where the bytes come from the static schema estimate — and, since
+the packed-wire motion PR, an interconnect record (collective launches +
+bytes-on-wire per query at the 8-segment plan shape)."""
 
 import bench
 
@@ -29,3 +31,31 @@ def test_roofline_context_replay_and_live():
     rec = live["per_query"]["q1"]
     assert rec["scan_gbps"] == 200.0
     assert 0 < rec["hbm_frac"] < 1
+
+
+def test_interconnect_context_records_shuffle_volume():
+    """The bench JSON's interconnect record: metadata-only planning at 8
+    segments totals every motion's launches and bytes-on-wire, packed vs
+    per-column — packed must need fewer launches AND fewer bytes."""
+    import cloudberry_tpu as cb
+    from tools.tpchgen import load_tpch
+
+    s = cb.Session()
+    load_tpch(s, sf=0.01, seed=3, tables=["lineitem", "orders",
+                                          "customer", "nation"])
+    ic = bench.interconnect_context(s, ["q3", "q10"], nseg=8)
+    assert ic["n_segments"] == 8
+    for qn in ("q3", "q10"):
+        rec = ic["per_query"][qn]
+        assert rec["motions"] >= 1
+        assert rec["launches_packed"] == rec["motions"]
+        assert rec["launches_percol"] > rec["launches_packed"]
+        # same bucket shapes in this static accounting, so packed pays
+        # only the word-alignment overhead — pinned small; the real
+        # padded-bytes win (adaptive rung vs worst-case static buckets)
+        # is measured live by tools/ic_bench.py --format packed|percol
+        assert 0 < rec["wire_bytes_packed"] \
+            < 1.25 * rec["wire_bytes_percol"]
+    # the metadata pass must not have materialized 8-segment shard
+    # arrays on the 1-segment session (counts-only planning fast path)
+    assert not any(k.endswith("@8") for k in s._shard_cache)

@@ -11,21 +11,231 @@ exactly the three collectives the engine's motions lower to
 - HASH redistribute   -> all_to_all
 - check reduction     -> psum
 
+Two modes:
+
+- primitive (default): raw collective bandwidth per payload size.
+- motion (``--format packed|percol|both``): a full TPC-H-shaped hash
+  SHUFFLE through the engine's real motion lowering
+  (exec/dist_executor.py DistLowerer._redistribute) — ``packed`` ships
+  every column plus the validity mask in ONE fused all_to_all on the
+  wire format of exec/kernels.py, sized to the adaptive capacity rung
+  the ladder converges to; ``percol`` replays the legacy one-collective-
+  per-column launches over planner-worst-case buckets. Reports launches
+  (counted at trace time), bytes-on-wire, padding efficiency, and wall
+  time; ``both`` additionally cross-checks per-column checksums between
+  the formats.
+
 Runs on whatever mesh is visible: 8 virtual CPU devices (tests), a real
 TPU slice, or a multi-host cluster joined via mesh.init_distributed
-(CBTPU_* env). Prints one JSON line per (collective, payload size) with
-achieved per-segment bandwidth.
+(CBTPU_* env). Prints one JSON line per measurement; ``--csv`` appends
+the same rows to a CSV file.
 
-Usage: python -m tools.ic_bench [--segs N] [--sizes bytes,bytes,...]
+Usage: python -m tools.ic_bench [--segs N] [--sizes bytes,...]
+       python -m tools.ic_bench --format packed [--rows N] [--cols 10]
+                                [--skew 0.5] [--csv out.csv]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
+
+
+class CountingTransport:
+    """Transport proxy counting data-plane collective launches at trace
+    time (all_gather / all_to_all; the stats pmax and check psum are
+    control-plane and excluded from the launch comparison)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.launches = 0
+
+    def all_gather(self, x, axis):
+        self.launches += 1
+        return self.inner.all_gather(x, axis)
+
+    def all_to_all(self, x, axis):
+        self.launches += 1
+        return self.inner.all_to_all(x, axis)
+
+    def psum(self, x, axis):
+        return self.inner.psum(x, axis)
+
+    def pmax(self, x, axis):
+        return self.inner.pmax(x, axis)
+
+
+def shuffle_columns(n_cols: int, rows: int, nseg: int, skew: float,
+                    seed: int = 11) -> dict:
+    """A TPC-H-shaped wide row set: int64 keys/amounts (DECIMAL cents ride
+    int64), f64 prices, int32 dates, an f32 and a bool flag — ``n_cols``
+    columns per segment, (nseg, rows) each. Column "c0" is the hash key;
+    ``skew`` is the fraction of rows sharing ONE hot key."""
+    rng = np.random.default_rng(seed)
+    cols: dict[str, np.ndarray] = {}
+    kinds = ["i64", "i64", "f64", "i32", "i64", "f64", "i32", "f32",
+             "bool", "i64"]
+    for i in range(n_cols):
+        kind = kinds[i % len(kinds)]
+        if i == 0:
+            k = rng.integers(0, 100_000, (nseg, rows))
+            hot = rng.random((nseg, rows)) < skew
+            cols["c0"] = np.where(hot, 7, k).astype(np.int64)
+        elif kind == "i64":
+            cols[f"c{i}"] = rng.integers(-1 << 40, 1 << 40, (nseg, rows))
+        elif kind == "f64":
+            cols[f"c{i}"] = rng.standard_normal((nseg, rows))
+        elif kind == "i32":
+            cols[f"c{i}"] = rng.integers(0, 20_000, (nseg, rows)
+                                         ).astype(np.int32)
+        elif kind == "f32":
+            cols[f"c{i}"] = rng.standard_normal(
+                (nseg, rows)).astype(np.float32)
+        else:
+            cols[f"c{i}"] = rng.integers(0, 2, (nseg, rows)
+                                         ).astype(np.bool_)
+    return cols
+
+
+def bench_shuffle(fmt: str, nseg: int, rows: int, n_cols: int,
+                  skew: float, backend: str, reps: int,
+                  capacity_factor: float = 2.0) -> dict:
+    """One shuffle measurement through the engine's real motion lowering;
+    returns the JSON record (and the received checksums under "_sums"
+    for the both-formats parity check)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from cloudberry_tpu.exec import kernels as K
+    from cloudberry_tpu.exec.dist_executor import DistLowerer, _shard_map
+    from cloudberry_tpu.parallel.mesh import SEG_AXIS, segment_mesh
+    from cloudberry_tpu.parallel.transport import make_transport
+    from cloudberry_tpu.plan import expr as ex
+    from cloudberry_tpu.plan import nodes as N
+    from cloudberry_tpu.types import INT64
+    from cloudberry_tpu.utils import hashing
+
+    mesh = segment_mesh(nseg)
+    data = shuffle_columns(n_cols, rows, nseg, skew)
+    packed = fmt == "packed"
+
+    # bucket capacity: percol replays the static planner discipline
+    # (fair share × capacity_factor); packed sizes to the adaptive rung
+    # the ladder converges to — the actual global max bucket, rounded up
+    dest_all = hashing.jump_consistent_hash_np(
+        hashing.hash_columns_np([data["c0"].reshape(-1)]), nseg)
+    actual_max = int(np.bincount(
+        np.repeat(np.arange(nseg), rows) * nseg + dest_all,
+        minlength=nseg * nseg).max())
+    if packed:
+        bucket_cap = K.rung_up(actual_max)
+    else:
+        bucket_cap = max(int(np.ceil(rows / nseg * capacity_factor)), 8)
+        bucket_cap = max(bucket_cap, actual_max)  # complete, not error
+
+    node = N.PMotion(None, "redistribute",
+                     hash_keys=[ex.ColumnRef("c0", INT64)])
+    node.bucket_cap = bucket_cap
+
+    tx = CountingTransport(make_transport(backend, nseg))
+
+    def _cksum(v, osel):
+        # order-independent exact checksum: sum of the value's u32 words
+        # over selected rows, in uint64 (no float reduction-order noise —
+        # the packed/percol parity comparison must be exact)
+        if v.dtype == jnp.bool_:
+            w = v.astype(jnp.uint32)[..., None]
+        else:
+            w = jax.lax.bitcast_convert_type(v, jnp.uint32)
+            if w.ndim == v.ndim:
+                w = w[..., None]
+        return jnp.sum(jnp.where(osel[..., None], w,
+                                 jnp.uint32(0)).astype(jnp.uint64))
+
+    def seg_fn(x):
+        cols = {k: v[0] for k, v in x.items()}
+        sel = jnp.ones((rows,), dtype=jnp.bool_)
+        low = DistLowerer({}, nseg, tx=tx, packed=packed)
+        out, osel = low._redistribute(node, cols, sel)
+        # checksums keep every received column alive (and cross-check
+        # packed vs percol when both formats run)
+        return {k: _cksum(v, osel)[None] for k, v in out.items()}
+
+    in_specs = ({k: P(SEG_AXIS, None) for k in data},)
+    fn = jax.jit(_shard_map(seg_fn, mesh, in_specs, P(SEG_AXIS)))
+    out = jax.block_until_ready(fn(data))  # trace + compile (counts tx)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        out = jax.block_until_ready(fn(data))
+        best = min(best, time.time() - t0)
+
+    layout = K.wire_layout({k: jnp.asarray(v[0]).dtype
+                            for k, v in data.items()})
+    n_bufrows = nseg * bucket_cap
+    if packed:
+        wire = n_bufrows * layout.row_bytes()
+    else:
+        wire = sum(n_bufrows * np.dtype(v.dtype).itemsize
+                   for v in data.values()) + n_bufrows  # + bool sel buffer
+    payload = rows * layout.payload_bytes()  # rows actually routed
+    rec = {
+        "mode": "shuffle",
+        "format": fmt,
+        "backend": backend,
+        "n_segments": nseg,
+        "rows_per_seg": rows,
+        "n_cols": n_cols,
+        "skew": skew,
+        "bucket_cap": bucket_cap,
+        "collective_launches": tx.launches,
+        "wire_bytes_per_seg": int(wire),
+        "payload_bytes_per_seg": int(payload),
+        "padding_frac": round(1.0 - payload / wire, 4),
+        "wall_ms": round(best * 1e3, 3),
+        "gbps_per_seg": round(wire * 8 / best / 1e9, 3),
+    }
+    # keep exact uint64 checksums (a float() here would collapse low-bit
+    # differences past 2^53 and mask real corruption in the parity check)
+    rec["_sums"] = {k: int(np.asarray(v).sum(dtype=np.uint64))
+                    for k, v in out.items()}
+    return rec
+
+
+def _emit(rec: dict, csv_path: str | None) -> None:
+    sums = rec.pop("_sums", None)
+    print(json.dumps(rec), flush=True)
+    if csv_path:
+        import csv
+        import sys
+
+        fields = list(rec)
+        path = csv_path
+        if os.path.exists(path):
+            with open(path, newline="") as f:
+                header = f.readline().strip().split(",")
+            if header != fields:
+                # primitive-mode and shuffle-mode rows have different
+                # schemas: never append misaligned rows under a foreign
+                # header — divert to a per-schema sibling file instead
+                base, ext = os.path.splitext(path)
+                path = f"{base}.{rec.get('mode', 'primitive')}" \
+                       f"{ext or '.csv'}"
+                print(f"csv schema differs from {csv_path}; "
+                      f"writing to {path}", file=sys.stderr)
+        new = not os.path.exists(path)
+        with open(path, "a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=fields)
+            if new:
+                w.writeheader()
+            w.writerow(rec)
+    if sums is not None:
+        rec["_sums"] = sums
 
 
 def main() -> None:
@@ -33,14 +243,26 @@ def main() -> None:
     ap.add_argument("--segs", type=int, default=0,
                     help="segments (default: all visible devices)")
     ap.add_argument("--sizes", type=str, default="65536,1048576,16777216",
-                    help="per-segment payload bytes, comma-separated")
+                    help="per-segment payload bytes, comma-separated "
+                         "(primitive mode)")
     ap.add_argument("--backend", default="xla",
                     help="motion transport: xla | ring "
                          "(parallel/transport.py)")
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--format", choices=["packed", "percol", "both"],
+                    default=None,
+                    help="motion-level shuffle mode: packed (one fused "
+                         "all_to_all) vs percol (one collective per "
+                         "column); 'both' runs the pair and cross-checks")
+    ap.add_argument("--rows", type=int, default=50000,
+                    help="rows per segment (shuffle mode)")
+    ap.add_argument("--cols", type=int, default=10,
+                    help="columns in the shuffled row set")
+    ap.add_argument("--skew", type=float, default=0.0,
+                    help="fraction of rows sharing one hot key")
+    ap.add_argument("--csv", default=None,
+                    help="append measurements to this CSV file")
     args = ap.parse_args()
-
-    import os
 
     import jax
 
@@ -50,14 +272,39 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    from jax.sharding import PartitionSpec as P
-
-    from cloudberry_tpu.parallel.mesh import (SEG_AXIS, init_distributed,
-                                              segment_mesh)
-    from cloudberry_tpu.exec.dist_executor import _shard_map
+    from cloudberry_tpu.parallel.mesh import init_distributed
 
     init_distributed()
     nseg = args.segs or len(jax.devices())
+
+    if args.format is not None:
+        fmts = ["percol", "packed"] if args.format == "both" \
+            else [args.format]
+        recs = {}
+        for fmt in fmts:
+            recs[fmt] = bench_shuffle(fmt, nseg, args.rows, args.cols,
+                                      args.skew, args.backend, args.reps)
+            _emit(recs[fmt], args.csv)
+        if len(recs) == 2:
+            a, b = recs["percol"]["_sums"], recs["packed"]["_sums"]
+            ok = set(a) == set(b) and all(a[k] == b[k] for k in a)
+            print(json.dumps({
+                "mode": "shuffle-parity",
+                "checksums_match": bool(ok),
+                "launch_ratio": round(
+                    recs["percol"]["collective_launches"]
+                    / max(recs["packed"]["collective_launches"], 1), 2),
+                "wire_bytes_ratio": round(
+                    recs["percol"]["wire_bytes_per_seg"]
+                    / max(recs["packed"]["wire_bytes_per_seg"], 1), 3),
+            }), flush=True)
+        return
+
+    from jax.sharding import PartitionSpec as P
+
+    from cloudberry_tpu.parallel.mesh import SEG_AXIS, segment_mesh
+    from cloudberry_tpu.exec.dist_executor import _shard_map
+
     mesh = segment_mesh(nseg)
 
     def bench(fn, x, label, nbytes):
@@ -67,13 +314,14 @@ def main() -> None:
             t0 = time.time()
             out = jax.block_until_ready(fn(x))
             best = min(best, time.time() - t0)
-        print(json.dumps({
+        rec = {
             "collective": label,
             "payload_bytes_per_seg": nbytes,
             "n_segments": nseg,
             "wall_ms": round(best * 1e3, 3),
             "gbps_per_seg": round(nbytes * 8 / best / 1e9, 3),
-        }), flush=True)
+        }
+        _emit(rec, args.csv)
         return out
 
     from cloudberry_tpu.parallel.transport import make_transport
