@@ -154,6 +154,37 @@ def interconnect_context(session, qnames, nseg: int = 8) -> dict:
     return out
 
 
+def compile_cache_context(session, qnames) -> dict:
+    """The compile-cache record next to the roofline/interconnect records:
+    per query, how the generic-plan layer (sched/paramplan.py) sees it —
+    how many literal tokens the skeleton hoists, how many plan slots bind
+    as device inputs, and whether the statement is generic-eligible (a
+    repeat with different literals reuses the compiled program, zero
+    recompiles). Metadata-only: plans, never compiles or executes."""
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.sched import paramplan
+    from cloudberry_tpu.sql.parser import parse_sql
+    from tools.tpch_queries import QUERIES
+
+    out = {"per_query": {}}
+    for qn in qnames:
+        q = QUERIES[qn]
+        norm = paramplan.normalize(q)
+        rec = {"params": len(norm[1]) if norm else 0,
+               "slots": 0, "generic": False}
+        try:
+            plan = plan_statement(parse_sql(q), session, {}).plan
+            _, bindings, _, slots = paramplan.analyze(session, plan)
+            rec["slots"] = len(slots)
+            rec["generic"] = bool(
+                norm and norm[1]
+                and not getattr(plan, "_no_stmt_cache", False))
+        except Exception as e:  # metadata must never fail the bench
+            rec["error"] = f"{type(e).__name__}: {e}"
+        out["per_query"][qn] = rec
+    return out
+
+
 # tables each bench query touches (generation cost scales with SF — load
 # only what the selected queries scan)
 QUERY_TABLES = {
@@ -254,6 +285,7 @@ def replay_last_good(reason: str) -> None:
                 bytes_by_q=lg.get("scan_bytes"),
                 wall_by_q=lg.get("tpu_wall_s")),
             "interconnect": lg.get("interconnect"),
+            "compile_cache": lg.get("compile_cache"),
         })
     except Exception:
         emit({
@@ -428,6 +460,12 @@ def measure() -> None:
     except Exception as e:  # never fail the bench on the metadata pass
         log(f"interconnect context failed: {type(e).__name__}: {e}")
         interconnect = None
+    try:
+        # plan-cache view: parameterization/generic eligibility per query
+        compile_cache = compile_cache_context(session, qnames)
+    except Exception as e:
+        log(f"compile_cache context failed: {type(e).__name__}: {e}")
+        compile_cache = None
     per_q = ", ".join(
         f"{q}={s:.2f}x/{rows_s[q]/1e6:.0f}Mrows_s_chip"
         f"/{roofline['per_query'].get(q, {}).get('hbm_frac', 0):.3f}HBM"
@@ -442,6 +480,7 @@ def measure() -> None:
         "vs_baseline": round(geo / 5.0, 3),
         "roofline": roofline,
         "interconnect": interconnect,
+        "compile_cache": compile_cache,
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })
@@ -501,7 +540,8 @@ def main() -> None:
         }
         # measured roofline inputs ride along so a later REPLAY can
         # attach the real denominator instead of the schema estimate
-        for k in ("scan_bytes", "tpu_wall_s", "interconnect"):
+        for k in ("scan_bytes", "tpu_wall_s", "interconnect",
+                  "compile_cache"):
             if k in rec and rec[k] is not None:
                 lg[k] = rec[k]
         with open(LAST_GOOD, "w") as f:

@@ -121,6 +121,40 @@ class ResourceConfig:
 
 
 @dataclass(frozen=True)
+class SchedConfig:
+    """Statement scheduler — generic plans + the micro-batch dispatcher
+    (sched/paramplan.py, sched/dispatcher.py; the plan_cache.c /
+    gang-dispatch analog)."""
+
+    # Parameterized generic plans: hoist constant literals out of repeated
+    # statements so same-shape SQL shares ONE compiled XLA program with
+    # literals fed as device inputs (zero recompiles after the first
+    # execution of a statement shape). Plans that fold literals at plan
+    # time (nextval, changed point-lookup row counts, literal-dependent
+    # partition pruning) detect the fold via plan-signature mismatch and
+    # keep today's compile-per-text path.
+    generic_plans: bool = True
+    # Continuous micro-batch dispatcher in front of the server's session:
+    # coalesce same-skeleton statements per tick into one launch. Off by
+    # default — the server (or tools/serve_bench.py) opts in.
+    enabled: bool = False
+    # Statements coalesced into one stacked launch per skeleton per tick.
+    max_batch: int = 16
+    # Bounded request queue (backpressure): submits beyond this block
+    # briefly, then fail with SchedQueueFull — the admission-gate feed.
+    max_queue: int = 256
+    # Coalescing window: after the first request arrives, wait this long
+    # for same-skeleton company before flushing.
+    tick_s: float = 0.002
+    # Default per-request deadline; expired requests fail without
+    # executing (SchedDeadline).
+    deadline_s: float = 30.0
+    # Generic-plan variants kept per statement skeleton (distinct plan
+    # shapes: capacity rungs, 0-vs-1 point matches, per-segment counts).
+    max_variants: int = 4
+
+
+@dataclass(frozen=True)
 class StorageConfig:
     """Durable storage (PAX/AOCS analog, storage/table_store.py).
 
@@ -175,6 +209,7 @@ class Config:
     exec: ExecConfig = field(default_factory=ExecConfig)
     planner: PlannerConfig = field(default_factory=PlannerConfig)
     resource: ResourceConfig = field(default_factory=ResourceConfig)
+    sched: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
 

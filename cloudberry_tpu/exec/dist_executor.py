@@ -58,10 +58,13 @@ def prepare_dist_inputs(plan: N.PlanNode, session, names=None):
     return inputs, in_specs
 
 
-def compile_distributed(plan: N.PlanNode, session):
+def compile_distributed(plan: N.PlanNode, session, param_keys=None):
     """Build the jitted SPMD program once; reusable across calls (the
     prepared-statement analog — inputs are re-prepared per call from the
-    session's sharded-table cache)."""
+    session's sharded-table cache). ``param_keys`` (generic plans,
+    sched/paramplan.py) adds a replicated "$params" input: "$prm<slot>"
+    scalars every segment reads identically, so literal rebinding never
+    retraces the SPMD program."""
     from cloudberry_tpu.parallel.transport import make_transport
 
     nseg = session.config.n_segments
@@ -71,9 +74,13 @@ def compile_distributed(plan: N.PlanNode, session):
     tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
     packed = ic.packed_wire
     _, in_specs = prepare_dist_inputs(plan, session)
+    if param_keys:
+        in_specs["$params"] = {k: P() for k in param_keys}
+    X.count_compile(session)
 
     def seg_fn(tables):
-        low = DistLowerer(tables, nseg, tx=tx, packed=packed)
+        low = DistLowerer(tables, nseg, tx=tx, packed=packed,
+                          params=tables.get("$params"))
         cols, sel = low.lower(plan)
         out = {f.name: cols[f.name][None] for f in plan.fields}
         # reduce checks to replicated scalars (any segment tripped) so
@@ -164,8 +171,10 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 class DistLowerer(X.Lowerer):
     def __init__(self, tables, nseg: int, platform: str | None = None,
-                 use_pallas: bool = False, tx=None, packed: bool = True):
-        super().__init__(tables, platform=platform, use_pallas=use_pallas)
+                 use_pallas: bool = False, tx=None, packed: bool = True,
+                 params=None):
+        super().__init__(tables, platform=platform, use_pallas=use_pallas,
+                         params=params)
         self.nseg = nseg
         # motion transport (ic_modules.c vtable analog): XLA-native
         # collectives or ppermute ring compositions

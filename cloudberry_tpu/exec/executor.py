@@ -39,6 +39,9 @@ class Executable:
     # scans bound to pruned micro-partition reads (plan/scanprune.py);
     # their inputs key by scan identity, not table name
     store_scans: list = None  # type: ignore[assignment]
+    # the unjitted trace function — the micro-batch dispatcher vmaps it
+    # into stacked-parameter executables (sched/paramplan.py rung_fn)
+    raw_fn: Callable = None  # type: ignore[assignment]
 
 
 def execute(plan: N.PlanNode, session) -> ColumnBatch:
@@ -57,6 +60,16 @@ def keyed_scan(s: N.PScan) -> bool:
     return hasattr(s, "_store_parts") or hasattr(s, "_point_rows")
 
 
+def count_compile(session) -> None:
+    """Record one XLA program construction on the engine's shared counters
+    (exec/instrument.py StatementLog) — the compile-hit observability every
+    plan-cache consumer reads (zero after warmup is the generic-plan
+    contract, sched/paramplan.py)."""
+    log = getattr(session, "stmt_log", None)
+    if log is not None:
+        log.bump("compiles")
+
+
 def compile_plan(plan: N.PlanNode, session,
                  platform: str | None = None) -> Executable:
     scans = list(scans_of(plan))
@@ -65,14 +78,16 @@ def compile_plan(plan: N.PlanNode, session,
                           if not keyed_scan(s)})
     platform = platform or jax.default_backend()
     use_pallas = session.config.exec.use_pallas
+    count_compile(session)
 
     def run(tables):
-        low = Lowerer(tables, platform=platform, use_pallas=use_pallas)
+        low = Lowerer(tables, platform=platform, use_pallas=use_pallas,
+                      params=tables.get("$params"))
         cols, sel = low.lower(plan)
         out = {f.name: cols[f.name] for f in plan.fields}
         return out, sel, low.checks
 
-    return Executable(plan, jax.jit(run), table_names, store_scans)
+    return Executable(plan, jax.jit(run), table_names, store_scans, run)
 
 
 def prepare_tables(table_names: list[str], session,
@@ -126,20 +141,29 @@ def _assemble_inputs(table_names, store_scans, session, segment) -> dict:
 def _load_point_scan(scan: N.PScan, session, segment) -> dict:
     """Slice exactly the sidecar-matched rows (plan/pointlookup.py) out
     of the table — or its direct-dispatched shard — as the scan input."""
-    rows = scan._point_rows
-    t = session.catalog.table(scan.table_name)
+    return point_scan_slice(scan.table_name, scan._point_rows, session,
+                            segment)
+
+
+def point_scan_slice(table_name: str, rows, session, segment) -> dict:
+    """One point-bound scan's input columns: the matched rows sliced from
+    the table (or its direct-dispatched shard). Shared by normal input
+    assembly and the generic-plan fast rebind (sched/paramplan.py), which
+    re-slices per literal without re-planning. Slices stay HOST arrays —
+    jit converts at dispatch, and the micro-batch path stacks many
+    requests host-side before the single device transfer."""
+    t = session.catalog.table(table_name)
     t.ensure_loaded()
     out = {}
     if segment is None or t.policy.kind == "replicated":
         for c, v in t.data.items():
-            out[c] = jnp.asarray(np.asarray(v)[rows])
+            out[c] = np.asarray(v)[rows]
         for c, vm in t.validity.items():
-            out[f"$nn:{c}"] = jnp.asarray(
-                np.asarray(vm, dtype=np.bool_)[rows])
+            out[f"$nn:{c}"] = np.asarray(vm, dtype=np.bool_)[rows]
     else:
-        st = session.sharded_table(scan.table_name)
+        st = session.sharded_table(table_name)
         for c, v in st.columns.items():
-            out[c] = jnp.asarray(np.asarray(v[segment])[rows])
+            out[c] = np.asarray(v[segment])[rows]
     return out
 
 
@@ -423,8 +447,12 @@ class Lowerer:
     which overrides scan (per-segment inputs) and motion (collectives)."""
 
     def __init__(self, tables, platform: str | None = None,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, params=None):
         self.tables = tables
+        # runtime literal bindings for a generic plan (sched/paramplan.py):
+        # "$prm<slot>" -> scalar array, injected next to the columns when
+        # an expression carries Param leaves
+        self.params = params
         self.checks: dict[str, jnp.ndarray] = {}
         # replicated observability scalars (e.g. each redistribute's
         # observed bucket demand) — the distributed executor returns
@@ -503,6 +531,14 @@ class Lowerer:
                 arr = jnp.zeros((node.capacity,), dtype=jnp.bool_)
             cols[out] = arr
         n = node.num_rows if node.num_rows >= 0 else node.capacity
+        key = getattr(node, "_nrows_key", None)
+        if key is not None and self.params is not None \
+                and key in self.params:
+            # generic plan: the row count rides the $params input, so one
+            # compiled program serves every direct-dispatch segment (and
+            # every table version at unchanged capacity) — the count is
+            # data, the CAPACITY is the shape
+            n = self.params[key]
         sel = jnp.arange(node.capacity) < n
         return cols, sel
 
@@ -534,8 +570,13 @@ class Lowerer:
 
     def expr(self, e: ex.Expr, cols) -> jnp.ndarray:
         """Evaluate an expression; uncorrelated scalar subqueries (InitPlan
-        analog) are lowered once inside the same program and broadcast."""
+        analog) are lowered once inside the same program and broadcast;
+        Param leaves (generic plans) read their runtime binding from the
+        program's "$params" input."""
         subs = [n for n in ex.walk(e) if isinstance(n, ex.SubqueryScalar)]
+        if self.params is not None \
+                and any(isinstance(n, ex.Param) for n in ex.walk(e)):
+            cols = {**cols, **self.params}
         if not subs:
             return compile_expr(e)(cols)
         aug = dict(cols)

@@ -28,6 +28,20 @@ def compile_expr(e: ex.Expr) -> Callable[[Columns], jnp.ndarray]:
         val = np.asarray(e.value, dtype=e.dtype.np_dtype)
         return lambda cols: jnp.asarray(val)
 
+    if isinstance(e, ex.Param):
+        # runtime-bound literal: the Lowerer injects the slot's value next
+        # to the columns (from the program's "$params" input), so a generic
+        # plan re-executes with new literals WITHOUT retracing. A trace
+        # without bindings (non-generic recompile of a rewritten plan)
+        # bakes the build-time value — the original statement's constant.
+        name = e.input_name
+        fallback = None if e.value is None else \
+            np.asarray(e.value, dtype=e.dtype.np_dtype)
+        if fallback is None:
+            return lambda cols: cols[name]
+        return lambda cols: cols[name] if name in cols \
+            else jnp.asarray(fallback)
+
     if isinstance(e, ex.BinOp):
         lf, rf = compile_expr(e.left), compile_expr(e.right)
         op = _BINOPS[e.op]

@@ -40,6 +40,23 @@ class StatementLog:
         self._active: dict[int, dict] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
+        # engine-wide scheduler/plan-cache counters (compiles, dispatches,
+        # stmt_cache_hits, generic_hits, generic_builds, param_binds, ...):
+        # the compile-hit / parameterization observability the serving
+        # layer exposes via serve/meta.py "sched"
+        self.counters = collections.Counter()
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return int(self.counters.get(name, 0))
+
+    def counter_snapshot(self) -> dict:
+        with self._lock:
+            return {k: int(v) for k, v in sorted(self.counters.items())}
 
     def begin(self, sql: str, session_id: int = 0) -> int:
         sid = next(self._ids)
@@ -50,7 +67,7 @@ class StatementLog:
         return sid
 
     def finish(self, sid: int, status: str, rows: int = -1,
-               error: str | None = None) -> None:
+               error: str | None = None, **extra) -> None:
         with self._lock:
             entry = self._active.pop(sid, None)
             if entry is None:
@@ -60,6 +77,9 @@ class StatementLog:
             entry["rows"] = rows
             if error:
                 entry["error"] = error[:500]
+            # per-statement scheduler observability (compile count, cache
+            # path, batch membership) rides the history entry
+            entry.update(extra)
             self._recent.append(entry)
 
     def activity(self) -> list[dict]:

@@ -42,6 +42,31 @@ class Literal(Expr):
 
 
 @dataclass(frozen=True)
+class Param(Expr):
+    """Runtime-bound scalar literal — the PARAM_EXTERN analog.
+
+    A generic plan (sched/paramplan.py) hoists constant literals out of
+    filter/project expressions into numbered parameter slots; the compiled
+    program reads slot values from a ``$prm<slot>`` entry that
+    ``prepare_inputs``-time binding injects next to the table columns. Same-
+    shape statements then share ONE compiled executable with literals fed
+    as device inputs instead of baked constants.
+
+    ``value`` keeps the build-time literal: a program traced WITHOUT a
+    binding input (e.g. the expansion-growth retry recompiling a rewritten
+    plan on the non-generic path) bakes it as a constant — semantically the
+    original statement — and re-analysis of a rewritten plan recovers its
+    binding vector from it."""
+    slot: int
+    dtype: SqlType
+    value: Any = None
+
+    @property
+    def input_name(self) -> str:
+        return f"$prm{self.slot}"
+
+
+@dataclass(frozen=True)
 class BinOp(Expr):
     """op ∈ {+,-,*,/,=,<>,<,<=,>,>=,and,or}"""
     op: str
@@ -196,7 +221,7 @@ def rewrite(e: Expr, fn) -> Expr:
         if d is not None:
             object.__setattr__(out, "_out_dict", d)
         return out
-    # leaves (ColumnRef, Literal, IsValid, SubqueryScalar) pass through
+    # leaves (ColumnRef, Literal, Param, IsValid, SubqueryScalar) pass
     return e
 
 

@@ -1,0 +1,19 @@
+"""Statement scheduler — parameterized generic plans + the continuous
+micro-batch dispatcher (the plan_cache.c / gang-dispatch analog).
+
+Two layers:
+
+- ``paramplan``: literal parameterization. Same-shape statements share ONE
+  compiled XLA program keyed on the normalized statement skeleton, with
+  literals fed as device inputs (``$params``) instead of baked constants —
+  the generic-plan side of PostgreSQL's plan_cache.c, where the dominant
+  cost amortized is XLA compilation rather than planning.
+- ``dispatcher``: a bounded async request queue in front of a serving
+  Session that coalesces same-skeleton statements per tick into one
+  stacked (vmapped) launch — the continuous-batching shape of an
+  inference stack, applied to SQL dispatch.
+"""
+
+from cloudberry_tpu.sched.paramplan import normalize  # noqa: F401
+from cloudberry_tpu.sched.dispatcher import (  # noqa: F401
+    Dispatcher, SchedDeadline, SchedQueueFull)

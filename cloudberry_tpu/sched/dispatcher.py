@@ -1,0 +1,284 @@
+"""Continuous micro-batch dispatcher — the gang-dispatch analog.
+
+A bounded async request queue in front of a serving Session. Handler
+threads ``submit()`` statements and block on their result; ONE worker
+thread drains the queue each tick, groups requests by statement skeleton
+(sched/paramplan.normalize), and executes each group:
+
+- same-skeleton groups flush as ONE stacked (vmapped) launch through the
+  group's generic plan (paramplan.run_batch) — per-request host work is a
+  tokenize-only fast rebind (point lookups) or a sub-millisecond re-plan,
+  and the XLA launch cost amortizes across the batch;
+- everything else (non-parameterizable statements, writes, shape drift
+  mid-batch) falls back to ordinary sequential ``session.sql``.
+
+Flow control mirrors the reference's interconnect discipline: the queue is
+BOUNDED (backpressure — a full queue rejects enqueues after a short wait,
+SchedQueueFull), every request carries a deadline (expired requests fail
+WITHOUT executing, SchedDeadline), and executions feed the session's
+existing admission gate (exec/resource.py) — the dispatcher adds
+coalescing, never a second admission authority.
+
+FAULT_POINTs at the three seams: ``sched_enqueue`` (request admission to
+the queue), ``sched_coalesce`` (group formation), ``sched_flush`` (the
+batched launch, armed inside paramplan.run_batch).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from cloudberry_tpu.sched import paramplan
+
+
+class SchedQueueFull(RuntimeError):
+    """Backpressure: the bounded request queue stayed full past the
+    enqueue grace period."""
+
+
+class SchedDeadline(RuntimeError):
+    """The request's deadline expired before (or while) it executed."""
+
+
+@dataclass
+class _Request:
+    sql: str
+    deadline: float                  # monotonic absolute
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Optional[BaseException] = None
+
+    def finish(self, result=None, error=None):
+        self.result = result
+        self.error = error
+        self.done.set()
+
+
+class Dispatcher:
+    """One worker thread coalescing a session's read statements.
+
+    ``exec_scope`` (optional): a zero-argument callable returning a
+    context manager held around every execution — the server passes its
+    shared-session read-lock scope so dispatched reads keep excluding
+    concurrent catalog writers exactly like direct dispatch does.
+    """
+
+    def __init__(self, session, exec_scope=None):
+        self.session = session
+        cfg = session.config.sched
+        self.max_batch = max(1, cfg.max_batch)
+        self.max_queue = max(1, cfg.max_queue)
+        self.tick_s = max(0.0, cfg.tick_s)
+        self.deadline_s = cfg.deadline_s
+        self._exec_scope = exec_scope or contextlib.nullcontext
+        self._q: list[_Request] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {
+            "enqueued": 0, "rejected": 0, "expired": 0,
+            "batches": 0, "batched_requests": 0, "singles": 0,
+            "seq_fallbacks": 0, "occupancy_sum": 0.0, "max_depth": 0,
+        }
+        # the serving layer reads queue/batch observability through the
+        # session (serve/meta.py "sched")
+        session._dispatcher = self
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> "Dispatcher":
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="cbtpu-dispatcher")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # drain: nothing may block forever on a dead worker
+        with self._cond:
+            pending, self._q = self._q, []
+        for r in pending:
+            r.finish(error=RuntimeError("dispatcher stopped"))
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, sql: str, deadline_s: Optional[float] = None,
+               enqueue_wait_s: float = 0.25):
+        """Run one statement through the dispatcher; blocks until its
+        result is ready. Raises SchedQueueFull (backpressure) or
+        SchedDeadline; other execution errors re-raise as-is."""
+        from cloudberry_tpu.utils.faultinject import fault_point
+
+        fault_point("sched_enqueue")
+        budget = self.deadline_s if deadline_s is None else deadline_s
+        req = _Request(sql, time.monotonic() + budget)
+        with self._cond:
+            end = time.monotonic() + enqueue_wait_s
+            while len(self._q) >= self.max_queue and not self._stop:
+                left = end - time.monotonic()
+                if left <= 0:
+                    self.stats["rejected"] += 1
+                    raise SchedQueueFull(
+                        f"dispatcher queue full ({self.max_queue} "
+                        "requests waiting); retry or raise "
+                        "config.sched.max_queue")
+                self._cond.wait(timeout=left)
+            if self._stop:
+                raise RuntimeError("dispatcher stopped")
+            self._q.append(req)
+            self.stats["enqueued"] += 1
+            self.stats["max_depth"] = max(self.stats["max_depth"],
+                                          len(self._q))
+            self._cond.notify_all()
+        req.done.wait(timeout=budget + 60.0)
+        if not req.done.is_set():
+            raise SchedDeadline(f"request did not finish within "
+                                f"{budget + 60.0:.0f}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ------------------------------------------------------------- worker
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait(timeout=0.5)
+                if self._stop:
+                    return
+            # coalescing window: give same-skeleton company a tick to
+            # arrive (continuous batching — the queue keeps filling while
+            # the previous batch executes, so a loaded server rarely
+            # actually sleeps here)
+            if self.tick_s:
+                with self._cond:
+                    deadline = time.monotonic() + self.tick_s
+                    while len(self._q) < self.max_batch and not self._stop:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cond.wait(timeout=left)
+            with self._cond:
+                batch, self._q = self._q, []
+                self._cond.notify_all()  # wake blocked submitters
+            if batch:
+                try:
+                    self._process(batch)
+                except BaseException as e:  # never kill the worker
+                    for r in batch:
+                        if not r.done.is_set():
+                            r.finish(error=e)
+
+    def _groups(self, batch: list[_Request]):
+        """Group same-skeleton requests, preserving arrival order within
+        a group; non-parameterizable statements ride alone."""
+        groups: dict = {}
+        order: list = []
+        for r in batch:
+            norm = paramplan.normalize(r.sql)
+            key = (norm[0],) if norm is not None and norm[1] \
+                else ("solo", id(r))
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(r)
+        return [groups[k] for k in order]
+
+    def _process(self, batch: list[_Request]) -> None:
+        from cloudberry_tpu.utils.faultinject import fault_point
+
+        fault_point("sched_coalesce")
+        for group in self._groups(batch):
+            live: list[_Request] = []
+            now = time.monotonic()
+            for r in group:
+                if now > r.deadline:
+                    self.stats["expired"] += 1
+                    r.finish(error=SchedDeadline(
+                        "deadline expired before dispatch"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            while live:
+                chunk, live = live[:self.max_batch], live[self.max_batch:]
+                self._run_group(chunk)
+
+    def _run_group(self, group: list[_Request]) -> None:
+        log = self.session.stmt_log
+        if len(group) > 1:
+            sids = [log.begin(r.sql) for r in group]
+            c0 = log.counter("compiles")
+            try:
+                with self._exec_scope():
+                    out = paramplan.run_batch(self.session,
+                                              [r.sql for r in group])
+            except BaseException as e:
+                for sid in sids:
+                    log.finish(sid, "error",
+                               error=f"{type(e).__name__}: {e}")
+                for r in group:
+                    r.finish(error=e)
+                return
+            if out is not None:
+                self.stats["batches"] += 1
+                self.stats["batched_requests"] += len(group)
+                self.stats["occupancy_sum"] += \
+                    len(group) / paramplan._next_pow2(len(group))
+                # a flush that built a generic plan or a new rung DID
+                # compile — attribute the delta to the batch head so the
+                # per-statement compiles= field never under-reports
+                compiled = log.counter("compiles") - c0
+                for i, (r, sid, batch) in enumerate(zip(group, sids,
+                                                        out)):
+                    log.finish(sid, "ok", rows=batch.num_rows(),
+                               batch=len(group),
+                               compiles=compiled if i == 0 else 0)
+                    r.finish(result=batch)
+                return
+            self.stats["seq_fallbacks"] += 1
+            for sid in sids:
+                log.finish(sid, "requeued")  # re-logged by session.sql
+        # sequential path: ordinary dispatch, one statement at a time
+        for r in group:
+            if time.monotonic() > r.deadline:
+                self.stats["expired"] += 1
+                r.finish(error=SchedDeadline(
+                    "deadline expired before dispatch"))
+                continue
+            self.stats["singles"] += 1
+            try:
+                with self._exec_scope():
+                    r.finish(result=self.session.sql(r.sql))
+            except BaseException as e:
+                r.finish(error=e)
+
+    def snapshot(self) -> dict:
+        """Observability snapshot for serve/meta.py."""
+        with self._cond:
+            depth = len(self._q)
+            st = dict(self.stats)
+        occ = st.pop("occupancy_sum")
+        st["avg_occupancy"] = round(occ / st["batches"], 4) \
+            if st["batches"] else 0.0
+        st["queue_depth"] = depth
+        st["max_batch"] = self.max_batch
+        st["max_queue"] = self.max_queue
+        return st

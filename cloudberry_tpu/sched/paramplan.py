@@ -1,0 +1,864 @@
+"""Parameterized generic plans — the plan_cache.c analog.
+
+``Session._stmt_cache`` keys on exact SQL text, so ``WHERE k = 42`` and
+``WHERE k = 99`` each pay a full parse→plan→XLA-compile even though they
+need the same program. This module makes same-shape statements share one
+compiled executable:
+
+1. ``normalize`` lexes the statement and hoists constant literals into a
+   parameter vector, producing a SKELETON string (the cache key) — the
+   query-fingerprint normalization of plan_cache.c's generic plans.
+2. On first execution of a skeleton, the freshly bound plan's
+   filter/project literals are rewritten to ``expr.Param`` slots and the
+   program is compiled with a ``$params`` input; the literal VALUES travel
+   as device inputs.
+3. On a later execution with different literals, the statement is re-bound
+   (host-only, sub-millisecond) and its plan's STRUCTURAL SIGNATURE is
+   compared with the cached generic plan's; on a match the new literal
+   values (and point-lookup row slices / direct-dispatch segment) bind
+   into the existing program — ZERO XLA compiles.
+
+Plans that fold literals into plan STRUCTURE — nextval (plan-time sequence
+allocation, ``_no_stmt_cache``), literal-dependent partition pruning
+(``_store_parts``), a point lookup whose match count changed, a
+direct-dispatch row-count change — are non-generic by construction: the
+signature (or the ``_no_stmt_cache`` gate) refuses the rebind and the
+statement keeps today's compile-per-text path.
+
+The signature deliberately captures everything the TRACE bakes in: node
+shapes and capacities, baked literal values outside param sites, DictLookup
+table contents (string-predicate lookup tables are literal-derived),
+dictionary identity for collation rank tables (guarded by table versions),
+and shared-subtree (PShare) topology.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from cloudberry_tpu.plan import expr as ex
+from cloudberry_tpu.plan import nodes as N
+from cloudberry_tpu.sql.lexer import LexError, tokenize
+from cloudberry_tpu.types import DType, SqlType
+
+
+class UnsupportedPlan(Exception):
+    """The plan contains a shape the generic-plan walker does not model —
+    the statement silently keeps the non-generic path."""
+
+
+# ------------------------------------------------------------- skeletons
+
+
+_PARAM_HEADS = ("select", "with", "(")
+# literals after these keywords are STRUCTURAL (plan shape / bind-time
+# folds), never parameters: LIMIT/OFFSET become static node fields and
+# INTERVAL quantities fold into date arithmetic at bind time
+_KEEP_AFTER = ("limit", "offset", "interval")
+
+
+def normalize(sql: str):
+    """(skeleton, literal texts) for a parameterizable statement, else
+    None. The skeleton is the token stream with number/string literals
+    replaced by kind-tagged placeholders — same-shape statements collide
+    on it regardless of their literal values."""
+    head = sql.lstrip()[:1]
+    if not head:
+        return None
+    first = sql.split(None, 1)[0].lower() if head != "(" else "("
+    if first not in _PARAM_HEADS:
+        return None
+    try:
+        toks = tokenize(sql)
+    except LexError:
+        return None
+    parts: list[str] = []
+    params: list[str] = []
+    prev = ""
+    for t in toks:
+        if t.kind == "number" and prev not in _KEEP_AFTER:
+            params.append(t.text)
+            parts.append("?n")
+        elif t.kind == "string" and prev not in _KEEP_AFTER:
+            params.append(t.text)
+            parts.append("?s")
+        elif t.kind == "string":
+            parts.append(f"'{t.text}'")
+        elif t.kind != "eof":
+            parts.append(t.text)
+        prev = t.text if t.kind == "ident" else ""
+    return " ".join(parts), tuple(params)
+
+
+# ------------------------------------------------------- plan signatures
+
+
+def _tsig(t: Optional[SqlType]):
+    if t is None:
+        return None
+    return (t.base.value, t.scale)
+
+
+def _pyval(v) -> Any:
+    """Baked literal value as a hashable python scalar."""
+    if isinstance(v, str):
+        return v
+    try:
+        return np.asarray(v).item()
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def _param_scalar(e: ex.Literal) -> bool:
+    """Literal eligible to travel as a device input: a numeric/bool/date
+    scalar (strings stay baked — their plan effect is DictLookup tables,
+    whose contents the signature hashes)."""
+    if isinstance(e.value, str):
+        return False
+    try:
+        np.asarray(e.value, dtype=e.dtype.np_dtype)
+    except (TypeError, ValueError, OverflowError):
+        return False
+    return np.ndim(e.value) == 0
+
+
+class _Walker:
+    """One canonical walk shared by signature building, parameter-slot
+    numbering, binding extraction, and the literal→Param rewrite: every
+    consumer MUST see nodes, expression sites, and literals in the same
+    order, or rebinding would feed values into the wrong slots."""
+
+    def __init__(self, session, rewrite: bool = False):
+        self.rewrite = rewrite
+        self.slots: list[SqlType] = []
+        self.bindings: dict[str, np.ndarray] = {}
+        self.keyed: list[N.PScan] = []
+        self._nrw = 0  # scan row-count parameter slots ($nrw<i>)
+        self._memo: dict[int, int] = {}
+        # table-owned dictionaries are version-pinned (any content change
+        # bumps the table version) — only literal-derived dictionaries
+        # need content hashing in the signature
+        self._table_dicts = {
+            id(d)
+            for t in session.catalog.tables.values()
+            for d in getattr(t, "dicts", {}).values()}
+
+    # ------------------------------------------------------- expressions
+
+    def esig(self, e: Optional[ex.Expr], paramable: bool):
+        """(signature, possibly-rewritten expr) for one expression."""
+        if e is None:
+            return None, None
+        if isinstance(e, ex.Literal):
+            if paramable and _param_scalar(e):
+                slot = len(self.slots)
+                self.slots.append(e.dtype)
+                key = f"$prm{slot}"
+                self.bindings[key] = np.asarray(e.value,
+                                                dtype=e.dtype.np_dtype)
+                # the Param KEEPS the literal: the baked fallback for a
+                # non-generic recompile (growth retry) and the binding
+                # source when a rewritten plan is re-analyzed
+                new = ex.Param(slot, e.dtype, e.value) if self.rewrite \
+                    else e
+                return ("P", _tsig(e.dtype)), new
+            return ("L", _tsig(e.dtype), _pyval(e.value)), e
+        if isinstance(e, ex.Param):
+            # re-analysis of an already-rewritten plan (the expansion-growth
+            # retry re-enters the generic gate with the same plan object):
+            # the Param's kept build-time value IS the binding
+            if not paramable or e.value is None:
+                raise UnsupportedPlan("Param at a non-parameter site")
+            slot = len(self.slots)
+            self.slots.append(e.dtype)
+            key = f"$prm{slot}"
+            self.bindings[key] = np.asarray(e.value,
+                                            dtype=e.dtype.np_dtype)
+            new = ex.Param(slot, e.dtype, e.value) if self.rewrite else e
+            return ("P", _tsig(e.dtype)), new
+        if isinstance(e, ex.ColumnRef):
+            return ("C", e.name, _tsig(e.dtype)), e
+        if isinstance(e, ex.BinOp):
+            ls, ln = self.esig(e.left, paramable)
+            rs, rn = self.esig(e.right, paramable)
+            new = ex.BinOp(e.op, ln, rn, e.dtype) if self.rewrite else e
+            return ("B", e.op, _tsig(e.dtype), ls, rs), new
+        if isinstance(e, ex.UnaryOp):
+            s, n = self.esig(e.operand, paramable)
+            new = ex.UnaryOp(e.op, n, e.dtype) if self.rewrite else e
+            return ("U", e.op, _tsig(e.dtype), s), new
+        if isinstance(e, ex.Cast):
+            s, n = self.esig(e.operand, paramable)
+            new = ex.Cast(n, e.dtype) if self.rewrite else e
+            return ("T", _tsig(e.operand.dtype), _tsig(e.dtype), s), new
+        if isinstance(e, ex.Func):
+            # scale_down's k literal is consumed at COMPILE time
+            # (expr_compile reads e.args[1].value) — args stay baked
+            sub_param = paramable and e.name != "scale_down"
+            sigs, news = [], []
+            for a in e.args:
+                s, n = self.esig(a, sub_param)
+                sigs.append(s)
+                news.append(n)
+            new = ex.Func(e.name, tuple(news), e.dtype) if self.rewrite \
+                else e
+            return ("F", e.name, _tsig(e.dtype), tuple(sigs)), new
+        if isinstance(e, ex.CaseWhen):
+            sigs, news = [], []
+            for c, v in e.whens:
+                cs, cn = self.esig(c, paramable)
+                vs, vn = self.esig(v, paramable)
+                sigs.append((cs, vs))
+                news.append((cn, vn))
+            os_, on = self.esig(e.otherwise, paramable)
+            new = ex.CaseWhen(tuple(news), on, e.dtype) if self.rewrite \
+                else e
+            return ("W", _tsig(e.dtype), tuple(sigs), os_), new
+        if isinstance(e, ex.DictLookup):
+            s, n = self.esig(e.column, False)
+            tab = np.asarray(e.table)
+            tsig = ("DL", s, str(tab.dtype), tab.shape,
+                    hash(tab.tobytes()), self._dictsig(
+                        getattr(e, "_out_dict", None)))
+            if self.rewrite and n is not e.column:
+                out = ex.DictLookup(n, e.table, e.dtype)
+                d = getattr(e, "_out_dict", None)
+                if d is not None:
+                    object.__setattr__(out, "_out_dict", d)
+                return tsig, out
+            return tsig, e
+        if isinstance(e, ex.IsValid):
+            return ("V", tuple(e.mask_names), e.negate), e
+        if isinstance(e, ex.SubqueryScalar):
+            # the subplan lowers inside the same program — recurse; its
+            # filter/project literals are param sites like any other
+            psig = self.nsig(e.plan)
+            return ("SQ", e.mode, _tsig(e.dtype), psig), e
+        raise UnsupportedPlan(f"expression {type(e).__name__}")
+
+    def _dictsig(self, d):
+        if d is None:
+            return None
+        if id(d) in self._table_dicts:
+            return ("tdict", len(d))
+        return ("dict", len(d), hash(tuple(d.values)))
+
+    def _fieldsig(self, node: N.PlanNode):
+        return tuple(
+            (f.name, _tsig(f.type), f.masks, self._dictsig(f.sdict),
+             f._is_null_col)
+            for f in node.fields)
+
+    # ------------------------------------------------------------- nodes
+
+    def _site(self, node, attr: str, paramable: bool):
+        """Signature one expression attribute; rewrite in place when
+        building the generic plan."""
+        s, n = self.esig(getattr(node, attr), paramable)
+        if self.rewrite and n is not None:
+            setattr(node, attr, n)
+        return s
+
+    def nsig(self, node: N.PlanNode):
+        key = id(node)
+        if key in self._memo:
+            # shared subtree (PShare / runtime-filter build): reference by
+            # first-visit index — topology is part of the program
+            return ("ref", self._memo[key])
+        self._memo[key] = len(self._memo)
+        t = type(node).__name__
+        if isinstance(node, N.PScan):
+            if hasattr(node, "_point_rows"):
+                extra = ("pt", len(node._point_rows))
+                self.keyed.append(node)
+                nrows = node.num_rows  # the slice length IS the shape
+            elif hasattr(node, "_store_parts"):
+                extra = ("store",
+                         tuple(p["file"] for p in node._store_parts))
+                self.keyed.append(node)
+                nrows = node.num_rows
+            else:
+                # whole-table/shard scan: the row count is DATA, not
+                # shape — bind it as a parameter so one program serves
+                # every direct-dispatch segment (per-segment counts
+                # differ; the padded capacity does not)
+                extra = None
+                nrows = "$param"
+                key = f"$nrw{self._nrw}"
+                self._nrw += 1
+                self.bindings[key] = np.asarray(node.num_rows
+                                                if node.num_rows >= 0
+                                                else node.capacity,
+                                                dtype=np.int64)
+                if self.rewrite:
+                    node._nrows_key = key
+            return (t, node.table_name,
+                    tuple(sorted(node.column_map.items())),
+                    tuple(sorted(node.mask_map.items())),
+                    node.capacity, nrows, extra,
+                    self._fieldsig(node))
+        if isinstance(node, N.PFilter):
+            return (t, self._site(node, "predicate", True),
+                    self.nsig(node.child))
+        if isinstance(node, N.PProject):
+            sigs = []
+            for i, (name, e) in enumerate(list(node.exprs)):
+                s, n = self.esig(e, True)
+                if self.rewrite:
+                    node.exprs[i] = (name, n)
+                sigs.append((name, s))
+            return (t, tuple(sigs), self._fieldsig(node),
+                    self.nsig(node.child))
+        if isinstance(node, N.PJoin):
+            bk = tuple(self.esig(k, False)[0] for k in node.build_keys)
+            pk = tuple(self.esig(k, False)[0] for k in node.probe_keys)
+            return (t, node.kind, tuple(node.build_payload),
+                    node.match_name, node.probe_match_name,
+                    node.unique_build, node.out_capacity, node.null_aware,
+                    node.pack_bits, bk, pk,
+                    self._site(node, "residual", False),
+                    self._site(node, "build_key_valid", False),
+                    self._site(node, "probe_key_valid", False),
+                    self.nsig(node.build), self.nsig(node.probe))
+        if isinstance(node, N.PAgg):
+            keys = tuple((name, self.esig(e, False)[0])
+                         for name, e in node.group_keys)
+            aggs = tuple(
+                (name, c.func, c.distinct,
+                 self.esig(c.arg, False)[0],
+                 self.esig(c.filter, False)[0])
+                for name, c in node.aggs)
+            return (t, node.mode, node.capacity, keys, aggs,
+                    self._fieldsig(node), self.nsig(node.child))
+        if isinstance(node, N.PSort):
+            keys = tuple((self.esig(e, False)[0], asc)
+                         for e, asc in node.keys)
+            return (t, keys, self._fieldsig(node), self.nsig(node.child))
+        if isinstance(node, N.PLimit):
+            return (t, node.limit, node.offset, self.nsig(node.child))
+        if isinstance(node, N.PWindow):
+            pk = tuple(self.esig(e, False)[0] for e in node.partition_keys)
+            ok = tuple((self.esig(e, False)[0], asc)
+                       for e, asc in node.order_keys)
+            calls = tuple((name, func, self.esig(arg, False)[0])
+                          for name, func, arg in node.calls)
+            valids = tuple(self.esig(v, False)[0]
+                           for v in (node.valids or ()))
+            params = tuple(
+                None if p is None else tuple(
+                    (k, self.esig(v, False)[0]
+                     if isinstance(v, ex.Expr) else v)
+                    for k, v in sorted(p.items()))
+                for p in (node.params or ()))
+            return (t, pk, ok, calls, valids, params, node.frame,
+                    self._fieldsig(node), self.nsig(node.child))
+        if isinstance(node, N.PShare):
+            return (t, self.nsig(node.child))
+        if isinstance(node, N.PConcat):
+            return (t, tuple(self.nsig(c) for c in node.inputs),
+                    self._fieldsig(node))
+        if isinstance(node, N.PRuntimeFilter):
+            bk = tuple(self.esig(k, False)[0] for k in node.build_keys)
+            pk = tuple(self.esig(k, False)[0] for k in node.probe_keys)
+            return (t, node.pack_bits, bk, pk, self.nsig(node.build),
+                    self.nsig(node.child))
+        if isinstance(node, N.PMotion):
+            hk = tuple(self.esig(k, False)[0] for k in node.hash_keys)
+            return (t, node.kind, node.out_capacity, node.bucket_cap,
+                    node.pre_compact, hk, self._fieldsig(node),
+                    self.nsig(node.child))
+        raise UnsupportedPlan(f"node {t}")
+
+
+def analyze(session, plan: N.PlanNode, rewrite: bool = False):
+    """(signature, bindings, keyed scans, slot types) for a bound plan.
+    ``rewrite=True`` (generic-plan build only) additionally replaces every
+    parameter-site literal with its ``expr.Param`` slot IN PLACE."""
+    w = _Walker(session, rewrite=rewrite)
+    root = ("root", w.nsig(plan),
+            getattr(plan, "_direct_segment", None) is not None,
+            w._fieldsig(plan))
+    return root, w.bindings, w.keyed, w.slots
+
+
+# ------------------------------------------------------ the generic plan
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def _text_converter(t: SqlType):
+    """Literal token text → physical value, matching the binder's typed
+    conversions (the fast-rebind contract is validated at build time:
+    converter(build text) must equal the plan's bound literal)."""
+    from cloudberry_tpu.plan.planner import _exact_decimal
+    from cloudberry_tpu.types import date_to_days
+
+    if t.base in (DType.INT32, DType.INT64):
+        return lambda s: int(s)
+    if t.base == DType.DECIMAL:
+        return lambda s, k=t.scale: _exact_decimal(s, k)
+    if t.base == DType.FLOAT64:
+        return lambda s: float(s)
+    if t.base == DType.DATE:
+        return lambda s: date_to_days(s)
+    return None
+
+
+@dataclass
+class FastRebind:
+    """Tokenize-only rebinding for the canonical point-lookup shape
+    (``WHERE k = ?`` on an indexed column): skip parse/bind/plan entirely
+    — convert the literal text, sidecar-search the rows, slice the scan
+    input, feed the value as the one parameter. The dispatcher's batch
+    path leans on this to make per-request host work ~microseconds."""
+
+    table: str
+    phys: str
+    sqltype: SqlType
+    expect_rows: int
+    input_key: str
+    param_key: Optional[str]
+    hashed_direct: bool          # multi-seg: route via the dist-key hash
+    dist_dtype: Optional[np.dtype]
+
+    def bind(self, session, text: str):
+        """(inputs, bindings) for one literal text, or None → caller
+        falls back to the full re-plan rebind."""
+        from cloudberry_tpu.plan import pointlookup as PL
+
+        conv = _text_converter(self.sqltype)
+        try:
+            v = conv(text)
+        except (ValueError, TypeError, OverflowError):
+            return None
+        seg = None
+        if self.hashed_direct:
+            from cloudberry_tpu.utils import hashing
+
+            nseg = session.config.n_segments
+            h = hashing.hash_columns_np(
+                [np.asarray([v], dtype=self.dist_dtype)])
+            seg = int(hashing.jump_consistent_hash_np(h, nseg)[0])
+        rows = PL._lookup(session, self.table, self.phys, seg, v)
+        if rows is None or len(rows) != self.expect_rows:
+            return None
+        from cloudberry_tpu.exec import executor as X
+
+        inputs = {self.input_key: X.point_scan_slice(
+            self.table, rows, session, seg)}
+        bindings = {}
+        if self.param_key is not None:
+            bindings[self.param_key] = np.asarray(
+                v, dtype=self.sqltype.np_dtype)
+        return inputs, bindings
+
+
+def _redistributes(plan):
+    """Redistribute motions in walk order, deduped by identity (shared
+    subtrees re-walk) — the correspondence channel for copying observed
+    bucket stats from the traced plan onto a signature-equal rebind."""
+    from cloudberry_tpu.exec import executor as X
+
+    seen: set[int] = set()
+    out = []
+    for n in X.all_nodes(plan):
+        if isinstance(n, N.PMotion) and n.kind == "redistribute" \
+                and id(n) not in seen:
+            seen.add(id(n))
+            out.append(n)
+    return out
+
+
+class GenericPlan:
+    """One compiled program shared by every statement matching a
+    (skeleton, signature) pair — rebinding feeds new literals/slices."""
+
+    def __init__(self, session, skeleton: str, plan: N.PlanNode,
+                 names, sig, bindings, keyed, slots):
+        from cloudberry_tpu.exec import executor as X
+        from cloudberry_tpu.exec.resource import estimate_plan_memory
+        from cloudberry_tpu.exec.udf import registry_version
+
+        self.skeleton = skeleton
+        self.sig = sig
+        self.config = session.config
+        self.versions = session._table_versions(names)
+        self.ddlv = (session.catalog.ddl_version, registry_version())
+        self.plan = plan
+        self.param_keys = sorted(bindings, key=lambda k: (k[:4],
+                                                          int(k[4:])))
+        self.keyed_keys = [s._input_key for s in keyed]
+        self.table_names = sorted({s.table_name
+                                   for s in X.scans_of(plan)
+                                   if not X.keyed_scan(s)})
+        self.est_bytes = estimate_plan_memory(plan).peak_bytes
+        seg = getattr(plan, "_direct_segment", None)
+        if session.config.n_segments > 1 and seg is None:
+            self.kind = "dist"
+        else:
+            self.kind = "direct" if seg is not None else "single"
+        if self.kind == "dist":
+            from cloudberry_tpu.exec import dist_executor as DX
+
+            self.fn = DX.compile_distributed(
+                plan, session, param_keys=self.param_keys or None)
+            self.exe = None
+        else:
+            self.exe = X.compile_plan(plan, session)
+            self.fn = None
+        # stacked-launch eligibility for the dispatcher (sched/dispatcher):
+        # "sliced"  — every scan is a keyed point slice: stack ALL inputs;
+        # "shared"  — no keyed scans, single-program: tables ride once
+        #             (in_axes=None), only $params stacks.
+        if self.kind in ("single", "direct") and self.keyed_keys \
+                and not self.table_names:
+            self.stack_mode = "sliced"
+        elif self.kind == "single" and not self.keyed_keys \
+                and self.param_keys:
+            self.stack_mode = "shared"
+        else:
+            self.stack_mode = None
+        self.fast: Optional[FastRebind] = None
+        self._rungs: dict[int, Any] = {}
+        self._rung_lock = __import__("threading").Lock()
+
+    def matches(self, session, sig, versions, ddlv) -> bool:
+        return (self.sig == sig and self.config is session.config
+                and self.versions == versions and self.ddlv == ddlv)
+
+    # --------------------------------------------------------- execution
+
+    def bind_inputs(self, session, planB, keyedB, bindings) -> dict:
+        """Assemble the program's inputs from a freshly bound plan:
+        table columns (under the rebind's direct-dispatch segment), keyed
+        scan slices REMAPPED to the compiled program's input keys, and the
+        literal bindings as the ``$params`` entry."""
+        from cloudberry_tpu.exec import executor as X
+
+        seg = getattr(planB, "_direct_segment", None)
+        tables = X.prepare_tables(self.table_names, session, segment=seg)
+        for key, s in zip(self.keyed_keys, keyedB):
+            if hasattr(s, "_point_rows"):
+                tables[key] = X.point_scan_slice(
+                    s.table_name, s._point_rows, session, seg)
+            else:
+                tables[key] = X._load_store_scan(s, session)
+        if bindings:
+            tables["$params"] = dict(bindings)
+        return tables
+
+    def run(self, session, planB, keyedB, bindings):
+        """Execute the cached program with one rebind's values — never
+        compiles."""
+        from cloudberry_tpu.exec import executor as X
+
+        session.stmt_log.bump("param_binds")
+        if self.kind == "dist":
+            from cloudberry_tpu.exec import dist_executor as DX
+
+            inputs, _ = DX.prepare_dist_inputs(planB, session)
+            if bindings:
+                inputs["$params"] = dict(bindings)
+            cols, sel, checks, stats = self.fn(inputs)
+            # the stats keys embed the TRACED plan's node ids — pin the
+            # observed bucket demand there, then copy onto the rebind's
+            # motions (signature-equal plans walk identically), so a skew
+            # overflow still promotes straight to the fitting rung
+            DX.record_motion_stats(self.plan, stats)
+            for a, b in zip(_redistributes(self.plan),
+                            _redistributes(planB)):
+                ob = getattr(a, "_observed_bucket", None)
+                if ob is not None:
+                    b._observed_bucket = ob
+            X.raise_checks(checks)
+            host_cols = {k: DX._local_row(v) for k, v in cols.items()}
+            return X.make_batch(self.plan, host_cols, DX._local_row(sel))
+        inputs = self.bind_inputs(session, planB, keyedB, bindings)
+        return X.run_executable(self.exe, inputs)
+
+    # ----------------------------------------------------- stacked launch
+
+    def rung_fn(self, session, rung: int):
+        """The vmapped executable for a batch of ``rung`` rebinds —
+        compiled once per power-of-two rung (the dispatcher pads batches
+        up to the rung, so recompiles are bounded by log2(max_batch))."""
+        import jax
+
+        with self._rung_lock:
+            fn = self._rungs.get(rung)
+        if fn is not None:
+            return fn
+        from cloudberry_tpu.exec import executor as X
+
+        X.count_compile(session)
+        session.stmt_log.bump("batch_rung_compiles")
+        if self.stack_mode == "sliced":
+            axes: Any = 0
+        else:
+            axes = {n: None for n in self.table_names}
+            axes["$params"] = 0
+        fn = jax.jit(jax.vmap(self.exe.raw_fn, in_axes=(axes,)))
+        with self._rung_lock:
+            self._rungs[rung] = fn
+        return fn
+
+
+# ----------------------------------------------------- session-side cache
+
+
+_GENERIC_CACHE_MAX = 32
+
+
+def _try_fast(session, gp: GenericPlan, plan, tok_params, bindings,
+              keyed, slots) -> Optional[FastRebind]:
+    """Attach the tokenize-only rebind template when the statement is the
+    canonical single-parameter point lookup."""
+    if len(tok_params) != 1 or len(slots) > 1 or len(keyed) != 1:
+        return None
+    if gp.kind == "dist" or gp.table_names:
+        return None
+    s = keyed[0]
+    if not hasattr(s, "_point_rows"):
+        return None
+    out_to_phys = {out: phys for phys, out in s.column_map.items()}
+    phys = out_to_phys.get(getattr(s, "_point_col", None))
+    if phys is None:
+        return None
+    t = session.catalog.table(s.table_name)
+    sqltype = t.schema.field(phys).type
+    conv = _text_converter(sqltype)
+    if conv is None:
+        return None
+    prm_keys = [k for k in gp.param_keys if k.startswith("$prm")]
+    if len(prm_keys) != len(gp.param_keys):
+        return None  # row-count params imply non-keyed scans — not fast
+    param_key = prm_keys[0] if prm_keys else None
+    try:
+        v = conv(tok_params[0])
+    except (ValueError, TypeError, OverflowError):
+        return None
+    # the converter must reproduce BOTH the bound literal (the $params
+    # value) and the sidecar probe value, or fast rebinding would diverge
+    # from the binder's typed folds — validate against the build's values
+    if param_key is not None:
+        bound = bindings[param_key]
+        if slots[0] != sqltype or not np.asarray(v, bound.dtype) == bound:
+            return None
+    hashed_direct = False
+    dist_dtype = None
+    if session.config.n_segments > 1:
+        if getattr(plan, "_direct_segment", None) is None:
+            return None
+        if t.policy.kind == "hashed":
+            if list(t.policy.keys) != [phys]:
+                return None
+            hashed_direct = True
+            dist_dtype = t.schema.field(phys).type.np_dtype
+        elif t.policy.kind != "replicated":
+            return None
+    return FastRebind(s.table_name, phys, sqltype, s.num_rows,
+                      gp.keyed_keys[0], param_key, hashed_direct,
+                      dist_dtype)
+
+
+def _eligible(session, query, plan) -> bool:
+    if not session.config.sched.generic_plans:
+        return False
+    if getattr(plan, "_no_stmt_cache", False):
+        return False
+    return True
+
+
+@dataclass
+class Prep:
+    """One statement's rebinding package: the shared program plus this
+    execution's freshly bound plan and its literal values."""
+    gp: GenericPlan
+    plan: N.PlanNode
+    keyed: list
+    bindings: dict
+    built: bool = False
+
+    def run(self, session):
+        return self.gp.run(session, self.plan, self.keyed, self.bindings)
+
+
+def lookup_or_build(session, query: str, plan) -> Optional[Prep]:
+    """The generic-plan gate for one freshly bound plan: normalize, match
+    the (skeleton, signature) cache, build on miss. None → the statement
+    keeps the non-generic path."""
+    from cloudberry_tpu.exec import executor as X
+
+    if not _eligible(session, query, plan):
+        return None
+    norm = normalize(query)
+    if norm is None or not norm[1]:
+        return None
+    skeleton, tok_params = norm
+    names = sorted({s.table_name for s in X.scans_of(plan)})
+    if session._any_external(names):
+        return None
+    try:
+        versions = session._table_versions(names)
+    except KeyError:
+        return None
+    from cloudberry_tpu.exec.udf import registry_version
+
+    ddlv = (session.catalog.ddl_version, registry_version())
+    try:
+        sig, bindings, keyed, slots = analyze(session, plan)
+    except UnsupportedPlan:
+        return None
+    lock = session._generic_lock
+    cache = session._generic_cache
+    with lock:
+        bucket = cache.pop(skeleton, None)
+        if bucket is not None:
+            cache[skeleton] = bucket  # LRU touch
+            for gp in bucket:
+                if gp.matches(session, sig, versions, ddlv):
+                    session.stmt_log.bump("generic_hits")
+                    return Prep(gp, plan, keyed, bindings)
+    # build: re-walk with rewrite=True so the compiled program reads its
+    # literals from $params (slot order identical by the walker contract)
+    sig2, bindings2, keyed2, slots2 = analyze(session, plan, rewrite=True)
+    assert sig2 == sig and list(bindings2) == list(bindings)
+    gp = GenericPlan(session, skeleton, plan, names, sig, bindings2,
+                     keyed2, slots2)
+    gp.fast = _try_fast(session, gp, plan, tok_params, bindings2, keyed2,
+                        slots2)
+    session.stmt_log.bump("generic_builds")
+    with lock:
+        bucket = cache.setdefault(skeleton, [])
+        bucket.append(gp)
+        del bucket[:-session.config.sched.max_variants]
+        while len(cache) > _GENERIC_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+    return Prep(gp, plan, keyed2, bindings2, built=True)
+
+
+def generic_runner(session, query: str, plan):
+    """Session hook (session._execute_and_cache): a zero-argument runner
+    over the shared compiled program, or None for non-generic
+    statements."""
+    prep = lookup_or_build(session, query, plan)
+    if prep is None:
+        return None
+    return lambda: prep.run(session)
+
+
+# -------------------------------------------------------- batch execution
+
+
+def prepare_one(session, query: str) -> Optional[Prep]:
+    """Full host-side preparation of one statement for the dispatcher:
+    parse → bind/plan → generic lookup/build. None → not batchable."""
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.sql.parser import parse_sql
+
+    session._sync_store()
+    try:
+        stmt = parse_sql(query)
+        result = plan_statement(stmt, session, {})
+    except Exception:
+        return None
+    if result.is_ddl:
+        return None
+    return lookup_or_build(session, query, result.plan)
+
+
+def run_batch(session, sqls: list[str]):
+    """Execute same-skeleton statements as ONE stacked launch: per-request
+    host rebinding (tokenize-only when the fast template applies, else a
+    host re-plan), inputs stacked to the next power-of-two rung, one
+    vmapped program launch, results split per request.
+
+    Returns a list of ColumnBatch (one per statement) or None when the
+    group is not stackable — the dispatcher then falls back to sequential
+    dispatch. Never compiles except once per (skeleton, signature, rung).
+    """
+    import jax
+
+    from cloudberry_tpu.exec import executor as X
+    from cloudberry_tpu.exec.resource import ResourceError
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    if len(sqls) < 2 or not session.config.sched.generic_plans:
+        return None
+    prep0 = prepare_one(session, sqls[0])
+    if prep0 is None or prep0.gp.stack_mode is None:
+        return None
+    gp = prep0.gp
+    shared = gp.stack_mode == "shared"
+    if shared:
+        # tables ride ONCE (vmap in_axes=None) — per request only the
+        # literal bindings vary
+        from cloudberry_tpu.exec import executor as X
+
+        base = X.prepare_tables(gp.table_names, session, segment=None)
+        per: list[dict] = [dict(prep0.bindings)]
+    else:
+        per = [gp.bind_inputs(session, prep0.plan, prep0.keyed,
+                              prep0.bindings)]
+    for q in sqls[1:]:
+        bound = None
+        if gp.fast is not None:
+            norm = normalize(q)
+            if norm is None or norm[0] != gp.skeleton:
+                return None
+            fb = gp.fast.bind(session, norm[1][0])
+            if fb is not None:
+                tabs, binds = fb
+                if binds:
+                    tabs["$params"] = binds
+                bound = tabs
+                session.stmt_log.bump("fast_rebinds")
+        if bound is None:
+            p = prepare_one(session, q)
+            if p is None or p.gp is not gp:
+                return None  # shape drifted mid-batch — sequential path
+            bound = dict(p.bindings) if shared \
+                else gp.bind_inputs(session, p.plan, p.keyed, p.bindings)
+        per.append(bound)
+    k = len(per)
+    rung = _next_pow2(k)
+    per += [per[-1]] * (rung - k)
+    if shared:
+        stacked = dict(base)
+        stacked["$params"] = {
+            key: np.stack([b[key] for b in per])
+            for key in gp.param_keys}
+    else:
+        # host-side stacking: leaves are numpy (point_scan_slice), so the
+        # whole batch crosses to the device as ONE transfer per leaf at
+        # dispatch instead of one put per request per column
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *per)
+    fn = gp.rung_fn(session, rung)
+    cost = gp.est_bytes * (rung if gp.stack_mode == "shared" else 1)
+    try:
+        with session._gate, session._admitted(cost):
+            fault_point("sched_flush")
+            session.stmt_log.bump("dispatches")
+            cols, sel, checks = fn(stacked)
+            X.raise_checks(checks)
+    except (ResourceError, X.ExecError):
+        # vmapped checks OR across lanes: ONE request's runtime check
+        # (subquery cardinality, expansion overflow, ...) must not error
+        # its batchmates — fall back to sequential dispatch, where each
+        # statement gets its own verdict and the grow-and-retry loop
+        return None
+    session.stmt_log.bump("batched_statements", k)
+    out = []
+    host_cols = {name: np.asarray(v) for name, v in cols.items()}
+    host_sel = np.asarray(sel)
+    for i in range(k):
+        out.append(X.make_batch(
+            gp.plan, {name: v[i] for name, v in host_cols.items()},
+            host_sel[i]))
+    return out

@@ -31,7 +31,7 @@ def _table_row(name: str, t) -> dict:
 
 def describe(session, kind: str, arg=None):
     """One metadata answer. Kinds: tables | columns | stats | views |
-    matviews | sequences | info | summary."""
+    matviews | sequences | info | activity | sched | summary."""
     # metadata must see other sessions' committed DDL — a thin client may
     # only ever ask metadata questions, so sync here, not just in sql()
     session._sync_store()
@@ -75,6 +75,17 @@ def describe(session, kind: str, arg=None):
             "tables": len(cat.tables),
             "views": len(cat.views),
             "matviews": len(cat.matviews),
+        }
+    if kind == "sched":
+        # scheduler observability: queue depth / batch occupancy from the
+        # micro-batch dispatcher (when one is attached) plus the engine's
+        # compile-hit / parameterization counters (sched/paramplan.py via
+        # exec/instrument.py StatementLog)
+        disp = getattr(session, "_dispatcher", None)
+        return {
+            "generic_plans": bool(session.config.sched.generic_plans),
+            "dispatcher": disp.snapshot() if disp is not None else None,
+            "counters": session.stmt_log.counter_snapshot(),
         }
     if kind == "activity":
         # pg_stat_activity role: running + recent statements across every

@@ -194,6 +194,17 @@ class Server:
 
         self.cron = Scheduler(self.session,
                               execute=self._cron_execute).load()
+        # continuous micro-batch dispatcher (sched/dispatcher.py, the
+        # gang-dispatch analog): opt-in via config.sched.enabled — read
+        # statements coalesce into stacked launches on the SERVER session;
+        # executions hold the same statement-level lock scope direct
+        # dispatch would
+        self.dispatcher = None
+        if self.session.config.sched.enabled:
+            from cloudberry_tpu.sched import Dispatcher
+
+            self.dispatcher = Dispatcher(self.session,
+                                         exec_scope=self._locked)
 
     def _locked(self, write: bool = False):
         """Statement-level lock scope: a no-op in per-connection mode
@@ -260,6 +271,15 @@ class Server:
                if "auth" not in req else "authentication failed")
         return ({"ok": False, "fatal": True, "error": msg}, False)
 
+    @staticmethod
+    def _parameterizable(sql: str) -> bool:
+        """Reads worth coalescing: the skeleton normalizer hoists at
+        least one literal (same-shape statements can share a launch)."""
+        from cloudberry_tpu.sched import paramplan
+
+        norm = paramplan.normalize(sql)
+        return norm is not None and bool(norm[1])
+
     # ------------------------------------------------- connection sessions
 
     def _connection_session(self):
@@ -278,6 +298,8 @@ class Server:
         # one activity/history log across ALL backends: "who runs what"
         # must span connections (pg_stat_activity is cluster-wide)
         s.stmt_log = self.session.stmt_log
+        # dispatcher observability (serve/meta.py "sched") spans backends
+        s._dispatcher = getattr(self.session, "_dispatcher", None)
         return s
 
     def _end_connection(self, sess) -> None:
@@ -301,15 +323,21 @@ class Server:
             # a standby never runs jobs: the primary owns the schedule
             # (pg_cron likewise runs on the primary only)
             self.cron.start()
+        if self.dispatcher is not None:
+            self.dispatcher.start()
         return self
 
     def serve_forever(self) -> None:
         if not self.read_only:
             self.cron.start()  # foreground entry point runs jobs too
+        if self.dispatcher is not None:
+            self.dispatcher.start()
         self._server.serve_forever()
 
     def stop(self) -> None:
         self.cron.stop()
+        if self.dispatcher is not None:
+            self.dispatcher.stop()
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
@@ -384,7 +412,20 @@ class Server:
             return {"ok": False, "etype": "ReadOnlyError",
                     "error": "read-only standby: route writes to the "
                              "primary server"}
-        if self.per_connection:
+        if self.dispatcher is not None and _is_read(sql) \
+                and _first_word(sql) not in _TXN_STARTERS \
+                and getattr(sess, "_txn_snapshot", None) is None \
+                and self._parameterizable(sql):
+            # micro-batch dispatch: PARAMETERIZABLE reads coalesce on the
+            # server session (same committed snapshot a fresh backend
+            # would read); a connection holding an open transaction keeps
+            # its own session so its snapshot stays visible.
+            # Non-parameterizable reads keep the concurrent handler-thread
+            # path — routing them through the single dispatcher worker
+            # would head-of-line-block point lookups behind heavy scans.
+            result = self.dispatcher.submit(
+                sql, deadline_s=req.get("deadline_s"))
+        elif self.per_connection:
             # each connection is its own backend: statement-level locking
             # is unnecessary (no shared catalog objects) and transactions
             # ride the store's multi-session OCC

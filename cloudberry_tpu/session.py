@@ -101,6 +101,12 @@ class Session:
         # at most once per session (bounded recompiles, exec/dist_executor)
         self._rung_cache: dict = {}
         self._rung_lock = __import__("threading").Lock()
+        # generic-plan cache (sched/paramplan.py, the plan_cache.c analog):
+        # statement SKELETON -> compiled programs with literals as device
+        # inputs, so same-shape statements with different literals share
+        # one executable (zero recompiles after the first)
+        self._generic_cache: dict = {}
+        self._generic_lock = __import__("threading").Lock()
         # counts-only shard layout (planning fast path; sharded_table
         # materializes the actual arrays for execution)
         self._shard_count_cache: dict = {}
@@ -166,6 +172,11 @@ class Session:
 
         h = self.config.health
         log_id = self.stmt_log.begin(query, self._session_id)
+        # per-statement compile observability: the delta of the engine-wide
+        # compile counter over this statement (exact single-threaded; an
+        # upper bound under concurrency) — "zero after warmup" is the
+        # generic-plan acceptance contract
+        compiles_before = self.stmt_log.counter("compiles")
         try:
             if h.retries <= 0 or not _read_only(query):
                 # DML/DDL/COPY are NOT retried: a device failure striking
@@ -189,7 +200,8 @@ class Session:
         is_batch = hasattr(out, "num_rows")
         self.stmt_log.finish(
             log_id, "ok" if is_batch else str(out)[:80],
-            rows=out.num_rows() if is_batch else -1)
+            rows=out.num_rows() if is_batch else -1,
+            compiles=self.stmt_log.counter("compiles") - compiles_before)
         return out
 
     def _recover_mesh(self, e: Exception) -> None:
@@ -236,6 +248,8 @@ class Session:
                 self._stmt_cache.clear()
             with self._rung_lock:
                 self._rung_cache.clear()
+            with self._generic_lock:
+                self._generic_cache.clear()
             self._store_scan_cache.clear()
             return True
 
@@ -247,6 +261,17 @@ class Session:
         fault_point("dispatch_start")
         fault_point("exec_device_lost")
 
+    @staticmethod
+    def _stmt_cache_key(query: str, params: dict) -> str:
+        """Statement-cache key: the SQL text PLUS the user-supplied
+        ``sql(query, **params)`` arguments — two calls with the same text
+        but different params must never share a cached runner (the
+        reference's plan cache likewise keys prepared statements on their
+        parameter signature)."""
+        if not params:
+            return query
+        return query + "\x00" + repr(sorted(params.items()))
+
     def _sql_once(self, query: str, **params: Any):
         from cloudberry_tpu.exec.resource import check_admission
         from cloudberry_tpu.plan.planner import plan_statement
@@ -255,9 +280,12 @@ class Session:
 
         self._sync_store()
         self.last_tiled_report = None  # set again by a tiled runner
-        cached = self._cached_statement(query)
+        ckey = self._stmt_cache_key(query, params)
+        cached = self._cached_statement(ckey)
         if cached is not None:
             runner, cost = cached
+            self.stmt_log.bump("stmt_cache_hits")
+            self.stmt_log.bump("dispatches")
             self._dispatch_seams(fault_point)
             with self._gate, self._admitted(cost):
                 return runner()
@@ -301,13 +329,15 @@ class Session:
                     texe.session = self
             if texe is None:
                 raise
+            self.stmt_log.bump("dispatches")
             self._dispatch_seams(fault_point)
             with self._gate, self._admitted(
                     self.config.resource.query_mem_bytes):
-                return self._run_cached_tiled(query, texe)
+                return self._run_cached_tiled(ckey, texe)
+        self.stmt_log.bump("dispatches")
         self._dispatch_seams(fault_point)
         with self._gate, self._admitted(est.peak_bytes) as sid:
-            return self._run_with_growth(query, result.plan, sid)
+            return self._run_with_growth(ckey, query, result.plan, sid)
 
     def _admitted(self, cost: int):
         """Queue slot (bounded active statements, MAX_COST, priority wake
@@ -331,7 +361,8 @@ class Session:
 
         return _cm()
 
-    def _run_with_growth(self, query: str, plan, stmt_id: int = 0):
+    def _run_with_growth(self, ckey: str, query: str, plan,
+                         stmt_id: int = 0):
         """Execute; on a detected join-expansion overflow, grow the pair
         buffer (re-checking admission) and retry — adaptive capacity, never
         truncation (exec/executor.py:grow_expansion). Growth that blows the
@@ -343,10 +374,10 @@ class Session:
 
         for _ in range(6):
             try:
-                return self._execute_and_cache(query, plan)
+                return self._execute_and_cache(ckey, query, plan)
             except ExecError as e:
                 with self._stmt_lock:  # drop the failed runner
-                    self._stmt_cache.pop(query, None)
+                    self._stmt_cache.pop(ckey, None)
                 # allow_fallback: this loop may be retrying a program
                 # served from the rung cache, whose check messages can
                 # embed node ids from an equivalent, since-collected
@@ -367,16 +398,16 @@ class Session:
                     texe = plan_tiled(plan, self)  # …or the plan spills
                     if texe is None:
                         raise
-                    return self._run_cached_tiled(query, texe)
-        return self._execute_and_cache(query, plan)
+                    return self._run_cached_tiled(ckey, texe)
+        return self._execute_and_cache(ckey, query, plan)
 
-    def _run_cached_tiled(self, query: str, texe):
+    def _run_cached_tiled(self, ckey: str, texe):
         from cloudberry_tpu.exec import executor as X
 
         names = sorted({s.table_name
                         for s in X.scans_of(texe._whole_plan())})
         if not self._any_external(names):
-            self._cache_statement(query, names, texe.run,
+            self._cache_statement(ckey, names, texe.run,
                                   self.config.resource.query_mem_bytes)
         return texe.run()
 
@@ -568,25 +599,28 @@ class Session:
 
     _STMT_CACHE_MAX = 64
 
-    def _cached_statement(self, query: str):
+    def _cached_statement(self, ckey: str):
         """(runner, cost) from a live cache entry, else None — returned
         together so the caller never re-indexes an entry a concurrent
         thread may have evicted. LRU: a hit moves the entry to the
         dict's end (under the lock — hits MUTATE the dict) so hot
         prepared statements survive bursts of one-off queries."""
         with self._stmt_lock:
-            entry = self._stmt_cache.pop(query, None)
+            entry = self._stmt_cache.pop(ckey, None)
             if entry is not None:
-                self._stmt_cache[query] = entry  # LRU touch
+                self._stmt_cache[ckey] = entry  # LRU touch
         if entry is None:
             return None
         from cloudberry_tpu.exec.udf import registry_version
 
-        names, versions, nseg, ddlv, runner, cost = entry
+        names, versions, cfg, ddlv, runner, cost = entry
         # ddlv pairs the catalog DDL version with the UDF registry
         # version: re-registering a function must drop plans that baked
-        # its OLD results in at bind time
-        stale = (nseg != self.config.n_segments
+        # its OLD results in at bind time. The config IDENTITY check is
+        # the config-epoch guard: any with_overrides/degrade_mesh swap
+        # (n_segments, pallas, packed wire, ...) replaces the frozen tree
+        # wholesale, so `is` catches every knob a program may have baked.
+        stale = (cfg is not self.config
                  or ddlv != (self.catalog.ddl_version,
                              registry_version()))
         if not stale:
@@ -596,16 +630,26 @@ class Session:
                 stale = True
         if stale:
             with self._stmt_lock:  # free the compiled program
-                self._stmt_cache.pop(query, None)
+                self._stmt_cache.pop(ckey, None)
             return None
         return runner, cost
 
-    def _execute_and_cache(self, query: str, plan):
+    def _execute_and_cache(self, ckey: str, query: str, plan):
         from cloudberry_tpu.exec import executor as X
 
         names = sorted({s.table_name for s in X.scans_of(plan)})
         seg = getattr(plan, "_direct_segment", None)
-        if seg is not None:
+        runner = None
+        if self.config.sched.generic_plans:
+            # generic-plan gate (sched/paramplan.py): same-shape
+            # statements share one compiled program with literals bound
+            # as device inputs — zero recompiles on a skeleton hit
+            from cloudberry_tpu.sched import paramplan
+
+            runner = paramplan.generic_runner(self, query, plan)
+        if runner is not None:
+            pass
+        elif seg is not None:
             exe = X.compile_plan(plan, self)
             runner = lambda: X.run_executable(
                 exe, X.prepare_inputs(exe, self, segment=seg))
@@ -625,26 +669,26 @@ class Session:
                 and not self._any_external(names):
             from cloudberry_tpu.exec.resource import estimate_plan_memory
 
-            self._cache_statement(query, names, runner,
+            self._cache_statement(ckey, names, runner,
                                   estimate_plan_memory(plan).peak_bytes)
         return runner()
 
-    def _cache_statement(self, query: str, names, runner,
+    def _cache_statement(self, ckey: str, names, runner,
                          cost: int = 0) -> None:
         from cloudberry_tpu.exec.udf import registry_version
 
         entry = (
             names, self._table_versions(names),
-            self.config.n_segments,
+            self.config,
             (self.catalog.ddl_version, registry_version()), runner, cost)
         with self._stmt_lock:
-            self._stmt_cache.pop(query, None)  # re-insert at the tail
+            self._stmt_cache.pop(ckey, None)  # re-insert at the tail
             while len(self._stmt_cache) >= self._STMT_CACHE_MAX:
                 # LRU eviction (hits reorder, so the head really is the
                 # least recently used) keeps the cache and its pinned
                 # XLA programs bounded under literal-inlining workloads
                 self._stmt_cache.pop(next(iter(self._stmt_cache)))
-            self._stmt_cache[query] = entry
+            self._stmt_cache[ckey] = entry
 
     # ----------------------------------------------- capacity-rung cache
     # Redistribute bucket capacities live on a power-of-two rung ladder

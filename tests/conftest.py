@@ -23,6 +23,49 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # 'slow' marks the opt-out tier: tier-1 runs `-m 'not slow'` under a
+    # hard wall-clock cap (ROADMAP.md); slow tests run in the full suite
+    config.addinivalue_line(
+        "markers", "slow: excluded from the capped tier-1 run")
+
+
+# Duration-based re-tiering (tier-1 overran its 870s cap): the slowest
+# tests whose coverage a cheaper tier-1 sibling retains move to the slow
+# tier — single-segment variants stay for every marked dist8 case, q3
+# stays for the marked q10 packed-parity pins, the memo module keeps its
+# behavior tests while its perf-property searches move, and the spill
+# modules keep one representative of each recognized spine. Node-id
+# suffixes so fixture-parametrized products can be tiered individually.
+_SLOW_TIER = (
+    "test_spill_dist.py::test_dist_merge_overflow_grows_accumulator",
+    "test_spill_dist.py::test_dist_tiled_topn_matches_in_memory",
+    "test_spill_sort_window.py::test_window_spill_matches_in_memory"
+    "[dist8]",
+    "test_spill_sort_window.py::test_skewed_redistribute_grows_bucket",
+    "test_spill.py::test_tiled_spine_expansion_join",
+    "test_packed_motion.py::test_tpch_packed_parity_pinned[q10-seg1]",
+    "test_packed_motion.py::test_tpch_packed_parity_pinned[q10-seg8]",
+    "test_memo.py::test_memo_region_survives_out_of_grammar_sibling",
+    "test_memo.py::test_memo_equivalence_random_queries",
+    "test_memo.py::test_memo_lookahead_beats_greedy_threshold",
+    "test_memo.py::test_joint_order_beats_row_dp",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q59]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q38]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q74]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q33]",
+    "test_tpcds.py::test_tpcds_distributed[q17]",
+    "test_tpcds.py::test_tpcds_distributed[q25]",
+    "test_tpcds.py::test_tpcds_distributed[q29]",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.nodeid.endswith(_SLOW_TIER):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture
 def session():
     import cloudberry_tpu as cb

@@ -1,0 +1,111 @@
+"""Parameterization correctness over TPC-H (ISSUE-3 satellite): every
+query must produce BIT-IDENTICAL results through the parameterized
+(generic-plan) path vs the literal-folded path, at 1 and 8 segments.
+
+Tier-1 runs a representative subset (scan+agg, join, filter-heavy, CASE)
+plus perturbed-literal rebinds; the full both-segment sweep over every
+TPC-H query rides the ``slow`` tier (tier-1 wall-clock is capped)."""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config
+from tools.tpch_queries import QUERIES
+from tools.tpchgen import load_tpch
+
+SUBSET = ["q1", "q3", "q6", "q14"]
+
+# literal perturbations that keep each query valid — the REBIND path:
+# same skeleton, different parameter vector
+PERTURB = {
+    "q1": ("'1998-12-01'", "'1998-11-15'"),
+    "q3": ("'1995-03-15'", "'1995-03-01'"),
+    "q6": ("24", "30"),
+    "q14": ("'1995-09-01'", "'1995-06-01'"),
+}
+
+
+def _pair(nseg):
+    on = cb.Session(Config(n_segments=nseg))
+    off = cb.Session(Config(n_segments=nseg).with_overrides(
+        **{"sched.generic_plans": False}))
+    for s in (on, off):
+        load_tpch(s, sf=0.01, seed=7)
+    return on, off
+
+
+@pytest.fixture(scope="module")
+def pair1():
+    return _pair(1)
+
+
+@pytest.fixture(scope="module")
+def pair8():
+    return _pair(8)
+
+
+def assert_bit_identical(got, want, name):
+    gsel, wsel = np.asarray(got.sel), np.asarray(want.sel)
+    assert int(gsel.sum()) == int(wsel.sum()), name
+    gcols = got.decoded_columns()
+    wcols = want.decoded_columns()
+    assert list(gcols) == list(wcols), name
+    for cname in gcols:
+        g, w = np.asarray(gcols[cname]), np.asarray(wcols[cname])
+        if g.dtype == object or w.dtype == object:
+            np.testing.assert_array_equal(g, w, err_msg=f"{name}.{cname}")
+        else:
+            # bit-identical, floats included: the generic program runs
+            # the SAME ops with literals as inputs instead of constants
+            np.testing.assert_array_equal(
+                g.view(np.uint8) if g.dtype.kind == "f" else g,
+                w.view(np.uint8) if w.dtype.kind == "f" else w,
+                err_msg=f"{name}.{cname}")
+
+
+def _run_pair(on, off, qname, sql=None):
+    sql = sql or QUERIES[qname]
+    got = on.sql(sql)
+    want = off.sql(sql)
+    assert_bit_identical(got, want, qname)
+
+
+@pytest.mark.parametrize("qname", SUBSET)
+def test_subset_parity_single(pair1, qname):
+    on, off = pair1
+    _run_pair(on, off, qname)
+    # rebind with a perturbed literal: zero recompiles AND bit-identity
+    old, new = PERTURB[qname]
+    assert old in QUERIES[qname]
+    c0 = on.stmt_log.counter("compiles")
+    _run_pair(on, off, qname + "-rebind",
+              QUERIES[qname].replace(old, new))
+    assert on.stmt_log.counter("compiles") == c0, \
+        f"{qname}: perturbed literal recompiled"
+
+
+@pytest.mark.parametrize("qname", ["q3", "q6"])
+def test_subset_parity_dist8(pair8, qname):
+    on, off = pair8
+    _run_pair(on, off, qname)
+    old, new = PERTURB[qname]
+    c0 = on.stmt_log.counter("compiles")
+    _run_pair(on, off, qname + "-rebind",
+              QUERIES[qname].replace(old, new))
+    assert on.stmt_log.counter("compiles") == c0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("nseg", [1, 8])
+@pytest.mark.parametrize("qname", sorted(QUERIES))
+def test_full_parity_sweep(qname, nseg, request):
+    """Every TPC-H query, both segment counts: parameterized vs
+    literal-folded, bit-identical (the full satellite sweep; slow tier)."""
+    key = f"_parity_pair_{nseg}"
+    pair = getattr(request.session, key, None)  # reuse across params
+    if pair is None:
+        pair = _pair(nseg)
+        setattr(request.session, key, pair)
+    on, off = pair
+    _run_pair(on, off, f"{qname}@{nseg}", QUERIES[qname])

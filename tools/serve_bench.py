@@ -1,0 +1,212 @@
+"""Closed-loop serving benchmark — QPS/latency for the micro-batch
+dispatcher vs one-at-a-time dispatch (the ISSUE-3 acceptance harness).
+
+N client threads hammer a Server over the wire protocol with a statement
+mix; each mode runs the SAME closed loop and the CSV rows make the
+comparison direct:
+
+    mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,compiles,\
+dispatches,batches,batched_requests,avg_occupancy
+
+- ``direct``  — dispatcher off: every request is its own parse→(generic
+  rebind)→launch through the shared session.
+- ``batched`` — dispatcher on (config.sched.enabled): same-skeleton
+  requests coalesce per tick into one stacked vmapped launch.
+
+Mixes:
+- ``point`` — repeated point lookups with rotating literals
+  (``SELECT k, v, w FROM pts WHERE k = <r>``): the prepared-statement
+  serving shape; generic plans make it compile-free, the dispatcher makes
+  it launch-amortized.
+- ``q6``    — a parameterized TPC-H-Q6-shaped aggregate over a synthetic
+  lineitem slice with rotating predicate literals.
+- ``mixed`` — 80% point / 20% q6.
+
+Runs on CPU (JAX_PLATFORMS=cpu) for CI smoke; on real hardware the launch
+amortization grows with dispatch overhead. Usage:
+
+    python tools/serve_bench.py --mode both --mix point --clients 8 \
+        --duration 5 --csv out.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
+              "compiles,dispatches,batches,batched_requests,avg_occupancy")
+
+
+def build_session(mode: str, rows: int, tick_s: float, max_batch: int):
+    import numpy as np
+
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import Config
+
+    cfg = Config().with_overrides(**{
+        "sched.enabled": mode == "batched",
+        "sched.tick_s": tick_s,
+        "sched.max_batch": max_batch,
+    })
+    s = cb.Session(cfg)
+    s.sql("create table pts (k bigint, v bigint, w double) "
+          "distributed by (k)")
+    t = s.catalog.table("pts")
+    t.set_data({
+        "k": np.arange(rows, dtype=np.int64),
+        "v": (np.arange(rows, dtype=np.int64) * 7) % 1000,
+        "w": np.arange(rows, dtype=np.float64) * 0.5,
+    }, {})
+    s.sql("create table li (qty decimal(2), price decimal(2), "
+          "disc decimal(2), sd date)")
+    rng = np.random.default_rng(11)
+    m = max(rows // 2, 1024)
+    s.catalog.table("li").set_data({
+        "qty": rng.integers(1, 5000, m).astype(np.int64),
+        "price": rng.integers(100, 10000, m).astype(np.int64),
+        "disc": rng.integers(0, 11, m).astype(np.int64),
+        "sd": rng.integers(8000, 12000, m).astype(np.int32),
+    }, {})
+    return s
+
+
+def _point_sql(i: int, rows: int) -> str:
+    return f"select k, v, w from pts where k = {(i * 2654435761) % rows}"
+
+
+def _q6_sql(i: int) -> str:
+    lo = 1 + (i % 5)
+    return ("select sum(price * disc) as rev from li "
+            f"where disc between 0.0{lo} and 0.0{lo + 4} "
+            f"and qty < {20 + (i % 7)}.0")
+
+
+def _mix_sql(mix: str, i: int, rows: int) -> str:
+    if mix == "point":
+        return _point_sql(i, rows)
+    if mix == "q6":
+        return _q6_sql(i)
+    return _q6_sql(i) if i % 5 == 4 else _point_sql(i, rows)
+
+
+def run_mode(mode: str, mix: str, clients: int, duration_s: float,
+             rows: int, tick_s: float, max_batch: int) -> dict:
+    """One closed-loop run; returns the CSV row fields."""
+    from cloudberry_tpu.serve import Client, Server
+
+    session = build_session(mode, rows, tick_s, max_batch)
+    # warm the compile caches OUTSIDE the measured window: the bench
+    # compares steady-state dispatch, not first-compile latency
+    session.sql(_point_sql(0, rows))
+    session.sql(_q6_sql(0))
+    c_before = session.stmt_log.counter("compiles")
+    d_before = session.stmt_log.counter("dispatches")
+
+    lats: list[float] = []
+    lat_lock = threading.Lock()
+    errors: list[str] = []
+    stop_at = [0.0]
+
+    def worker(wid: int):
+        lat_local = []
+        try:
+            with Client(srv.host, srv.port) as c:
+                i = wid * 100_003
+                while time.monotonic() < stop_at[0]:
+                    sql = _mix_sql(mix, i, rows)
+                    i += 1
+                    t0 = time.monotonic()
+                    c.sql(sql)
+                    lat_local.append(time.monotonic() - t0)
+        except Exception as e:  # pragma: no cover - surfaced in result
+            errors.append(f"{type(e).__name__}: {e}")
+        with lat_lock:
+            lats.extend(lat_local)
+
+    with Server(session=session) as srv:
+        stop_at[0] = time.monotonic() + duration_s
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(clients)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration_s + 120)
+        wall = time.monotonic() - t_start
+        disp = session.stmt_log
+        dsnap = getattr(session, "_dispatcher", None)
+        dstats = dsnap.snapshot() if dsnap is not None else {}
+    if errors:
+        raise RuntimeError(f"bench clients failed: {errors[:3]}")
+    lats.sort()
+
+    def pct(p: float) -> float:
+        if not lats:
+            return 0.0
+        return lats[min(len(lats) - 1, int(p * len(lats)))] * 1000
+
+    return {
+        "mode": mode, "mix": mix, "clients": clients,
+        "duration_s": round(wall, 2), "requests": len(lats),
+        "qps": round(len(lats) / max(wall, 1e-9), 1),
+        "p50_ms": round(pct(0.50), 3), "p99_ms": round(pct(0.99), 3),
+        "compiles": disp.counter("compiles") - c_before,
+        "dispatches": disp.counter("dispatches") - d_before,
+        "batches": dstats.get("batches", 0),
+        "batched_requests": dstats.get("batched_requests", 0),
+        "avg_occupancy": dstats.get("avg_occupancy", 0.0),
+    }
+
+
+def csv_row(r: dict) -> str:
+    return ",".join(str(r[k]) for k in CSV_HEADER.split(","))
+
+
+def main(argv=None) -> list[dict]:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", default="both",
+                    choices=["both", "direct", "batched"])
+    ap.add_argument("--mix", default="point",
+                    choices=["point", "q6", "mixed"])
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--tick-s", type=float, default=0.002)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--csv", default=None,
+                    help="append CSV rows to this file")
+    args = ap.parse_args(argv)
+
+    modes = ["direct", "batched"] if args.mode == "both" else [args.mode]
+    out = []
+    print(CSV_HEADER)
+    for mode in modes:
+        r = run_mode(mode, args.mix, args.clients, args.duration,
+                     args.rows, args.tick_s, args.max_batch)
+        out.append(r)
+        print(csv_row(r), flush=True)
+    if args.csv:
+        new = not os.path.exists(args.csv)
+        with open(args.csv, "a") as fh:
+            if new:
+                fh.write(CSV_HEADER + "\n")
+            for r in out:
+                fh.write(csv_row(r) + "\n")
+    if len(out) == 2:
+        base, batched = out[0]["qps"], out[1]["qps"]
+        if base > 0:
+            print(f"# batched/direct QPS: {batched / base:.2f}x",
+                  file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()
