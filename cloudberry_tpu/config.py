@@ -200,11 +200,29 @@ class HealthConfig:
     # Shrink the segment mesh to the live device count before retrying.
     degrade: bool = True
     backoff_s: float = 0.2
+    # Admission circuit breaker (lifecycle.CircuitBreaker): this many
+    # CONSECUTIVE statements needing a device-loss recovery trip the
+    # engine to read-only-degraded — writes refuse with the retryable
+    # BreakerOpen until a health probe closes it. 0 disables.
+    breaker_threshold: int = 3
+    # Seconds the breaker stays open before a write may half-open it
+    # (one health probe decides).
+    breaker_cooldown_s: float = 30.0
+    # HealthMonitor probe-history ring size (bounded: a long-lived server
+    # probing on an interval must not leak).
+    monitor_history: int = 256
 
 
 @dataclass(frozen=True)
 class Config:
     n_segments: int = 1
+    # Per-statement wall-clock limit in seconds (the statement_timeout
+    # GUC): every statement gets a deadline this far out; cooperative
+    # checks at execution seams (and the server watchdog) convert an
+    # overrun into the retryable StatementTimeout. 0 disables. A
+    # per-request deadline (dispatcher deadline_s / wire "deadline_s")
+    # tightens but never loosens this.
+    statement_timeout_s: float = 0.0
     interconnect: InterconnectConfig = field(default_factory=InterconnectConfig)
     exec: ExecConfig = field(default_factory=ExecConfig)
     planner: PlannerConfig = field(default_factory=PlannerConfig)

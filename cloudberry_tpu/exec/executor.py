@@ -370,6 +370,12 @@ def grow_expansion(plan: N.PlanNode, message: str, factor: int = 4,
     guaranteed progress. Tiled callers keep it off: their id miss means
     the overflowing node is genuinely outside the plan at hand, and the
     original error must surface, not a mutated retry."""
+    from cloudberry_tpu.lifecycle import check_cancel
+
+    # cancel seam: each grow-and-retry round recompiles and re-runs the
+    # whole program — a cancelled statement must stop climbing the
+    # capacity ladder, not ride it to the ceiling first
+    check_cancel()
     node = find_expansion_node(plan, message)
     join_hits = [node] if node is not None else []
     if not join_hits and allow_fallback \

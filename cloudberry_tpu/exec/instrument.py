@@ -62,9 +62,50 @@ class StatementLog:
         sid = next(self._ids)
         with self._lock:
             self._active[sid] = {
-                "id": sid, "session": session_id,
+                "id": sid, "session": session_id, "state": "running",
                 "sql": sql[:500], "started": time.time()}
         return sid
+
+    # ------------------------------------------------ statement lifecycle
+    # The active registry doubles as the cancellation directory (the
+    # pg_stat_activity + pg_cancel_backend pair): a session attaches its
+    # StatementHandle at begin time, and any thread — the watchdog, the
+    # server's `cancel <id>` verb — cancels by statement id.
+
+    def attach(self, sid: int, handle) -> None:
+        """Register a lifecycle.StatementHandle for an active statement."""
+        with self._lock:
+            entry = self._active.get(sid)
+            if entry is not None:
+                entry["handle"] = handle
+
+    def active_handles(self) -> list[tuple[int, object]]:
+        """(statement id, handle) for every active statement that has
+        one — the watchdog's scan set."""
+        with self._lock:
+            return [(sid, e["handle"]) for sid, e in self._active.items()
+                    if e.get("handle") is not None]
+
+    def cancel(self, sid: int, reason: str = "cancelled") -> bool:
+        """Cancel an active statement by id (pg_cancel_backend analog).
+        Returns False when the id is not an active, cancellable
+        statement (already finished, or never attached a handle)."""
+        with self._lock:
+            entry = self._active.get(sid)
+            handle = entry.get("handle") if entry is not None else None
+        if handle is None:
+            return False
+        if handle.token.cancel(reason,
+                               f"statement {sid} cancelled by request"):
+            self.bump("cancel_requests")
+        self.mark_cancelling(sid)
+        return True
+
+    def mark_cancelling(self, sid: int) -> None:
+        with self._lock:
+            entry = self._active.get(sid)
+            if entry is not None:
+                entry["state"] = "cancelling"
 
     def finish(self, sid: int, status: str, rows: int = -1,
                error: str | None = None, **extra) -> None:
@@ -72,6 +113,10 @@ class StatementLog:
             entry = self._active.pop(sid, None)
             if entry is None:
                 return
+            # the handle (and its token) must not outlive the statement
+            # in the history ring
+            entry.pop("handle", None)
+            entry.pop("state", None)
             entry["wall_s"] = round(time.time() - entry["started"], 4)
             entry["status"] = status
             entry["rows"] = rows
@@ -83,11 +128,21 @@ class StatementLog:
             self._recent.append(entry)
 
     def activity(self) -> list[dict]:
-        """Currently-executing statements (pg_stat_activity role)."""
+        """Currently-executing statements (pg_stat_activity role), with
+        live lifecycle state: id, state (running/cancelling), elapsed,
+        and time left to the deadline when one is set."""
         now = time.time()
+        mono = time.monotonic()
+        out = []
         with self._lock:
-            return [{**e, "elapsed_s": round(now - e["started"], 4)}
-                    for e in self._active.values()]
+            for e in self._active.values():
+                row = {k: v for k, v in e.items() if k != "handle"}
+                row["elapsed_s"] = round(now - e["started"], 4)
+                h = e.get("handle")
+                if h is not None and h.deadline is not None:
+                    row["deadline_in_s"] = round(h.deadline - mono, 4)
+                out.append(row)
+        return out
 
     def recent(self, limit: int = 50) -> list[dict]:
         """Most recent completed statements, newest first."""

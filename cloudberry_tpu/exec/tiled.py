@@ -571,7 +571,13 @@ class AdaptiveTiledMixin:
     _what = "tiled execution"
 
     def _run_adaptive(self) -> ColumnBatch:
+        from cloudberry_tpu.lifecycle import check_cancel
+
         while True:
+            # cancel seam: each adaptive round restarts the whole tile
+            # stream — a cancelled/over-deadline statement stops between
+            # rounds instead of re-streaming the table
+            check_cancel()
             try:
                 return self._run_once()
             except X.ExecError as e:
@@ -823,6 +829,9 @@ class TiledExecutable(AdaptiveTiledMixin):
             n_tiles = 1
 
         fault_point("tiled_finalize")
+        from cloudberry_tpu.lifecycle import check_cancel
+
+        check_cancel()
         cols, sel, fchecks = finalize_fn(acc)
         X.raise_checks(fchecks)
         self.report["n_tiles"] = n_tiles
@@ -996,6 +1005,9 @@ class SortTiledExecutable(TiledExecutable):
                 key_runs[i].append(np.asarray(k)[mask])
 
         fault_point("tiled_finalize")
+        from cloudberry_tpu.lifecycle import check_cancel
+
+        check_cancel()
         cols, karr = merge_sorted_runs(runs, key_runs,
                                        shape.sortnode.child.fields,
                                        len(shape.sortnode.keys))
@@ -1133,6 +1145,13 @@ def _leaf_of(root: N.PlanNode) -> N.PlanNode:
 
 
 def _raise_tile_checks(checks: dict, tile_idx: int) -> None:
+    # the per-tile cancel seam (the CHECK_FOR_INTERRUPTS row-boundary
+    # analog): every step/chunk of the single-node AND distributed tiled
+    # executables passes through here, so cancellation latency is bounded
+    # by one tile's device launch
+    from cloudberry_tpu.lifecycle import check_cancel
+
+    check_cancel()
     for msg, bad in checks.items():
         if bool(np.asarray(bad).any()):
             raise X.ExecError(f"[tile {tile_idx}] {msg}")

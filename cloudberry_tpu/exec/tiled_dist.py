@@ -737,6 +737,11 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             _raise_tile_checks(checks, 0)
             n_tiles = 1
 
+        # cancel seam before the finalize motions (the merge collective):
+        # the per-tile checks bound the stream, this bounds the tail
+        from cloudberry_tpu.lifecycle import check_cancel
+
+        check_cancel()
         cols, sel, fchecks = finalize_fn(acc)
         X.raise_checks(fchecks)
         self.report["n_tiles"] = n_tiles

@@ -214,7 +214,9 @@ def cmd_serve(args) -> int:
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
-        srv.stop()
+        # smart shutdown: finish accepted work, refuse new requests with
+        # the retryable drain error, then close (Ctrl-C twice to force)
+        srv.stop(drain_s=10.0)
     return 0
 
 

@@ -73,13 +73,27 @@ def probe(n_devices: Optional[int] = None) -> ProbeResult:
 
 @dataclass
 class HealthMonitor:
-    """Interval prober (FtsProbeMain loop analog)."""
+    """Interval prober (FtsProbeMain loop analog). ``history`` is a
+    BOUNDED ring: a long-lived server probing on an interval must never
+    grow its status log without bound. ``history_maxlen`` 0 (the
+    default) reads config.health.monitor_history."""
 
     interval_s: float = 30.0
     on_failure: Optional[Callable[[ProbeResult], None]] = None
-    history: list[ProbeResult] = field(default_factory=list)
+    history_maxlen: int = 0
+    history: "object" = None
     _stop: threading.Event = field(default_factory=threading.Event)
     _thread: Optional[threading.Thread] = None
+
+    def __post_init__(self):
+        import collections
+
+        if not self.history_maxlen:
+            from cloudberry_tpu.config import get_config
+
+            self.history_maxlen = get_config().health.monitor_history
+        self.history = collections.deque(self.history or (),
+                                         maxlen=self.history_maxlen)
 
     def start(self) -> None:
         if self._thread is not None:

@@ -78,9 +78,10 @@ class Dispatcher:
         self._q: list[_Request] = []
         self._cond = threading.Condition()
         self._stop = False
+        self._busy = False          # worker mid-batch (drain observability)
         self._thread: Optional[threading.Thread] = None
         self.stats = {
-            "enqueued": 0, "rejected": 0, "expired": 0,
+            "enqueued": 0, "rejected": 0, "expired": 0, "cancelled": 0,
             "batches": 0, "batched_requests": 0, "singles": 0,
             "seq_fallbacks": 0, "occupancy_sum": 0.0, "max_depth": 0,
         }
@@ -106,15 +107,36 @@ class Dispatcher:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
-        # drain: nothing may block forever on a dead worker
+        # nothing may block forever on a dead worker: whatever drain()
+        # could not finish fails with the RETRYABLE drain error — an
+        # accepted request is answered or failed, never silently dropped
+        from cloudberry_tpu.lifecycle import ServerDraining
+
         with self._cond:
             pending, self._q = self._q, []
         for r in pending:
-            r.finish(error=RuntimeError("dispatcher stopped"))
+            r.finish(error=ServerDraining(
+                "dispatcher stopped while this request was queued; "
+                "retry against the serving primary"))
 
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._q)
+
+    def drain(self, timeout_s: float) -> bool:
+        """Wait until the queue is empty AND the worker is idle — every
+        accepted request has been answered (the smart-shutdown wait).
+        Returns False when work remains at the timeout (the caller then
+        cancels stragglers; nothing is ever silently dropped — stop()
+        fails whatever is still queued)."""
+        end = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while self._q or self._busy:
+                left = end - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=min(left, 0.1))
+        return True
 
     # ------------------------------------------------------------- submit
 
@@ -140,7 +162,9 @@ class Dispatcher:
                         "config.sched.max_queue")
                 self._cond.wait(timeout=left)
             if self._stop:
-                raise RuntimeError("dispatcher stopped")
+                from cloudberry_tpu.lifecycle import ServerDraining
+
+                raise ServerDraining("dispatcher stopped")
             self._q.append(req)
             self.stats["enqueued"] += 1
             self.stats["max_depth"] = max(self.stats["max_depth"],
@@ -177,6 +201,7 @@ class Dispatcher:
                         self._cond.wait(timeout=left)
             with self._cond:
                 batch, self._q = self._q, []
+                self._busy = bool(batch)
                 self._cond.notify_all()  # wake blocked submitters
             if batch:
                 try:
@@ -185,6 +210,10 @@ class Dispatcher:
                     for r in batch:
                         if not r.done.is_set():
                             r.finish(error=e)
+                finally:
+                    with self._cond:
+                        self._busy = False
+                        self._cond.notify_all()  # wake drain waiters
 
     def _groups(self, batch: list[_Request]):
         """Group same-skeleton requests, preserving arrival order within
@@ -222,14 +251,60 @@ class Dispatcher:
                 self._run_group(chunk)
 
     def _run_group(self, group: list[_Request]) -> None:
+        from cloudberry_tpu import lifecycle
+
         log = self.session.stmt_log
         if len(group) > 1:
+            # every batched request gets its own lifecycle handle in the
+            # activity view (cancellable by id, watchdog-visible); the
+            # stacked launch runs under a composite scope polling all of
+            # them at the flush/tile seams. config.statement_timeout_s
+            # tightens each deadline here because run_batch bypasses
+            # session.sql — the two dispatcher paths must enforce the
+            # same limit for the same statement
+            timeout = self.session.config.statement_timeout_s
+            t_dl = (time.monotonic() + timeout) if timeout else None
+
+            def _dl(r):
+                return r.deadline if t_dl is None \
+                    else min(r.deadline, t_dl)
+
             sids = [log.begin(r.sql) for r in group]
+            handles = [lifecycle.StatementHandle(sid, deadline=_dl(r))
+                       for sid, r in zip(sids, group)]
+            for sid, h in zip(sids, handles):
+                log.attach(sid, h)
             c0 = log.counter("compiles")
             try:
-                with self._exec_scope():
+                with self._exec_scope(), lifecycle.statement_scope(
+                        lifecycle.CompositeHandle(handles)):
                     out = paramplan.run_batch(self.session,
                                               [r.sql for r in group])
+            except lifecycle.StatementError:
+                # a member's cancel/timeout aborted the stacked launch:
+                # that member fails with ITS verdict; innocent batchmates
+                # re-route through the sequential path below
+                survivors: list[_Request] = []
+                for r, sid, h in zip(group, sids, handles):
+                    err = None
+                    try:
+                        h.check()
+                    except lifecycle.StatementError as e:
+                        err = e
+                    if err is not None:
+                        self.stats["cancelled"] += 1
+                        log.finish(sid, "error",
+                                   error=f"{type(err).__name__}: {err}")
+                        r.finish(error=err)
+                    else:
+                        log.finish(sid, "requeued")
+                        survivors.append(r)
+                if survivors:
+                    # straight to sequential dispatch: this is a cancel
+                    # abort, not a generic-plan miss — it must not count
+                    # as (or re-log) a seq_fallback
+                    self._run_sequential(survivors)
+                return
             except BaseException as e:
                 for sid in sids:
                     log.finish(sid, "error",
@@ -256,7 +331,10 @@ class Dispatcher:
             self.stats["seq_fallbacks"] += 1
             for sid in sids:
                 log.finish(sid, "requeued")  # re-logged by session.sql
-        # sequential path: ordinary dispatch, one statement at a time
+        self._run_sequential(group)
+
+    def _run_sequential(self, group: list[_Request]) -> None:
+        """Ordinary dispatch, one statement at a time."""
         for r in group:
             if time.monotonic() > r.deadline:
                 self.stats["expired"] += 1
@@ -266,7 +344,11 @@ class Dispatcher:
             self.stats["singles"] += 1
             try:
                 with self._exec_scope():
-                    r.finish(result=self.session.sql(r.sql))
+                    # the request's deadline governs EXECUTION too (the
+                    # session checks it at its cancel seams), not just
+                    # time-in-queue
+                    r.finish(result=self.session.sql(
+                        r.sql, _deadline=r.deadline))
             except BaseException as e:
                 r.finish(error=e)
 

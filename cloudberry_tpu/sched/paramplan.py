@@ -844,6 +844,12 @@ def run_batch(session, sqls: list[str]):
     try:
         with session._gate, session._admitted(cost):
             fault_point("sched_flush")
+            # cancel seam at the batched launch: a cancelled/expired
+            # member aborts the flush (StatementError is NOT part of the
+            # fallback catch below — the dispatcher re-routes survivors)
+            from cloudberry_tpu.lifecycle import check_cancel
+
+            check_cancel()
             session.stmt_log.bump("dispatches")
             cols, sel, checks = fn(stacked)
             X.raise_checks(checks)

@@ -1,4 +1,4 @@
-from cloudberry_tpu.serve.client import Client
+from cloudberry_tpu.serve.client import Client, ServerError
 from cloudberry_tpu.serve.server import Server
 
-__all__ = ["Server", "Client"]
+__all__ = ["Server", "Client", "ServerError"]

@@ -1,21 +1,43 @@
-"""Client for the JSON-over-TCP serving layer (the libpq analog)."""
+"""Client for the JSON-over-TCP serving layer (the libpq analog).
+
+Errors carry the server's lifecycle taxonomy (lifecycle.py): ``etype``
+names the engine error class and ``retryable`` is the server's verdict —
+True for failures about WHEN the statement ran (drain, backpressure,
+deadline pressure), False for failures about the statement itself.
+``retry_reads=True`` opts into automatic retries of IDEMPOTENT reads on
+retryable errors with jittered exponential backoff (writes never retry:
+the engine does not replay DML, and neither may the client).
+"""
 
 from __future__ import annotations
 
 import json
+import random
 import socket
+import time
 
 
 class ServerError(RuntimeError):
-    pass
+    """An error response from the server. ``etype`` is the engine error
+    class name; ``retryable`` is the server's taxonomy verdict."""
+
+    def __init__(self, message: str, etype: str | None = None,
+                 retryable: bool = False):
+        super().__init__(message)
+        self.etype = etype
+        self.retryable = retryable
 
 
 class Client:
     def __init__(self, host: str, port: int, timeout: float = 120.0,
-                 token: str | None = None):
+                 token: str | None = None, retry_reads: bool = False,
+                 max_retries: int = 3, backoff_s: float = 0.05):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._r = self._sock.makefile("rb")
         self._w = self._sock.makefile("wb")
+        self.retry_reads = retry_reads
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
         if token is not None:
             self._request({"auth": token})
 
@@ -27,18 +49,52 @@ class Client:
             raise ServerError("server closed the connection")
         resp = json.loads(line)
         if not resp.get("ok"):
-            raise ServerError(resp.get("error", "unknown server error"))
+            raise ServerError(resp.get("error", "unknown server error"),
+                              etype=resp.get("etype"),
+                              retryable=bool(resp.get("retryable")))
         resp.pop("ok")
         return resp
 
-    def sql(self, query: str) -> dict:
+    def sql(self, query: str, deadline_s: float | None = None) -> dict:
         """Execute one statement; returns {"columns", "rows", "rowcount"}
         for queries or {"status": ...} for DDL/DML; raises ServerError on
-        engine errors."""
-        return self._request({"sql": query})
+        engine errors. ``deadline_s`` bounds the statement end to end
+        (queueing AND execution — the per-request statement_timeout).
+
+        With ``retry_reads`` enabled, a READ that fails with a retryable
+        error (server draining, queue backpressure, deadline pressure)
+        retries up to ``max_retries`` times with jittered exponential
+        backoff. Writes are never auto-retried — a retried write could
+        double-apply."""
+        req: dict = {"sql": query}
+        if deadline_s is not None:
+            req["deadline_s"] = deadline_s
+        if not self.retry_reads:
+            return self._request(req)
+        from cloudberry_tpu.sql.classify import read_only
+
+        if not read_only(query):
+            return self._request(req)
+        delay = self.backoff_s
+        for attempt in range(self.max_retries + 1):
+            try:
+                return self._request(req)
+            except ServerError as e:
+                if not e.retryable or attempt == self.max_retries:
+                    raise
+                # full jitter: desynchronize a thundering herd of
+                # retrying clients (they all saw the same drain/overload)
+                time.sleep(delay * (0.5 + random.random()))
+                delay *= 2
+        raise AssertionError("unreachable")
 
     def rows(self, query: str) -> list[list]:
         return self.sql(query)["rows"]
+
+    def cancel(self, statement_id: int) -> dict:
+        """Cancel a running statement by its activity id (the
+        pg_cancel_backend analog; ids via meta("activity"))."""
+        return self._request({"cancel": statement_id})
 
     def meta(self, kind: str, arg=None):
         """Catalog metadata snapshot (tables/columns/stats/views/matviews/

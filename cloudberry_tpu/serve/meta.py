@@ -68,6 +68,7 @@ def describe(session, kind: str, arg=None):
     if kind == "sequences":
         return sorted(getattr(cat, "sequences", {}) or ())
     if kind == "info":
+        breaker = getattr(session, "_breaker", None)
         return {
             "engine": "cloudberry_tpu",
             "n_segments": int(session.config.n_segments),
@@ -75,6 +76,9 @@ def describe(session, kind: str, arg=None):
             "tables": len(cat.tables),
             "views": len(cat.views),
             "matviews": len(cat.matviews),
+            # admission circuit breaker (lifecycle.py): closed | open
+            # (read-only-degraded) | half-open, with trip counters
+            "breaker": breaker.snapshot() if breaker is not None else None,
         }
     if kind == "sched":
         # scheduler observability: queue depth / batch occupancy from the

@@ -132,7 +132,8 @@ class Server:
                  read_only: bool = False,
                  auth_token: Optional[str] = None,
                  max_login_failures: int = 3,
-                 lockout_s: float = 60.0):
+                 lockout_s: float = 60.0,
+                 watchdog_interval_s: float = 0.05):
         import cloudberry_tpu as cb
 
         self.session = session if session is not None else cb.Session(config)
@@ -149,6 +150,17 @@ class Server:
         self._login_failures: dict[str, list] = {}
         self._login_lock = threading.Lock()
         self._rw = _RWLock()
+        # statement-lifecycle state (lifecycle.py): the watchdog cancels
+        # over-deadline statements (statement_timeout enforcement even
+        # when the worker thread is wedged at an interruptible seam);
+        # _draining + the in-flight request count drive graceful drain
+        from cloudberry_tpu.lifecycle import Watchdog
+
+        self.watchdog = Watchdog(self.session.stmt_log,
+                                 interval_s=watchdog_interval_s)
+        self._draining = False
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -164,18 +176,26 @@ class Server:
                         line = line.strip()
                         if not line:
                             continue
+                        # in-flight window covers compute AND response
+                        # write: drain waits until every accepted request
+                        # has its answer on the wire
+                        outer._request_begin()
                         try:
-                            req = json.loads(line)
-                            if not authed:
-                                resp, authed = outer._authenticate(req,
-                                                                   addr)
-                            else:
-                                resp = outer._execute(req, sess)
-                        except Exception as e:  # bad client must not kill us
-                            resp = {"ok": False, "etype": type(e).__name__,
-                                    "error": f"{type(e).__name__}: {e}"}
-                        self.wfile.write(json.dumps(resp).encode() + b"\n")
-                        self.wfile.flush()
+                            try:
+                                req = json.loads(line)
+                                if not authed:
+                                    resp, authed = outer._authenticate(
+                                        req, addr)
+                                else:
+                                    resp = outer._execute(req, sess)
+                            except Exception as e:
+                                # bad client/statement must not kill us
+                                resp = outer._error_resp(e)
+                            self.wfile.write(
+                                json.dumps(resp).encode() + b"\n")
+                            self.wfile.flush()
+                        finally:
+                            outer._request_end()
                         if resp.get("fatal"):
                             return
                 finally:
@@ -205,6 +225,29 @@ class Server:
 
             self.dispatcher = Dispatcher(self.session,
                                          exec_scope=self._locked)
+
+    # -------------------------------------------------- lifecycle plumbing
+
+    def _request_begin(self) -> None:
+        with self._inflight_cond:
+            self._inflight += 1
+
+    def _request_end(self) -> None:
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
+    @staticmethod
+    def _error_resp(e: BaseException) -> dict:
+        """Wire error with the shared taxonomy: ``etype`` names the
+        error class, ``retryable`` is the server's verdict (the client's
+        auto-retry trusts it — one classifier, lifecycle.is_retryable,
+        for both sides)."""
+        from cloudberry_tpu.lifecycle import is_retryable
+
+        return {"ok": False, "etype": type(e).__name__,
+                "retryable": is_retryable(e),
+                "error": f"{type(e).__name__}: {e}"}
 
     def _locked(self, write: bool = False):
         """Statement-level lock scope: a no-op in per-connection mode
@@ -298,6 +341,9 @@ class Server:
         # one activity/history log across ALL backends: "who runs what"
         # must span connections (pg_stat_activity is cluster-wide)
         s.stmt_log = self.session.stmt_log
+        # one circuit breaker: device-loss flapping is an ENGINE
+        # condition, so read-only-degraded spans backends like the gate
+        s._breaker = self.session._breaker
         # dispatcher observability (serve/meta.py "sched") spans backends
         s._dispatcher = getattr(self.session, "_dispatcher", None)
         return s
@@ -325,6 +371,7 @@ class Server:
             self.cron.start()
         if self.dispatcher is not None:
             self.dispatcher.start()
+        self.watchdog.start()
         return self
 
     def serve_forever(self) -> None:
@@ -332,12 +379,41 @@ class Server:
             self.cron.start()  # foreground entry point runs jobs too
         if self.dispatcher is not None:
             self.dispatcher.start()
+        self.watchdog.start()
         self._server.serve_forever()
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 0.0) -> None:
+        """Shut down; with ``drain_s`` > 0, gracefully (smart shutdown):
+        new requests refuse with the retryable SERVER_DRAINING error
+        while accepted in-flight work (handler threads AND the
+        dispatcher queue) finishes; whatever is still running at the
+        budget's end is CANCELLED with the same retryable drain error —
+        every accepted request gets an answer, never a silent drop."""
+        import time as _t
+
+        self._draining = True
+        if drain_s > 0:
+            end = _t.monotonic() + drain_s
+            with self._inflight_cond:
+                while self._inflight and _t.monotonic() < end:
+                    self._inflight_cond.wait(
+                        timeout=min(0.1, max(end - _t.monotonic(), 0.01)))
+            if self.dispatcher is not None:
+                self.dispatcher.drain(max(0.0, end - _t.monotonic()))
+            # stragglers past the budget: cancel cooperatively so their
+            # handlers write the retryable drain error before we close
+            for _sid, h in self.session.stmt_log.active_handles():
+                h.token.cancel(
+                    "drain", "statement abandoned by server drain; "
+                    "retry against the serving primary")
+            with self._inflight_cond:
+                grace = _t.monotonic() + 2.0
+                while self._inflight and _t.monotonic() < grace:
+                    self._inflight_cond.wait(timeout=0.1)
         self.cron.stop()
         if self.dispatcher is not None:
             self.dispatcher.stop()
+        self.watchdog.stop()
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
@@ -353,6 +429,33 @@ class Server:
     # ------------------------------------------------------------- execution
 
     def _execute(self, req: dict, sess) -> dict:
+        if "cancel" in req:
+            # the pg_cancel_backend analog: cancel a running statement by
+            # its activity id ({"meta": "activity"} lists them). The
+            # target fails with StatementCancelled at its next seam.
+            # Deliberately ABOVE the drain gate: cancelling your own
+            # straggler is most useful exactly while the server drains.
+            try:
+                sid = int(req["cancel"])
+            except (TypeError, ValueError):
+                return {"ok": False, "etype": "ValueError",
+                        "retryable": False,
+                        "error": "cancel needs an integer statement id"}
+            if sess.stmt_log.cancel(sid):
+                return {"ok": True, "status": f"CANCEL {sid}"}
+            return {"ok": False, "etype": "UnknownStatement",
+                    "retryable": False,
+                    "error": f"no active statement {sid} "
+                             "(already finished, or never started)"}
+        if self._draining:
+            # smart shutdown: accepted in-flight work finishes, NEW work
+            # is refused with the RETRYABLE drain error so clients fail
+            # over (the promoted standby / restarted primary serves it)
+            return {"ok": False, "etype": "ServerDraining",
+                    "retryable": True,
+                    "error": "SERVER_DRAINING: server is draining for "
+                             "shutdown; retry against the serving "
+                             "primary"}
         if "meta" in req:
             # catalog metadata over the wire (the pg_catalog role for thin
             # clients — the MCP analog, serve/mcp.py, is the main consumer)
@@ -406,6 +509,14 @@ class Server:
         sql = req.get("sql")
         if not isinstance(sql, str):
             return {"ok": False, "error": "request must carry a 'sql' string"}
+        # per-request deadline: every dispatch path converts it to the
+        # session's monotonic deadline, so it governs execution (cancel
+        # seams, watchdog), not just the dispatcher queue
+        deadline = None
+        if req.get("deadline_s") is not None:
+            import time as _t
+
+            deadline = _t.monotonic() + float(req["deadline_s"])
         if self.read_only and not _is_read(sql):
             # hot standby: reads only; the store's epoch sync delivers the
             # primary's commits, nothing here may produce one
@@ -429,7 +540,7 @@ class Server:
             # each connection is its own backend: statement-level locking
             # is unnecessary (no shared catalog objects) and transactions
             # ride the store's multi-session OCC
-            result = sess.sql(sql)
+            result = sess.sql(sql, _deadline=deadline)
         elif _first_word(sql) in _TXN_STARTERS:
             # all connections share ONE session: a wire-level BEGIN would
             # absorb other clients' autocommit writes into its rollback
@@ -444,7 +555,7 @@ class Server:
             # concurrent readers would race the data/stats swap (the OCC
             # layer handles cross-PROCESS writers; this lock, threads)
             with self._locked(write=not _is_read(sql)):
-                result = sess.sql(sql)
+                result = sess.sql(sql, _deadline=deadline)
         if isinstance(result, dict):
             # DECLARE PARALLEL RETRIEVE CURSOR: endpoint directory + token
             return {"ok": True, **{k: _json_safe(v) if not isinstance(

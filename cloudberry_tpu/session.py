@@ -122,6 +122,14 @@ class Session:
         from cloudberry_tpu.exec.instrument import StatementLog
 
         self.stmt_log = StatementLog()
+        # admission circuit breaker (lifecycle.py): K consecutive
+        # device-loss recoveries trip writes to read-only-degraded; a
+        # server shares ONE across its connection sessions, like the gate
+        from cloudberry_tpu.lifecycle import CircuitBreaker
+
+        self._breaker = CircuitBreaker(
+            self.config.health.breaker_threshold,
+            self.config.health.breaker_cooldown_s)
         self._session_id = id(self) & 0xFFFF
         # COPY ... LOG ERRORS row rejects, per table (the error-log /
         # gp_read_error_log analog, cdbsreh.c)
@@ -163,40 +171,101 @@ class Session:
         return pd.DataFrame(self.copy_errors.get(table.lower(), []),
                             columns=["line", "errmsg", "rawdata"])
 
-    def sql(self, query: str, **params: Any):
+    def sql(self, query: str, _deadline: float | None = None,
+            **params: Any):
         """Run one statement with failure recovery (the FTS consumption
         point, fts.c:118): a device/runtime failure probes the devices,
         optionally shrinks the segment mesh to the live count (stateless
-        segments — placement re-derives for any n), and re-dispatches."""
+        segments — placement re-derives for any n), and re-dispatches.
+
+        ``_deadline`` (monotonic absolute seconds, lifecycle.py): the
+        statement's cancellation deadline, checked cooperatively at
+        execution seams. ``config.statement_timeout_s`` tightens it;
+        the dispatcher/server pass their per-request deadline here so it
+        governs EXECUTION, not just queueing. (Underscored so it can
+        never shadow a user bind parameter in ``**params``.)"""
+        import time as _t
+
+        from cloudberry_tpu import lifecycle
         from cloudberry_tpu.parallel.health import run_with_retry
 
         h = self.config.health
         log_id = self.stmt_log.begin(query, self._session_id)
+        deadline = _deadline
+        timeout = self.config.statement_timeout_s
+        if timeout:
+            t_dl = _t.monotonic() + timeout
+            deadline = t_dl if deadline is None else min(deadline, t_dl)
+        handle = lifecycle.StatementHandle(log_id, deadline=deadline)
+        self.stmt_log.attach(log_id, handle)
+        is_read = _read_only(query)
+        # device-loss recoveries THIS statement needed — the circuit
+        # breaker's consecutive-recovery signal; trial = this write is
+        # the half-open probe write and owns the breaker verdict
+        recoveries = [0]
+        trial = False
+
+        def on_retry(e):
+            recoveries[0] += 1
+            if h.probe_on_error:
+                self._recover_mesh(e)
+
         # per-statement compile observability: the delta of the engine-wide
         # compile counter over this statement (exact single-threaded; an
         # upper bound under concurrency) — "zero after warmup" is the
         # generic-plan acceptance contract
         compiles_before = self.stmt_log.counter("compiles")
+        head = query.lstrip()[:10].split(None, 1)
+        is_txn_control = bool(head) and head[0].lower() in (
+            "begin", "commit", "rollback", "abort", "start", "end")
         try:
-            if h.retries <= 0 or not _read_only(query):
-                # DML/DDL/COPY are NOT retried: a device failure striking
-                # after the host-side mutation would re-apply the statement
-                # on retry (re-execution is only safe when re-running cannot
-                # change state — the reference's FTS likewise lets in-flight
-                # write transactions abort rather than replay them)
-                out = self._sql_once(query, **params)
-            else:
-                out = run_with_retry(
-                    lambda: self._sql_once(query, **params),
-                    retries=h.retries, backoff_s=h.backoff_s,
-                    on_retry=self._recover_mesh if h.probe_on_error
-                    else None)
+            with lifecycle.statement_scope(handle):
+                if not is_read and not is_txn_control:
+                    # read-only-degraded admission: an open breaker
+                    # refuses writes (retryable) while reads keep
+                    # flowing. Transaction control is EXEMPT: it is
+                    # host-side only (never dispatches to devices), and
+                    # a session must always be able to ROLLBACK out of
+                    # an open transaction on a degraded engine
+                    trial = self._breaker.check_write()
+                if h.retries <= 0 or not is_read:
+                    # DML/DDL/COPY are NOT retried: a device failure
+                    # striking after the host-side mutation would re-apply
+                    # the statement on retry (re-execution is only safe
+                    # when re-running cannot change state — the
+                    # reference's FTS likewise lets in-flight write
+                    # transactions abort rather than replay them)
+                    out = self._sql_once(query, **params)
+                else:
+                    out = run_with_retry(
+                        lambda: self._sql_once(query, **params),
+                        retries=h.retries, backoff_s=h.backoff_s,
+                        on_retry=on_retry)
         except BaseException as e:
             # BaseException too: a Ctrl-C mid-statement must not leave a
             # phantom "running" entry in the shared active registry
+            if trial:
+                # the half-open trial write failed (loss, semantic error,
+                # cancel — any reason): re-arm the cooldown, never wedge
+                self._breaker.trial_failed()
+            elif recoveries[0]:
+                # recovery was attempted but the statement still failed
+                # (retries exhausted): a hard outage counts toward the
+                # trip threshold exactly like a recovered flap
+                self._breaker.record_recovery()
+            if isinstance(e, lifecycle.StatementTimeout):
+                self.stmt_log.bump("statement_timeouts")
+            elif isinstance(e, lifecycle.StatementCancelled):
+                self.stmt_log.bump("statement_cancels")
             self.stmt_log.finish(log_id, "error",
                                  error=f"{type(e).__name__}: {e}")
             raise
+        if trial:
+            self._breaker.trial_succeeded()
+        if recoveries[0]:
+            self._breaker.record_recovery()
+        else:
+            self._breaker.record_success()
         is_batch = hasattr(out, "num_rows")
         self.stmt_log.finish(
             log_id, "ok" if is_batch else str(out)[:80],
@@ -257,9 +326,15 @@ class Session:
     def _dispatch_seams(fault_point) -> None:
         """The two seams every dispatch path hits: dispatch_start (not
         retriable) and exec_device_lost (retriable via health.recoverable
-        — the virtual mesh cannot lose a real device; this seam can)."""
+        — the virtual mesh cannot lose a real device; this seam can).
+        The cancel check AFTER them gates dispatch: an already-expired or
+        cancelled statement never launches (the dispatcher's
+        deadline-before-dispatch discipline, now for every path)."""
+        from cloudberry_tpu.lifecycle import check_cancel
+
         fault_point("dispatch_start")
         fault_point("exec_device_lost")
+        check_cancel()
 
     @staticmethod
     def _stmt_cache_key(query: str, params: dict) -> str:
@@ -521,6 +596,18 @@ class Session:
                         fault_point
 
                     fault_point("occ_commit_window")
+                    # cancellation seam: a statement cancelled while
+                    # waiting on (or wedged inside) the commit window
+                    # aborts cleanly — nothing published, lock released,
+                    # RAM state restored (the before-commit-point abort)
+                    from cloudberry_tpu import lifecycle
+
+                    try:
+                        lifecycle.check_cancel()
+                    except lifecycle.StatementError:
+                        self.store.abort_txn()
+                        self._restore_snapshot(snap)
+                        raise
                     base = getattr(self, "_txn_base", {})
                     conflicts = self.store.conflicting_tables(base)
                     if conflicts:

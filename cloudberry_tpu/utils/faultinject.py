@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 
@@ -28,6 +28,9 @@ class _Arm:
     start_hit: int = 1    # trigger from the Nth hit...
     end_hit: int = 1 << 30  # ...through this hit
     hits: int = 0
+    # interruptible wedge: 'hang' blocks on this instead of a raw sleep,
+    # so reset_fault() releases a wedged thread immediately
+    wake: threading.Event = field(default_factory=threading.Event)
 
 
 _registry: dict[str, _Arm] = {}
@@ -39,22 +42,35 @@ def inject_fault(name: str, action: str = "error", sleep_s: float = 0.0,
                  start_hit: int = 1, end_hit: int = 1 << 30) -> None:
     """Arm a fault point (the gp_inject_fault() analog)."""
     with _lock:
+        old = _registry.get(name)
         _registry[name] = _Arm(action, sleep_s, start_hit, end_hit)
+    if old is not None:
+        old.wake.set()  # a re-arm releases threads wedged on the old arm
 
 
 def reset_fault(name: Optional[str] = None) -> None:
     with _lock:
         if name is None:
+            arms = list(_registry.values())
             _registry.clear()
         else:
-            _registry.pop(name, None)
+            arm = _registry.pop(name, None)
+            arms = [arm] if arm is not None else []
+    for arm in arms:  # outside the lock: waking needs no registry state
+        arm.wake.set()
 
 
 def fault_point(name: str) -> bool:
     """Declare a fault point. Returns True if the caller should SKIP the
-    guarded step ('skip' action); raises/sleeps for other armed actions."""
-    _seen.add(name)
+    guarded step ('skip' action); raises/sleeps for other armed actions.
+
+    The 'hang' action is a COOPERATIVE wedge (the reference's 'suspend'
+    with gp_inject_fault resume semantics): it blocks on the arm's event
+    — released by reset_fault()/re-arm — while polling the statement's
+    cancellation seam, so a watchdog/cancel converts the wedge into a
+    StatementTimeout/StatementCancelled and the worker thread survives."""
     with _lock:
+        _seen.add(name)  # under the lock: handler threads race discovery
         arm = _registry.get(name)
         if arm is None:
             return False
@@ -63,6 +79,7 @@ def fault_point(name: str) -> bool:
             return False
         action = arm.action
         sleep_s = arm.sleep_s
+        wake = arm.wake
     if action == "error":
         raise InjectedFault(f"fault injected at {name!r}")
     if action == "sleep":
@@ -71,10 +88,17 @@ def fault_point(name: str) -> bool:
     if action == "skip":
         return True
     if action == "hang":
-        time.sleep(3600.0)
+        from cloudberry_tpu.lifecycle import check_cancel
+
+        end = time.monotonic() + (sleep_s or 3600.0)
+        while not wake.wait(timeout=0.05):
+            check_cancel()
+            if time.monotonic() >= end:
+                break
     return False
 
 
 def known_fault_points() -> set[str]:
     """Fault points hit at least once this process (discovery aid)."""
-    return set(_seen)
+    with _lock:
+        return set(_seen)

@@ -1,0 +1,634 @@
+"""Statement lifecycle survivability — cancellation, timeouts, watchdog,
+drain, circuit breaker (the statement_timeout / pg_cancel_backend /
+smart-shutdown analog suite).
+
+Chaos discipline (faultinjector.c role): wedges and losses are provoked
+deterministically at the armed seams; the assertions are the ISSUE-4
+acceptance criteria — a hung statement returns a timeout WITHIN its
+deadline while the serving thread survives, results after a cancel are
+bit-identical on re-run, drain never silently drops an accepted request,
+and the breaker walks trip → half-open → close.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu import lifecycle
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.serve import Client, Server, ServerError
+from cloudberry_tpu.utils import faultinject as FI
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FI.reset_fault()
+    yield
+    FI.reset_fault()
+
+
+def _mk(**ov):
+    over = {"n_segments": 1}
+    over.update(ov)
+    return cb.Session(get_config().with_overrides(**over))
+
+
+def _load(s, n=64):
+    s.sql("create table t (k bigint, v bigint) distributed by (k)")
+    s.catalog.table("t").set_data(
+        {"k": np.arange(n, dtype=np.int64),
+         "v": (np.arange(n, dtype=np.int64) * 7) % 13})
+
+
+# ------------------------------------------------------------- taxonomy
+
+
+def test_taxonomy_retryable_vs_semantic():
+    assert lifecycle.StatementTimeout.retryable
+    assert lifecycle.ServerDraining.retryable
+    assert lifecycle.BreakerOpen.retryable
+    assert not lifecycle.StatementCancelled.retryable
+    assert lifecycle.is_retryable(lifecycle.StatementTimeout("x"))
+    assert not lifecycle.is_retryable(lifecycle.StatementCancelled("x"))
+    # the sched pair is retryable BY NAME (shared with the client side)
+    assert lifecycle.is_retryable("SchedQueueFull")
+    assert lifecycle.is_retryable("SchedDeadline")
+    assert not lifecycle.is_retryable("BindError")
+    assert not lifecycle.is_retryable(ValueError("nope"))
+
+
+def test_cancel_token_first_reason_wins():
+    tok = lifecycle.CancelToken()
+    assert tok.cancel("timeout")
+    assert not tok.cancel("cancelled")  # later cancels never overwrite
+    with pytest.raises(lifecycle.StatementTimeout):
+        tok.raise_if_cancelled()
+
+
+def test_handle_deadline_records_timeout_on_token():
+    h = lifecycle.StatementHandle(1, deadline=time.monotonic() - 0.01)
+    with pytest.raises(lifecycle.StatementTimeout):
+        h.check()
+    assert h.token.cancelled and h.token.reason == "timeout"
+
+
+def test_check_cancel_noop_outside_scope():
+    lifecycle.check_cancel()  # no active statement: must not raise
+
+
+# --------------------------------------------------- statement_timeout_s
+
+
+def test_statement_timeout_config_enforced():
+    s = _mk(statement_timeout_s=0.6)
+    _load(s)
+    s.sql("select sum(v) as sv from t")  # warm the compile cache
+    FI.inject_fault("dispatch_start", "hang", start_hit=1, end_hit=1)
+    t0 = time.monotonic()
+    with pytest.raises(lifecycle.StatementTimeout):
+        s.sql("select sum(v) as sv from t")
+    assert time.monotonic() - t0 < 5.0  # nothing waits out the wedge
+    assert s.stmt_log.counter("statement_timeouts") == 1
+    # phantom-free: the active registry is empty, history has the error
+    assert s.stmt_log.activity() == []
+    assert "StatementTimeout" in s.stmt_log.recent(1)[0]["error"]
+
+
+def test_per_statement_deadline_tightens():
+    s = _mk()
+    _load(s)
+    s.sql("select sum(v) as sv from t")
+    FI.inject_fault("dispatch_start", "hang", start_hit=1, end_hit=1)
+    with pytest.raises(lifecycle.StatementTimeout):
+        s.sql("select sum(v) as sv from t",
+              _deadline=time.monotonic() + 0.3)
+
+
+# ------------------------------------------------------- cancel mid-tile
+
+
+def _mk_spill():
+    s = _mk(**{"resource.query_mem_bytes": 4 << 20})
+    rng = np.random.default_rng(3)
+    s.sql("CREATE TABLE dim (k BIGINT, g BIGINT) DISTRIBUTED BY (k)")
+    s.sql("CREATE TABLE fact (k BIGINT, v BIGINT) DISTRIBUTED BY (k)")
+    s.catalog.table("dim").set_data(
+        {"k": np.arange(500), "g": np.arange(500) % 9})
+    s.catalog.table("fact").set_data(
+        {"k": rng.integers(0, 500, 200_000),
+         "v": rng.integers(0, 100, 200_000)})
+    return s
+
+
+_SPILL_Q = ("select g, sum(v) as sv from fact join dim on fact.k = dim.k "
+            "group by g order by g")
+
+
+def test_cancel_mid_tile_bit_identical_rerun():
+    """Cancel lands between tile steps (the per-tile seam); the SAME
+    session then re-runs the statement and the result is bit-identical
+    to an undisturbed run — cancellation leaves no partial state."""
+    expect = _mk_spill().sql(_SPILL_Q).to_pandas()
+
+    s = _mk_spill()
+    FI.inject_fault("tile_step", "sleep", sleep_s=0.05)  # slow the stream
+    errs = []
+
+    def bg():
+        try:
+            s.sql(_SPILL_Q)
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            errs.append(e)
+
+    th = threading.Thread(target=bg)
+    th.start()
+    act = None
+    for _ in range(500):
+        act = s.stmt_log.activity()
+        if act:
+            break
+        time.sleep(0.01)
+    assert act, "statement never appeared in the activity view"
+    time.sleep(0.25)  # let it get into the tile stream
+    assert s.stmt_log.cancel(act[0]["id"])
+    th.join(timeout=60)
+    assert errs and isinstance(errs[0], lifecycle.StatementCancelled)
+
+    FI.reset_fault()
+    got = s.sql(_SPILL_Q).to_pandas()
+    assert s.last_tiled_report is not None  # really the tiled path
+    assert expect.equals(got)
+
+
+# --------------------------------------------------------------- watchdog
+
+
+def test_watchdog_cancels_over_deadline_statement():
+    """Deterministic watchdog unit: an attached handle past its deadline
+    is cancelled with reason 'timeout', state flips to cancelling, and
+    the counter records it."""
+    from cloudberry_tpu.exec.instrument import StatementLog
+
+    log = StatementLog()
+    sid = log.begin("select 1")
+    h = lifecycle.StatementHandle(sid, deadline=time.monotonic() - 0.01)
+    log.attach(sid, h)
+    live = lifecycle.StatementHandle(
+        log.begin("select 2"), deadline=time.monotonic() + 60)
+    log.attach(live.statement_id, live)
+    wd = lifecycle.Watchdog(log)
+    assert wd.scan() == 1
+    assert h.token.cancelled and h.token.reason == "timeout"
+    assert not live.token.cancelled
+    states = {e["id"]: e["state"] for e in log.activity()}
+    assert states[sid] == "cancelling"
+    assert log.counter("watchdog_timeouts") == 1
+    assert wd.scan() == 0  # idempotent: already cancelled
+
+
+def test_hung_statement_times_out_worker_survives():
+    """ISSUE-4 acceptance: an armed `hang` at an exec seam returns a
+    timeout error WITHIN the deadline, the serving thread survives, and
+    the immediately following statement is bit-identical to an
+    undisturbed run."""
+    s = _mk()
+    _load(s)
+    expect = s.sql("select v, count(*) as c from t group by v "
+                   "order by v").to_pandas()
+    with Server(session=s) as srv:
+        with Client(srv.host, srv.port) as c:
+            FI.inject_fault("dispatch_start", "hang",
+                            start_hit=1, end_hit=1)
+            t0 = time.monotonic()
+            with pytest.raises(ServerError) as ei:
+                c.sql("select v, count(*) as c from t group by v "
+                      "order by v", deadline_s=0.5)
+            elapsed = time.monotonic() - t0
+            assert ei.value.etype == "StatementTimeout"
+            assert ei.value.retryable
+            assert elapsed < 5.0  # bounded by deadline + poll, not 3600s
+            # the SAME connection (same handler thread) keeps serving
+            got = c.sql("select v, count(*) as c from t group by v "
+                        "order by v")
+            assert [list(r) for r in got["rows"]] == \
+                expect.values.tolist()
+
+
+def test_cancel_verb_over_wire():
+    """pg_cancel_backend analog: a second client finds the statement in
+    the activity view and cancels it by id."""
+    s = _mk()
+    _load(s)
+    with Server(session=s) as srv:
+        FI.inject_fault("dispatch_start", "hang", start_hit=1, end_hit=1)
+        errs = []
+
+        def bg():
+            with Client(srv.host, srv.port) as c1:
+                try:
+                    c1.sql("select sum(v) as sv from t")
+                except ServerError as e:
+                    errs.append(e)
+
+        th = threading.Thread(target=bg)
+        th.start()
+        with Client(srv.host, srv.port) as c2:
+            act = None
+            for _ in range(500):
+                act = c2.meta("activity")["active"]
+                if act:
+                    break
+                time.sleep(0.01)
+            assert act and act[0]["state"] == "running"
+            assert c2.cancel(act[0]["id"])["status"] == \
+                f"CANCEL {act[0]['id']}"
+            # cancelling a finished/unknown id reports cleanly
+            with pytest.raises(ServerError) as ei:
+                c2.cancel(999_999)
+            assert ei.value.etype == "UnknownStatement"
+        th.join(timeout=30)
+        assert errs and errs[0].etype == "StatementCancelled"
+        assert not errs[0].retryable
+
+
+# ------------------------------------------------------------------ drain
+
+
+def test_drain_under_load_never_drops_silently():
+    """ISSUE-4 acceptance: Server.stop(drain_s) under concurrent load —
+    every accepted request completes or fails with the RETRYABLE drain
+    error; a closed connection is a visible client-side error, never a
+    request that vanished."""
+    s = _mk()
+    _load(s, n=256)
+    s.sql("select v, count(*) as c from t group by v")  # warm compile
+    srv = Server(session=s).start()
+    stop_flag = [False]
+    outcomes = []  # per request: "ok" | etype | "closed"
+    lock = threading.Lock()
+
+    def worker(wid):
+        try:
+            with Client(srv.host, srv.port) as c:
+                while not stop_flag[0]:
+                    try:
+                        c.sql("select v, count(*) as c from t group by v")
+                        with lock:
+                            outcomes.append("ok")
+                    except ServerError as e:
+                        with lock:
+                            outcomes.append(e.etype or str(e))
+                        if e.etype is None:  # connection closed
+                            return
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                outcomes.append(f"conn:{type(e).__name__}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)  # real in-flight load
+    srv.stop(drain_s=10.0)
+    stop_flag[0] = True
+    for t in threads:
+        t.join(timeout=30)
+    oks = outcomes.count("ok")
+    assert oks > 0
+    # every non-ok outcome is the retryable drain refusal or a visible
+    # connection close after shutdown — never any OTHER failure
+    bad = [o for o in outcomes
+           if o not in ("ok", "ServerDraining")
+           and not o.startswith("conn:") and o != "server closed the "
+           "connection"]
+    assert not bad, bad
+    # drain really completed the accepted work: nothing active remains
+    assert s.stmt_log.activity() == []
+
+
+def test_draining_refusal_is_retryable():
+    s = _mk()
+    _load(s)
+    srv = Server(session=s).start()
+    with Client(srv.host, srv.port) as c:
+        c.sql("select 1 as x")
+        srv._draining = True  # refuse-new without closing the socket
+        with pytest.raises(ServerError) as ei:
+            c.sql("select 1 as x")
+        assert ei.value.etype == "ServerDraining"
+        assert ei.value.retryable
+        assert "SERVER_DRAINING" in str(ei.value)
+    srv.stop()
+
+
+def test_dispatcher_drain_and_stop_taxonomy():
+    """A stopped dispatcher fails queued work with the retryable drain
+    error, and drain() reports idle correctly."""
+    from cloudberry_tpu.sched.dispatcher import Dispatcher
+
+    s = _mk(**{"sched.enabled": True})
+    _load(s)
+    d = Dispatcher(s).start()
+    assert d.drain(1.0)  # idle: immediate
+    d.stop()
+    with pytest.raises(lifecycle.ServerDraining):
+        d.submit("select 1")
+
+
+# -------------------------------------------------------- circuit breaker
+
+
+def test_breaker_trip_halfopen_close():
+    # a LONG cooldown pins the refusal assertions (no wall-clock race
+    # under full-suite load); the half-open phases then shorten it to 0
+    # instead of sleeping — the state machine is what's under test
+    s = _mk(**{"health.breaker_threshold": 2,
+               "health.breaker_cooldown_s": 60.0})
+    _load(s)
+    # two CONSECUTIVE statements needing a device-loss recovery trip it
+    for _ in range(2):
+        FI.inject_fault("exec_device_lost", "error",
+                        start_hit=1, end_hit=1)
+        s.sql("select sum(v) as sv from t")
+    assert s._breaker.snapshot()["state"] == "open"
+    assert s._breaker.snapshot()["trips"] == 1
+    # read-only-degraded: writes refuse retryably, reads still serve
+    with pytest.raises(lifecycle.BreakerOpen):
+        s.sql("create table w1 (x bigint)")
+    assert s.sql("select count(*) as c from t").to_pandas()["c"][0] == 64
+    # inside the cooldown the write refuses WITHOUT probing
+    with pytest.raises(lifecycle.BreakerOpen):
+        s.sql("create table w1 (x bigint)")
+    # half-open with a FAILING probe: stays open, cooldown re-arms
+    s._breaker._probe_fn = \
+        lambda: type("R", (), {"ok": False, "error": "dead"})()
+    s._breaker.cooldown_s = 0.0
+    with pytest.raises(lifecycle.BreakerOpen):
+        s.sql("create table w1 (x bigint)")
+    assert s._breaker.snapshot()["state"] == "open"
+    # half-open with a HEALTHY probe: the trial write closes it
+    s._breaker._probe_fn = None
+    assert str(s.sql("create table w1 (x bigint)")) \
+        .startswith("CREATE TABLE")
+    snap = s._breaker.snapshot()
+    assert snap["state"] == "closed" and snap["consecutive_recoveries"] == 0
+
+
+def test_breaker_success_resets_consecutive():
+    s = _mk(**{"health.breaker_threshold": 2})
+    _load(s)
+    FI.inject_fault("exec_device_lost", "error", start_hit=1, end_hit=1)
+    s.sql("select sum(v) as sv from t")   # one recovery
+    s.sql("select sum(v) as sv from t")   # clean: resets the streak
+    FI.inject_fault("exec_device_lost", "error", start_hit=1, end_hit=1)
+    s.sql("select sum(v) as sv from t")   # one again — NOT consecutive
+    assert s._breaker.snapshot()["state"] == "closed"
+    assert s._breaker.snapshot()["trips"] == 0
+
+
+def test_breaker_trips_on_hard_outage():
+    """Recovery ATTEMPTS count even when the statement ultimately fails
+    (retries exhausted): a total outage must trip the breaker, not just
+    a flap mild enough for retries to win."""
+    s = _mk(**{"health.breaker_threshold": 2})
+    _load(s)
+    for _ in range(2):
+        FI.inject_fault("exec_device_lost", "error")  # EVERY attempt
+        with pytest.raises(FI.InjectedFault):
+            s.sql("select sum(v) as sv from t")
+        FI.reset_fault()
+    assert s._breaker.snapshot()["state"] == "open"
+
+
+def test_breaker_trial_failure_reopens_no_wedge():
+    """A half-open trial write failing for a SEMANTIC reason re-arms the
+    cooldown (trial_failed) — the breaker never wedges in half-open, and
+    the next post-cooldown write can still close it."""
+    s = _mk(**{"health.breaker_threshold": 1,
+               "health.breaker_cooldown_s": 0.0})
+    _load(s)
+    FI.inject_fault("exec_device_lost", "error", start_hit=1, end_hit=1)
+    s.sql("select sum(v) as sv from t")  # one recovery: trips at K=1
+    assert s._breaker.snapshot()["state"] == "open"
+    with pytest.raises(ValueError):
+        s.sql("create table t (k bigint)")  # trial write: duplicate table
+    assert s._breaker.snapshot()["state"] == "open"  # re-armed, not stuck
+    assert str(s.sql("create table w2 (x bigint)")) \
+        .startswith("CREATE TABLE")
+    assert s._breaker.snapshot()["state"] == "closed"
+
+
+def test_breaker_reads_never_close_half_open():
+    """Only the trial WRITE's verdict moves a half-open breaker — a
+    concurrent read succeeding proves nothing about writes."""
+    ok_probe = lambda: type("R", (), {"ok": True})()  # noqa: E731
+    b = lifecycle.CircuitBreaker(threshold=1, cooldown_s=0.0,
+                                 probe_fn=ok_probe)
+    b.record_recovery()
+    assert b.snapshot()["state"] == "open"
+    assert b.check_write() is True  # this write is the trial
+    b.record_success()              # a read completing mid-trial
+    assert b.snapshot()["state"] == "half-open"
+    with pytest.raises(lifecycle.BreakerOpen):
+        b.check_write()             # a second write: still degraded
+    b.trial_succeeded()
+    assert b.snapshot()["state"] == "closed"
+
+
+def test_breaker_exempts_transaction_control():
+    """An open breaker must never trap a session in its transaction:
+    BEGIN/ROLLBACK are host-side only and bypass the write gate."""
+    s = _mk(**{"health.breaker_threshold": 1,
+               "health.breaker_cooldown_s": 60.0})
+    _load(s)
+    s.sql("begin")
+    s.sql("insert into t values (999, 0)")
+    FI.inject_fault("exec_device_lost", "error", start_hit=1, end_hit=1)
+    s.sql("select sum(v) as sv from t")  # trips at K=1
+    assert s._breaker.snapshot()["state"] == "open"
+    with pytest.raises(lifecycle.BreakerOpen):
+        s.sql("insert into t values (1000, 0)")
+    assert s.sql("rollback") == "ROLLBACK"  # always allowed
+    assert s.sql("select count(*) as c from t").to_pandas()["c"][0] == 64
+
+
+def test_breaker_raising_probe_reopens():
+    """A probe that RAISES counts as a failed probe: back open with a
+    fresh cooldown, never wedged in half-open."""
+
+    def bad_probe():
+        raise RuntimeError("probe transport died")
+
+    b = lifecycle.CircuitBreaker(threshold=1, cooldown_s=0.0,
+                                 probe_fn=bad_probe)
+    b.record_recovery()
+    with pytest.raises(lifecycle.BreakerOpen) as ei:
+        b.check_write()
+    assert "probe raised" in str(ei.value)
+    assert b.snapshot()["state"] == "open"  # resolvable, not half-open
+    b._probe_fn = lambda: type("R", (), {"ok": True})()
+    assert b.check_write() is True  # the slot recovered
+
+
+def test_breaker_state_in_meta_info():
+    s = _mk()
+    _load(s)
+    with Server(session=s) as srv, Client(srv.host, srv.port) as c:
+        info = c.meta("info")
+    assert info["breaker"]["state"] == "closed"
+
+
+# ------------------------------------------------- dispatcher lifecycle
+
+
+def test_dispatcher_deadline_governs_execution():
+    """The per-request deadline reaches EXECUTION on the sequential
+    dispatcher path (not just time-in-queue): a wedged statement dies
+    with the timeout taxonomy, and the dispatcher survives."""
+    s = _mk(**{"sched.enabled": True})
+    _load(s)
+    s.sql("select sum(v) as sv from t")  # warm
+    from cloudberry_tpu.sched.dispatcher import Dispatcher
+
+    d = Dispatcher(s).start()
+    try:
+        FI.inject_fault("dispatch_start", "hang", start_hit=1, end_hit=1)
+        with pytest.raises(
+                (lifecycle.StatementTimeout, Exception)) as ei:
+            d.submit("select sum(v) as sv from t", deadline_s=0.4)
+        assert type(ei.value).__name__ in ("StatementTimeout",
+                                           "SchedDeadline")
+        FI.reset_fault()
+        out = d.submit("select sum(v) as sv from t", deadline_s=30)
+        assert out.num_rows() == 1
+    finally:
+        d.stop()
+
+
+# ------------------------------------------------------- client retries
+
+
+class _FlakyClient(Client):
+    """Client whose transport fails N times with a canned response —
+    unit harness for the retry policy (no server)."""
+
+    def __init__(self, failures, etype, retryable, retry_reads=True):
+        # bypass Client.__init__ (no socket)
+        self.retry_reads = retry_reads
+        self.max_retries = 3
+        self.backoff_s = 0.001
+        self.calls = 0
+        self._failures = failures
+        self._etype = etype
+        self._retryable = retryable
+
+    def _request(self, req):
+        self.calls += 1
+        if self.calls <= self._failures:
+            raise ServerError("transient", etype=self._etype,
+                              retryable=self._retryable)
+        return {"rows": [], "columns": [], "rowcount": 0}
+
+
+def test_client_retries_idempotent_reads_opt_in():
+    c = _FlakyClient(2, "ServerDraining", True)
+    assert c.sql("select 1")["rowcount"] == 0
+    assert c.calls == 3  # two retries then success
+
+
+def test_client_retry_off_by_default():
+    c = _FlakyClient(1, "ServerDraining", True, retry_reads=False)
+    with pytest.raises(ServerError):
+        c.sql("select 1")
+    assert c.calls == 1
+
+
+def test_client_never_retries_writes_or_semantic_errors():
+    c = _FlakyClient(1, "ServerDraining", True)
+    with pytest.raises(ServerError):
+        c.sql("insert into t values (1)")  # a write: never retried
+    assert c.calls == 1
+    c2 = _FlakyClient(1, "BindError", False)
+    with pytest.raises(ServerError):
+        c2.sql("select 1")  # semantic: never retried
+    assert c2.calls == 1
+
+
+def test_client_retry_gives_up_after_max():
+    c = _FlakyClient(99, "SchedQueueFull", True)
+    with pytest.raises(ServerError):
+        c.sql("select 1")
+    assert c.calls == c.max_retries + 1
+
+
+# ----------------------------------------------------- satellite fixes
+
+
+def test_hang_fault_interruptible_by_reset():
+    """The `hang` action sleeps on an event reset_fault() sets — no more
+    uninterruptible 3600s wedge."""
+    FI.inject_fault("lifecycle_test_hang", "hang")
+    done = threading.Event()
+
+    def bg():
+        FI.fault_point("lifecycle_test_hang")
+        done.set()
+
+    th = threading.Thread(target=bg, daemon=True)
+    t0 = time.monotonic()
+    th.start()
+    time.sleep(0.15)
+    assert not done.is_set()  # really wedged
+    FI.reset_fault("lifecycle_test_hang")
+    th.join(timeout=5)
+    assert done.is_set() and time.monotonic() - t0 < 5.0
+
+
+def test_health_history_bounded():
+    from cloudberry_tpu.parallel import health
+
+    mon = health.HealthMonitor(interval_s=3600, history_maxlen=4)
+    for _ in range(6):
+        mon.probe_now()
+    assert len(mon.history) == 4  # deque dropped the oldest two
+
+
+def test_occ_commit_window_cancel_aborts_clean(tmp_path):
+    """Cancellation inside the OCC commit window aborts the transaction
+    (nothing published) and releases the store lock."""
+    s = cb.Session(get_config().with_overrides(
+        **{"storage.root": str(tmp_path)}))
+    s.sql("create table t (a bigint)")
+    s.sql("insert into t values (1)")
+    h = lifecycle.StatementHandle(0)
+    h.token.cancel("cancelled")
+    s.txn("begin")
+    s.sql("insert into t values (2)")
+    with lifecycle.statement_scope(h):
+        with pytest.raises(lifecycle.StatementCancelled):
+            s.txn("commit")
+    # aborted: RAM restored, store untouched, lock free for the next txn
+    assert s.sql("select count(*) as c from t").to_pandas()["c"][0] == 1
+    s.txn("begin")
+    s.sql("insert into t values (3)")
+    assert s.txn("commit") == "COMMIT"
+    assert s.sql("select count(*) as c from t").to_pandas()["c"][0] == 2
+
+
+def test_serve_bench_cancel_mix_smoke():
+    """CPU smoke of the lifecycle bench workload: deadlined requests ride
+    the same closed loop and the CSV row carries the new counters."""
+    import tools.serve_bench as SB
+
+    r = SB.run_mode("direct", "point", clients=2, duration_s=0.8,
+                    rows=20_000, tick_s=0.002, max_batch=8,
+                    cancel_mix=0.5, deadline_s=0.004)
+    assert r["requests"] > 0
+    assert "deadline_misses" in r and "cancels" in r
+    assert r["deadline_misses"] >= 0
+    row = SB.csv_row(r)
+    assert row.startswith("direct,point,2,")
+    assert len(row.split(",")) == len(SB.CSV_HEADER.split(","))

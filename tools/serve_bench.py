@@ -41,7 +41,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
-              "compiles,dispatches,batches,batched_requests,avg_occupancy")
+              "compiles,dispatches,batches,batched_requests,avg_occupancy,"
+              "deadline_misses,cancels")
 
 
 def build_session(mode: str, rows: int, tick_s: float, max_batch: int):
@@ -97,9 +98,17 @@ def _mix_sql(mix: str, i: int, rows: int) -> str:
 
 
 def run_mode(mode: str, mix: str, clients: int, duration_s: float,
-             rows: int, tick_s: float, max_batch: int) -> dict:
-    """One closed-loop run; returns the CSV row fields."""
-    from cloudberry_tpu.serve import Client, Server
+             rows: int, tick_s: float, max_batch: int,
+             cancel_mix: float = 0.0, deadline_s: float = 0.005) -> dict:
+    """One closed-loop run; returns the CSV row fields.
+
+    ``cancel_mix``: fraction of requests carrying a TIGHT per-request
+    deadline (``deadline_s``) — the statement-lifecycle workload. Those
+    that miss fail with the retryable timeout taxonomy (StatementTimeout
+    / SchedDeadline) and count as ``deadline_misses``, not errors; the
+    ``cancels`` column reports the engine's cancellation counters
+    (cancel verb + watchdog) over the run."""
+    from cloudberry_tpu.serve import Client, Server, ServerError
 
     session = build_session(mode, rows, tick_s, max_batch)
     # warm the compile caches OUTSIDE the measured window: the bench
@@ -108,27 +117,43 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     session.sql(_q6_sql(0))
     c_before = session.stmt_log.counter("compiles")
     d_before = session.stmt_log.counter("dispatches")
+    x_before = (session.stmt_log.counter("cancel_requests")
+                + session.stmt_log.counter("watchdog_timeouts"))
 
+    _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
+                    "SchedDeadline")
     lats: list[float] = []
+    misses = [0]
     lat_lock = threading.Lock()
     errors: list[str] = []
     stop_at = [0.0]
+    stride = max(1, int(round(1.0 / cancel_mix))) if cancel_mix else 0
 
     def worker(wid: int):
         lat_local = []
+        miss_local = 0
         try:
             with Client(srv.host, srv.port) as c:
                 i = wid * 100_003
                 while time.monotonic() < stop_at[0]:
                     sql = _mix_sql(mix, i, rows)
+                    dl = deadline_s if stride and i % stride == 0 else None
                     i += 1
                     t0 = time.monotonic()
-                    c.sql(sql)
+                    try:
+                        c.sql(sql, deadline_s=dl)
+                    except ServerError as e:
+                        # a deadlined request missing its deadline is the
+                        # workload working, not a bench failure
+                        if dl is None or e.etype not in _MISS_ETYPES:
+                            raise
+                        miss_local += 1
                     lat_local.append(time.monotonic() - t0)
         except Exception as e:  # pragma: no cover - surfaced in result
             errors.append(f"{type(e).__name__}: {e}")
         with lat_lock:
             lats.extend(lat_local)
+            misses[0] += miss_local
 
     with Server(session=session) as srv:
         stop_at[0] = time.monotonic() + duration_s
@@ -162,6 +187,9 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         "batches": dstats.get("batches", 0),
         "batched_requests": dstats.get("batched_requests", 0),
         "avg_occupancy": dstats.get("avg_occupancy", 0.0),
+        "deadline_misses": misses[0],
+        "cancels": (disp.counter("cancel_requests")
+                    + disp.counter("watchdog_timeouts")) - x_before,
     }
 
 
@@ -180,6 +208,11 @@ def main(argv=None) -> list[dict]:
     ap.add_argument("--rows", type=int, default=200_000)
     ap.add_argument("--tick-s", type=float, default=0.002)
     ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--cancel-mix", type=float, default=0.0,
+                    help="fraction of requests carrying a tight "
+                         "per-request deadline (lifecycle workload)")
+    ap.add_argument("--deadline-s", type=float, default=0.005,
+                    help="the tight deadline used by --cancel-mix")
     ap.add_argument("--csv", default=None,
                     help="append CSV rows to this file")
     args = ap.parse_args(argv)
@@ -189,7 +222,9 @@ def main(argv=None) -> list[dict]:
     print(CSV_HEADER)
     for mode in modes:
         r = run_mode(mode, args.mix, args.clients, args.duration,
-                     args.rows, args.tick_s, args.max_batch)
+                     args.rows, args.tick_s, args.max_batch,
+                     cancel_mix=args.cancel_mix,
+                     deadline_s=args.deadline_s)
         out.append(r)
         print(csv_row(r), flush=True)
     if args.csv:
