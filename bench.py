@@ -154,6 +154,55 @@ def interconnect_context(session, qnames, nseg: int = 8) -> dict:
     return out
 
 
+def join_filter_context(session, qnames, nseg: int = 8) -> dict:
+    """The join-path record next to the interconnect one: per bench query
+    at the ``nseg``-segment plan shape, the runtime join filters the
+    planner would insert above probe-side redistributes (exact vs bloom
+    digest — plan/nodes.py PRuntimeFilter) with their statically
+    estimated probe-row reduction, plus how many joins ride the
+    sorted-build join-index cache (exec/joinindex.py). Metadata-only
+    plans; the live counters block reports what THIS process's actual
+    executions observed (cache hits, filter pre/post rows)."""
+    import copy
+
+    from cloudberry_tpu.exec.executor import all_nodes
+    from cloudberry_tpu.plan import nodes as PN
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.sql.parser import parse_sql
+    from tools.tpch_queries import QUERIES
+
+    clone = copy.copy(session)
+    clone.config = session.config.with_overrides(n_segments=nseg)
+    out = {"n_segments": nseg, "per_query": {}}
+    for qn in qnames:
+        plan = plan_statement(parse_sql(QUERIES[qn]), clone, {}).plan
+        rec = {"filters_exact": 0, "filters_digest": 0,
+               "est_rows_in": 0, "est_rows_out": 0, "indexed_joins": 0}
+        seen: set = set()
+        for node in all_nodes(plan):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, PN.PRuntimeFilter):
+                rec["filters_exact" if node.mode == "exact"
+                    else "filters_digest"] += 1
+                if getattr(node, "_est_in", None) is not None:
+                    rec["est_rows_in"] += int(node._est_in)
+                    rec["est_rows_out"] += int(node._est_out)
+            elif isinstance(node, PN.PJoin) \
+                    and getattr(node, "_jix", None) is not None:
+                rec["indexed_joins"] += 1
+        out["per_query"][qn] = rec
+    log_ = session.stmt_log
+    out["counters"] = {
+        "join_index_builds": log_.counter("join_index_builds"),
+        "join_index_hits": log_.counter("join_index_hits"),
+        "jf_rows_in": log_.counter("jf_rows_in"),
+        "jf_rows_out": log_.counter("jf_rows_out"),
+    }
+    return out
+
+
 def compile_cache_context(session, qnames) -> dict:
     """The compile-cache record next to the roofline/interconnect records:
     per query, how the generic-plan layer (sched/paramplan.py) sees it —
@@ -286,6 +335,7 @@ def replay_last_good(reason: str) -> None:
                 wall_by_q=lg.get("tpu_wall_s")),
             "interconnect": lg.get("interconnect"),
             "compile_cache": lg.get("compile_cache"),
+            "join_filter": lg.get("join_filter"),
         })
     except Exception:
         emit({
@@ -466,6 +516,13 @@ def measure() -> None:
     except Exception as e:
         log(f"compile_cache context failed: {type(e).__name__}: {e}")
         compile_cache = None
+    try:
+        # join-path view: runtime filters (eligible joins + estimated
+        # reduction) and join-index cache usage observed this run
+        join_filter = join_filter_context(session, qnames)
+    except Exception as e:
+        log(f"join_filter context failed: {type(e).__name__}: {e}")
+        join_filter = None
     per_q = ", ".join(
         f"{q}={s:.2f}x/{rows_s[q]/1e6:.0f}Mrows_s_chip"
         f"/{roofline['per_query'].get(q, {}).get('hbm_frac', 0):.3f}HBM"
@@ -481,6 +538,7 @@ def measure() -> None:
         "roofline": roofline,
         "interconnect": interconnect,
         "compile_cache": compile_cache,
+        "join_filter": join_filter,
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })
@@ -541,7 +599,7 @@ def main() -> None:
         # measured roofline inputs ride along so a later REPLAY can
         # attach the real denominator instead of the schema estimate
         for k in ("scan_bytes", "tpu_wall_s", "interconnect",
-                  "compile_cache"):
+                  "compile_cache", "join_filter"):
             if k in rec and rec[k] is not None:
                 lg[k] = rec[k]
         with open(LAST_GOOD, "w") as f:

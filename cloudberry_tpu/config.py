@@ -60,6 +60,36 @@ class ExecConfig:
 
 
 @dataclass(frozen=True)
+class JoinFilterConfig:
+    """Runtime join-filter digests + the join-index cache (the
+    semijoin-reduction / runtime-filter-pushdown pair: ORCA's semijoin
+    transforms, nodeRuntimeFilter.c's bloom mode).
+
+    The EXACT runtime filter (planner.runtime_filter_threshold) all-gathers
+    every packed build key and is preferred for small builds; the DIGEST
+    filter here covers the builds too big for that: a fixed-size bloom
+    bitmap plus packed-key min/max, broadcast as ONE tiny collective and
+    applied to probe rows BEFORE their redistribute. Bloom false positives
+    only let extra rows through — results stay bit-identical; min/max and
+    the join itself remain exact."""
+
+    # Digest (bloom + min/max) runtime filters on probe-side redistributes
+    # whose estimated wire savings exceed the digest broadcast cost.
+    enabled: bool = True
+    # Bloom bitmap size in bits (rounded to a power of two ≥ 64). 2^18
+    # bits = 32 KiB on the wire per segment — noise next to a typical
+    # shuffle, sized for ~100k-key builds at k=3 probes.
+    bloom_bits: int = 1 << 18
+    # Hash probes per key (false-positive rate ≈ (1 - e^{-k·n/m})^k).
+    bloom_k: int = 3
+    # Join-index (sorted-build) cache entries per session: cached
+    # (sort order, sorted packed keys, packing ranges) per build table
+    # version — repeated statements skip the build-side argsort entirely.
+    # 0 disables the cache.
+    index_cache: int = 32
+
+
+@dataclass(frozen=True)
 class PlannerConfig:
     """Cost-model analog of cdbpath.c's motion choices."""
 
@@ -226,6 +256,7 @@ class Config:
     interconnect: InterconnectConfig = field(default_factory=InterconnectConfig)
     exec: ExecConfig = field(default_factory=ExecConfig)
     planner: PlannerConfig = field(default_factory=PlannerConfig)
+    join_filter: JoinFilterConfig = field(default_factory=JoinFilterConfig)
     resource: ResourceConfig = field(default_factory=ResourceConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)

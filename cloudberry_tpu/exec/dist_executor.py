@@ -40,6 +40,17 @@ def prepare_dist_inputs(plan: N.PlanNode, session, names=None):
     table out of the resident inputs)."""
     inputs = {}
     in_specs = {}
+    if plan is not None:
+        # cached sorted-build join indexes ride as extra program inputs
+        # (exec/joinindex.py): 'shard'-mode arrays split on the segment
+        # axis, whole-table/gathered ones replicated. Tiled callers pass
+        # plan=None and the join lowering falls back to its in-program
+        # argsort.
+        from cloudberry_tpu.exec.joinindex import dist_join_index_inputs
+
+        jix_in, jix_specs = dist_join_index_inputs(plan, session)
+        inputs.update(jix_in)
+        in_specs.update(jix_specs)
     if names is None:
         names = sorted({s.table_name for s in X.scans_of(plan)})
     for name in names:
@@ -101,7 +112,10 @@ def compile_distributed(plan: N.PlanNode, session, param_keys=None):
 def record_motion_stats(plan: N.PlanNode, stats: dict) -> None:
     """Pin each redistribute's observed global bucket demand onto its
     motion node (``_observed_bucket``): on overflow the retry promotes
-    straight to the rung that fits instead of probing rung by rung."""
+    straight to the rung that fits instead of probing rung by rung.
+    Runtime-filter row counts pin the same way (``_jf_pre``/``_jf_post``).
+    Engine-counter accumulation lives in record_jf_counters — called
+    separately, only once raise_checks passed."""
     import re
 
     # redistribute-only by construction; the kind filter also guards the
@@ -110,13 +124,38 @@ def record_motion_stats(plan: N.PlanNode, stats: dict) -> None:
     # grow_expansion's id-match path)
     motions = {id(n): n for n in X.all_nodes(plan)
                if isinstance(n, N.PMotion) and n.kind == "redistribute"}
+    filters = {id(n): n for n in X.all_nodes(plan)
+               if isinstance(n, N.PRuntimeFilter)}
     for key, v in stats.items():
         m = re.search(r"required bucket \(node (\d+)\)", key)
-        if m is None:
+        if m is not None:
+            node = motions.get(int(m.group(1)))
+            if node is not None:
+                node._observed_bucket = int(np.asarray(v))
             continue
-        node = motions.get(int(m.group(1)))
-        if node is not None:
-            node._observed_bucket = int(np.asarray(v))
+        m = re.search(r"join_filter (pre|post) \(node (\d+)\)", key)
+        if m is not None:
+            node = filters.get(int(m.group(2)))
+            if node is not None:
+                which = "_jf_pre" if m.group(1) == "pre" else "_jf_post"
+                setattr(node, which, int(np.asarray(v)))
+
+
+def record_jf_counters(stats: dict, log) -> None:
+    """Accumulate runtime-filter row counts on the engine counters
+    (jf_rows_in / jf_rows_out) — the observed-reduction observability
+    bench.py and ic_bench --join-filter read. Call AFTER raise_checks:
+    an overflowed attempt that grow_expansion retries must not count its
+    probe rows twice."""
+    import re
+
+    if log is None:
+        return
+    for key, v in stats.items():
+        m = re.search(r"join_filter (pre|post)", key)
+        if m is not None:
+            log.bump("jf_rows_in" if m.group(1) == "pre"
+                     else "jf_rows_out", int(np.asarray(v)))
 
 
 def execute_distributed(plan: N.PlanNode, session,
@@ -128,6 +167,7 @@ def execute_distributed(plan: N.PlanNode, session,
     cols, sel, checks, stats = fn(inputs)
     record_motion_stats(plan, stats)
     X.raise_checks(checks)
+    record_jf_counters(stats, getattr(session, "stmt_log", None))
     # every segment computed the (gathered) final result; read the first
     # shard THIS HOST can address (on a multi-host mesh, segment 0 may
     # live on another process — any local copy is identical post-gather)
@@ -209,11 +249,16 @@ class DistLowerer(X.Lowerer):
         return self.tx.psum(local, SEG_AXIS) > 0
 
     def runtime_filter(self, node):
-        """Exact semi-join pushdown (nodeRuntimeFilter.c analog): all-gather
-        the PACKED u64 build keys (keys only — the cheapest collective),
-        sorted-membership-test the probe rows, and AND into the selection
-        BEFORE the probe's redistribute. Packing ranges reduce globally so
-        every segment packs identically."""
+        """Semi-join pushdown (nodeRuntimeFilter.c analog) before the
+        probe's redistribute. mode='exact': all-gather the PACKED u64
+        build keys (keys only — the cheapest complete collective) and
+        sorted-membership-test the probe rows. mode='digest': all-gather
+        only a per-key u64 min/max + bloom-bitmap digest (one tiny
+        collective regardless of build size; bloom false positives let
+        extra rows through, the join stays exact). Packing ranges reduce
+        globally so every segment packs identically."""
+        if getattr(node, "mode", "exact") == "digest":
+            return self._digest_filter(node)
         pcols, psel = self.lower(node.child)
         bcols, bsel = self.lower_shared(node.build)
         bkeys = [self.expr(k, bcols) for k in node.build_keys]
@@ -238,7 +283,67 @@ class DistLowerer(X.Lowerer):
         pos = jnp.clip(jnp.searchsorted(kb_sorted, kp), 0,
                        kb_sorted.shape[0] - 1)
         hit = (kb_sorted[pos] == kp) & (kp != big)
+        self._filter_stats(node, psel, psel & hit)
         return pcols, psel & hit
+
+    def _digest_filter(self, node):
+        """Digest-mode runtime filter: each segment builds a local digest
+        — per key column the u64 [lo, hi] (as u32 word pairs) plus the
+        bloom bitmap words — ships it in ONE all_gather, then reduces
+        (min/max/OR) so every segment holds the GLOBAL digest. Probe rows
+        outside any key's range or absent from the bloom drop before the
+        shuffle; min/max is exact, bloom errs only toward keeping rows."""
+        pcols, psel = self.lower(node.child)
+        bcols, bsel = self.lower_shared(node.build)
+        bus = [K.sort_key_u64(self.expr(k, bcols))
+               for k in node.build_keys]
+        pus = [K.sort_key_u64(self.expr(k, pcols))
+               for k in node.probe_keys]
+        bits = K.bloom_bits_pow2(node.bloom_bits)
+        kk = max(node.bloom_k, 1)
+
+        def u64_words(x):
+            return jnp.stack([(x & jnp.uint64(0xFFFFFFFF)),
+                              (x >> jnp.uint64(32))]).astype(jnp.uint32)
+
+        parts = []
+        for u in bus:
+            lo = jnp.min(jnp.where(bsel, u, K._U64_MAX))
+            hi = jnp.max(jnp.where(bsel, u, jnp.uint64(0)))
+            parts += [u64_words(lo), u64_words(hi)]
+        parts.append(K.bloom_build(bus, bsel, bits, kk))
+        digest = jnp.concatenate(parts)            # (4·nkeys + bits/32,)
+        # ONE tiny collective for the whole digest (tiled all_gather
+        # concatenates: reshape back to per-segment rows)
+        gathered = self.tx.all_gather(digest, SEG_AXIS) \
+            .reshape(self.nseg, digest.shape[0])
+
+        def seg_u64(col0):
+            w = gathered[:, col0:col0 + 2].astype(jnp.uint64)
+            return w[:, 0] | (w[:, 1] << jnp.uint64(32))
+
+        hit = psel
+        for i, u in enumerate(pus):
+            glo = jnp.min(seg_u64(4 * i))
+            ghi = jnp.max(seg_u64(4 * i + 2))
+            hit = hit & (u >= glo) & (u <= ghi)
+        off = 4 * len(bus)
+        bloom = gathered[0, off:]
+        for s in range(1, self.nseg):
+            bloom = bloom | gathered[s, off:]
+        hit = hit & K.bloom_test(bloom, pus, bits, kk)
+        self._filter_stats(node, psel, psel & hit)
+        return pcols, psel & hit
+
+    def _filter_stats(self, node, pre, post):
+        """Replicated observability: global probe rows before/after the
+        filter (psum over segments) — the host pins them on the plan node
+        (record_motion_stats) for EXPLAIN ANALYZE consumers, bench.py's
+        join_filter record, and ic_bench --join-filter."""
+        self.stats[f"join_filter pre (node {id(node)})"] = self.tx.psum(
+            jnp.sum(pre.astype(jnp.int32)), SEG_AXIS)
+        self.stats[f"join_filter post (node {id(node)})"] = self.tx.psum(
+            jnp.sum(post.astype(jnp.int32)), SEG_AXIS)
 
     def motion(self, node: N.PMotion):
         cols, sel = self.lower_shared(node.child)

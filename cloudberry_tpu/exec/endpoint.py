@@ -91,13 +91,15 @@ def declare(session, name: str, query_ast) -> dict:
             if stripped is not None:
                 from cloudberry_tpu.exec.dist_executor import (
                     compile_distributed, prepare_dist_inputs,
-                    record_motion_stats)
+                    record_jf_counters, record_motion_stats)
 
                 fn = compile_distributed(stripped, session)
                 inputs, _ = prepare_dist_inputs(stripped, session)
                 cols, sel, checks, stats = fn(inputs)
                 record_motion_stats(stripped, stats)
                 X.raise_checks(checks)
+                record_jf_counters(stats,
+                                   getattr(session, "stmt_log", None))
                 sel_np = np.asarray(sel)
                 for s in range(nseg):
                     shard_cols = {k: np.asarray(v)[s]

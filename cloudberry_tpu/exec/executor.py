@@ -31,6 +31,14 @@ class ExecError(RuntimeError):
     pass
 
 
+class DuplicateBuildKeyError(ExecError):
+    """The planner assumed a unique (PK) build side but the data holds
+    duplicate build keys — a semantic error (results would be wrong, so
+    the statement aborts; never retryable). Raised from the runtime
+    duplicate check every inner/left lookup join carries, instead of
+    silently trusting the planner's uniqueness inference."""
+
+
 @dataclass
 class Executable:
     plan: N.PlanNode
@@ -113,9 +121,9 @@ def prepare_tables(table_names: list[str], session,
 def prepare_inputs(exe: Executable, session,
                    segment: int | None = None) -> dict:
     """All inputs for one executable: RAM tables by name plus pruned
-    store reads keyed by scan identity."""
+    store reads keyed by scan identity plus cached join indexes."""
     return _assemble_inputs(exe.table_names, exe.store_scans or (),
-                            session, segment)
+                            session, segment, plan=exe.plan)
 
 
 def prepare_plan_inputs(plan: N.PlanNode, session,
@@ -125,16 +133,24 @@ def prepare_plan_inputs(plan: N.PlanNode, session,
     return _assemble_inputs(
         sorted({s.table_name for s in scans if not keyed_scan(s)}),
         [s for s in scans if keyed_scan(s)],
-        session, segment)
+        session, segment, plan=plan)
 
 
-def _assemble_inputs(table_names, store_scans, session, segment) -> dict:
+def _assemble_inputs(table_names, store_scans, session, segment,
+                     plan=None) -> dict:
     tables = prepare_tables(table_names, session, segment=segment)
     for s in store_scans:
         if hasattr(s, "_point_rows"):
             tables[s._input_key] = _load_point_scan(s, session, segment)
         else:
             tables[s._input_key] = _load_store_scan(s, session)
+    if plan is not None:
+        # cached sorted-build join indexes ride next to the tables (the
+        # $params discipline): same shapes every execution, so feeding a
+        # fresh index never retraces — exec/joinindex.py
+        from cloudberry_tpu.exec.joinindex import join_index_inputs
+
+        tables.update(join_index_inputs(plan, session, segment))
     return tables
 
 
@@ -213,6 +229,8 @@ def run_executable(exe: Executable, tables: dict) -> ColumnBatch:
 def raise_checks(checks: dict) -> None:
     for msg, bad in checks.items():
         if bool(np.asarray(bad).any()):
+            if "duplicate keys" in msg:
+                raise DuplicateBuildKeyError(msg)
             raise ExecError(msg)
 
 
@@ -613,6 +631,29 @@ class Lowerer:
 
     # ------------------------------------------------------------ operators
 
+    def _join_index(self, node: N.PJoin):
+        """Cached sorted-build index for this join (exec/joinindex.py):
+        (order, sorted packed keys, packing ranges) fed as a program
+        input, or None → compute the argsort in-program. Tiled/spill
+        assemblies never provide the input, so the fallback is automatic
+        there; distributed 'shard'-mode arrays arrive with a leading
+        (1, …) segment axis inside shard_map and normalize here."""
+        spec = getattr(node, "_jix", None)
+        if spec is None:
+            return None
+        jix = self.tables.get(spec.key)
+        if jix is None:
+            return None
+        order, skeys = jnp.asarray(jix["order"]), jnp.asarray(jix["skeys"])
+        if order.ndim == 2:
+            order, skeys = order[0], skeys[0]
+        ranges = []
+        for i in range(len(node.build_keys)):
+            lo = jnp.asarray(jix[f"lo{i}"]).reshape(())
+            span = jnp.asarray(jix[f"span{i}"]).reshape(())
+            ranges.append((lo, span))
+        return order, skeys, ranges
+
     def join(self, node: N.PJoin):
         # lower_shared: a runtime filter may reference the same build
         # subtree — it must trace once
@@ -643,8 +684,14 @@ class Lowerer:
         if fused is not None:
             matched, payload, has_dup = fused
         else:
-            idx, matched, has_dup = K.join_lookup(
-                bkeys, bselm, pkeys, pselm, bits=node.pack_bits)
+            jix = self._join_index(node)
+            if jix is not None:
+                idx, matched, has_dup = K.join_lookup_sorted(
+                    jix[0], jix[1], jix[2], pkeys, pselm,
+                    bits=node.pack_bits)
+            else:
+                idx, matched, has_dup = K.join_lookup(
+                    bkeys, bselm, pkeys, pselm, bits=node.pack_bits)
             payload = K.gather_payload(
                 {n: bcols[n] for n in node.build_payload}, idx, matched)
         if node.kind in ("inner", "left"):
@@ -972,8 +1019,8 @@ class Lowerer:
         expand equi-match pairs, evaluate the residual per pair, then
         OR-reduce back onto probe rows."""
         cap = node.out_capacity
-        pi, bi, osel, _matched, total = K.join_expand(
-            bkeys, bselm, pkeys, pselm, cap, bits=node.pack_bits)
+        pi, bi, osel, _matched, total = self._expand_pairs(
+            node, bkeys, bselm, pkeys, pselm, cap)
         self.checks[
             f"semi-join expansion overflow: match pairs exceed capacity "
             f"{cap} (node {id(node)})"] = total > cap
@@ -986,6 +1033,17 @@ class Lowerer:
         sel = psel & hit if node.kind == "semi" else psel & ~hit
         return dict(pcols), sel
 
+    def _expand_pairs(self, node: N.PJoin, bkeys, bselm, pkeys, pselm,
+                      cap: int):
+        """join_expand through the cached sorted-build index when one is
+        fed (skips the build argsort), else the full kernel."""
+        jix = self._join_index(node)
+        if jix is not None:
+            return K.join_expand_sorted(jix[0], jix[1], jix[2], pkeys,
+                                        pselm, cap, bits=node.pack_bits)
+        return K.join_expand(bkeys, bselm, pkeys, pselm, cap,
+                             bits=node.pack_bits)
+
     def _join_expand(self, node: N.PJoin, bcols, bsel, bselm, bkeys,
                      pcols, psel, pselm, pkeys):
         """Many-to-many expansion: one output row per match pair; LEFT joins
@@ -994,8 +1052,8 @@ class Lowerer:
         are unmatched by construction — bselm/pselm exclude them from
         matching, bsel/psel keep them in the preserved regions)."""
         cap = node.out_capacity
-        pi, bi, osel, matched, total = K.join_expand(
-            bkeys, bselm, pkeys, pselm, cap, bits=node.pack_bits)
+        pi, bi, osel, matched, total = self._expand_pairs(
+            node, bkeys, bselm, pkeys, pselm, cap)
         need = total
         is_pair = osel
         j = jnp.arange(cap, dtype=total.dtype)

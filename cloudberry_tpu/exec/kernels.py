@@ -427,6 +427,60 @@ def _dtype_min(dt):
 # --------------------------------------------------------------------------
 
 
+def build_sort(
+    build_key: Sequence[jnp.ndarray],
+    build_sel: jnp.ndarray,
+    bits: int = 64,
+) -> tuple[jnp.ndarray, jnp.ndarray, list]:
+    """The build side's sort scaffolding: (order, sorted packed keys,
+    packing ranges). ONE implementation shared by the in-program joins
+    and the host-side join-index cache (exec/joinindex.py mirrors it in
+    numpy) — the two must agree bit-for-bit, including stable tie order,
+    for cached indexes to be drop-in replacements."""
+    ranges = key_ranges(list(build_key), build_sel)
+    kb = pack_with_ranges(list(build_key), ranges)
+    big = _U32_MAX if bits == 32 else _U64_MAX
+    if bits == 32:
+        kb = downcast32(kb)
+    kb_masked = jnp.where(build_sel, kb, big)
+    order = jnp.argsort(kb_masked)
+    return order, kb_masked[order], ranges
+
+
+def dup_check(kb_sorted: jnp.ndarray, bits: int = 64) -> jnp.ndarray:
+    """Duplicate build keys, for free off the already-sorted keys (the
+    sentinel — unselected/out-of-range rows — never counts)."""
+    big = _U32_MAX if bits == 32 else _U64_MAX
+    if kb_sorted.shape[0] <= 1:
+        return jnp.asarray(False)
+    return ((kb_sorted[1:] == kb_sorted[:-1])
+            & (kb_sorted[1:] != big)).any()
+
+
+def join_lookup_sorted(
+    order: jnp.ndarray,
+    kb_sorted: jnp.ndarray,
+    ranges: Sequence[tuple[jnp.ndarray, jnp.ndarray]],
+    probe_key: Sequence[jnp.ndarray],
+    probe_sel: jnp.ndarray,
+    bits: int = 64,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """join_lookup against a PRE-SORTED build (computed in-program or fed
+    from the session join-index cache): probe packing + binary search
+    only, no argsort."""
+    kp = pack_with_ranges(list(probe_key), ranges)
+    big = _U64_MAX
+    if bits == 32:
+        kp, big = downcast32(kp), _U32_MAX
+    pos = jnp.searchsorted(kb_sorted, kp)
+    pos_c = jnp.clip(pos, 0, kb_sorted.shape[0] - 1)
+    # kp == sentinel marks out-of-range probes; excluding it also makes the
+    # empty-build case (kb_sorted all sentinel) correctly match nothing.
+    matched = (kb_sorted[pos_c] == kp) & probe_sel & (kp != big)
+    build_row = order[pos_c].astype(jnp.int32)
+    return build_row, matched, dup_check(kb_sorted, bits)
+
+
 def join_lookup(
     build_key: Sequence[jnp.ndarray],
     build_sel: jnp.ndarray,
@@ -444,25 +498,9 @@ def join_lookup(
     int32[cap_p], matched bool[cap_p], has_dup scalar bool — duplicate
     build keys detected, for free off the already-sorted keys).
     """
-    ranges = key_ranges(list(build_key), build_sel)
-    kb = pack_with_ranges(list(build_key), ranges)
-    kp = pack_with_ranges(list(probe_key), ranges)
-    big = _U64_MAX
-    if bits == 32:
-        kb, kp, big = downcast32(kb), downcast32(kp), _U32_MAX
-    kb_masked = jnp.where(build_sel, kb, big)
-    order = jnp.argsort(kb_masked)
-    kb_sorted = kb_masked[order]
-    pos = jnp.searchsorted(kb_sorted, kp)
-    pos_c = jnp.clip(pos, 0, kb_sorted.shape[0] - 1)
-    # kp == sentinel marks out-of-range probes; excluding it also makes the
-    # empty-build case (kb_sorted all sentinel) correctly match nothing.
-    matched = (kb_sorted[pos_c] == kp) & probe_sel & (kp != big)
-    build_row = order[pos_c].astype(jnp.int32)
-    has_dup = ((kb_sorted[1:] == kb_sorted[:-1])
-               & (kb_sorted[1:] != big)).any() \
-        if kb_sorted.shape[0] > 1 else jnp.asarray(False)
-    return build_row, matched, has_dup
+    order, kb_sorted, ranges = build_sort(build_key, build_sel, bits)
+    return join_lookup_sorted(order, kb_sorted, ranges, probe_key,
+                              probe_sel, bits)
 
 
 def gather_payload(cols: Columns, idx: jnp.ndarray, matched: jnp.ndarray) -> Columns:
@@ -495,34 +533,130 @@ def join_expand(
              matched[probe_cap] (per-probe any-match, for outer joins),
              total_matches scalar).
     """
-    ranges = key_ranges(list(build_key), build_sel)
-    kb = pack_with_ranges(list(build_key), ranges)
+    order, kb_sorted, ranges = build_sort(build_key, build_sel, bits)
+    return join_expand_sorted(order, kb_sorted, ranges, probe_key,
+                              probe_sel, out_capacity, bits)
+
+
+def join_expand_sorted(
+    order: jnp.ndarray,
+    kb_sorted: jnp.ndarray,
+    ranges: Sequence[tuple[jnp.ndarray, jnp.ndarray]],
+    probe_key: Sequence[jnp.ndarray],
+    probe_sel: jnp.ndarray,
+    out_capacity: int,
+    bits: int = 64,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """join_expand against a PRE-SORTED build (see join_lookup_sorted)."""
     kp = pack_with_ranges(list(probe_key), ranges)
     big = _U64_MAX
     if bits == 32:
-        kb, kp, big = downcast32(kb), downcast32(kp), _U32_MAX
-    kb_masked = jnp.where(build_sel, kb, big)
-    order = jnp.argsort(kb_masked)
-    kb_sorted = kb_masked[order]
+        kp, big = downcast32(kp), _U32_MAX
 
     start = jnp.searchsorted(kb_sorted, kp, side="left")
     end = jnp.searchsorted(kb_sorted, kp, side="right")
     ok = probe_sel & (kp != big)
-    cnt = jnp.where(ok, end - start, 0)
+    # overflow hardening: searchsorted returns a NARROW index dtype, and a
+    # cumsum KEEPS its input dtype — per-probe counts must widen to int64
+    # BEFORE the prefix sum so the total-vs-capacity overflow check can
+    # never itself wrap on a large fanout (capacities past 2^16 rows with
+    # hot keys multiply fast; the check is the last line of defense and
+    # must be exact at any count)
+    cnt = jnp.where(ok, (end - start).astype(jnp.int64), jnp.int64(0))
     matched = cnt > 0
 
     offsets = jnp.cumsum(cnt)
-    total = offsets[-1] if cnt.shape[0] else jnp.asarray(0, cnt.dtype)
-    j = jnp.arange(out_capacity, dtype=offsets.dtype)
+    total = offsets[-1] if cnt.shape[0] else jnp.asarray(0, jnp.int64)
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
     # probe row for output slot j: first i with offsets[i] > j
     pi = jnp.searchsorted(offsets, j, side="right")
     pi_c = jnp.clip(pi, 0, cnt.shape[0] - 1)
     base = offsets[pi_c] - cnt[pi_c]          # first slot of probe row pi
     k = j - base
     out_sel = j < total
-    build_pos = jnp.clip(start[pi_c] + k, 0, kb_sorted.shape[0] - 1)
+    build_pos = jnp.clip(start[pi_c].astype(jnp.int64) + k, 0,
+                         kb_sorted.shape[0] - 1)
     build_row = order[build_pos].astype(jnp.int32)
     return pi_c.astype(jnp.int32), build_row, out_sel, matched, total
+
+
+# --------------------------------------------------------------------------
+# bloom digest — runtime join filters (plan/nodes.py PRuntimeFilter
+# mode="digest"): a fixed-size bitmap over RANGE-FREE key hashes, so every
+# segment's insertions agree on bit positions without a collective range
+# reduction first. The digest (per-key u64 min/max + the bitmap words)
+# rides ONE tiny all_gather; probe rows failing the min/max or bloom test
+# drop BEFORE their redistribute. False positives only let extra rows
+# through — the join itself stays exact.
+# --------------------------------------------------------------------------
+
+
+_MIX_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_MIX_M2 = jnp.uint64(0x94D049BB133111EB)
+_MIX_SEED = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer — u64 arithmetic only (TPU-legal)."""
+    x = (x ^ (x >> jnp.uint64(30))) * _MIX_M1
+    x = (x ^ (x >> jnp.uint64(27))) * _MIX_M2
+    return x ^ (x >> jnp.uint64(31))
+
+
+def bloom_hash(key_u64s: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """One u64 hash per row over the sort_key_u64 forms of the key tuple.
+    Deliberately independent of packing ranges: equal key tuples hash
+    identically on every segment, unlike packed keys whose ranges are
+    fragment-local."""
+    h = jnp.broadcast_to(_MIX_SEED, key_u64s[0].shape)
+    for u in key_u64s:
+        h = _mix64(h ^ u)
+    return h
+
+
+def bloom_bits_pow2(bits: int) -> int:
+    """Clamp a configured bitmap size to a power of two ≥ 64 (word math
+    and the position mask rely on it)."""
+    return 1 << max(6, int(bits - 1).bit_length())
+
+
+def _bloom_positions(h: jnp.ndarray, bits: int, k: int) -> list:
+    """k bit positions per row sliced from ONE 64-bit hash — disjoint
+    slices while they fit, overlapping (still a valid bloom) beyond."""
+    lb = max(bits.bit_length() - 1, 1)
+    step = max((64 - lb) // max(k, 1), 1)
+    mask = jnp.uint64(bits - 1)
+    return [((h >> jnp.uint64(i * step)) & mask).astype(jnp.int32)
+            for i in range(max(k, 1))]
+
+
+def bloom_build(key_u64s: Sequence[jnp.ndarray], sel: jnp.ndarray,
+                bits: int, k: int) -> jnp.ndarray:
+    """(bits // 32,) uint32 bitmap over the SELECTED rows' key hashes.
+    Built as a bool bitmap (scatter of ones — the bitmap is tiny) then
+    packed to words for the wire; cross-segment combination is a bitwise
+    OR of the words."""
+    h = bloom_hash(key_u64s)
+    bm = jnp.zeros((bits,), dtype=jnp.bool_)
+    for pos in _bloom_positions(h, bits, k):
+        idx = jnp.where(sel, pos, bits)
+        bm = bm.at[idx].set(True, mode="drop")
+    w = bm.reshape(bits // 32, 32).astype(jnp.uint32)
+    return jnp.sum(w << jnp.arange(32, dtype=jnp.uint32), axis=1,
+                   dtype=jnp.uint32)
+
+
+def bloom_test(words: jnp.ndarray, key_u64s: Sequence[jnp.ndarray],
+               bits: int, k: int) -> jnp.ndarray:
+    """Per-row membership test against a packed bitmap: True = possibly
+    present (false positives possible), False = definitely absent."""
+    h = bloom_hash(key_u64s)
+    ok = jnp.ones(h.shape, dtype=jnp.bool_)
+    for pos in _bloom_positions(h, bits, k):
+        w = words[pos >> 5]
+        ok = ok & (((w >> (pos & 31).astype(jnp.uint32))
+                    & jnp.uint32(1)) != 0)
+    return ok
 
 
 # --------------------------------------------------------------------------

@@ -99,6 +99,15 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
     # the tile stream and resident loads key inputs by TABLE NAME: a
     # point-sliced scan would miss its $pt input — restore full scans
     unbind_point_lookups(plan)
+    # join-index inputs are a one-shot-executor feature: tiled prelude/
+    # step programs assemble their own input dicts, so drop the
+    # annotations — joins then compute their argsort in-program
+    # (exec/joinindex.py documents the fallback contract). The strip is
+    # speculative: a decline below restores the stash so the one-shot
+    # fallback keeps its cached indexes.
+    from cloudberry_tpu.exec.joinindex import (restore_join_index,
+                                               stash_join_index,
+                                               strip_join_index)
     shape = _analyze(plan)
     if shape is None:
         return None
@@ -109,6 +118,15 @@ def plan_tiled(plan: N.PlanNode, session) -> Optional["TiledExecutable"]:
     for node in shape.spine:
         if isinstance(node, N.PJoin) and hasattr(node, "_min_out_cap"):
             del node._min_out_cap
+    stash = stash_join_index(plan)
+    strip_join_index(plan)
+    t = _plan_by_mode(shape, session)
+    if t is None:
+        restore_join_index(stash)
+    return t
+
+
+def _plan_by_mode(shape: "_TileShape", session):
     if shape.mode == "topn":
         t = _plan_topn(shape, session)
         if t is not None:

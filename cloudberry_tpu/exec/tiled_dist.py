@@ -102,6 +102,16 @@ def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"
     for node in shape.spine:
         if isinstance(node, N.PJoin) and hasattr(node, "_min_out_cap"):
             del node._min_out_cap
+    # join-index inputs are a one-shot-executor feature (exec/joinindex):
+    # tiled step programs assemble their own inputs, so drop the
+    # annotations and let joins argsort in-program — speculatively: a
+    # decline below restores them for the one-shot fallback
+    from cloudberry_tpu.exec.joinindex import (restore_join_index,
+                                               stash_join_index,
+                                               strip_join_index)
+
+    jix_stash = stash_join_index(plan)
+    strip_join_index(plan)
 
     if shape.mode == "agg":
         from cloudberry_tpu.plan.cost import estimate_rows
@@ -123,11 +133,13 @@ def plan_tiled_dist(plan: N.PlanNode, session) -> Optional["DistTiledExecutable"
         # chain above the sort can apply host-side
         s2 = _to_dist_sort(shape)
         if s2 is None:
+            restore_join_index(jix_stash)
             return None
         shape = s2
         tile_rows = _choose_tile_dist(shape, budget,
                                       session.config.n_segments)
     if tile_rows is None:
+        restore_join_index(jix_stash)
         return None
     cls = {"topn": DistTopNTiledExecutable,
            "sort": DistSortTiledExecutable,

@@ -312,7 +312,8 @@ class Distributor:
 
     def redistribute(self, child: N.PlanNode, cap: int,
                      keys: list[ex.Expr],
-                     est_rows: float | None = None
+                     est_rows: float | None = None,
+                     est_under_exact: bool = False
                      ) -> tuple[N.PlanNode, int]:
         m = N.PMotion(child, "redistribute", hash_keys=list(keys))
         m.fields = list(child.fields)
@@ -335,6 +336,18 @@ class Distributor:
             # Rounded up to its capacity rung (kernels.rung_up) so equal-
             # shaped motions share compiled executables.
             m.bucket_cap = rung_up(max(exact, 8))
+            if est_rows is not None and est_under_exact:
+                # a DIGEST runtime filter shrank the input: the exact
+                # bound (computed on the UNFILTERED scan) stays the
+                # CEILING — it absorbs any skew — but the survivor
+                # estimate may seed a LOWER rung: fewer padded wire
+                # bytes, and an under-estimate (bloom false positives,
+                # skewed survivors) is a detected overflow that promotes
+                # back up the ladder (grow_expansion), never past the
+                # ceiling it started from and never a wrong result
+                est_bucket = rung_up(max(int(math.ceil(
+                    min(est_rows, cap) / self.nseg * factor)), 64))
+                m.bucket_cap = min(m.bucket_cap, est_bucket)
             m.out_capacity = m.bucket_cap * self.nseg
             return m, m.out_capacity
         # capacity-based flow control (the ic_udpifc.c:3018 analog): each
@@ -411,22 +424,48 @@ class Distributor:
 
     def _maybe_runtime_filter(self, node: N.PJoin, build_src: N.PlanNode,
                               probe: N.PlanNode, est_build_rows: float,
-                              est_semi_rows: float | None
-                              ) -> tuple[N.PlanNode, float | None]:
+                              est_semi_rows: float | None,
+                              est_probe_rows: float | None = None
+                              ) -> tuple[N.PlanNode, float | None, bool]:
         """Wrap the probe in a pre-motion runtime filter when profitable;
         returns (probe', TOTAL surviving-row estimate for bucket sizing —
         computed pre-walk by the caller so shard-mutated scans can't skew
-        it)."""
+        it, allow-undercut-of-exact-bound flag). Small builds get the
+        EXACT filter (all-gathered keys); bigger builds get the bloom +
+        min/max DIGEST when its estimated wire savings beat the digest
+        broadcast cost (config.join_filter)."""
+        if node.kind not in ("inner", "semi") or est_semi_rows is None:
+            return probe, None, False
+
+        def wrap(mode: str, bits: int = 0) -> N.PlanNode:
+            rf = N.PRuntimeFilter(probe, build_src,
+                                  list(node.build_keys),
+                                  list(node.probe_keys),
+                                  pack_bits=node.pack_bits, mode=mode,
+                                  bloom_bits=bits,
+                                  bloom_k=self.cfg.join_filter.bloom_k)
+            rf.fields = list(probe.fields)
+            rf.sharding = probe.sharding
+            return rf
+
         thresh = self.cfg.planner.runtime_filter_threshold
-        if thresh <= 0 or node.kind not in ("inner", "semi") \
-                or est_build_rows > thresh or est_semi_rows is None:
-            return probe, None
-        rf = N.PRuntimeFilter(probe, build_src,
-                              list(node.build_keys), list(node.probe_keys),
-                              pack_bits=node.pack_bits)
-        rf.fields = list(probe.fields)
-        rf.sharding = probe.sharding
-        return rf, max(est_semi_rows, 1.0)
+        if thresh > 0 and est_build_rows <= thresh:
+            rf = wrap("exact")
+            rf._est_in = est_probe_rows
+            rf._est_out = max(est_semi_rows, 1.0)
+            return rf, max(est_semi_rows, 1.0), False
+        if est_probe_rows is None:
+            return probe, None, False
+        ok, est, bits = digest_decision(est_build_rows, est_probe_rows,
+                                        est_semi_rows, probe.fields,
+                                        len(node.build_keys), self.cfg,
+                                        self.nseg)
+        if not ok:
+            return probe, None, False
+        rf = wrap("digest", bits)
+        rf._est_in = est_probe_rows
+        rf._est_out = max(est, 1.0)
+        return rf, max(est, 1.0), True
 
     # ----------------------------------------------------------------- join
 
@@ -436,6 +475,7 @@ class Distributor:
         # estimate BEFORE the walk mutates scan capacities to shard sizes
         # (both the build size and the runtime filter's survivor count)
         est_build_rows = estimate_rows(node.build, self.session.catalog)
+        est_probe_rows = estimate_rows(node.probe, self.session.catalog)
         est_semi_rows = semi_estimate(node.build, node.probe,
                                       node.build_keys, node.probe_keys,
                                       self.session.catalog) \
@@ -500,11 +540,12 @@ class Distributor:
             if choice == "broadcast":
                 build, bcap = self.broadcast(build, bcap)
             elif choice == "redist_probe":
-                probe, est = self._maybe_runtime_filter(
-                    node, build, probe, est_build_rows, est_semi_rows)
+                probe, est, under = self._maybe_runtime_filter(
+                    node, build, probe, est_build_rows, est_semi_rows,
+                    est_probe_rows)
                 probe, pcap = self.redistribute(
                     probe, pcap, [node.probe_keys[i] for i in bsub],
-                    est_rows=est)
+                    est_rows=est, est_under_exact=under)
             elif choice == "redist_build":
                 build, bcap = self.redistribute(
                     build, bcap, [node.build_keys[i] for i in psub])
@@ -512,12 +553,13 @@ class Distributor:
                 build_src = build
                 build, bcap = self.redistribute(build, bcap,
                                                 list(node.build_keys))
-                probe, est = self._maybe_runtime_filter(
+                probe, est, under = self._maybe_runtime_filter(
                     node, build_src, probe, est_build_rows,
-                    est_semi_rows)
+                    est_semi_rows, est_probe_rows)
                 probe, pcap = self.redistribute(probe, pcap,
                                                 list(node.probe_keys),
-                                                est_rows=est)
+                                                est_rows=est,
+                                                est_under_exact=under)
         elif b_part and not p_part:
             if node.kind in ("inner", "semi"):
                 # probe replicated/singleton, build partitioned: each segment
@@ -630,6 +672,70 @@ class Distributor:
         out = _finalize_project(final, node, finalize)
         out.sharding = final.sharding
         return out, 1
+
+
+def digest_survivors(est_build: float, est_probe: float, est_semi: float,
+                     bits: int, k: int) -> float:
+    """Probe rows expected to SURVIVE a digest runtime filter: the true
+    partners plus bloom false positives at the estimated load factor
+    (fpr ≈ (1 - e^{-k·n/m})^k) — the costing currency shared by the
+    distributor's eligibility rule and the memo's motion pricing."""
+    import math as _m
+
+    m = max(bits, 64)
+    kk = max(k, 1)
+    fpr = (1.0 - _m.exp(-kk * max(est_build, 1.0) / m)) ** kk
+    return min(est_probe,
+               est_semi + fpr * max(est_probe - est_semi, 0.0))
+
+
+def digest_decision(est_build: float, est_probe: float, est_semi: float,
+                    probe_fields, n_keys: int, cfg,
+                    nseg: int) -> tuple[bool, float, int]:
+    """(eligible, survivor estimate, bloom bits) — THE digest eligibility
+    rule: fires only above the exact filter's threshold, and only when the
+    estimated wire savings beat the digest broadcast cost. One copy shared
+    by the distributor's filter insertion (_maybe_runtime_filter) and the
+    memo's motion pricing (digest_filter_frac), so the two can't drift."""
+    from cloudberry_tpu.exec.kernels import bloom_bits_pow2
+
+    jf = cfg.join_filter
+    est_probe = max(est_probe, 1.0)
+    if not jf.enabled:
+        return False, est_probe, 0
+    thresh = cfg.planner.runtime_filter_threshold
+    if thresh > 0 and est_build <= thresh:
+        return False, est_probe, 0  # exact-filter territory
+    bits = bloom_bits_pow2(jf.bloom_bits)
+    est = digest_survivors(est_build, est_probe, est_semi, bits,
+                           jf.bloom_k)
+    row_bytes = max(sum(f.type.np_dtype.itemsize
+                        for f in probe_fields), 1)
+    saved = (est_probe - est) * row_bytes * (nseg - 1) / max(nseg, 1)
+    digest_bytes = (bits // 8 + 32 * n_keys) * nseg
+    return saved > digest_bytes, est, bits
+
+
+def digest_filter_frac(node: N.PJoin, catalog, cfg, nseg: int) -> float:
+    """Fraction of probe rows expected on the wire after the pre-motion
+    runtime filter a probe redistribute would get, 1.0 when none fires.
+    DIGEST mode only — the exact filter (small builds) is deliberately
+    unmodeled so existing plan choices stay put; the digest covers the
+    big-build shuffles where semijoin reduction decides the motion."""
+    from cloudberry_tpu.plan.cost import estimate_rows, semi_estimate
+
+    if not cfg.join_filter.enabled or node.kind not in ("inner", "semi"):
+        return 1.0
+    est_b = estimate_rows(node.build, catalog)
+    est_p = max(estimate_rows(node.probe, catalog), 1.0)
+    est_semi = semi_estimate(node.build, node.probe, node.build_keys,
+                             node.probe_keys, catalog)
+    ok, est, _ = digest_decision(est_b, est_p, est_semi,
+                                 node.probe.fields,
+                                 len(node.build_keys), cfg, nseg)
+    if not ok:
+        return 1.0
+    return max(est / est_p, 1e-6)
 
 
 def _join_out_cap(node: N.PJoin, bcap: int, pcap: int,

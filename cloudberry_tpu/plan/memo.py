@@ -264,10 +264,17 @@ def _explore_join(node: N.PJoin, catalog, nseg: int,
                     ch + ((node, "broadcast"),)))
             bsub = _hashed_key_positions(bsh, node.build_keys)
             psub = _hashed_key_positions(psh, node.probe_keys)
+            # semijoin reduction: a probe redistribute ships only the rows
+            # a pre-motion DIGEST runtime filter would pass (stamped by
+            # annotate_distribution via distribute.digest_filter_frac) —
+            # the same currency the distributor uses when it inserts the
+            # filter, so a big-build join whose probe shrinks 10x on the
+            # wire wins redist_probe over broadcast on its real bytes
+            jfrac = getattr(node, "_jf_frac", 1.0)
             if bsub is not None:
                 keys = [node.probe_keys[i] for i in bsub]
                 _keep_best(out, Alt(
-                    base + _redist_cost(est_p, wp,
+                    base + _redist_cost(est_p * jfrac, wp,
                                         hot(node.probe, keys), nseg),
                     _redist_sharding(keys),
                     ch + ((node, "redist_probe"),)))
@@ -281,7 +288,7 @@ def _explore_join(node: N.PJoin, catalog, nseg: int,
                 base + _redist_cost(est_b, wb,
                                     hot(node.build, node.build_keys),
                                     nseg)
-                + _redist_cost(est_p, wp,
+                + _redist_cost(est_p * jfrac, wp,
                                hot(node.probe, node.probe_keys), nseg),
                 _redist_sharding(node.probe_keys),
                 ch + ((node, "redist_both"),)))
@@ -684,6 +691,21 @@ def annotate_distribution(plan: N.PlanNode, session) -> None:
     gst = session.config.planner.gather_single_threshold
     annotated: set[int] = set()
     seen: set[int] = set()
+
+    # pre-stamp each join's digest-filter survival fraction so the
+    # exploration (which deliberately has no config in scope) prices
+    # probe redistributes at their POST-FILTER bytes; the joint search
+    # (mask-based, no join nodes yet) stays unmodeled by design
+    from cloudberry_tpu.exec.executor import all_nodes
+    from cloudberry_tpu.plan.distribute import digest_filter_frac
+
+    for nd in all_nodes(plan):
+        if isinstance(nd, N.PJoin) and not hasattr(nd, "_jf_frac"):
+            try:
+                nd._jf_frac = digest_filter_frac(nd, catalog,
+                                                 session.config, nseg)
+            except Exception:
+                nd._jf_frac = 1.0
 
     def region(root: N.PlanNode, agg: Optional[N.PAgg]) -> None:
         alts = explore(root, catalog, nseg, thr, gst)

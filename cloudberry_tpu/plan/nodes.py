@@ -312,21 +312,35 @@ class PRuntimeFilter(PlanNode):
     """Semi-join pushdown before a probe-side motion (nodeRuntimeFilter.c
     analog): drop probe rows whose join key provably has no build partner
     BEFORE the shuffle. The build reference is the SAME object the join
-    lowers (memoized, traced once); the membership test all-gathers ONLY
-    the packed u64 build keys — the cheapest possible collective — and is
-    exact (sorted lookup), so unlike a bloom there are no false positives
-    and the planner may shrink downstream motion buffers on its estimate."""
+    lowers (memoized, traced once). Two modes:
+
+    - ``exact``: all-gather ONLY the packed u64 build keys — the cheapest
+      complete collective — and sorted-membership-test the probes. No
+      false positives, so the planner may shrink downstream motion
+      buffers on its semi estimate. Preferred for small builds
+      (planner.runtime_filter_threshold).
+    - ``digest``: build sides too big to ship whole broadcast a COMPACT
+      digest instead — per-key u64 min/max plus a fixed-size bloom
+      bitmap (config.join_filter) in one tiny all_gather. Bloom false
+      positives only let extra rows through; results stay bit-identical
+      with the filter on or off, and a survivor overflow just promotes
+      the motion one capacity rung (exec/executor.py grow_expansion)."""
 
     child: PlanNode                  # probe subtree (pre-motion)
     build: PlanNode                  # shared with the join's build input
     build_keys: list[ex.Expr] = dc_field(default_factory=list)
     probe_keys: list[ex.Expr] = dc_field(default_factory=list)
     pack_bits: int = 64              # see PJoin.pack_bits
+    mode: str = "exact"              # 'exact' | 'digest'
+    bloom_bits: int = 0              # digest bitmap size (power of two)
+    bloom_k: int = 3                 # digest hash probes per key
 
     def children(self):
         return [self.child]          # build is walked under the join
 
     def title(self):
+        if self.mode == "digest":
+            return f"RuntimeFilter digest(bloom={self.bloom_bits})"
         return "RuntimeFilter"
 
 

@@ -1232,11 +1232,22 @@ def _optimize(plan: N.PlanNode, session) -> N.PlanNode:
             # routed to ONE shard: the sorted sidecar then narrows the
             # scan to the matching rows (index/block-directory analog)
             optimize_point_lookups(plan, session)
+            _annotate_join_index(plan, session)
             return plan
     plan = _distribute(plan, session)
     if session.config.n_segments <= 1:
         optimize_point_lookups(plan, session)
+    _annotate_join_index(plan, session)
     return plan
+
+
+def _annotate_join_index(plan: N.PlanNode, session) -> None:
+    """Stamp eligible joins with their sorted-build cache spec
+    (exec/joinindex.py) — runs LAST so the specs see final capacities,
+    motions, and the direct-dispatch rewrite."""
+    from cloudberry_tpu.exec.joinindex import annotate_join_index
+
+    annotate_join_index(plan, session)
 
 
 def _distribute(plan: N.PlanNode, session) -> N.PlanNode:

@@ -315,10 +315,16 @@ class _Walker:
         if isinstance(node, N.PJoin):
             bk = tuple(self.esig(k, False)[0] for k in node.build_keys)
             pk = tuple(self.esig(k, False)[0] for k in node.probe_keys)
+            # the join-index slot is structural: a program compiled WITH
+            # the cached-sorted-build input cannot serve a plan without
+            # it (and vice versa) — the spec key carries table/columns/
+            # bits/layout so signature-equal plans want the same input
+            jix = getattr(node, "_jix", None)
             return (t, node.kind, tuple(node.build_payload),
                     node.match_name, node.probe_match_name,
                     node.unique_build, node.out_capacity, node.null_aware,
-                    node.pack_bits, bk, pk,
+                    node.pack_bits, jix.key if jix is not None else None,
+                    bk, pk,
                     self._site(node, "residual", False),
                     self._site(node, "build_key_valid", False),
                     self._site(node, "probe_key_valid", False),
@@ -363,7 +369,10 @@ class _Walker:
         if isinstance(node, N.PRuntimeFilter):
             bk = tuple(self.esig(k, False)[0] for k in node.build_keys)
             pk = tuple(self.esig(k, False)[0] for k in node.probe_keys)
-            return (t, node.pack_bits, bk, pk, self.nsig(node.build),
+            # digest slots (mode + bloom geometry) are structural: the
+            # traced collective and bitmap shapes differ per mode
+            return (t, node.pack_bits, node.mode, node.bloom_bits,
+                    node.bloom_k, bk, pk, self.nsig(node.build),
                     self.nsig(node.child))
         if isinstance(node, N.PMotion):
             hk = tuple(self.esig(k, False)[0] for k in node.hash_keys)
@@ -496,6 +505,12 @@ class GenericPlan:
         self.table_names = sorted({s.table_name
                                    for s in X.scans_of(plan)
                                    if not X.keyed_scan(s)})
+        # cached sorted-build join indexes this program reads next to its
+        # tables (exec/joinindex.py) — rebinds re-feed them per table
+        # version, the vmapped batch path rides them in_axes=None
+        from cloudberry_tpu.exec.joinindex import jix_specs_of
+
+        self.jix_keys = [s.key for s in jix_specs_of(plan)]
         self.est_bytes = estimate_plan_memory(plan).peak_bytes
         seg = getattr(plan, "_direct_segment", None)
         if session.config.n_segments > 1 and seg is None:
@@ -542,6 +557,10 @@ class GenericPlan:
 
         seg = getattr(planB, "_direct_segment", None)
         tables = X.prepare_tables(self.table_names, session, segment=seg)
+        if self.jix_keys:
+            from cloudberry_tpu.exec.joinindex import join_index_inputs
+
+            tables.update(join_index_inputs(self.plan, session, seg))
         for key, s in zip(self.keyed_keys, keyedB):
             if hasattr(s, "_point_rows"):
                 tables[key] = X.point_scan_slice(
@@ -576,6 +595,7 @@ class GenericPlan:
                 if ob is not None:
                     b._observed_bucket = ob
             X.raise_checks(checks)
+            DX.record_jf_counters(stats, session.stmt_log)
             host_cols = {k: DX._local_row(v) for k, v in cols.items()}
             return X.make_batch(self.plan, host_cols, DX._local_row(sel))
         inputs = self.bind_inputs(session, planB, keyedB, bindings)
@@ -601,6 +621,8 @@ class GenericPlan:
             axes: Any = 0
         else:
             axes = {n: None for n in self.table_names}
+            # join indexes ride once per batch, like the tables
+            axes.update({k: None for k in self.jix_keys})
             axes["$params"] = 0
         fn = jax.jit(jax.vmap(self.exe.raw_fn, in_axes=(axes,)))
         with self._rung_lock:
@@ -801,6 +823,10 @@ def run_batch(session, sqls: list[str]):
         from cloudberry_tpu.exec import executor as X
 
         base = X.prepare_tables(gp.table_names, session, segment=None)
+        if gp.jix_keys:
+            from cloudberry_tpu.exec.joinindex import join_index_inputs
+
+            base.update(join_index_inputs(gp.plan, session, None))
         per: list[dict] = [dict(prep0.bindings)]
     else:
         per = [gp.bind_inputs(session, prep0.plan, prep0.keyed,

@@ -257,6 +257,15 @@ class Session:
                 self.stmt_log.bump("statement_timeouts")
             elif isinstance(e, lifecycle.StatementCancelled):
                 self.stmt_log.bump("statement_cancels")
+            else:
+                from cloudberry_tpu.exec.executor import \
+                    DuplicateBuildKeyError
+
+                if isinstance(e, DuplicateBuildKeyError):
+                    # the PK-inference violation surfaced by the join's
+                    # runtime duplicate check — a counted, semantic
+                    # (never-retried) error class of its own
+                    self.stmt_log.bump("duplicate_build_key_errors")
             self.stmt_log.finish(log_id, "error",
                                  error=f"{type(e).__name__}: {e}")
             raise

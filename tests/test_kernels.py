@@ -298,3 +298,75 @@ def test_double_order_by_end_to_end():
           "(4, -1e300), (5, 0.0), (6, 3.25), (7, null)")
     df = s.sql("select k from fd order by x").to_pandas()
     assert list(df["k"]) == [4, 2, 5, 1, 6, 3, 7]  # NULLs last
+
+
+def test_join_expand_total_exact_past_2_16():
+    """Regression: the pair-count cumsum and capacity comparison must run
+    in int64 regardless of searchsorted's narrow index dtype — a fanout
+    past 2^16 pairs must report its EXACT total (a wrapped count would
+    defeat the overflow check itself)."""
+    nb, np_ = 300, 300  # 90000 pairs > 2^16
+    bk = [jnp.zeros(nb, dtype=jnp.int64)]
+    pk = [jnp.zeros(np_, dtype=jnp.int64)]
+    cap = 1 << 17
+    pi, bi, osel, matched, total = K.join_expand(
+        bk, jnp.ones(nb, dtype=bool), pk, jnp.ones(np_, dtype=bool), cap)
+    assert total.dtype == jnp.int64
+    assert int(total) == nb * np_
+    assert int(np.asarray(osel).sum()) == nb * np_
+    assert bool(np.asarray(matched).all())
+    # each probe row pairs with every build row exactly once
+    counts = np.bincount(np.asarray(pi)[np.asarray(osel)], minlength=np_)
+    np.testing.assert_array_equal(counts, np.full(np_, nb))
+
+
+def test_join_lookup_presorted_parity():
+    """join_lookup fed a HOST-precomputed index (the join-index cache's
+    numpy mirror) must be bit-identical to the in-program argsort path —
+    order, matches, dup flag, at 64 and 32 bits."""
+    from cloudberry_tpu.exec.joinindex import _np_index
+
+    rng = np.random.default_rng(5)
+    nb, np_ = 512, 1024
+    bvals = rng.permutation(1 << 12)[:nb].astype(np.int64)
+    pvals = rng.integers(0, 1 << 13, np_).astype(np.int64)
+    n_build = 400  # tail rows unselected
+    bsel = _sel(n_build, nb)
+    psel = _sel(900, np_)
+    for bits in (64, 32):
+        idx0, m0, dup0 = K.join_lookup([jnp.asarray(bvals)], bsel,
+                                       [jnp.asarray(pvals)], psel,
+                                       bits=bits)
+        jix = _np_index([bvals], n_build, nb, bits)
+        ranges = [(jnp.asarray(jix["lo0"]), jnp.asarray(jix["span0"]))]
+        idx1, m1, dup1 = K.join_lookup_sorted(
+            jnp.asarray(jix["order"]), jnp.asarray(jix["skeys"]), ranges,
+            [jnp.asarray(pvals)], psel, bits=bits)
+        np.testing.assert_array_equal(np.asarray(m0), np.asarray(m1))
+        np.testing.assert_array_equal(np.asarray(idx0)[np.asarray(m0)],
+                                      np.asarray(idx1)[np.asarray(m1)])
+        assert bool(dup0) == bool(dup1) == False  # noqa: E712
+
+
+def test_join_expand_presorted_parity():
+    """join_expand through a host-precomputed index: identical pair sets
+    AND identical output order (stable ties mirror np argsort)."""
+    from cloudberry_tpu.exec.joinindex import _np_index
+
+    rng = np.random.default_rng(6)
+    nb, np_ = 256, 512
+    bvals = rng.integers(0, 64, nb).astype(np.int64)  # heavy dups
+    pvals = rng.integers(0, 96, np_).astype(np.int64)
+    n_build = 200
+    bsel = _sel(n_build, nb)
+    psel = _sel(480, np_)
+    cap = 1 << 13
+    r0 = K.join_expand([jnp.asarray(bvals)], bsel,
+                       [jnp.asarray(pvals)], psel, cap)
+    jix = _np_index([bvals], n_build, nb, 64)
+    ranges = [(jnp.asarray(jix["lo0"]), jnp.asarray(jix["span0"]))]
+    r1 = K.join_expand_sorted(jnp.asarray(jix["order"]),
+                              jnp.asarray(jix["skeys"]), ranges,
+                              [jnp.asarray(pvals)], psel, cap)
+    for a, b in zip(r0, r1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
