@@ -203,6 +203,27 @@ def join_filter_context(session, qnames, nseg: int = 8) -> dict:
     return out
 
 
+def recovery_context(session) -> dict:
+    """The robustness record next to the lifecycle/join-path ones: the
+    mid-statement recovery configuration (exec/recovery.py) and what
+    THIS process's executions actually did — device-loss retries, tile
+    checkpoints/resumes, and the replay cost. Counter-only: never plans,
+    compiles, or executes."""
+    cfg = session.config.recovery
+    h = session.config.health
+    lg = session.stmt_log
+    return {
+        "enabled": bool(cfg.enabled),
+        "checkpoint_every": int(cfg.checkpoint_every),
+        "retries": int(h.retries),
+        "retry_budget_s": float(h.retry_budget_s),
+        "counters": {k: lg.counter(k) for k in (
+            "recoveries", "tile_checkpoints", "tile_resumes",
+            "tiles_replayed", "tile_resume_declined",
+            "recovery_wall_ms")},
+    }
+
+
 def compile_cache_context(session, qnames) -> dict:
     """The compile-cache record next to the roofline/interconnect records:
     per query, how the generic-plan layer (sched/paramplan.py) sees it —
@@ -336,6 +357,7 @@ def replay_last_good(reason: str) -> None:
             "interconnect": lg.get("interconnect"),
             "compile_cache": lg.get("compile_cache"),
             "join_filter": lg.get("join_filter"),
+            "recovery": lg.get("recovery"),
         })
     except Exception:
         emit({
@@ -523,6 +545,12 @@ def measure() -> None:
     except Exception as e:
         log(f"join_filter context failed: {type(e).__name__}: {e}")
         join_filter = None
+    try:
+        # robustness view: recovery config + per-run recovery counters
+        recovery = recovery_context(session)
+    except Exception as e:
+        log(f"recovery context failed: {type(e).__name__}: {e}")
+        recovery = None
     per_q = ", ".join(
         f"{q}={s:.2f}x/{rows_s[q]/1e6:.0f}Mrows_s_chip"
         f"/{roofline['per_query'].get(q, {}).get('hbm_frac', 0):.3f}HBM"
@@ -539,6 +567,7 @@ def measure() -> None:
         "interconnect": interconnect,
         "compile_cache": compile_cache,
         "join_filter": join_filter,
+        "recovery": recovery,
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })
@@ -599,7 +628,7 @@ def main() -> None:
         # measured roofline inputs ride along so a later REPLAY can
         # attach the real denominator instead of the schema estimate
         for k in ("scan_bytes", "tpu_wall_s", "interconnect",
-                  "compile_cache", "join_filter"):
+                  "compile_cache", "join_filter", "recovery"):
             if k in rec and rec[k] is not None:
                 lg[k] = rec[k]
         with open(LAST_GOOD, "w") as f:

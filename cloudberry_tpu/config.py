@@ -214,6 +214,28 @@ class StorageConfig:
 
 
 @dataclass(frozen=True)
+class RecoveryConfig:
+    """Mid-statement fault recovery (exec/recovery.py).
+
+    The tiled executors snapshot their compact carried state (agg
+    partials / top-N heaps / sort-merge run stores — small by
+    construction) to a host-side, statement-scoped checkpoint every
+    ``checkpoint_every`` tiles. A device-loss retry resumes from the
+    last snapshot — on the degraded survivor mesh when devices are gone
+    — replaying at most ``checkpoint_every`` tiles instead of the whole
+    stream (the immutable-storage analog of FTS + mirror promotion:
+    checkpointed re-execution)."""
+
+    enabled: bool = True
+    # Tiles between snapshots (K): tiles_replayed after a loss is ≤ K.
+    # Smaller = cheaper replay, more (tiny) host copies.
+    checkpoint_every: int = 4
+    # Statements whose checkpoints the store retains at once (LRU;
+    # entries are discarded when their statement finishes anyway).
+    max_statements: int = 8
+
+
+@dataclass(frozen=True)
 class HealthConfig:
     """Failure detection / recovery knobs (the FTS analog, fts.c:118).
 
@@ -229,7 +251,17 @@ class HealthConfig:
     probe_on_error: bool = True
     # Shrink the segment mesh to the live device count before retrying.
     degrade: bool = True
+    # First-retry backoff; attempt n waits backoff_s·2^n plus up to 50%
+    # jitter (thundering-herd protection when many statements lose the
+    # same device), capped at backoff_max_s. The wait is interruptible:
+    # cancellation/deadline cut it short (lifecycle.py).
     backoff_s: float = 0.2
+    backoff_max_s: float = 5.0
+    # Per-statement retry budget in seconds: once this much wall clock
+    # has gone to failed attempts + backoff, the next recoverable
+    # failure is raised instead of retried. 0 = no budget (the
+    # statement deadline still bounds everything).
+    retry_budget_s: float = 0.0
     # Admission circuit breaker (lifecycle.CircuitBreaker): this many
     # CONSECUTIVE statements needing a device-loss recovery trip the
     # engine to read-only-degraded — writes refuse with the retryable
@@ -261,6 +293,7 @@ class Config:
     sched: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
+    recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":
         """Return a copy with dotted-path overrides, e.g.

@@ -107,6 +107,24 @@ class StatementLog:
             if entry is not None:
                 entry["state"] = "cancelling"
 
+    def set_state(self, sid: int, state: str) -> None:
+        """Lifecycle state for the activity view (running/recovering).
+        'cancelling' is sticky — a cancelled statement must never read
+        as healthy again."""
+        with self._lock:
+            entry = self._active.get(sid)
+            if entry is not None and entry.get("state") != "cancelling":
+                entry["state"] = state
+
+    def annotate(self, sid: int, **kv) -> None:
+        """Attach observability fields to an ACTIVE statement (retry
+        attempts, backoff); they ride into the history entry at
+        finish()."""
+        with self._lock:
+            entry = self._active.get(sid)
+            if entry is not None:
+                entry.update(kv)
+
     def finish(self, sid: int, status: str, rows: int = -1,
                error: str | None = None, **extra) -> None:
         with self._lock:

@@ -825,20 +825,33 @@ class TiledExecutable(AdaptiveTiledMixin):
         return self.shape.agg.capacity
 
     def _run_once(self) -> ColumnBatch:
+        from cloudberry_tpu.exec import recovery as R
+
         prelude_fn, step_fn, finalize_fn = self._compile()
         resident = self._resident_inputs()
         prelude, pchecks = prelude_fn(resident)
         X.raise_checks(pchecks)
 
+        # mid-statement recovery (exec/recovery.py): resume from the last
+        # K-tile checkpoint instead of replaying the whole stream
+        ctx = R.begin(self, dist=False)
         acc = self._init_acc()
-        n_tiles = 0
+        if ctx is not None:
+            acc = ctx.restore_acc(acc)
+        skip = ctx.skip_rows if ctx is not None else 0
+        n_base = ctx.tiles_base if ctx is not None else 0
+        n_local = 0
         for tile, tile_n in _tile_feed(self.shape.stream, self.session,
-                                       self.tile_rows):
+                                       self.tile_rows, skip_rows=skip):
             fault_point("tile_step")
+            fault_point("tile_device_lost")
             acc, checks = step_fn(resident, prelude, tile,
                                   jnp.asarray(tile_n, dtype=jnp.int32), acc)
-            _raise_tile_checks(checks, n_tiles)
-            n_tiles += 1
+            _raise_tile_checks(checks, n_base + n_local)
+            n_local += 1
+            if ctx is not None:
+                ctx.tick(n_local, lambda: R.acc_payload(acc))
+        n_tiles = n_base + n_local
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
             empty = _empty_tile(self.shape.stream, self.tile_rows)
             acc, checks = step_fn(resident, prelude, empty,
@@ -853,6 +866,8 @@ class TiledExecutable(AdaptiveTiledMixin):
         cols, sel, fchecks = finalize_fn(acc)
         X.raise_checks(fchecks)
         self.report["n_tiles"] = n_tiles
+        if ctx is not None:
+            ctx.stamp_report(self.report)
         self.session.last_tiled_report = dict(self.report)
         return X.make_batch(self.shape.root, cols, sel)
 
@@ -996,31 +1011,41 @@ class SortTiledExecutable(TiledExecutable):
 
     def _stream_sorted(self):
         """Run the tile stream and the merge pass; returns
-        (sorted child columns, sorted normalized key columns, n_tiles)
-        as host arrays."""
+        (sorted child columns, sorted normalized key columns, n_tiles,
+        recovery ctx) as host arrays."""
+        from cloudberry_tpu.exec import recovery as R
+
         prelude_fn, step_fn = self._compile()
         shape = self.shape
         resident = self._resident_inputs()
         prelude, pchecks = prelude_fn(resident)
         X.raise_checks(pchecks)
 
+        ctx = R.begin(self, dist=False)
         names = [f.name for f in shape.sortnode.child.fields]
         runs: dict[str, list] = {nm: [] for nm in names}
         key_runs: list[list] = [[] for _ in shape.sortnode.keys]
-        n_tiles = 0
+        if ctx is not None:
+            runs, key_runs = ctx.restore_runs(runs, key_runs)
+        skip = ctx.skip_rows if ctx is not None else 0
+        n_base = ctx.tiles_base if ctx is not None else 0
+        n_local = 0
         for tile, tile_n in _tile_feed(shape.stream, self.session,
-                                       self.tile_rows):
+                                       self.tile_rows, skip_rows=skip):
             fault_point("tile_step")
+            fault_point("tile_device_lost")
             (pcols, psel, keys), checks = step_fn(
                 resident, prelude, tile,
                 jnp.asarray(tile_n, dtype=jnp.int32))
-            _raise_tile_checks(checks, n_tiles)
-            n_tiles += 1
+            _raise_tile_checks(checks, n_base + n_local)
+            n_local += 1
             mask = np.asarray(psel)
             for nm in names:
                 runs[nm].append(np.asarray(pcols[nm])[mask])
             for i, k in enumerate(keys):
                 key_runs[i].append(np.asarray(k)[mask])
+            if ctx is not None:
+                ctx.tick(n_local, lambda: R.runs_payload(runs, key_runs))
 
         fault_point("tiled_finalize")
         from cloudberry_tpu.lifecycle import check_cancel
@@ -1029,14 +1054,16 @@ class SortTiledExecutable(TiledExecutable):
         cols, karr = merge_sorted_runs(runs, key_runs,
                                        shape.sortnode.child.fields,
                                        len(shape.sortnode.keys))
-        return cols, karr, max(n_tiles, 1)
+        return cols, karr, max(n_base + n_local, 1), ctx
 
     def _run_once(self) -> ColumnBatch:
         shape = self.shape
-        cols, _karr, n_tiles = self._stream_sorted()
+        cols, _karr, n_tiles, ctx = self._stream_sorted()
         cols = host_apply_post(shape.post, cols)
         n_out = len(next(iter(cols.values()))) if cols else 0
         self.report["n_tiles"] = n_tiles
+        if ctx is not None:
+            ctx.stamp_report(self.report)
         self.session.last_tiled_report = dict(self.report)
         out_node = shape.post[0] if shape.post else shape.sortnode
         return X.make_batch(out_node, cols,
@@ -1084,7 +1111,7 @@ class WindowTiledExecutable(SortTiledExecutable):
     def _run_once(self) -> ColumnBatch:
         shape = self.shape
         self._chunk_compiled = None  # capacity may have changed
-        cols, karr, n_tiles = self._stream_sorted()
+        cols, karr, n_tiles, ctx = self._stream_sorted()
         names = [f.name for f in shape.winnode.child.fields]
         final, n_chunks = window_chunk_pass(
             self._chunk_fn(), shape.root, names, cols, karr,
@@ -1092,6 +1119,8 @@ class WindowTiledExecutable(SortTiledExecutable):
         n_out = len(next(iter(final.values()))) if final else 0
         self.report["n_tiles"] = n_tiles
         self.report["n_chunks"] = n_chunks
+        if ctx is not None:
+            ctx.stamp_report(self.report)
         self.session.last_tiled_report = dict(self.report)
         return X.make_batch(shape.root, final,
                             np.ones((n_out,), dtype=bool))
@@ -1200,12 +1229,16 @@ def _empty_tile(scan: N.PScan, tile_rows: int) -> dict:
     return t
 
 
-def _tile_feed(scan: N.PScan, session, tile_rows: int):
+def _tile_feed(scan: N.PScan, session, tile_rows: int,
+               skip_rows: int = 0):
     """Yield (tile dict of padded arrays, n_valid). Cold tables stream
     micro-partition files (host staging: the device never holds more than
-    one tile); warm tables slice their RAM arrays."""
+    one tile); warm tables slice their RAM arrays. ``skip_rows`` drops
+    the already-consumed prefix — the mid-statement resume entry point
+    (exec/recovery.py): single-node consumption is always a prefix of
+    the deterministic stream order."""
     if hasattr(scan, "_store_parts"):
-        yield from _store_tiles(scan, session, tile_rows)
+        yield from _store_tiles(scan, session, tile_rows, skip_rows)
         return
     t = session.catalog.table(scan.table_name)
     t.ensure_loaded()
@@ -1216,21 +1249,33 @@ def _tile_feed(scan: N.PScan, session, tile_rows: int):
                                if vm is not None
                                else np.ones(t.num_rows, dtype=np.bool_))
     rows = t.num_rows
-    for off in range(0, max(rows, 0), tile_rows):
+    for off in range(min(skip_rows, max(rows, 0)), max(rows, 0),
+                     tile_rows):
         n = min(tile_rows, rows - off)
         yield _pad_tile(cols, off, n, tile_rows), n
 
 
-def _store_tiles(scan: N.PScan, session, tile_rows: int):
+def _store_tiles(scan: N.PScan, session, tile_rows: int,
+                 skip_rows: int = 0):
     """Stream a pruned cold scan part-by-part, re-chunked to tile_rows:
     the out-of-core path — peak host memory is one partition + one tile."""
     store = session.catalog.store
     needed = _phys_cols(scan)
     pend: dict[str, list[np.ndarray]] = {}
     pend_rows = 0
+    skip_left = max(int(skip_rows), 0)
 
     def drain(final: bool):
-        nonlocal pend, pend_rows
+        nonlocal pend, pend_rows, skip_left
+        # drop the resume prefix first (rows a prior attempt consumed)
+        while skip_left > 0 and pend_rows > 0:
+            take = min(skip_left, pend_rows)
+            for name, chunks in pend.items():
+                cat = chunks[0] if len(chunks) == 1 \
+                    else np.concatenate(chunks)
+                pend[name] = [cat[take:]]
+            pend_rows -= take
+            skip_left -= take
         while pend_rows >= tile_rows or (final and pend_rows > 0):
             take = min(tile_rows, pend_rows)
             tile = {}

@@ -722,6 +722,14 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             return self._run_adaptive()
 
     def _run_once(self) -> ColumnBatch:
+        from cloudberry_tpu.exec import recovery as R
+
+        # mid-statement recovery (exec/recovery.py): the prepare step may
+        # grow g_cap for re-sharded partials, so it runs BEFORE the
+        # retile/refinalize/compile chain fixes the program shapes
+        ctx = R.begin(self, dist=True)
+        if ctx is not None:
+            ctx.prepare_dist()
         _retile_dist(self.shape, self.tile_rows, self.nseg)
         self._refinalize()
         prelude_fn, step_fn, finalize_fn = self._compile()
@@ -734,13 +742,22 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             prelude, pchecks = [], {}
 
         acc = self._init_acc()
-        n_tiles = 0
-        for tile, tile_ns in _dist_tile_feed(self.shape.stream,
-                                             self.session, self.tile_rows):
+        if ctx is not None:
+            acc = ctx.restore_acc(acc)
+        feed = (ctx.feed() if ctx is not None else None) \
+            or _dist_tile_feed(self.shape.stream, self.session,
+                               self.tile_rows)
+        n_base = ctx.tiles_base if ctx is not None else 0
+        n_local = 0
+        for tile, tile_ns in feed:
             fault_point("tile_step_dist")
+            fault_point("tile_device_lost")
             acc, checks = step_fn(resident, prelude, tile, tile_ns, acc)
-            _raise_tile_checks(checks, n_tiles)
-            n_tiles += 1
+            _raise_tile_checks(checks, n_base + n_local)
+            n_local += 1
+            if ctx is not None:
+                ctx.tick(n_local, lambda: R.acc_payload(acc))
+        n_tiles = n_base + n_local
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
             tile, _ = _empty_dist_tile(self.shape.stream, self.tile_rows,
                                        self.nseg)
@@ -757,6 +774,8 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         cols, sel, fchecks = finalize_fn(acc)
         X.raise_checks(fchecks)
         self.report["n_tiles"] = n_tiles
+        if ctx is not None:
+            ctx.stamp_report(self.report)
         self.session.last_tiled_report = dict(self.report)
         host_cols = {k: _local_row(v) for k, v in cols.items()}
         return X.make_batch(self.shape.root, host_cols, _local_row(sel))
@@ -909,7 +928,13 @@ class DistSortTiledExecutable(DistTiledExecutable):
 
     def _stream_sorted(self):
         """Per-segment tile stream + host merge; returns (sorted child
-        columns, sorted normalized keys, n_tiles) as host arrays."""
+        columns, sorted normalized keys, n_tiles, recovery ctx) as host
+        arrays."""
+        from cloudberry_tpu.exec import recovery as R
+
+        ctx = R.begin(self, dist=True)
+        if ctx is not None:
+            ctx.prepare_dist()
         prelude_fn, step_fn = self._compile()
         shape = self.shape
         resident, _ = prepare_dist_inputs(
@@ -922,14 +947,19 @@ class DistSortTiledExecutable(DistTiledExecutable):
         names = [f.name for f in shape.partial_plan.fields]
         runs: dict[str, list] = {nm: [] for nm in names}
         key_runs: list[list] = [[] for _ in shape.sortnode.keys]
-        n_tiles = 0
-        for tile, tile_ns in _dist_tile_feed(shape.stream, self.session,
-                                             self.tile_rows):
+        if ctx is not None:
+            runs, key_runs = ctx.restore_runs(runs, key_runs)
+        feed = (ctx.feed() if ctx is not None else None) \
+            or _dist_tile_feed(shape.stream, self.session, self.tile_rows)
+        n_base = ctx.tiles_base if ctx is not None else 0
+        n_local = 0
+        for tile, tile_ns in feed:
             fault_point("tile_step_dist")
+            fault_point("tile_device_lost")
             (pcols, psel, keys), checks = step_fn(resident, prelude,
                                                   tile, tile_ns)
-            _raise_tile_checks(checks, n_tiles)
-            n_tiles += 1
+            _raise_tile_checks(checks, n_base + n_local)
+            n_local += 1
             selnp = np.asarray(psel)
             for s in range(self.nseg):
                 m = selnp[s]
@@ -937,22 +967,26 @@ class DistSortTiledExecutable(DistTiledExecutable):
                     runs[nm].append(np.asarray(pcols[nm][s])[m])
                 for i, k in enumerate(keys):
                     key_runs[i].append(np.asarray(k[s])[m])
+            if ctx is not None:
+                ctx.tick(n_local, lambda: R.runs_payload(runs, key_runs))
         from cloudberry_tpu.exec.tiled import merge_sorted_runs
 
         cols, karr = merge_sorted_runs(runs, key_runs,
                                        shape.partial_plan.fields,
                                        len(shape.sortnode.keys))
-        return cols, karr, max(n_tiles, 1)
+        return cols, karr, max(n_base + n_local, 1), ctx
 
     def _run_once(self) -> ColumnBatch:
         _retile_dist(self.shape, self.tile_rows, self.nseg)
         shape = self.shape
-        cols, _karr, n_tiles = self._stream_sorted()
+        cols, _karr, n_tiles, ctx = self._stream_sorted()
         from cloudberry_tpu.exec.tiled import host_apply_post
 
         cols = host_apply_post(shape.post_above, cols)
         n_out = len(next(iter(cols.values()))) if cols else 0
         self.report["n_tiles"] = n_tiles
+        if ctx is not None:
+            ctx.stamp_report(self.report)
         self.session.last_tiled_report = dict(self.report)
         out_node = shape.post_above[0] if shape.post_above \
             else shape.sortnode
@@ -1002,7 +1036,7 @@ class DistWindowTiledExecutable(DistSortTiledExecutable):
         _retile_dist(self.shape, self.tile_rows, self.nseg)
         shape = self.shape
         self._chunk_compiled = None  # capacity may have changed
-        cols, karr, n_tiles = self._stream_sorted()
+        cols, karr, n_tiles, ctx = self._stream_sorted()
         names = [f.name for f in shape.partial_plan.fields]
         final, n_chunks = window_chunk_pass(
             self._chunk_fn(), shape.root, names, cols, karr,
@@ -1010,6 +1044,8 @@ class DistWindowTiledExecutable(DistSortTiledExecutable):
         n_out = len(next(iter(final.values()))) if final else 0
         self.report["n_tiles"] = n_tiles
         self.report["n_chunks"] = n_chunks
+        if ctx is not None:
+            ctx.stamp_report(self.report)
         self.session.last_tiled_report = dict(self.report)
         return X.make_batch(shape.root, final,
                             np.ones((n_out,), dtype=bool))

@@ -139,12 +139,35 @@ def recoverable(e: Exception) -> bool:
 
 def run_with_retry(fn: Callable, retries: int = 1,
                    backoff_s: float = 0.5,
-                   on_retry: Optional[Callable[[Exception], None]] = None
-                   ) -> object:
+                   on_retry: Optional[Callable] = None,
+                   max_backoff_s: float = 5.0,
+                   budget_s: float = 0.0,
+                   jitter: float = 0.5) -> object:
     """Re-dispatch on device/runtime failure (the recovery model: stateless
-    segments over immutable storage → failed statements simply re-run).
-    ``on_retry`` runs between attempts — the Session passes its
-    probe-and-degrade hook there (fts.c probe → configuration update)."""
+    segments over immutable storage → failed statements simply re-run;
+    mid-statement checkpoints make the re-run incremental,
+    exec/recovery.py).
+
+    - backoff between attempts is EXPONENTIAL with up to ``jitter``
+      proportional randomization (a lost device fails every statement on
+      it at once — synchronized retries would stampede the survivors),
+      capped at ``max_backoff_s``;
+    - ``budget_s`` is the per-statement retry budget: once that much
+      wall clock has gone to failed attempts + backoff, the next
+      recoverable failure raises instead of retrying (0 = no budget);
+    - the backoff honors the statement lifecycle: it waits on the
+      current statement's cancel token (interruptible — a cancel or
+      watchdog timeout cuts it short), never sleeps past the deadline,
+      and re-checks the deadline before dispatching the next attempt, so
+      an in-progress recovery counts as LIVENESS while the DEADLINE
+      stays enforced (lifecycle.py Watchdog contract);
+    - ``on_retry(exc, backoff_s)`` runs between attempts — the Session
+      passes its probe-and-degrade hook there (fts.c probe →
+      configuration update) and surfaces both args in the activity row.
+    """
+    import random
+
+    t0 = time.monotonic()
     last: Exception | None = None
     for attempt in range(retries + 1):
         try:
@@ -152,8 +175,28 @@ def run_with_retry(fn: Callable, retries: int = 1,
         except Exception as e:  # noqa: BLE001
             if not recoverable(e) or attempt == retries:
                 raise
+            if budget_s and time.monotonic() - t0 >= budget_s:
+                raise
             last = e
+            delay = min(backoff_s * (2 ** attempt)
+                        * (1.0 + jitter * random.random()),
+                        max_backoff_s)
             if on_retry is not None:
-                on_retry(e)
-            time.sleep(backoff_s * (2 ** attempt))
+                on_retry(e, delay)
+            from cloudberry_tpu.lifecycle import current_handle
+
+            h = current_handle()
+            token = getattr(h, "token", None)
+            if token is not None:
+                rem = h.remaining()
+                if rem is not None:
+                    delay = min(delay, max(rem, 0.0))
+                if delay > 0:
+                    token.wait(delay)
+                # raises StatementTimeout/StatementCancelled when the
+                # deadline passed (or a cancel landed) during the wait:
+                # the statement dies of its deadline, not as a "hang"
+                h.check()
+            elif delay > 0:
+                time.sleep(delay)
     raise last  # unreachable

@@ -79,6 +79,13 @@ def describe(session, kind: str, arg=None):
             # admission circuit breaker (lifecycle.py): closed | open
             # (read-only-degraded) | half-open, with trip counters
             "breaker": breaker.snapshot() if breaker is not None else None,
+            # mid-statement recovery (exec/recovery.py): device-loss
+            # retries, tile checkpoints/resumes, and the replay cost
+            "recovery": {k: session.stmt_log.counter(k) for k in (
+                "recoveries", "tile_checkpoints", "tile_resumes",
+                "tiles_replayed", "tile_resume_declined",
+                "tile_ckpt_failed", "recovery_wall_ms",
+                "watchdog_timeouts")},
         }
     if kind == "sched":
         # scheduler observability: queue depth / batch occupancy from the

@@ -346,6 +346,10 @@ class Server:
         s._breaker = self.session._breaker
         # dispatcher observability (serve/meta.py "sched") spans backends
         s._dispatcher = getattr(self.session, "_dispatcher", None)
+        # one checkpoint store: recovery.max_statements bounds the
+        # ENGINE's held checkpoints, not each backend's (statement ids
+        # come from the shared stmt_log, so keys never collide)
+        s._recovery = self.session._recovery
         return s
 
     def _end_connection(self, sess) -> None:

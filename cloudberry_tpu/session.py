@@ -130,6 +130,15 @@ class Session:
         self._breaker = CircuitBreaker(
             self.config.health.breaker_threshold,
             self.config.health.breaker_cooldown_s)
+        # mid-statement recovery checkpoints (exec/recovery.py): the
+        # tiled executors snapshot carried state every K tiles here, and
+        # a device-loss retry resumes from the last snapshot instead of
+        # replaying the whole stream; statement-scoped — discarded when
+        # the statement finishes
+        from cloudberry_tpu.exec.recovery import RecoveryStore
+
+        self._recovery = RecoveryStore(
+            self.config.recovery.max_statements)
         self._session_id = id(self) & 0xFFFF
         # COPY ... LOG ERRORS row rejects, per table (the error-log /
         # gp_read_error_log analog, cdbsreh.c)
@@ -203,10 +212,24 @@ class Session:
         # breaker's consecutive-recovery signal; trial = this write is
         # the half-open probe write and owns the breaker verdict
         recoveries = [0]
+        t_first_fail = [0.0]
         trial = False
 
-        def on_retry(e):
+        def on_retry(e, backoff_s=0.0):
             recoveries[0] += 1
+            if not t_first_fail[0]:
+                t_first_fail[0] = _t.monotonic()
+            # recovery observability: the activity row shows the attempt
+            # count + planned backoff, and the state flips to
+            # 'recovering' so a stalled row reads as a retry in
+            # progress, not a hang (the watchdog still enforces the
+            # DEADLINE — recovery is liveness, not license)
+            self.stmt_log.bump("recoveries")
+            self.stmt_log.set_state(log_id, "recovering")
+            self.stmt_log.annotate(
+                log_id, attempts=recoveries[0],
+                backoff_s=round(backoff_s, 4),
+                last_error=type(e).__name__)
             if h.probe_on_error:
                 self._recover_mesh(e)
 
@@ -237,10 +260,19 @@ class Session:
                     # transactions abort rather than replay them)
                     out = self._sql_once(query, **params)
                 else:
+                    def attempt():
+                        # a retried attempt is live again: the activity
+                        # row leaves 'recovering' when execution resumes
+                        if recoveries[0]:
+                            self.stmt_log.set_state(log_id, "running")
+                        return self._sql_once(query, **params)
+
                     out = run_with_retry(
-                        lambda: self._sql_once(query, **params),
+                        attempt,
                         retries=h.retries, backoff_s=h.backoff_s,
-                        on_retry=on_retry)
+                        on_retry=on_retry,
+                        max_backoff_s=h.backoff_max_s,
+                        budget_s=h.retry_budget_s)
         except BaseException as e:
             # BaseException too: a Ctrl-C mid-statement must not leave a
             # phantom "running" entry in the shared active registry
@@ -269,10 +301,20 @@ class Session:
             self.stmt_log.finish(log_id, "error",
                                  error=f"{type(e).__name__}: {e}")
             raise
+        finally:
+            # statement-scoped checkpoints die with their statement:
+            # success consumed them, and a semantic failure must not
+            # leak state to whatever reuses the log id space later
+            self._recovery.discard(log_id)
         if trial:
             self._breaker.trial_succeeded()
         if recoveries[0]:
             self._breaker.record_recovery()
+            # recovery latency observability: wall clock from the first
+            # device-loss failure to the statement completing
+            self.stmt_log.bump(
+                "recovery_wall_ms",
+                int((_t.monotonic() - t_first_fail[0]) * 1000))
         else:
             self._breaker.record_success()
         is_batch = hasattr(out, "num_rows")

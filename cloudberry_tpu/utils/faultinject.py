@@ -7,12 +7,20 @@ here: code declares FAULT_POINT("name") at interesting seams; tests arm
 actions. Used to provoke races/failures deterministically instead of hoping
 load finds them (the reference's stance — no TSan harness, deterministic
 provocation, §5.2).
+
+Chaos soaks use the PROBABILISTIC arm (``p`` < 1): each in-window hit
+fires with probability p from a per-arm seeded RNG — randomized but
+REPRODUCIBLE (same seed → same firing sequence). ``list_faults()``
+reports per-arm hit/fire telemetry plus every seam seen this process, so
+a soak can state exactly which seams fired.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -27,10 +35,14 @@ class _Arm:
     sleep_s: float = 0.0
     start_hit: int = 1    # trigger from the Nth hit...
     end_hit: int = 1 << 30  # ...through this hit
-    hits: int = 0
+    p: float = 1.0        # per-hit firing probability (chaos soaks)
+    seed: Optional[int] = None
+    hits: int = 0         # times the seam was reached while armed
+    fired: int = 0        # times the action actually triggered
     # interruptible wedge: 'hang' blocks on this instead of a raw sleep,
     # so reset_fault() releases a wedged thread immediately
     wake: threading.Event = field(default_factory=threading.Event)
+    rng: random.Random = None  # type: ignore[assignment]
 
 
 _registry: dict[str, _Arm] = {}
@@ -39,11 +51,18 @@ _lock = threading.Lock()
 
 
 def inject_fault(name: str, action: str = "error", sleep_s: float = 0.0,
-                 start_hit: int = 1, end_hit: int = 1 << 30) -> None:
-    """Arm a fault point (the gp_inject_fault() analog)."""
+                 start_hit: int = 1, end_hit: int = 1 << 30,
+                 p: float = 1.0, seed: Optional[int] = None) -> None:
+    """Arm a fault point (the gp_inject_fault() analog). ``p`` < 1 makes
+    each in-window hit fire probabilistically from a per-arm RNG seeded
+    by ``seed`` (default: a hash of the name, so re-arming reproduces
+    the same sequence)."""
+    arm = _Arm(action, sleep_s, start_hit, end_hit, p, seed)
+    arm.rng = random.Random(
+        seed if seed is not None else zlib.crc32(name.encode()))
     with _lock:
         old = _registry.get(name)
-        _registry[name] = _Arm(action, sleep_s, start_hit, end_hit)
+        _registry[name] = arm
     if old is not None:
         old.wake.set()  # a re-arm releases threads wedged on the old arm
 
@@ -77,6 +96,9 @@ def fault_point(name: str) -> bool:
         arm.hits += 1
         if not (arm.start_hit <= arm.hits <= arm.end_hit):
             return False
+        if arm.p < 1.0 and arm.rng.random() >= arm.p:
+            return False  # in-window hit that the dice spared
+        arm.fired += 1
         action = arm.action
         sleep_s = arm.sleep_s
         wake = arm.wake
@@ -102,3 +124,17 @@ def known_fault_points() -> set[str]:
     """Fault points hit at least once this process (discovery aid)."""
     with _lock:
         return set(_seen)
+
+
+def list_faults() -> dict:
+    """Per-arm telemetry (the gp_inject_fault 'status' analog): which
+    seams are armed, how often each was reached, and how often it
+    actually fired — the chaos-soak report of record — plus every seam
+    this process has seen (armed or not)."""
+    with _lock:
+        armed = {name: {
+            "action": a.action, "p": a.p, "seed": a.seed,
+            "start_hit": a.start_hit, "end_hit": a.end_hit,
+            "hits": a.hits, "fired": a.fired,
+        } for name, a in _registry.items()}
+        return {"armed": armed, "seen": sorted(_seen)}

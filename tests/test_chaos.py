@@ -274,10 +274,13 @@ def test_seam_inventory():
         names |= set(re.findall(r'fault_point\("([a-z_]+)"\)',
                                 p.read_text()))
     assert len(names) >= 20, sorted(names)
-    # the load-bearing seams must exist by exact name
+    # the load-bearing seams must exist by exact name — including the
+    # mid-statement recovery trio (exec/recovery.py): the deterministic/
+    # probabilistic tile kill and the checkpoint/resume chaos arms
     for required in ("dispatch_start", "exec_device_lost", "probe_degraded",
                      "tile_step", "tile_step_dist", "occ_commit_window",
                      "storage_commit_before_current", "endpoint_drain",
                      "serve_handler", "store_read_partition",
-                     "admission_check", "dml_update", "dml_delete"):
+                     "admission_check", "dml_update", "dml_delete",
+                     "tile_device_lost", "ckpt_save", "ckpt_resume"):
         assert required in names, required

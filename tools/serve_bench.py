@@ -6,7 +6,8 @@ mix; each mode runs the SAME closed loop and the CSV rows make the
 comparison direct:
 
     mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,compiles,\
-dispatches,batches,batched_requests,avg_occupancy
+dispatches,batches,batched_requests,avg_occupancy,deadline_misses,\
+cancels,recovery_count,tiles_replayed,recovery_ms
 
 - ``direct``  — dispatcher off: every request is its own parse→(generic
   rebind)→launch through the shared session.
@@ -42,20 +43,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               "compiles,dispatches,batches,batched_requests,avg_occupancy,"
-              "deadline_misses,cancels")
+              "deadline_misses,cancels,recovery_count,tiles_replayed,"
+              "recovery_ms")
 
 
-def build_session(mode: str, rows: int, tick_s: float, max_batch: int):
+def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
+                  mix: str = "point", chaos: float = 0.0):
     import numpy as np
 
     import cloudberry_tpu as cb
     from cloudberry_tpu.config import Config
 
-    cfg = Config().with_overrides(**{
+    over = {
         "sched.enabled": mode == "batched",
         "sched.tick_s": tick_s,
         "sched.max_batch": max_batch,
-    })
+    }
+    if mix == "spill":
+        # the chaos workload streams tiles: shrink the budget so the li
+        # aggregate runs through the tiled (checkpointable) path
+        over["resource.query_mem_bytes"] = 1 << 20
+    if chaos > 0:
+        # probabilistic device loss compounds per tile: give recovery
+        # more re-dispatches than the default flap allowance
+        over["health.retries"] = 4
+    cfg = Config().with_overrides(**over)
     s = cb.Session(cfg)
     s.sql("create table pts (k bigint, v bigint, w double) "
           "distributed by (k)")
@@ -89,17 +101,28 @@ def _q6_sql(i: int) -> str:
             f"and qty < {20 + (i % 7)}.0")
 
 
+def _spill_sql(i: int) -> str:
+    # a tiled (out-of-core) aggregate with rotating literals: under the
+    # shrunken spill-mix budget this statement streams tiles through the
+    # checkpoint seams — the --chaos recovery workload
+    return ("select sum(price) as sp, count(*) as c from li "
+            f"where qty < {4000 + (i % 50)}.0")
+
+
 def _mix_sql(mix: str, i: int, rows: int) -> str:
     if mix == "point":
         return _point_sql(i, rows)
     if mix == "q6":
         return _q6_sql(i)
+    if mix == "spill":
+        return _spill_sql(i)
     return _q6_sql(i) if i % 5 == 4 else _point_sql(i, rows)
 
 
 def run_mode(mode: str, mix: str, clients: int, duration_s: float,
              rows: int, tick_s: float, max_batch: int,
-             cancel_mix: float = 0.0, deadline_s: float = 0.005) -> dict:
+             cancel_mix: float = 0.0, deadline_s: float = 0.005,
+             chaos: float = 0.0) -> dict:
     """One closed-loop run; returns the CSV row fields.
 
     ``cancel_mix``: fraction of requests carrying a TIGHT per-request
@@ -107,21 +130,38 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     that miss fail with the retryable timeout taxonomy (StatementTimeout
     / SchedDeadline) and count as ``deadline_misses``, not errors; the
     ``cancels`` column reports the engine's cancellation counters
-    (cancel verb + watchdog) over the run."""
-    from cloudberry_tpu.serve import Client, Server, ServerError
+    (cancel verb + watchdog) over the run.
 
-    session = build_session(mode, rows, tick_s, max_batch)
+    ``chaos``: per-hit device-loss probability armed on the dispatch and
+    tile seams (utils/faultinject probabilistic arms) — the recovery
+    workload. The recovery_count / tiles_replayed / recovery_ms columns
+    report what the engine's checkpointed re-execution actually did;
+    pair with ``--mix spill`` so statements stream tiles worth
+    resuming."""
+    from cloudberry_tpu.serve import Client, Server, ServerError
+    from cloudberry_tpu.utils import faultinject as FI
+
+    session = build_session(mode, rows, tick_s, max_batch,
+                            mix=mix, chaos=chaos)
     # warm the compile caches OUTSIDE the measured window: the bench
     # compares steady-state dispatch, not first-compile latency
     session.sql(_point_sql(0, rows))
     session.sql(_q6_sql(0))
+    if mix == "spill":
+        session.sql(_spill_sql(0))
     c_before = session.stmt_log.counter("compiles")
     d_before = session.stmt_log.counter("dispatches")
     x_before = (session.stmt_log.counter("cancel_requests")
                 + session.stmt_log.counter("watchdog_timeouts"))
+    r_before = session.stmt_log.counter("recoveries")
+    tr_before = session.stmt_log.counter("tiles_replayed")
+    rw_before = session.stmt_log.counter("recovery_wall_ms")
 
     _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
                     "SchedDeadline")
+    # a chaos run's residual losses (retries exhausted under the armed
+    # device-loss rate) are the workload working, not bench failures
+    _CHAOS_ETYPES = ("InjectedFault", "XlaRuntimeError")
     lats: list[float] = []
     misses = [0]
     lat_lock = threading.Lock()
@@ -145,9 +185,12 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
                     except ServerError as e:
                         # a deadlined request missing its deadline is the
                         # workload working, not a bench failure
-                        if dl is None or e.etype not in _MISS_ETYPES:
+                        if dl is not None and e.etype in _MISS_ETYPES:
+                            miss_local += 1
+                        elif chaos and e.etype in _CHAOS_ETYPES:
+                            pass
+                        else:
                             raise
-                        miss_local += 1
                     lat_local.append(time.monotonic() - t0)
         except Exception as e:  # pragma: no cover - surfaced in result
             errors.append(f"{type(e).__name__}: {e}")
@@ -155,6 +198,9 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
             lats.extend(lat_local)
             misses[0] += miss_local
 
+    if chaos > 0:
+        FI.inject_fault("tile_device_lost", "error", p=chaos, seed=1234)
+        FI.inject_fault("exec_device_lost", "error", p=chaos, seed=4321)
     with Server(session=session) as srv:
         stop_at[0] = time.monotonic() + duration_s
         threads = [threading.Thread(target=worker, args=(i,))
@@ -168,6 +214,9 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         disp = session.stmt_log
         dsnap = getattr(session, "_dispatcher", None)
         dstats = dsnap.snapshot() if dsnap is not None else {}
+    if chaos > 0:
+        FI.reset_fault("tile_device_lost")
+        FI.reset_fault("exec_device_lost")
     if errors:
         raise RuntimeError(f"bench clients failed: {errors[:3]}")
     lats.sort()
@@ -190,6 +239,9 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         "deadline_misses": misses[0],
         "cancels": (disp.counter("cancel_requests")
                     + disp.counter("watchdog_timeouts")) - x_before,
+        "recovery_count": disp.counter("recoveries") - r_before,
+        "tiles_replayed": disp.counter("tiles_replayed") - tr_before,
+        "recovery_ms": disp.counter("recovery_wall_ms") - rw_before,
     }
 
 
@@ -202,7 +254,7 @@ def main(argv=None) -> list[dict]:
     ap.add_argument("--mode", default="both",
                     choices=["both", "direct", "batched"])
     ap.add_argument("--mix", default="point",
-                    choices=["point", "q6", "mixed"])
+                    choices=["point", "q6", "mixed", "spill"])
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--duration", type=float, default=5.0)
     ap.add_argument("--rows", type=int, default=200_000)
@@ -213,6 +265,10 @@ def main(argv=None) -> list[dict]:
                          "per-request deadline (lifecycle workload)")
     ap.add_argument("--deadline-s", type=float, default=0.005,
                     help="the tight deadline used by --cancel-mix")
+    ap.add_argument("--chaos", type=float, default=0.0,
+                    help="per-hit device-loss probability armed on the "
+                         "dispatch/tile seams (recovery workload; pair "
+                         "with --mix spill)")
     ap.add_argument("--csv", default=None,
                     help="append CSV rows to this file")
     args = ap.parse_args(argv)
@@ -224,7 +280,7 @@ def main(argv=None) -> list[dict]:
         r = run_mode(mode, args.mix, args.clients, args.duration,
                      args.rows, args.tick_s, args.max_batch,
                      cancel_mix=args.cancel_mix,
-                     deadline_s=args.deadline_s)
+                     deadline_s=args.deadline_s, chaos=args.chaos)
         out.append(r)
         print(csv_row(r), flush=True)
     if args.csv:
