@@ -182,6 +182,92 @@ class SchedConfig:
     # Generic-plan variants kept per statement skeleton (distinct plan
     # shapes: capacity rungs, 0-vs-1 point matches, per-segment counts).
     max_variants: int = 4
+    # Process-wide shared cache tier (sched/sharedcache.py): sessions over
+    # the SAME durable store share one generic-plan / rung / join-index
+    # cache scope, so tenant B re-binds tenant A's compiled skeleton with
+    # zero recompiles. Invalidation rides the existing signature
+    # discipline: store table VERSIONs key every entry and the config
+    # object identity is the config epoch. False keeps every session's
+    # caches private (the pre-tier behavior).
+    shared_cache: bool = True
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One declared workload tenant (the named-resource-group analog,
+    extended from admission to throughput scheduling)."""
+
+    name: str
+    # Deficit-weighted-round-robin share: under saturation a tenant's
+    # dispatch throughput is proportional to its weight.
+    weight: int = 1
+    # Concurrent statements of this tenant in flight (0 = unlimited).
+    max_concurrency: int = 0
+    # Bounded per-tenant request queue: submits beyond this depth refuse
+    # with the retryable TenantQueueFull (backpressure, never silent).
+    max_queue: int = 64
+
+
+@dataclass(frozen=True)
+class TenancyConfig:
+    """Per-tenant workload governance (sched/tenancy.py): tenants are
+    named resource groups picked in deficit-weighted-round-robin order
+    inside the dispatcher tick, with starvation-free aging and per-tenant
+    admission/backpressure — the CPU-share side of resource groups the
+    admission-only queues (exec/resource.py) do not cover."""
+
+    enabled: bool = False
+    # Declared tenants; requests carrying an unknown (or no) tenant name
+    # fall into an auto-created group with the defaults below.
+    tenants: tuple = ()          # tuple[TenantSpec, ...]
+    default_weight: int = 1
+    default_max_queue: int = 256
+    # DWRR quantum multiplier: each scheduling round a tenant's deficit
+    # grows by weight * quantum requests.
+    quantum: int = 1
+    # Starvation bound: a request waiting longer than this is picked
+    # ahead of deficit order (oldest first), so a starved tenant's tail
+    # latency stays bounded no matter how heavy its neighbors are.
+    aging_s: float = 0.5
+    # Grace period a blocking submit waits for queue space / a
+    # concurrency slot before refusing with TenantQueueFull.
+    slot_wait_s: float = 0.25
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Serving front end (serve/server.py + serve/asyncore.py).
+
+    The default transport is the EVENT-LOOP core: a handful of I/O
+    threads multiplex every connection through selectors with
+    non-blocking newline-JSON framing, and parsed requests execute on a
+    bounded worker pool (dispatcher-bound reads complete asynchronously,
+    so a worker never blocks on a queued batch). ``threaded=True`` keeps
+    the legacy thread-per-connection path."""
+
+    # Legacy thread-per-connection transport (socketserver). The event
+    # loop is the default: thousands of connections on io_threads.
+    threaded: bool = False
+    # Accepted-connection cap across the whole server (0 = unlimited):
+    # past it, new connections get ONE retryable SERVER_BUSY refusal line
+    # and close — bounded fds/threads instead of unbounded accept growth.
+    max_connections: int = 4096
+    # listen(2) backlog for the accept socket.
+    listen_backlog: int = 512
+    # Event-loop I/O threads; connections are sharded across them.
+    io_threads: int = 2
+    # Worker threads executing parsed requests (0 = auto:
+    # max(4, resource.max_concurrency)).
+    workers: int = 0
+    # Per-connection pipelined-request cap: a client that streams
+    # requests without reading responses is paused (its socket leaves
+    # the read set) once this many parsed requests are pending.
+    pipeline_depth: int = 64
+    # Longest accepted request line in bytes: a client streaming bytes
+    # with no newline would otherwise grow the framing buffer without
+    # bound (the pipelining cap only sees COMPLETE lines). Oversized
+    # lines get one fatal error response, then the connection closes.
+    max_line_bytes: int = 64 << 20
 
 
 @dataclass(frozen=True)
@@ -294,6 +380,8 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
     recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":
         """Return a copy with dotted-path overrides, e.g.

@@ -30,7 +30,6 @@ and strip the annotations at intake — exec/tiled.py, exec/tiled_dist.py).
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -196,32 +195,33 @@ def jix_specs_of(plan: N.PlanNode) -> list[JoinIndexSpec]:
     return out
 
 
-# ------------------------------------------------------- session-side LRU
-
-
-_init_lock = threading.Lock()
+# ------------------------------------------------- shared-scope LRU
+# (sched/sharedcache.py): sessions over the same durable store share one
+# join-index scope — a dimension table's sorted-build scaffolding is
+# computed once per store version engine-wide, not once per backend.
 
 
 def _cache(session):
-    cache = getattr(session, "_join_index_cache", None)
-    if cache is None:
-        with _init_lock:  # lock must exist before the cache is visible
-            cache = getattr(session, "_join_index_cache", None)
-            if cache is None:
-                session._join_index_lock = threading.Lock()
-                cache = session._join_index_cache = {}
-    return cache, session._join_index_lock
+    from cloudberry_tpu.sched import sharedcache
+
+    scope = sharedcache.scope_for(session)
+    return scope.joinindex, scope.joinindex_lock
 
 
 def _cached_index(session, spec: JoinIndexSpec, segment) -> dict:
-    """The spec's index arrays from the session LRU, built on miss.
-    Keyed on the table VERSION: any write bumps it, so stale indexes are
-    unreachable by construction (the invalidation contract)."""
+    """The spec's index arrays from the scope LRU, built on miss.
+    Keyed on the table's content-stable version token
+    (sharedcache.table_key — the store version for store-backed tables
+    outside transactions, object uid + local version otherwise): any
+    write bumps it, so stale indexes are unreachable by construction
+    (the invalidation contract)."""
+    from cloudberry_tpu.sched import sharedcache
+
     t = session.catalog.table(spec.table)
     t.ensure_loaded()
     nseg = session.config.n_segments
-    key = (spec.table, getattr(t, "_version", 0), spec.phys, spec.bits,
-           spec.mode, nseg, segment)
+    key = (sharedcache.table_key(session, spec.table), spec.phys,
+           spec.bits, spec.mode, nseg, segment)
     cache, lock = _cache(session)
     with lock:
         hit = cache.pop(key, None)

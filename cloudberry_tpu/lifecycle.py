@@ -81,11 +81,14 @@ class BreakerOpen(StatementError):
 
 
 # errors raised OUTSIDE this module that belong to the retryable side:
-# the dispatcher's backpressure/deadline pair (sched/dispatcher.py) and
-# the admission-wait refusals are about load, not about the statement
+# the dispatcher's backpressure/deadline pair (sched/dispatcher.py), the
+# per-tenant admission refusal (exec/resource.py TenantQueueFull), and
+# the accept-path connection cap (serve SERVER_BUSY) are about load and
+# WHEN the statement ran, not about the statement itself
 _RETRYABLE_NAMES = frozenset({
     "StatementTimeout", "ServerDraining", "BreakerOpen",
     "SchedQueueFull", "SchedDeadline",
+    "TenantQueueFull", "ServerBusy",
 })
 
 

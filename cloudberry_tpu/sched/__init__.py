@@ -12,8 +12,18 @@ Two layers:
   Session that coalesces same-skeleton statements per tick into one
   stacked (vmapped) launch — the continuous-batching shape of an
   inference stack, applied to SQL dispatch.
+
+Two more layers ride alongside (ISSUE-7, the multi-tenant serving core):
+
+- ``sharedcache``: the process-wide cache tier — sessions over one
+  durable store share generic-plan / rung / join-index scopes, so a
+  second tenant's identical-skeleton statements compile nothing;
+- ``tenancy``: per-tenant fair scheduling — named resource groups picked
+  in deficit-weighted-round-robin order inside the dispatcher tick, with
+  starvation-free aging and per-tenant backpressure (TenantQueueFull).
 """
 
 from cloudberry_tpu.sched.paramplan import normalize  # noqa: F401
 from cloudberry_tpu.sched.dispatcher import (  # noqa: F401
     Dispatcher, SchedDeadline, SchedQueueFull)
+from cloudberry_tpu.sched.tenancy import TenantScheduler  # noqa: F401

@@ -51,11 +51,35 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
+    # async completion (event-loop serving, serve/asyncore.py): called
+    # exactly once with this request after result/error are set, from
+    # whichever thread finished it
+    on_done: Optional[Any] = None
+    # tenancy bookkeeping: the scheduler that picked this request and
+    # the TenantGroup charged for it (stamped at pick time)
+    _sched: Optional[Any] = None
+    _tenant_group: Optional[Any] = None
+    _finish_lock: threading.Lock = field(default_factory=threading.Lock)
+    _finished: bool = False
 
     def finish(self, result=None, error=None):
+        with self._finish_lock:
+            # atomic test-and-set: stop()'s sweep and the enqueue/stop
+            # race may both reach a request — on_done must fire ONCE
+            if self._finished:
+                return
+            self._finished = True
         self.result = result
         self.error = error
         self.done.set()
+        g, self._tenant_group = self._tenant_group, None
+        if g is not None and self._sched is not None:
+            self._sched.finish(g)
+        if self.on_done is not None:
+            try:
+                self.on_done(self)
+            except Exception:
+                pass  # a dead connection must not poison the worker
 
 
 class Dispatcher:
@@ -65,9 +89,14 @@ class Dispatcher:
     context manager held around every execution — the server passes its
     shared-session read-lock scope so dispatched reads keep excluding
     concurrent catalog writers exactly like direct dispatch does.
+
+    ``tenancy`` (optional): a sched/tenancy.TenantScheduler. With it,
+    requests land in per-tenant bounded queues and each tick picks the
+    batch in deficit-weighted-round-robin order with starvation-free
+    aging — fair throughput under saturation instead of FIFO.
     """
 
-    def __init__(self, session, exec_scope=None):
+    def __init__(self, session, exec_scope=None, tenancy=None):
         self.session = session
         cfg = session.config.sched
         self.max_batch = max(1, cfg.max_batch)
@@ -75,6 +104,7 @@ class Dispatcher:
         self.tick_s = max(0.0, cfg.tick_s)
         self.deadline_s = cfg.deadline_s
         self._exec_scope = exec_scope or contextlib.nullcontext
+        self.tenancy = tenancy
         self._q: list[_Request] = []
         self._cond = threading.Condition()
         self._stop = False
@@ -112,16 +142,24 @@ class Dispatcher:
         # accepted request is answered or failed, never silently dropped
         from cloudberry_tpu.lifecycle import ServerDraining
 
-        with self._cond:
-            pending, self._q = self._q, []
-        for r in pending:
-            r.finish(error=ServerDraining(
-                "dispatcher stopped while this request was queued; "
-                "retry against the serving primary"))
+        for _ in range(2):  # second sweep closes the enqueue/stop race
+            with self._cond:
+                pending, self._q = self._q, []
+            if self.tenancy is not None:
+                pending += self.tenancy.pending()
+            if not pending:
+                break
+            for r in pending:
+                r.finish(error=ServerDraining(
+                    "dispatcher stopped while this request was queued; "
+                    "retry against the serving primary"))
 
     def queue_depth(self) -> int:
         with self._cond:
-            return len(self._q)
+            depth = len(self._q)
+        if self.tenancy is not None:
+            depth += self.tenancy.depth()
+        return depth
 
     def drain(self, timeout_s: float) -> bool:
         """Wait until the queue is empty AND the worker is idle — every
@@ -131,27 +169,59 @@ class Dispatcher:
         fails whatever is still queued)."""
         end = time.monotonic() + max(0.0, timeout_s)
         with self._cond:
-            while self._q or self._busy:
+            while self._pending_depth() or self._busy:
                 left = end - time.monotonic()
                 if left <= 0:
                     return False
                 self._cond.wait(timeout=min(left, 0.1))
         return True
 
+    def _pending_depth(self) -> int:
+        """Queued requests across the global and tenant queues (callers
+        hold self._cond; the tenancy lock nests safely below it)."""
+        depth = len(self._q)
+        if self.tenancy is not None:
+            depth += self.tenancy.depth()
+        return depth
+
     # ------------------------------------------------------------- submit
 
-    def submit(self, sql: str, deadline_s: Optional[float] = None,
-               enqueue_wait_s: float = 0.25):
-        """Run one statement through the dispatcher; blocks until its
-        result is ready. Raises SchedQueueFull (backpressure) or
-        SchedDeadline; other execution errors re-raise as-is."""
+    def _enqueue(self, req: _Request, tenant: Optional[str],
+                 wait_s: float) -> None:
+        """Admit one request (global or tenant queue), with the grace
+        wait and the retryable refusals. Raises SchedQueueFull /
+        TenantQueueFull / ServerDraining."""
         from cloudberry_tpu.utils.faultinject import fault_point
 
         fault_point("sched_enqueue")
-        budget = self.deadline_s if deadline_s is None else deadline_s
-        req = _Request(sql, time.monotonic() + budget)
+        from cloudberry_tpu.lifecycle import ServerDraining
+
+        if self.tenancy is not None:
+            with self._cond:
+                if self._stop:
+                    raise ServerDraining("dispatcher stopped")
+            req._sched = self.tenancy
+            try:
+                self.tenancy.enqueue(tenant, req, wait_s=wait_s)
+            except Exception:
+                with self._cond:
+                    self.stats["rejected"] += 1
+                raise
+            with self._cond:
+                self.stats["enqueued"] += 1
+                self.stats["max_depth"] = max(self.stats["max_depth"],
+                                              self._pending_depth())
+                stopped = self._stop
+                self._cond.notify_all()
+            if stopped:
+                # raced a concurrent stop(): fail visibly (idempotent
+                # finish — stop()'s own sweep may also reach it)
+                req.finish(error=ServerDraining(
+                    "dispatcher stopped while this request was queued; "
+                    "retry against the serving primary"))
+            return
         with self._cond:
-            end = time.monotonic() + enqueue_wait_s
+            end = time.monotonic() + wait_s
             while len(self._q) >= self.max_queue and not self._stop:
                 left = end - time.monotonic()
                 if left <= 0:
@@ -162,14 +232,23 @@ class Dispatcher:
                         "config.sched.max_queue")
                 self._cond.wait(timeout=left)
             if self._stop:
-                from cloudberry_tpu.lifecycle import ServerDraining
-
                 raise ServerDraining("dispatcher stopped")
             self._q.append(req)
             self.stats["enqueued"] += 1
             self.stats["max_depth"] = max(self.stats["max_depth"],
                                           len(self._q))
             self._cond.notify_all()
+
+    def submit(self, sql: str, deadline_s: Optional[float] = None,
+               enqueue_wait_s: float = 0.25,
+               tenant: Optional[str] = None):
+        """Run one statement through the dispatcher; blocks until its
+        result is ready. Raises SchedQueueFull / TenantQueueFull
+        (backpressure) or SchedDeadline; other execution errors re-raise
+        as-is."""
+        budget = self.deadline_s if deadline_s is None else deadline_s
+        req = _Request(sql, time.monotonic() + budget)
+        self._enqueue(req, tenant, enqueue_wait_s)
         req.done.wait(timeout=budget + 60.0)
         if not req.done.is_set():
             raise SchedDeadline(f"request did not finish within "
@@ -178,12 +257,25 @@ class Dispatcher:
             raise req.error
         return req.result
 
+    def submit_nowait(self, sql: str, deadline_s: Optional[float] = None,
+                      tenant: Optional[str] = None,
+                      on_done=None) -> _Request:
+        """Non-blocking submission for the event-loop front end: admit
+        (refusing IMMEDIATELY on a full queue — the caller's client
+        retries on the retryable taxonomy) and return; ``on_done(req)``
+        fires once when the request finishes, from the finishing
+        thread."""
+        budget = self.deadline_s if deadline_s is None else deadline_s
+        req = _Request(sql, time.monotonic() + budget, on_done=on_done)
+        self._enqueue(req, tenant, wait_s=0.0)
+        return req
+
     # ------------------------------------------------------------- worker
 
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._q and not self._stop:
+                while not self._pending_depth() and not self._stop:
                     self._cond.wait(timeout=0.5)
                 if self._stop:
                     return
@@ -194,15 +286,35 @@ class Dispatcher:
             if self.tick_s:
                 with self._cond:
                     deadline = time.monotonic() + self.tick_s
-                    while len(self._q) < self.max_batch and not self._stop:
+                    while self._pending_depth() < self.max_batch \
+                            and not self._stop:
                         left = deadline - time.monotonic()
                         if left <= 0:
                             break
                         self._cond.wait(timeout=left)
-            with self._cond:
-                batch, self._q = self._q, []
-                self._busy = bool(batch)
-                self._cond.notify_all()  # wake blocked submitters
+            if self.tenancy is not None:
+                # fair pick: deficit-weighted round robin with aging —
+                # WHOSE requests flush this tick is the tenancy policy,
+                # the skeleton grouping below stays workload-driven.
+                # _busy flips BEFORE the pick: pick() drains the tenant
+                # queues, and drain() must never observe depth==0 with
+                # an unprocessed batch in hand
+                with self._cond:
+                    self._busy = True
+                batch = self.tenancy.pick(self.max_batch)
+                with self._cond:
+                    self._busy = bool(batch)
+                    self._cond.notify_all()
+                if not batch:
+                    # queued tenants all at max_concurrency (direct-path
+                    # statements hold their slots): back off briefly
+                    time.sleep(min(0.02, self.tick_s or 0.02))
+                    continue
+            else:
+                with self._cond:
+                    batch, self._q = self._q, []
+                    self._busy = bool(batch)
+                    self._cond.notify_all()  # wake blocked submitters
             if batch:
                 try:
                     self._process(batch)
@@ -360,6 +472,10 @@ class Dispatcher:
         occ = st.pop("occupancy_sum")
         st["avg_occupancy"] = round(occ / st["batches"], 4) \
             if st["batches"] else 0.0
+        if self.tenancy is not None:
+            depth += self.tenancy.depth()
+            st["tenants"] = self.tenancy.snapshot()
+            st["fairness_index"] = round(self.tenancy.fairness_index(), 4)
         st["queue_depth"] = depth
         st["max_batch"] = self.max_batch
         st["max_queue"] = self.max_queue

@@ -491,13 +491,16 @@ class GenericPlan:
                  names, sig, bindings, keyed, slots):
         from cloudberry_tpu.exec import executor as X
         from cloudberry_tpu.exec.resource import estimate_plan_memory
-        from cloudberry_tpu.exec.udf import registry_version
+        from cloudberry_tpu.sched import sharedcache
 
         self.skeleton = skeleton
         self.sig = sig
         self.config = session.config
-        self.versions = session._table_versions(names)
-        self.ddlv = (session.catalog.ddl_version, registry_version())
+        # shared-tier guards (sched/sharedcache.py): content-stable table
+        # version tokens + the plan epoch — store-scope entries match
+        # across sessions, everything else stays private by construction
+        self.versions = sharedcache.table_versions(session, names)
+        self.ddlv = sharedcache.plan_epoch(session)
         self.plan = plan
         self.param_keys = sorted(bindings, key=lambda k: (k[:4],
                                                           int(k[4:])))
@@ -725,13 +728,13 @@ def lookup_or_build(session, query: str, plan) -> Optional[Prep]:
     names = sorted({s.table_name for s in X.scans_of(plan)})
     if session._any_external(names):
         return None
+    from cloudberry_tpu.sched import sharedcache
+
     try:
-        versions = session._table_versions(names)
+        versions = sharedcache.table_versions(session, names)
     except KeyError:
         return None
-    from cloudberry_tpu.exec.udf import registry_version
-
-    ddlv = (session.catalog.ddl_version, registry_version())
+    ddlv = sharedcache.plan_epoch(session)
     try:
         sig, bindings, keyed, slots = analyze(session, plan)
     except UnsupportedPlan:

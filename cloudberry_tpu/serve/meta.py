@@ -31,7 +31,8 @@ def _table_row(name: str, t) -> dict:
 
 def describe(session, kind: str, arg=None):
     """One metadata answer. Kinds: tables | columns | stats | views |
-    matviews | sequences | info | activity | sched | summary."""
+    matviews | sequences | info | activity | sched | tenants |
+    summary."""
     # metadata must see other sessions' committed DDL — a thin client may
     # only ever ask metadata questions, so sync here, not just in sql()
     session._sync_store()
@@ -91,13 +92,30 @@ def describe(session, kind: str, arg=None):
         # scheduler observability: queue depth / batch occupancy from the
         # micro-batch dispatcher (when one is attached) plus the engine's
         # compile-hit / parameterization counters (sched/paramplan.py via
-        # exec/instrument.py StatementLog)
+        # exec/instrument.py StatementLog) and the shared cache tier's
+        # scope (sched/sharedcache.py)
+        from cloudberry_tpu.sched import sharedcache
+
         disp = getattr(session, "_dispatcher", None)
         return {
             "generic_plans": bool(session.config.sched.generic_plans),
             "dispatcher": disp.snapshot() if disp is not None else None,
             "counters": session.stmt_log.counter_snapshot(),
+            "shared_cache": sharedcache.tier_snapshot(session),
         }
+    if kind == "tenants":
+        # per-tenant workload governance (sched/tenancy.py): weights,
+        # queue depth, running/served/rejected counters, queue-wait
+        # stats, and the weight-normalized fairness index
+        sched = getattr(session, "_tenancy", None)
+        if sched is None:
+            disp = getattr(session, "_dispatcher", None)
+            sched = getattr(disp, "tenancy", None) if disp else None
+        if sched is None:
+            return {"enabled": False}
+        return {"enabled": True,
+                "groups": sched.snapshot(),
+                "fairness_index": round(sched.fairness_index(), 4)}
     if kind == "activity":
         # pg_stat_activity role: running + recent statements across every
         # backend of this server (one shared StatementLog)

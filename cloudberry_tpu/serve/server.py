@@ -161,53 +161,32 @@ class Server:
         self._draining = False
         self._inflight = 0
         self._inflight_cond = threading.Condition()
-        outer = self
+        # accept-path connection cap (config.serve.max_connections): past
+        # it, a new connection gets ONE retryable SERVER_BUSY line and
+        # closes — bounded fds/threads instead of unbounded accept growth
+        self.max_connections = self._config.serve.max_connections
+        self._conn_count = 0
+        self._conn_lock = threading.Lock()
+        # per-tenant workload governance (sched/tenancy.py): named
+        # resource groups with DWRR weights, concurrency slots, and
+        # bounded queues; requests pick their group via {"tenant": name}
+        self.tenancy = None
+        if self._config.tenancy.enabled:
+            from cloudberry_tpu.sched.tenancy import TenantScheduler
 
-        class Handler(socketserver.StreamRequestHandler):
-            def handle(self):
-                from cloudberry_tpu.utils.faultinject import fault_point
+            self.tenancy = TenantScheduler(self._config.tenancy)
+        # tenancy observability spans the wire (serve/meta.py "tenants")
+        self.session._tenancy = self.tenancy
+        # transport: the event-loop front end (serve/asyncore.py) is the
+        # default — a handful of I/O threads multiplex every connection;
+        # config.serve.threaded keeps the thread-per-connection path
+        if self._config.serve.threaded:
+            self._transport = _ThreadedTransport(self, host, port)
+        else:
+            from cloudberry_tpu.serve.asyncore import AsyncFrontEnd
 
-                fault_point("serve_handler")
-                addr = self.client_address[0]
-                authed = outer.auth_token is None
-                sess = outer._connection_session()
-                try:
-                    for line in self.rfile:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        # in-flight window covers compute AND response
-                        # write: drain waits until every accepted request
-                        # has its answer on the wire
-                        outer._request_begin()
-                        try:
-                            try:
-                                req = json.loads(line)
-                                if not authed:
-                                    resp, authed = outer._authenticate(
-                                        req, addr)
-                                else:
-                                    resp = outer._execute(req, sess)
-                            except Exception as e:
-                                # bad client/statement must not kill us
-                                resp = outer._error_resp(e)
-                            self.wfile.write(
-                                json.dumps(resp).encode() + b"\n")
-                            self.wfile.flush()
-                        finally:
-                            outer._request_end()
-                        if resp.get("fatal"):
-                            return
-                finally:
-                    outer._end_connection(sess)
-
-        class TCP(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = TCP((host, port), Handler)
-        self.host, self.port = self._server.server_address
-        self._thread: Optional[threading.Thread] = None
+            self._transport = AsyncFrontEnd(self, host, port)
+        self.host, self.port = self._transport.host, self._transport.port
         # scheduled statements (pg_cron analog): jobs persist in the store
         # and run in the serving process's session
         from cloudberry_tpu.serve.cron import Scheduler
@@ -218,13 +197,15 @@ class Server:
         # gang-dispatch analog): opt-in via config.sched.enabled — read
         # statements coalesce into stacked launches on the SERVER session;
         # executions hold the same statement-level lock scope direct
-        # dispatch would
+        # dispatch would, and the tenancy scheduler (when enabled) owns
+        # the pick order inside its tick
         self.dispatcher = None
         if self.session.config.sched.enabled:
             from cloudberry_tpu.sched import Dispatcher
 
             self.dispatcher = Dispatcher(self.session,
-                                         exec_scope=self._locked)
+                                         exec_scope=self._locked,
+                                         tenancy=self.tenancy)
 
     # -------------------------------------------------- lifecycle plumbing
 
@@ -236,6 +217,49 @@ class Server:
         with self._inflight_cond:
             self._inflight -= 1
             self._inflight_cond.notify_all()
+
+    # ------------------------------------------------- connection admission
+
+    def _try_admit_conn(self) -> bool:
+        """Accept-path cap: True admits (counted), False means the caller
+        must send the SERVER_BUSY line and close."""
+        with self._conn_lock:
+            if self.max_connections and \
+                    self._conn_count >= self.max_connections:
+                return False
+            self._conn_count += 1
+            return True
+
+    def _conn_closed(self) -> None:
+        with self._conn_lock:
+            self._conn_count -= 1
+
+    def _busy_resp(self) -> dict:
+        return {"ok": False, "etype": "ServerBusy", "retryable": True,
+                "fatal": True,
+                "error": f"SERVER_BUSY: connection limit "
+                         f"({self.max_connections}) reached; retry "
+                         "shortly"}
+
+    def _busy_line(self) -> bytes:
+        return json.dumps(self._busy_resp()).encode() + b"\n"
+
+    def _process_line(self, line: bytes, sess, authed: bool, addr: str,
+                      async_cb=None):
+        """One wire line → (response dict | None, authed'): the
+        transport-independent request core. ``None`` means an async
+        completion owns the response (``async_cb`` will fire exactly
+        once with it — event-loop transport only)."""
+        try:
+            req = json.loads(line)
+            if not authed:
+                resp, authed = self._authenticate(req, addr)
+            else:
+                resp = self._execute(req, sess, async_cb=async_cb)
+        except Exception as e:
+            # bad client/statement must not kill the connection handler
+            resp = self._error_resp(e)
+        return resp, authed
 
     @staticmethod
     def _error_resp(e: BaseException) -> dict:
@@ -344,8 +368,10 @@ class Server:
         # one circuit breaker: device-loss flapping is an ENGINE
         # condition, so read-only-degraded spans backends like the gate
         s._breaker = self.session._breaker
-        # dispatcher observability (serve/meta.py "sched") spans backends
+        # dispatcher + tenancy observability (serve/meta.py "sched" /
+        # "tenants") spans backends
         s._dispatcher = getattr(self.session, "_dispatcher", None)
+        s._tenancy = self.tenancy
         # one checkpoint store: recovery.max_statements bounds the
         # ENGINE's held checkpoints, not each backend's (statement ids
         # come from the shared stmt_log, so keys never collide)
@@ -366,9 +392,7 @@ class Server:
     # --------------------------------------------------------------- control
 
     def start(self) -> "Server":
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._transport.start()
         if not self.read_only:
             # a standby never runs jobs: the primary owns the schedule
             # (pg_cron likewise runs on the primary only)
@@ -384,7 +408,7 @@ class Server:
         if self.dispatcher is not None:
             self.dispatcher.start()
         self.watchdog.start()
-        self._server.serve_forever()
+        self._transport.serve_forever()
 
     def stop(self, drain_s: float = 0.0) -> None:
         """Shut down; with ``drain_s`` > 0, gracefully (smart shutdown):
@@ -418,10 +442,7 @@ class Server:
         if self.dispatcher is not None:
             self.dispatcher.stop()
         self.watchdog.stop()
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
+        self._transport.stop()
 
     def __enter__(self):
         return self.start()
@@ -432,7 +453,18 @@ class Server:
 
     # ------------------------------------------------------------- execution
 
-    def _execute(self, req: dict, sess) -> dict:
+    def _tenant_slot(self, tenant):
+        """Per-tenant concurrency gate for statements that bypass the
+        dispatcher (writes, non-parameterizable reads): a no-op without
+        tenancy; otherwise bounded-wait admission that refuses with the
+        retryable TenantQueueFull (sched/tenancy.py)."""
+        import contextlib
+
+        if self.tenancy is None:
+            return contextlib.nullcontext()
+        return self.tenancy.slot(tenant)
+
+    def _execute(self, req: dict, sess, async_cb=None) -> Optional[dict]:
         if "cancel" in req:
             # the pg_cancel_backend analog: cancel a running statement by
             # its activity id ({"meta": "activity"} lists them). The
@@ -527,6 +559,7 @@ class Server:
             return {"ok": False, "etype": "ReadOnlyError",
                     "error": "read-only standby: route writes to the "
                              "primary server"}
+        tenant = req.get("tenant")
         if self.dispatcher is not None and _is_read(sql) \
                 and _first_word(sql) not in _TXN_STARTERS \
                 and getattr(sess, "_txn_snapshot", None) is None \
@@ -538,13 +571,32 @@ class Server:
             # Non-parameterizable reads keep the concurrent handler-thread
             # path — routing them through the single dispatcher worker
             # would head-of-line-block point lookups behind heavy scans.
+            if async_cb is not None:
+                # event-loop serving: the worker hands the request to the
+                # dispatcher and RETURNS — thousands of queued reads cost
+                # queue slots, not blocked worker threads; the response
+                # is rendered and written when the batch lands
+                def _done(r):
+                    if r.error is not None:
+                        async_cb(self._error_resp(r.error))
+                        return
+                    try:
+                        async_cb(self._render(r.result))
+                    except Exception as e:
+                        async_cb(self._error_resp(e))
+
+                self.dispatcher.submit_nowait(
+                    sql, deadline_s=req.get("deadline_s"),
+                    tenant=tenant, on_done=_done)
+                return None
             result = self.dispatcher.submit(
-                sql, deadline_s=req.get("deadline_s"))
+                sql, deadline_s=req.get("deadline_s"), tenant=tenant)
         elif self.per_connection:
             # each connection is its own backend: statement-level locking
             # is unnecessary (no shared catalog objects) and transactions
             # ride the store's multi-session OCC
-            result = sess.sql(sql, _deadline=deadline)
+            with self._tenant_slot(tenant):
+                result = sess.sql(sql, _deadline=deadline)
         elif _first_word(sql) in _TXN_STARTERS:
             # all connections share ONE session: a wire-level BEGIN would
             # absorb other clients' autocommit writes into its rollback
@@ -558,8 +610,14 @@ class Server:
             # shared session: reads share, catalog mutations exclude —
             # concurrent readers would race the data/stats swap (the OCC
             # layer handles cross-PROCESS writers; this lock, threads)
-            with self._locked(write=not _is_read(sql)):
+            with self._tenant_slot(tenant), \
+                    self._locked(write=not _is_read(sql)):
                 result = sess.sql(sql, _deadline=deadline)
+        return self._render(result)
+
+    def _render(self, result) -> dict:
+        """One execution result → the wire response dict (shared by the
+        synchronous paths and the dispatcher's async completion)."""
         if isinstance(result, dict):
             # DECLARE PARALLEL RETRIEVE CURSOR: endpoint directory + token
             return {"ok": True, **{k: _json_safe(v) if not isinstance(
@@ -579,3 +637,93 @@ class Server:
                 "rowcount": n,
             }
         return {"ok": True, "status": str(result)}
+
+
+# --------------------------------------------------------------- transports
+
+
+class _ThreadedTransport:
+    """The legacy thread-per-connection transport (socketserver), kept
+    behind ``config.serve.threaded``: one OS thread per connection,
+    blocking line reads, the same request core (Server._process_line)
+    the event-loop front end uses — plus the shared accept-path
+    connection cap."""
+
+    def __init__(self, server: Server, host: str, port: int):
+        outer = server
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                from cloudberry_tpu.utils.faultinject import fault_point
+
+                fault_point("serve_handler")
+                addr = self.client_address[0]
+                authed = outer.auth_token is None
+                sess = None
+                try:
+                    # inside the try: a failed backend-session creation
+                    # must still release the admitted connection slot
+                    sess = outer._connection_session()
+                    for line in self.rfile:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        # in-flight window covers compute AND response
+                        # write: drain waits until every accepted request
+                        # has its answer on the wire
+                        outer._request_begin()
+                        try:
+                            resp, authed = outer._process_line(
+                                line, sess, authed, addr)
+                            self.wfile.write(
+                                json.dumps(resp).encode() + b"\n")
+                            self.wfile.flush()
+                        finally:
+                            outer._request_end()
+                        if resp.get("fatal"):
+                            return
+                finally:
+                    try:
+                        if sess is not None:
+                            outer._end_connection(sess)
+                    finally:
+                        outer._conn_closed()
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+            # bound the kernel accept queue too (socketserver's default
+            # is 5 — too small under bursts; unbounded is the other sin)
+            request_queue_size = max(16, outer._config.serve.listen_backlog)
+
+            def verify_request(self, request, client_address):
+                # the connection cap, enforced at accept: past it the
+                # client gets ONE retryable SERVER_BUSY line and a close
+                if outer._try_admit_conn():
+                    return True
+                try:
+                    # best-effort, non-blocking: the refusal must never
+                    # stall the accept thread on an unresponsive peer
+                    request.setblocking(False)
+                    request.send(outer._busy_line())
+                except OSError:
+                    pass
+                return False
+
+        self._server = TCP((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -95,18 +95,16 @@ class Session:
         # concurrent readers
         self._stmt_cache: dict = {}
         self._stmt_lock = __import__("threading").Lock()
-        # capacity-rung executable cache: one compiled SPMD program per
-        # (statement, motion-rung signature) — skew promotion climbs a
-        # power-of-two bucket ladder, and each rung's executable compiles
-        # at most once per session (bounded recompiles, exec/dist_executor)
-        self._rung_cache: dict = {}
-        self._rung_lock = __import__("threading").Lock()
-        # generic-plan cache (sched/paramplan.py, the plan_cache.c analog):
-        # statement SKELETON -> compiled programs with literals as device
-        # inputs, so same-shape statements with different literals share
-        # one executable (zero recompiles after the first)
-        self._generic_cache: dict = {}
-        self._generic_lock = __import__("threading").Lock()
+        # shared cache tier (sched/sharedcache.py): the generic-plan,
+        # capacity-rung, and join-index caches live in an engine-wide
+        # SCOPE — sessions over the same durable store share one (tenant
+        # B re-binds tenant A's compiled skeleton with zero recompiles),
+        # storeless sessions get a private scope (pre-tier behavior).
+        # The _generic_cache/_rung_cache properties below are views into
+        # it so existing callers and tests keep working.
+        from cloudberry_tpu.sched import sharedcache
+
+        self._cache_scope = sharedcache.scope_for(self)
         # counts-only shard layout (planning fast path; sharded_table
         # materializes the actual arrays for execution)
         self._shard_count_cache: dict = {}
@@ -146,6 +144,26 @@ class Session:
         # open parallel retrieve cursors (the endpoint registry analog,
         # cdbendpoint.c EndpointTokenHash) — name -> ParallelCursor
         self.parallel_cursors: dict[str, object] = {}
+
+    # shared-tier views (sched/sharedcache.py): one lock/dict pair per
+    # cache per SCOPE — shared across every session of a store scope,
+    # private otherwise. Kept as properties so the pre-tier call sites
+    # (paramplan, tests, degrade_mesh) stay unchanged.
+    @property
+    def _generic_cache(self) -> dict:
+        return self._cache_scope.generic
+
+    @property
+    def _generic_lock(self):
+        return self._cache_scope.generic_lock
+
+    @property
+    def _rung_cache(self) -> dict:
+        return self._cache_scope.rung
+
+    @property
+    def _rung_lock(self):
+        return self._cache_scope.rung_lock
 
     def retrieve(self, cursor: str, segment: int,
                  limit: int | None = None, token: str | None = None):
@@ -869,11 +887,18 @@ class Session:
         if getattr(plan, "_no_stmt_cache", False) \
                 or self._any_external(names):
             return compile_distributed(plan, self)
+        from cloudberry_tpu.sched import sharedcache
+
         try:
-            versions = self._table_versions(names)
+            versions = sharedcache.table_versions(self, names)
         except KeyError:
             return compile_distributed(plan, self)
-        key = (query, self.config.n_segments, self.catalog.ddl_version,
+        # rung programs close over their traced plan, so cross-session
+        # reuse demands the plan be a pure function of store content:
+        # the scope token pins entries to one catalog generation unless
+        # the scope is shared and view-free (sharedcache.rung_scope_token)
+        key = (query, self.config.n_segments,
+               sharedcache.rung_scope_token(self),
                registry_version(), versions, self._motion_rung_sig(plan))
         with self._rung_lock:
             fn = self._rung_cache.pop(key, None)

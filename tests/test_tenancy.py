@@ -1,0 +1,330 @@
+"""Per-tenant fair scheduling (sched/tenancy.py): DWRR weights, aging,
+backpressure taxonomy, dispatcher fairness under saturation, and the
+client-retry regression for a saturated tenant (ISSUE-7)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import Config, TenancyConfig, TenantSpec
+from cloudberry_tpu.exec.resource import TenantQueueFull
+from cloudberry_tpu.sched.tenancy import TenantScheduler
+
+
+def _sched(tenants, **kv):
+    cfg = TenancyConfig(enabled=True, tenants=tuple(tenants), **kv)
+    return TenantScheduler(cfg)
+
+
+class _Item:
+    """Opaque schedulable item (the dispatcher's _Request stand-in)."""
+
+
+# ------------------------------------------------------------------ DWRR
+
+
+def test_dwrr_picks_proportional_to_weight():
+    """Deterministic core property: with both queues saturated and no
+    aging, pick order serves tenants exactly 3:1."""
+    s = _sched([TenantSpec("gold", weight=3, max_queue=1000),
+                TenantSpec("silver", weight=1, max_queue=1000)],
+               aging_s=3600.0)
+    now = time.monotonic()
+    items = {}
+    for name in ("gold", "silver"):
+        for _ in range(120):
+            it = _Item()
+            items[id(it)] = name
+            s.enqueue(name, it)
+    picked = []
+    while True:
+        batch = s.pick(8, now=now)
+        if not batch:
+            break
+        picked.extend(items[id(it)] for it in batch)
+        for it in batch:
+            s.finish(s.group(items[id(it)]))
+    # while BOTH queues were non-empty (first 160 picks), the ratio is
+    # exactly 3:1 per round
+    head = picked[:160]
+    g = head.count("gold")
+    sv = head.count("silver")
+    assert g == 3 * sv, (g, sv)
+    assert len(picked) == 240  # nothing lost
+
+
+def test_aging_overrides_deficit_order():
+    """A head waiting past aging_s is picked FIRST (oldest first), no
+    matter how heavy the competing tenant — the starvation bound."""
+    s = _sched([TenantSpec("heavy", weight=100, max_queue=1000),
+                TenantSpec("starved", weight=1, max_queue=1000)],
+               aging_s=0.5)
+    t0 = time.monotonic()
+    old = _Item()
+    s.enqueue("starved", old)
+    for _ in range(50):
+        s.enqueue("heavy", _Item())
+    # 10s later: the starved head is over-age and goes first
+    batch = s.pick(4, now=t0 + 10.0)
+    assert batch[0] is old
+    assert s.snapshot()["starved"]["aged"] == 1
+
+
+def test_max_concurrency_respected_even_by_aging():
+    s = _sched([TenantSpec("t", weight=1, max_concurrency=1,
+                           max_queue=10)], aging_s=0.01)
+    a, b = _Item(), _Item()
+    s.enqueue("t", a)
+    s.enqueue("t", b)
+    t0 = time.monotonic()
+    assert s.pick(8, now=t0 + 5.0) == [a]  # the slot cap holds
+    assert s.pick(8, now=t0 + 5.0) == []   # a still running
+    s.finish(s.group("t"))
+    assert s.pick(8, now=t0 + 5.0) == [b]
+
+
+def test_tenant_queue_full_is_retryable_by_name():
+    from cloudberry_tpu.lifecycle import is_retryable
+
+    s = _sched([TenantSpec("t", weight=1, max_queue=2)])
+    s.enqueue("t", _Item())
+    s.enqueue("t", _Item())
+    with pytest.raises(TenantQueueFull):
+        s.enqueue("t", _Item(), wait_s=0.0)
+    assert is_retryable("TenantQueueFull")
+    assert is_retryable("ServerBusy")
+    assert s.snapshot()["t"]["rejected"] == 1
+
+
+def test_unknown_tenant_gets_default_group():
+    s = _sched([TenantSpec("gold", weight=3)])
+    s.enqueue("walkin", _Item())
+    snap = s.snapshot()
+    assert "walkin" in snap and snap["walkin"]["weight"] == 1
+    s.enqueue(None, _Item())
+    assert "default" in s.snapshot()
+
+
+def test_slot_gates_direct_path_concurrency():
+    s = _sched([TenantSpec("t", weight=1, max_concurrency=1,
+                           max_queue=1)], slot_wait_s=0.05)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with s.slot("t"):
+            entered.set()
+            release.wait(timeout=30)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    assert entered.wait(timeout=5)
+    with pytest.raises(TenantQueueFull):
+        with s.slot("t", wait_s=0.05):
+            pass
+    release.set()
+    th.join(timeout=10)
+    with s.slot("t"):
+        pass  # slot free again
+
+
+# ------------------------------------------- dispatcher-level fairness
+
+
+def _point_session(**over):
+    cfg = Config().with_overrides(**over)
+    s = cb.Session(cfg)
+    s.sql("create table pts (k bigint, v bigint) distributed by (k)")
+    s.catalog.table("pts").set_data({
+        "k": np.arange(20_000, dtype=np.int64),
+        "v": np.arange(20_000, dtype=np.int64) * 3}, {})
+    return s
+
+
+def test_dispatcher_fairness_3_to_1_under_saturation():
+    """ISSUE-7 acceptance: two tenants at 3:1 weights under saturation
+    observe dispatch throughput within 15% of the weight ratio (pinned
+    on the scheduler's pick counters — picks ARE throughput while both
+    queues stay backlogged)."""
+    from cloudberry_tpu.sched import Dispatcher, TenantScheduler as TS
+
+    s = _point_session(**{
+        "sched.enabled": True, "sched.tick_s": 0.001,
+        "sched.max_batch": 8, "sched.max_queue": 2048})
+    s.sql("select k, v from pts where k = 1")  # warm the generic plan
+    tcfg = TenancyConfig(
+        enabled=True, aging_s=3600.0,
+        tenants=(TenantSpec("gold", weight=3, max_queue=1000),
+                 TenantSpec("silver", weight=1, max_queue=1000)))
+    sched = TS(tcfg)
+    d = Dispatcher(s, tenancy=sched)
+    done = [0, 0]
+    lock = threading.Lock()
+
+    def _mark(idx):
+        def f(r):
+            with lock:
+                done[idx] += 1
+        return f
+
+    # pre-fill BOTH queues (saturation by construction), then serve
+    for i in range(150):
+        d.submit_nowait(f"select k, v from pts where k = {i}",
+                        tenant="gold", on_done=_mark(0))
+        d.submit_nowait(f"select k, v from pts where k = {10_000 + i}",
+                        tenant="silver", on_done=_mark(1))
+    d.start()
+    end = time.monotonic() + 120
+    # sample while both queues are still non-empty: picks ratio == 3:1
+    while time.monotonic() < end:
+        snap = sched.snapshot()
+        if snap["gold"]["picks"] + snap["silver"]["picks"] >= 120:
+            break
+        time.sleep(0.01)
+    snap = sched.snapshot()
+    try:
+        g, sv = snap["gold"]["picks"], snap["silver"]["picks"]
+        assert sv > 0
+        ratio = g / sv
+        assert 3.0 * 0.85 <= ratio <= 3.0 * 1.15, (g, sv, ratio)
+        assert sched.fairness_index() > 0.9
+    finally:
+        d.drain(120)
+        d.stop()
+    assert sum(done) == 300  # every request answered
+
+
+def test_dispatcher_aging_bounds_starved_wait():
+    """A weight-1 tenant flooded out by a weight-20 neighbor still sees
+    its requests served: aging picks over-age heads first, so the
+    starved tenant's worst wait stays near the aging bound + one batch,
+    not the whole backlog."""
+    from cloudberry_tpu.sched import Dispatcher, TenantScheduler as TS
+
+    s = _point_session(**{
+        "sched.enabled": True, "sched.tick_s": 0.001,
+        "sched.max_batch": 8, "sched.max_queue": 4096})
+    s.sql("select k, v from pts where k = 1")
+    tcfg = TenancyConfig(
+        enabled=True, aging_s=0.05,
+        tenants=(TenantSpec("heavy", weight=20, max_queue=40_000),
+                 TenantSpec("starved", weight=1, max_queue=100)))
+    sched = TS(tcfg)
+    d = Dispatcher(s, tenancy=sched)
+    for i in range(20_000):
+        d.submit_nowait(f"select k, v from pts where k = {i % 2000}",
+                        tenant="heavy", on_done=None)
+    waits = []
+    lock = threading.Lock()
+
+    def _rec(t0):
+        def f(r):
+            with lock:
+                waits.append(time.monotonic() - t0)
+        return f
+
+    d.start()
+    time.sleep(0.05)
+    for i in range(5):
+        d.submit_nowait(f"select k, v from pts where k = {15_000 + i}",
+                        tenant="starved", on_done=_rec(time.monotonic()))
+    end = time.monotonic() + 120
+    while time.monotonic() < end:
+        with lock:
+            if len(waits) == 5:
+                break
+        time.sleep(0.01)
+    try:
+        assert len(waits) == 5
+        snap = sched.snapshot()
+        # served long before the 20k-deep heavy backlog drained: the
+        # starved tenant's worst wait is bounded by the DWRR round +
+        # aging channel, not by its neighbor's queue depth
+        assert snap["heavy"]["queued"] > 0, \
+            "backlog drained too fast to observe starvation"
+        assert max(waits) < 5.0, waits
+        assert snap["starved"]["wait_max_ms"] < 5000.0
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------- wire-level pieces
+
+
+def test_server_tenant_backpressure_and_client_retry():
+    """ISSUE-7 satellite: a saturated tenant's reads fail with the
+    retryable TenantQueueFull and a retry_reads client eventually
+    succeeds once the queue drains."""
+    from cloudberry_tpu.serve import Client, Server, ServerError
+
+    s = _point_session(**{
+        "tenancy.enabled": True,
+        "tenancy.slot_wait_s": 0.02,
+        "tenancy.tenants": (
+            TenantSpec("small", weight=1, max_concurrency=1,
+                       max_queue=1),)})
+    with Server(session=s) as srv:
+        # saturate the tenant deterministically: hold its single slot
+        # via the server's own scheduler, then observe the wire refusal
+        ts = srv.tenancy
+        with ts.slot("small"):
+            with pytest.raises(TenantQueueFull):
+                with ts.slot("small", wait_s=0.01):
+                    pass
+            # wire-level: the refusal reaches the client as retryable
+            with Client(srv.host, srv.port, tenant="small") as c:
+                with pytest.raises(ServerError) as ei:
+                    c.sql("select count(*) as n from pts "
+                          "group by k order by n limit 1")
+                assert ei.value.etype == "TenantQueueFull"
+                assert ei.value.retryable
+        # slot free now: a retry_reads client gets through
+        with Client(srv.host, srv.port, tenant="small",
+                    retry_reads=True, max_retries=5,
+                    backoff_s=0.02) as c:
+            out = c.sql("select count(*) as n from pts "
+                        "group by k order by n limit 1")
+            assert out["rowcount"] == 1
+
+
+def test_serve_bench_tenants_smoke():
+    """CPU smoke of the ISSUE-7 bench mode: the multiplexed driver runs
+    declared tenants through the event-loop core and the CSV rows carry
+    the per-tenant QPS / p50 / p99 / queue-depth / fairness columns."""
+    import tools.serve_bench as SB
+
+    tenants = SB.parse_tenantspec("gold:3,silver:1", 24)
+    r = SB.run_mode("batched", "point", clients=24, duration_s=1.5,
+                    rows=20_000, tick_s=0.002, max_batch=8,
+                    tenants=tenants)
+    assert r["requests"] > 0
+    assert len(SB.csv_row(r).split(",")) == len(SB.CSV_HEADER.split(","))
+    per = {t["tenant"]: t for t in r["_tenants"]}
+    assert set(per) == {"gold", "silver"}
+    for row in per.values():
+        assert row["tenant_qps"] > 0
+        assert len(SB.csv_row(row).split(",")) == \
+            len(SB.CSV_HEADER.split(","))
+    # saturated 3:1 weights: gold at least keeps ahead (the strict ±15%
+    # ratio pin lives in test_dispatcher_fairness_3_to_1_under_saturation
+    # where saturation is constructed, not load-dependent)
+    assert per["gold"]["tenant_qps"] >= per["silver"]["tenant_qps"]
+    assert 0.0 < r["fairness_index"] <= 1.0
+
+
+def test_meta_tenants_over_the_wire():
+    from cloudberry_tpu.serve import Client, Server
+
+    s = _point_session(**{
+        "tenancy.enabled": True,
+        "tenancy.tenants": (TenantSpec("gold", weight=3),)})
+    with Server(session=s) as srv:
+        with Client(srv.host, srv.port, tenant="gold") as c:
+            c.sql("select k, v from pts where k = 42")
+            t = c.meta("tenants")
+            assert t["enabled"]
+            assert t["groups"]["gold"]["weight"] == 3
+            assert 0.0 < t["fairness_index"] <= 1.0

@@ -1,13 +1,25 @@
 """Closed-loop serving benchmark — QPS/latency for the micro-batch
 dispatcher vs one-at-a-time dispatch (the ISSUE-3 acceptance harness).
 
-N client threads hammer a Server over the wire protocol with a statement
-mix; each mode runs the SAME closed loop and the CSV rows make the
-comparison direct:
+N simulated clients hammer a Server over the wire protocol with a
+statement mix; each mode runs the SAME closed loop and the CSV rows make
+the comparison direct:
 
     mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,compiles,\
 dispatches,batches,batched_requests,avg_occupancy,deadline_misses,\
-cancels,recovery_count,tiles_replayed,recovery_ms
+cancels,recovery_count,tiles_replayed,recovery_ms,tenant,tenant_qps,\
+tenant_p50_ms,tenant_p99_ms,tenant_queue_depth,fairness_index
+
+Small runs drive one OS thread per client; large runs (or any --tenants
+run) multiplex the clients over a few selector driver threads, each
+connection an INDEPENDENT closed loop — that is how the bench sustains
+1000+ simulated clients against the event-loop serving core
+(serve/asyncore.py). With --tenants, requests carry tenant names, the
+server schedules them deficit-weighted-round-robin (sched/tenancy.py),
+and each tenant gets its own CSV row (per-tenant QPS / p50 / p99 /
+peak queue depth) under the aggregate's fairness_index (Jain's index
+over weight-normalized picks; 1.0 = throughput exactly proportional to
+weight).
 
 - ``direct``  — dispatcher off: every request is its own parse→(generic
   rebind)→launch through the shared session.
@@ -33,7 +45,10 @@ amortization grows with dispatch overhead. Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import selectors
+import socket
 import sys
 import threading
 import time
@@ -44,11 +59,37 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               "compiles,dispatches,batches,batched_requests,avg_occupancy,"
               "deadline_misses,cancels,recovery_count,tiles_replayed,"
-              "recovery_ms")
+              "recovery_ms,tenant,tenant_qps,tenant_p50_ms,tenant_p99_ms,"
+              "tenant_queue_depth,fairness_index")
+
+
+def parse_tenantspec(spec: str, clients: int):
+    """'gold:3,silver:1' → [TenantSpec, ...]; per-field form is
+    name:weight[:max_concurrency[:max_queue]]. The default queue depth
+    scales with the client count so a closed-loop bench saturates the
+    SCHEDULER (the fairness story), not the admission refusal."""
+    from cloudberry_tpu.config import TenantSpec
+
+    out = []
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        bits = part.strip().split(":")
+        # the scheduler lowercases group names — match it here so the
+        # per-tenant snapshot lookups (queue depth) resolve
+        name = bits[0].lower()
+        weight = int(bits[1]) if len(bits) > 1 else 1
+        conc = int(bits[2]) if len(bits) > 2 else 0
+        queue = int(bits[3]) if len(bits) > 3 else max(256, clients * 2)
+        out.append(TenantSpec(name=name, weight=weight,
+                              max_concurrency=conc, max_queue=queue))
+    return out
 
 
 def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
-                  mix: str = "point", chaos: float = 0.0):
+                  mix: str = "point", chaos: float = 0.0,
+                  tenants=None, server_core: str = "async",
+                  clients: int = 16, aging_s: float = None):
     import numpy as np
 
     import cloudberry_tpu as cb
@@ -58,7 +99,20 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         "sched.enabled": mode == "batched",
         "sched.tick_s": tick_s,
         "sched.max_batch": max_batch,
+        "serve.threaded": server_core == "threaded",
     }
+    if clients > 64:
+        # warehouse-concurrency closed loop: the global dispatcher queue
+        # must hold every in-flight client
+        over["sched.max_queue"] = max(256, clients * 2)
+    if tenants:
+        over["tenancy.enabled"] = True
+        over["tenancy.tenants"] = tuple(tenants)
+        if aging_s is not None:
+            # the weights-vs-tail dial: queues deeper than aging_s's
+            # wait turn DWRR into oldest-first (bounded p99, flattened
+            # ratio) — raise it when the ratio is what you measure
+            over["tenancy.aging_s"] = aging_s
     if mix == "spill":
         # the chaos workload streams tiles: shrink the budget so the li
         # aggregate runs through the tiled (checkpointable) path
@@ -119,10 +173,92 @@ def _mix_sql(mix: str, i: int, rows: int) -> str:
     return _q6_sql(i) if i % 5 == 4 else _point_sql(i, rows)
 
 
+_BACKPRESSURE_ETYPES = ("TenantQueueFull", "SchedQueueFull", "ServerBusy")
+
+
+def _mux_driver(wid: int, n_conns: int, first_idx: int, host, port,
+                mix: str, rows: int, tenant_names, stop_at, lat_map,
+                lat_lock, rejects, errors):
+    """One driver thread simulating ``n_conns`` independent closed-loop
+    clients: a selector loop sends each connection's next request the
+    moment its previous response lands, so per-tenant throughput under
+    saturation reflects the SERVER's scheduling (a lock-step
+    send-all/recv-all cycle would equalize tenants by construction)."""
+    sel = selectors.DefaultSelector()
+    conns = []
+    local: dict = {}
+    rej_local = 0
+    try:
+        for j in range(n_conns):
+            idx = first_idx + j
+            s = socket.create_connection((host, port), timeout=120)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            r = s.makefile("rb")
+            w = s.makefile("wb")
+            tenant = tenant_names[idx % len(tenant_names)] \
+                if tenant_names else None
+            rec = {"s": s, "r": r, "w": w, "tenant": tenant,
+                   "i": idx * 100_003, "t0": 0.0}
+            conns.append(rec)
+            sel.register(s, selectors.EVENT_READ, rec)
+            local.setdefault(tenant, [])
+
+        def send_next(rec):
+            req = {"sql": _mix_sql(mix, rec["i"], rows)}
+            if rec["tenant"]:
+                req["tenant"] = rec["tenant"]
+            rec["i"] += 1
+            rec["t0"] = time.monotonic()
+            rec["w"].write(json.dumps(req).encode() + b"\n")
+            rec["w"].flush()
+
+        for rec in conns:
+            send_next(rec)
+        while time.monotonic() < stop_at[0]:
+            for key, _ in sel.select(timeout=0.1):
+                rec = key.data
+                line = rec["r"].readline()
+                if not line:
+                    raise RuntimeError("server closed a bench connection")
+                resp = json.loads(line)
+                dt = time.monotonic() - rec["t0"]
+                if resp.get("ok"):
+                    local[rec["tenant"]].append(dt)
+                elif resp.get("etype") in _BACKPRESSURE_ETYPES:
+                    # retryable refusal: counted as BACKPRESSURE (its
+                    # own metric — NOT a deadline miss), loop retries
+                    rej_local += 1
+                else:
+                    raise RuntimeError(resp.get("error", "bench error"))
+                if time.monotonic() < stop_at[0]:
+                    send_next(rec)
+    except Exception as e:  # pragma: no cover - surfaced in result
+        errors.append(f"{type(e).__name__}: {e}")
+    finally:
+        for rec in conns:
+            try:
+                rec["s"].close()
+            except OSError:
+                pass
+        sel.close()
+    with lat_lock:
+        rejects[0] += rej_local
+        for tenant, lats in local.items():
+            lat_map.setdefault(tenant, []).extend(lats)
+
+
+def _pct(lats, p: float) -> float:
+    if not lats:
+        return 0.0
+    return lats[min(len(lats) - 1, int(p * len(lats)))] * 1000
+
+
 def run_mode(mode: str, mix: str, clients: int, duration_s: float,
              rows: int, tick_s: float, max_batch: int,
              cancel_mix: float = 0.0, deadline_s: float = 0.005,
-             chaos: float = 0.0) -> dict:
+             chaos: float = 0.0, tenants=None,
+             server_core: str = "async",
+             driver_threads: int = 16, aging_s: float = None) -> dict:
     """One closed-loop run; returns the CSV row fields.
 
     ``cancel_mix``: fraction of requests carrying a TIGHT per-request
@@ -142,7 +278,9 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     from cloudberry_tpu.utils import faultinject as FI
 
     session = build_session(mode, rows, tick_s, max_batch,
-                            mix=mix, chaos=chaos)
+                            mix=mix, chaos=chaos, tenants=tenants,
+                            server_core=server_core, clients=clients,
+                            aging_s=aging_s)
     # warm the compile caches OUTSIDE the measured window: the bench
     # compares steady-state dispatch, not first-compile latency
     session.sql(_point_sql(0, rows))
@@ -201,10 +339,35 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     if chaos > 0:
         FI.inject_fault("tile_device_lost", "error", p=chaos, seed=1234)
         FI.inject_fault("exec_device_lost", "error", p=chaos, seed=4321)
+    lat_map: dict = {}
+    rejects = [0]  # backpressure refusals (mux driver) — own metric
+    tenant_names = [t.name for t in tenants] if tenants else None
+    # driver choice: one OS thread per client stays exact for small runs
+    # (and the cancel-mix workload needs per-request deadlines); past
+    # that — or whenever tenants are declared — a few selector driver
+    # threads each multiplex many independent closed-loop connections,
+    # which is how the bench sustains 1k+ simulated clients
+    mux = tenants is not None or clients > 32
     with Server(session=session) as srv:
         stop_at[0] = time.monotonic() + duration_s
-        threads = [threading.Thread(target=worker, args=(i,))
-                   for i in range(clients)]
+        if mux:
+            nthreads = min(driver_threads, clients)
+            per = (clients + nthreads - 1) // nthreads
+            threads = []
+            first = 0
+            for i in range(nthreads):
+                n = min(per, clients - first)
+                if n <= 0:
+                    break
+                threads.append(threading.Thread(
+                    target=_mux_driver,
+                    args=(i, n, first, srv.host, srv.port, mix, rows,
+                          tenant_names, stop_at, lat_map, lat_lock,
+                          rejects, errors)))
+                first += n
+        else:
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(clients)]
         t_start = time.monotonic()
         for t in threads:
             t.start()
@@ -214,23 +377,24 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         disp = session.stmt_log
         dsnap = getattr(session, "_dispatcher", None)
         dstats = dsnap.snapshot() if dsnap is not None else {}
+        tsnap = srv.tenancy.snapshot() if srv.tenancy is not None else {}
+        fidx = srv.tenancy.fairness_index() \
+            if srv.tenancy is not None else 1.0
     if chaos > 0:
         FI.reset_fault("tile_device_lost")
         FI.reset_fault("exec_device_lost")
     if errors:
         raise RuntimeError(f"bench clients failed: {errors[:3]}")
-    lats.sort()
+    if not mux:
+        lat_map[None] = lats
+    all_lats = sorted(x for ls in lat_map.values() for x in ls)
 
-    def pct(p: float) -> float:
-        if not lats:
-            return 0.0
-        return lats[min(len(lats) - 1, int(p * len(lats)))] * 1000
-
-    return {
+    out = {
         "mode": mode, "mix": mix, "clients": clients,
-        "duration_s": round(wall, 2), "requests": len(lats),
-        "qps": round(len(lats) / max(wall, 1e-9), 1),
-        "p50_ms": round(pct(0.50), 3), "p99_ms": round(pct(0.99), 3),
+        "duration_s": round(wall, 2), "requests": len(all_lats),
+        "qps": round(len(all_lats) / max(wall, 1e-9), 1),
+        "p50_ms": round(_pct(all_lats, 0.50), 3),
+        "p99_ms": round(_pct(all_lats, 0.99), 3),
         "compiles": disp.counter("compiles") - c_before,
         "dispatches": disp.counter("dispatches") - d_before,
         "batches": dstats.get("batches", 0),
@@ -242,7 +406,32 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         "recovery_count": disp.counter("recoveries") - r_before,
         "tiles_replayed": disp.counter("tiles_replayed") - tr_before,
         "recovery_ms": disp.counter("recovery_wall_ms") - rw_before,
+        "tenant": "all",
+        "tenant_qps": round(len(all_lats) / max(wall, 1e-9), 1),
+        "tenant_p50_ms": round(_pct(all_lats, 0.50), 3),
+        "tenant_p99_ms": round(_pct(all_lats, 0.99), 3),
+        "tenant_queue_depth": dstats.get("max_depth", 0),
+        "fairness_index": round(fidx, 4),
+        # non-CSV extras for programmatic callers
+        "_backpressure": rejects[0],
     }
+    if tenant_names:
+        # one CSV row per tenant, riding the aggregate's shared columns
+        trs = []
+        for name in tenant_names:
+            tl = sorted(lat_map.get(name, []))
+            tr = dict(out)
+            tr.update({
+                "tenant": name,
+                "tenant_qps": round(len(tl) / max(wall, 1e-9), 1),
+                "tenant_p50_ms": round(_pct(tl, 0.50), 3),
+                "tenant_p99_ms": round(_pct(tl, 0.99), 3),
+                "tenant_queue_depth": tsnap.get(name, {}).get(
+                    "max_depth", 0),
+            })
+            trs.append(tr)
+        out["_tenants"] = trs
+    return out
 
 
 def csv_row(r: dict) -> str:
@@ -269,26 +458,62 @@ def main(argv=None) -> list[dict]:
                     help="per-hit device-loss probability armed on the "
                          "dispatch/tile seams (recovery workload; pair "
                          "with --mix spill)")
+    ap.add_argument("--tenants", default=None,
+                    help="tenant spec 'name:weight[:conc[:queue]],...' "
+                         "— enables per-tenant fair scheduling and the "
+                         "per-tenant CSV rows (e.g. gold:3,silver:1)")
+    ap.add_argument("--server-core", default="async",
+                    choices=["async", "threaded"],
+                    help="serving transport: the event-loop front end "
+                         "(default) or legacy thread-per-connection")
+    ap.add_argument("--driver-threads", type=int, default=16,
+                    help="selector driver threads multiplexing the "
+                         "simulated clients (large --clients runs)")
+    ap.add_argument("--aging-s", type=float, default=None,
+                    help="tenancy starvation bound override (waits past "
+                         "it are served oldest-first, trading weight "
+                         "proportionality for bounded p99)")
     ap.add_argument("--csv", default=None,
                     help="append CSV rows to this file")
     args = ap.parse_args(argv)
 
+    if args.clients > 256:
+        # 1k+ simulated clients need 2x that many fds in ONE process
+        # (both socket ends live here); lift the soft limit to the hard
+        try:
+            import resource as _res
+
+            soft, hard = _res.getrlimit(_res.RLIMIT_NOFILE)
+            want = min(hard, max(soft, args.clients * 4 + 256))
+            if want > soft:
+                _res.setrlimit(_res.RLIMIT_NOFILE, (want, hard))
+        except (ImportError, ValueError, OSError):
+            pass
+    tenants = parse_tenantspec(args.tenants, args.clients) \
+        if args.tenants else None
     modes = ["direct", "batched"] if args.mode == "both" else [args.mode]
     out = []
+    rows_out = []
     print(CSV_HEADER)
     for mode in modes:
         r = run_mode(mode, args.mix, args.clients, args.duration,
                      args.rows, args.tick_s, args.max_batch,
                      cancel_mix=args.cancel_mix,
-                     deadline_s=args.deadline_s, chaos=args.chaos)
+                     deadline_s=args.deadline_s, chaos=args.chaos,
+                     tenants=tenants, server_core=args.server_core,
+                     driver_threads=args.driver_threads,
+                     aging_s=args.aging_s)
         out.append(r)
-        print(csv_row(r), flush=True)
+        rows_out.append(r)
+        rows_out.extend(r.get("_tenants", ()))
+        for rr in [r] + list(r.get("_tenants", ())):
+            print(csv_row(rr), flush=True)
     if args.csv:
         new = not os.path.exists(args.csv)
         with open(args.csv, "a") as fh:
             if new:
                 fh.write(CSV_HEADER + "\n")
-            for r in out:
+            for r in rows_out:
                 fh.write(csv_row(r) + "\n")
     if len(out) == 2:
         base, batched = out[0]["qps"], out[1]["qps"]
