@@ -203,6 +203,29 @@ def join_filter_context(session, qnames, nseg: int = 8) -> dict:
     return out
 
 
+def lint_context() -> dict:
+    """The static-analysis record next to the perf ones: graftlint's
+    verdict on the CURRENT tree (rule counts, suppression count, files)
+    so invariant drift — a new finding, a creeping suppression pile —
+    is visible in the bench trajectory. Purely static: runs identically
+    on live and replay rounds, never touches a device."""
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "lint_gate", os.path.join(REPO, "tools", "lint_gate.py"))
+        gate = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(gate)
+        # ONE record shape, owned by tools/lint_gate.py — the CI gate
+        # and the bench trajectory must never drift apart
+        rec = gate.gate_record()
+        rec["findings"] = len(rec["findings"])
+        rec.pop("suppression_sites", None)
+        return rec
+    except Exception as e:  # the bench must never die on its metadata
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
 def recovery_context(session) -> dict:
     """The robustness record next to the lifecycle/join-path ones: the
     mid-statement recovery configuration (exec/recovery.py) and what
@@ -358,6 +381,7 @@ def replay_last_good(reason: str) -> None:
             "compile_cache": lg.get("compile_cache"),
             "join_filter": lg.get("join_filter"),
             "recovery": lg.get("recovery"),
+            "lint": lint_context(),
         })
     except Exception:
         emit({
@@ -367,6 +391,7 @@ def replay_last_good(reason: str) -> None:
             "vs_baseline": 0.0,
             "roofline": roofline_context(
                 bench_queries(), float(os.environ.get("BENCH_SF", "1.0"))),
+            "lint": lint_context(),
         })
 
 
@@ -568,6 +593,7 @@ def measure() -> None:
         "compile_cache": compile_cache,
         "join_filter": join_filter,
         "recovery": recovery,
+        "lint": lint_context(),
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })

@@ -369,6 +369,7 @@ def _walk_spine(top: N.PlanNode, session):
     builds: list[N.PlanNode] = []
     seen: set[int] = set()
     cur = top
+    # graftlint: ignore[seam-loop] bounded plan-tree descent (one step per node; catalog lookups only) — terminates with the tree, never a tile/retry loop
     while True:
         if isinstance(cur, (N.PFilter, N.PProject)):
             spine.append(cur)

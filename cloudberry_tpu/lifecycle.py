@@ -80,11 +80,22 @@ class BreakerOpen(StatementError):
     retryable = True
 
 
+class ServerBusy(StatementError):
+    """The accept-path connection cap refused the connection (one
+    SERVER_BUSY line, then close) — pure load shedding, retry after
+    backoff. The server writes this refusal as a dict literal at accept
+    time (no exception crosses the wire), but the class must EXIST so
+    the by-name contract round-trips: the client retries the etype
+    ``ServerBusy`` because this name is in the taxonomy, and graftlint's
+    tax-name-unknown rule holds the registry to names that resolve."""
+
+    retryable = True
+
+
 # errors raised OUTSIDE this module that belong to the retryable side:
-# the dispatcher's backpressure/deadline pair (sched/dispatcher.py), the
-# per-tenant admission refusal (exec/resource.py TenantQueueFull), and
-# the accept-path connection cap (serve SERVER_BUSY) are about load and
-# WHEN the statement ran, not about the statement itself
+# the dispatcher's backpressure/deadline pair (sched/dispatcher.py) and
+# the per-tenant admission refusal (exec/resource.py TenantQueueFull)
+# are about load and WHEN the statement ran, not the statement itself
 _RETRYABLE_NAMES = frozenset({
     "StatementTimeout", "ServerDraining", "BreakerOpen",
     "SchedQueueFull", "SchedDeadline",

@@ -1,0 +1,168 @@
+"""graftlint configuration — scope, known-concurrent classes, lock order.
+
+Everything project-specific the passes need lives here so the analyzer
+core stays generic: which files are in scope, which attribute names map
+to which concurrent classes (the cross-class acquisition edges the AST
+cannot type), which modules are kernel/tile scope, and the DECLARED lock
+order the runtime witness asserts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# directories never scanned (relative path components)
+EXCLUDE_DIRS = frozenset({
+    "__pycache__", ".git", ".pytest_cache", "tests", "build", "dist",
+})
+
+# files never scanned (relative path suffixes)
+EXCLUDE_FILES = frozenset({
+    "conftest.py",
+})
+
+# classes whose shared mutable attributes the lock pass audits even when
+# the mixed-guard heuristic alone would not select them — the concurrent
+# core's known-shared objects (ISSUE 8 / DESIGN.md "Multi-tenant serving
+# core"). CacheScope is the shared-cache tier's per-scope record (the
+# SharedCacheTier analog).
+CONCURRENT_CLASSES = frozenset({
+    "Dispatcher", "TenantScheduler", "CacheScope", "StatementLog",
+    "RecoveryStore", "CircuitBreaker", "CancelToken", "Watchdog",
+    "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
+})
+
+# attribute-name → class-name hints for cross-class lock edges: when a
+# method calls ``self.<attr>.m()`` while holding a lock, the pass needs
+# the attribute's class to know which locks ``m`` acquires. Python has
+# no static types here; these are the project's stable wiring names.
+ATTR_CLASS_HINTS = {
+    "tenancy": "TenantScheduler",
+    "stmt_log": "StatementLog",
+    "_breaker": "CircuitBreaker",
+    "_recovery": "RecoveryStore",
+    "_gate": "AdmissionGate",
+    "_vmem": "VmemTracker",
+    "_queues_mgr": "QueueManager",
+    "dispatcher": "Dispatcher",
+    "_dispatcher": "Dispatcher",
+    "watchdog": "Watchdog",
+    "_rw": "_RWLock",
+    "loop": "_IOLoop",
+    "conn": "_Conn",
+    "token": "CancelToken",
+    "_cache_scope": "CacheScope",
+    "scope": "CacheScope",
+    "session": "Session",
+    "sess": "Session",
+    "_sched": "TenantScheduler",
+}
+
+# modules (repo-relative path suffixes) whose jitted / kernel functions
+# the trace-purity pass audits
+KERNEL_MODULES = (
+    "exec/kernels.py",
+    "exec/pallas_kernels.py",
+    "exec/expr_compile.py",
+    "exec/executor.py",
+    "exec/dist_executor.py",
+    "exec/tiled.py",
+    "exec/tiled_dist.py",
+    "exec/instrument.py",
+)
+
+# functions in kernel scope whose name contains one of these substrings
+# implement the int64/DECIMAL limb convention itself — the one place f32
+# accumulation of integer limbs is the POINT, not a bug
+LIMB_FUNC_MARKERS = ("limb", "decimal")
+
+# modules whose unbounded tile/retry loops must contain a cancel seam
+SEAM_LOOP_MODULES = (
+    "exec/tiled.py",
+    "exec/tiled_dist.py",
+    "exec/recovery.py",
+)
+
+# calls that count as a cancellation seam inside a loop body
+CANCEL_SEAM_CALLS = frozenset({
+    "check_cancel", "raise_if_cancelled", "_raise_tile_checks", "check",
+})
+
+# modules whose wire-response dict literals the taxonomy pass audits
+WIRE_MODULES = (
+    "serve/server.py",
+    "serve/asyncore.py",
+    "serve/mcp.py",
+)
+
+# where the taxonomy of record lives
+TAXONOMY_MODULE = "lifecycle.py"
+RETRYABLE_NAMES_CONST = "_RETRYABLE_NAMES"
+
+# where the seam inventory of record lives
+FAULTINJECT_MODULE = "utils/faultinject.py"
+INVENTORY_CONST = "INVENTORY"
+
+# ---------------------------------------------------------------- witness
+
+# The DECLARED lock acquisition order (coarse ranks; acquiring a lock of
+# rank <= a held lock's rank, other than re-entering the same object, is
+# a violation the runtime witness records). Derived from the static
+# acquisition graph (`python -m cloudberry_tpu.lint --dot`) — update BOTH
+# when the order legitimately changes, and keep DESIGN.md's section in
+# sync. Locks not named here are unwitnessed.
+WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
+    # rank 0 — serving front end (outermost)
+    ("Server._inflight_cond", "Server._conn_lock", "Server._login_lock",
+     "_RWLock._cond", "_Conn.lock", "_IOLoop._tlock"),
+    # rank 1 — scheduling tier + session cache sync
+    ("Dispatcher._cond", "Session._sync_lock"),
+    # rank 2 — tenancy / breaker / cache-tier locks (Dispatcher._cond
+    # and Session._sync_lock callers nest into these)
+    ("TenantScheduler._lock", "CircuitBreaker._lock",
+     "CacheScope.generic_lock", "CacheScope.rung_lock",
+     "CacheScope.joinindex_lock", "RecoveryStore._lock",
+     "AdmissionGate._lock", "VmemTracker._cond", "QueueManager._cond",
+     "Session._stmt_lock"),
+    # rank 3 — accounting taken while cache locks are held (the
+    # compile-counter bump inside a generic-plan build holds
+    # generic_lock → StatementLog._lock; plan-local rung growth nests
+    # under the session rung lock)
+    ("StatementLog._lock", "GenericPlan._rung_lock"),
+    # rank 4 — innermost leaves (never call out while held)
+    ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock"),
+)
+
+
+def witness_ranks() -> dict[str, int]:
+    return {name: rank
+            for rank, tier in enumerate(WITNESS_ORDER)
+            for name in tier}
+
+
+@dataclass
+class LintConfig:
+    """One run's scope + knobs (tests override paths/excludes to point
+    the analyzer at fixture trees)."""
+
+    exclude_dirs: frozenset = EXCLUDE_DIRS
+    exclude_files: frozenset = EXCLUDE_FILES
+    concurrent_classes: frozenset = CONCURRENT_CLASSES
+    attr_class_hints: dict = field(
+        default_factory=lambda: dict(ATTR_CLASS_HINTS))
+    kernel_modules: tuple = KERNEL_MODULES
+    limb_func_markers: tuple = LIMB_FUNC_MARKERS
+    seam_loop_modules: tuple = SEAM_LOOP_MODULES
+    cancel_seam_calls: frozenset = CANCEL_SEAM_CALLS
+    wire_modules: tuple = WIRE_MODULES
+    taxonomy_module: str = TAXONOMY_MODULE
+    faultinject_module: str = FAULTINJECT_MODULE
+    # seam names armed only from tests/tools (not declared at an engine
+    # call site) that the inventory still documents
+    inventory_extra_ok: frozenset = frozenset()
+
+    def in_scope(self, relpath: str) -> bool:
+        parts = relpath.replace("\\", "/").split("/")
+        if any(p in self.exclude_dirs for p in parts[:-1]):
+            return False
+        return parts[-1] not in self.exclude_files

@@ -1,0 +1,2 @@
+"""graftlint passes — one module per rule family (locks, tracepurity,
+taxonomy, seams). Each exposes ``run(modules, cfg, ...) -> [Finding]``."""

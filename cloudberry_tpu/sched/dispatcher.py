@@ -123,7 +123,10 @@ class Dispatcher:
 
     def start(self) -> "Dispatcher":
         if self._thread is None:
-            self._stop = False
+            with self._cond:
+                # published under the lock: a submitter blocked on a
+                # stopped queue must never miss the restart flip
+                self._stop = False
             self._thread = threading.Thread(target=self._loop,
                                             daemon=True,
                                             name="cbtpu-dispatcher")
@@ -153,6 +156,13 @@ class Dispatcher:
                 r.finish(error=ServerDraining(
                     "dispatcher stopped while this request was queued; "
                     "retry against the serving primary"))
+
+    def _bump(self, name: str, n=1) -> None:
+        """Worker-side stats updates take the lock too: handler threads
+        bump enqueued/rejected under _cond, and snapshot() copies under
+        it — a bare += here would be a racy read-modify-write."""
+        with self._cond:
+            self.stats[name] += n
 
     def queue_depth(self) -> int:
         with self._cond:
@@ -351,7 +361,7 @@ class Dispatcher:
             now = time.monotonic()
             for r in group:
                 if now > r.deadline:
-                    self.stats["expired"] += 1
+                    self._bump("expired")
                     r.finish(error=SchedDeadline(
                         "deadline expired before dispatch"))
                 else:
@@ -404,7 +414,7 @@ class Dispatcher:
                     except lifecycle.StatementError as e:
                         err = e
                     if err is not None:
-                        self.stats["cancelled"] += 1
+                        self._bump("cancelled")
                         log.finish(sid, "error",
                                    error=f"{type(err).__name__}: {err}")
                         r.finish(error=err)
@@ -425,10 +435,11 @@ class Dispatcher:
                     r.finish(error=e)
                 return
             if out is not None:
-                self.stats["batches"] += 1
-                self.stats["batched_requests"] += len(group)
-                self.stats["occupancy_sum"] += \
-                    len(group) / paramplan._next_pow2(len(group))
+                with self._cond:
+                    self.stats["batches"] += 1
+                    self.stats["batched_requests"] += len(group)
+                    self.stats["occupancy_sum"] += \
+                        len(group) / paramplan._next_pow2(len(group))
                 # a flush that built a generic plan or a new rung DID
                 # compile — attribute the delta to the batch head so the
                 # per-statement compiles= field never under-reports
@@ -440,7 +451,7 @@ class Dispatcher:
                                compiles=compiled if i == 0 else 0)
                     r.finish(result=batch)
                 return
-            self.stats["seq_fallbacks"] += 1
+            self._bump("seq_fallbacks")
             for sid in sids:
                 log.finish(sid, "requeued")  # re-logged by session.sql
         self._run_sequential(group)
@@ -449,11 +460,11 @@ class Dispatcher:
         """Ordinary dispatch, one statement at a time."""
         for r in group:
             if time.monotonic() > r.deadline:
-                self.stats["expired"] += 1
+                self._bump("expired")
                 r.finish(error=SchedDeadline(
                     "deadline expired before dispatch"))
                 continue
-            self.stats["singles"] += 1
+            self._bump("singles")
             try:
                 with self._exec_scope():
                     # the request's deadline governs EXECUTION too (the

@@ -235,7 +235,10 @@ class Server:
             self._conn_count -= 1
 
     def _busy_resp(self) -> dict:
-        return {"ok": False, "etype": "ServerBusy", "retryable": True,
+        from cloudberry_tpu.lifecycle import ServerBusy
+
+        return {"ok": False, "etype": ServerBusy.__name__,
+                "retryable": True,
                 "fatal": True,
                 "error": f"SERVER_BUSY: connection limit "
                          f"({self.max_connections}) reached; retry "
@@ -315,7 +318,7 @@ class Server:
         with self._login_lock:
             fails, until = self._login_failures.get(addr, [0, 0.0])
             if time.monotonic() < until:
-                return ({"ok": False, "fatal": True,
+                return ({"ok": False, "fatal": True, "retryable": False,
                          "error": "too many failed logins; address locked "
                                   f"for {self.lockout_s:.0f}s"}, False)
         import hmac
@@ -336,7 +339,8 @@ class Server:
             self._login_failures[addr] = [fails, until]
         msg = ("authentication required: send {\"auth\": \"<token>\"} first"
                if "auth" not in req else "authentication failed")
-        return ({"ok": False, "fatal": True, "error": msg}, False)
+        return ({"ok": False, "fatal": True, "retryable": False,
+                 "error": msg}, False)
 
     @staticmethod
     def _parameterizable(sql: str) -> bool:
@@ -512,6 +516,7 @@ class Server:
                     return {"ok": True, "jobs": self.cron.status()}
                 if self.read_only:
                     return {"ok": False, "etype": "ReadOnlyError",
+                            "retryable": False,
                             "error": "read-only standby: the primary "
                                      "owns the cron schedule"}
                 if op == "schedule":
@@ -523,17 +528,17 @@ class Server:
                     self.cron.unschedule(c.get("name", ""))
                     return {"ok": True,
                             "status": f"UNSCHEDULE {c['name']}"}
-                return {"ok": False,
+                return {"ok": False, "retryable": False,
                         "error": f"unknown cron op {op!r}"}
             except (CronError, ValueError) as e:
                 return {"ok": False, "etype": type(e).__name__,
-                        "error": str(e)}
+                        "retryable": False, "error": str(e)}
         if "retrieve" in req:
             # retrieve-mode request (cdbendpointretrieve.c analog): drain
             # one endpoint of a parallel cursor; token REQUIRED on the wire
             r = req["retrieve"]
             if not isinstance(r, dict) or "token" not in r:
-                return {"ok": False,
+                return {"ok": False, "retryable": False,
                         "error": "retrieve needs cursor/segment/token"}
             with self._locked():
                 out = sess.retrieve(
@@ -544,7 +549,8 @@ class Server:
             return {"ok": True, **out}
         sql = req.get("sql")
         if not isinstance(sql, str):
-            return {"ok": False, "error": "request must carry a 'sql' string"}
+            return {"ok": False, "retryable": False,
+                    "error": "request must carry a 'sql' string"}
         # per-request deadline: every dispatch path converts it to the
         # session's monotonic deadline, so it governs execution (cancel
         # seams, watchdog), not just the dispatcher queue
@@ -557,6 +563,7 @@ class Server:
             # hot standby: reads only; the store's epoch sync delivers the
             # primary's commits, nothing here may produce one
             return {"ok": False, "etype": "ReadOnlyError",
+                    "retryable": False,
                     "error": "read-only standby: route writes to the "
                              "primary server"}
         tenant = req.get("tenant")
@@ -601,7 +608,7 @@ class Server:
             # all connections share ONE session: a wire-level BEGIN would
             # absorb other clients' autocommit writes into its rollback
             # scope — refuse rather than silently break their durability
-            return {"ok": False, "error":
+            return {"ok": False, "retryable": False, "error":
                     "transactions over the wire need a durable store "
                     "(connections share one session); start the server "
                     "with config.storage.root set, or use the in-process "

@@ -981,6 +981,7 @@ class Session:
                     buf[s, :n] = sorted_arr[starts[s]:starts[s] + n]
                 cols[cname] = buf
             st = ShardedTable(cols, counts, cap, False, version)
+        # graftlint: ignore[lock-unguarded] deliberate lock-free publish: key embeds nseg, entry is version-checked on read, and concurrent writers produce identical values (last-writer-wins is idempotent)
         self._shard_cache[key] = st
         return st
 
@@ -1009,6 +1010,7 @@ class Session:
                 else _assign
             counts = np.bincount(assign, minlength=nseg).astype(np.int64)\
                 if len(assign) else np.zeros(nseg, dtype=np.int64)
+        # graftlint: ignore[lock-unguarded] deliberate lock-free publish: version rides the value and all writers derive identical counts — a race only repeats work
         self._shard_count_cache[key] = (version, counts)
         return counts
 

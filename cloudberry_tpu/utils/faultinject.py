@@ -45,6 +45,34 @@ class _Arm:
     rng: random.Random = None  # type: ignore[assignment]
 
 
+# The seam contract of record: every fault_point() call site in the
+# engine, by name. Chaos soaks and tests arm seams from this list;
+# graftlint's seam pass (lint/passes/seams.py) fails the build when a
+# call site is missing here (seam-unknown) or an entry here has no call
+# site left (seam-stale) — a renamed seam must never silently drop out
+# of soak coverage. Tests may declare ad-hoc seams of their own; those
+# live in the tests, not in this inventory.
+INVENTORY = frozenset({
+    # planner/session dispatch
+    "admission_check", "dispatch_start", "dist_execute_start",
+    # storage / OCC
+    "copy_from", "occ_commit_window", "storage_commit_before_current",
+    "store_lock_acquire", "store_read_partition", "sync_store",
+    # DML
+    "dml_delete", "dml_insert_select", "dml_update",
+    # serving / endpoints
+    "serve_handler", "endpoint_drain", "fdist_get",
+    # matviews
+    "matview_maintain", "matview_refresh",
+    # scheduler (sched/dispatcher.py)
+    "sched_enqueue", "sched_coalesce", "sched_flush",
+    # tiled execution + recovery
+    "tile_step", "tile_step_dist", "tiled_finalize",
+    "ckpt_save", "ckpt_resume", "tile_device_lost",
+    # mesh health
+    "exec_device_lost", "probe_degraded",
+})
+
 _registry: dict[str, _Arm] = {}
 _seen: set[str] = set()
 _lock = threading.Lock()

@@ -14,6 +14,16 @@ from cloudberry_tpu.config import Config
 from cloudberry_tpu.serve import Client, Server, ServerError
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_witness():
+    # runtime lock-order witness (lint/witness.py): the event-loop
+    # front end + tenancy scheduler run under declared-order checking
+    from cloudberry_tpu.lint import witness
+
+    with witness.watching():
+        yield
+
+
 def _session(**over):
     s = cb.Session(Config().with_overrides(**over) if over else Config())
     s.sql("create table t (a bigint, b bigint) distributed by (a)")

@@ -30,6 +30,17 @@ def _clean_faults():
     FI.reset_fault()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_witness():
+    # runtime lock-order witness (lint/witness.py): every lock this
+    # suite's servers/sessions create is order-checked against the
+    # declared ranks; a violation anywhere in the module fails here
+    from cloudberry_tpu.lint import witness
+
+    with witness.watching():
+        yield
+
+
 def _mk(**ov):
     over = {"n_segments": 1}
     over.update(ov)

@@ -1,0 +1,713 @@
+"""graftlint seeded-bug fixtures: each pass must CATCH its target class
+with the right rule id and file:line, suppressions must silence exactly
+their site, and syntax-error inputs must become findings, not crashes.
+
+The fixtures are written into tmp trees shaped like the package (the
+rule scoping keys off module path suffixes), then linted with a config
+whose excludes do not skip them.
+"""
+
+import textwrap
+
+import pytest
+
+from cloudberry_tpu.lint import run_lint
+from cloudberry_tpu.lint.config import LintConfig
+
+
+def _lint_tree(tmp_path, files: dict):
+    """Write {relpath: source} under tmp_path and lint the tree."""
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint([str(root)], LintConfig(exclude_files=frozenset()))
+
+
+def _by_rule(result, rule):
+    return [f for f in result.unsuppressed if f.rule == rule]
+
+
+# ------------------------------------------------------------ lock pass
+
+
+LOCK_CYCLE_SRC = """
+    import threading
+
+
+    class Exchange:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def forward(self):
+            with self._a:
+                with self._b:
+                    return 1
+
+        def backward(self):
+            with self._b:
+                with self._a:
+                    return 2
+"""
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    result = _lint_tree(tmp_path, {"exchange.py": LOCK_CYCLE_SRC})
+    hits = _by_rule(result, "lock-order")
+    assert hits, [f.render() for f in result.findings]
+    assert hits[0].file.endswith("exchange.py")
+    # the cycle names both locks and anchors at a real acquisition line
+    assert "Exchange._a" in hits[0].message
+    assert "Exchange._b" in hits[0].message
+    assert hits[0].line in (12, 13, 17, 18)
+
+
+def test_lock_cycle_through_cross_class_call(tmp_path):
+    """The graph must see acquisitions made INSIDE a call performed
+    while a lock is held (the AST-invisible half is the witness's job;
+    the call-visible half is this pass's)."""
+    src = """
+    import threading
+
+
+    class StatementLog:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bump(self):
+            with self._lock:
+                return 1
+
+
+    class Dispatcher:
+        def __init__(self, stmt_log):
+            self._cond = threading.Condition()
+            self.stmt_log = stmt_log
+
+        def tick(self):
+            with self._cond:
+                self.stmt_log.bump()
+    """
+    result = _lint_tree(tmp_path, {"sched.py": src})
+    assert not _by_rule(result, "lock-order")  # acyclic is clean
+    # now close the cycle: the log calls back into the dispatcher
+    # while holding its own lock
+    src2 = src.replace(
+        """
+        def bump(self):
+            with self._lock:
+                return 1
+""",
+        """
+        def bump(self):
+            with self._lock:
+                self.dispatcher.tick()
+""")
+    result2 = _lint_tree(tmp_path, {"sched.py": src2})
+    hits = _by_rule(result2, "lock-order")
+    assert hits, [f.render() for f in result2.findings]
+    assert "Dispatcher._cond" in hits[0].message
+    assert "StatementLog._lock" in hits[0].message
+
+
+def test_unguarded_mixed_write_detected(tmp_path):
+    src = """
+    import threading
+
+
+    class Dispatcher:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.stats = {"enqueued": 0, "expired": 0}
+
+        def enqueue(self):
+            with self._cond:
+                self.stats["enqueued"] += 1
+
+        def worker_tick(self):
+            self.stats["expired"] += 1
+    """
+    result = _lint_tree(tmp_path, {"disp.py": src})
+    hits = _by_rule(result, "lock-unguarded")
+    assert len(hits) == 1
+    assert hits[0].line == 15
+    assert "Dispatcher.stats" in hits[0].message
+
+
+def test_self_deadlock_reacquire_detected(tmp_path):
+    src = """
+    import threading
+
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def size(self):
+            with self._lock:
+                return 1
+
+        def snapshot(self):
+            with self._lock:
+                return self.size()
+    """
+    result = _lint_tree(tmp_path, {"store.py": src})
+    hits = _by_rule(result, "lock-held-call")
+    assert hits and hits[0].line == 15  # the re-acquiring call site
+    assert "Store.size" in hits[0].message
+
+
+def test_nested_function_writes_are_audited(tmp_path):
+    """Closures/callbacks are part of the method's body for the lock
+    pass (with a fresh held stack — they run later): a bare write to a
+    mixed-guard attribute inside a nested def is still a finding."""
+    src = """
+    import threading
+
+
+    class FE:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.stats = {"done": 0}
+
+        def locked(self):
+            with self._cond:
+                self.stats["done"] += 1
+
+        def submit(self):
+            def on_done():
+                self.stats["done"] += 1
+            return on_done
+    """
+    result = _lint_tree(tmp_path, {"fe.py": src})
+    hits = _by_rule(result, "lock-unguarded")
+    assert [f.line for f in hits] == [16]  # the write inside on_done
+
+
+def test_annotated_lock_and_stamp_forms_recognized(tmp_path):
+    """`self._lock: threading.Lock = threading.Lock()` is discovered,
+    and `retryable: bool = True` counts as an explicit stamp."""
+    src = """
+    import threading
+
+    _RETRYABLE_NAMES = frozenset({"Typed"})
+
+
+    class StatementError(RuntimeError):
+        retryable = False
+
+
+    class Typed(StatementError):
+        retryable: bool = True
+
+
+    class C:
+        def __init__(self):
+            self._lock: threading.Lock = threading.Lock()
+            self.n = 0
+
+        def locked(self):
+            with self._lock:
+                self.n += 1
+
+        def bare(self):
+            self.n += 1
+    """
+    result = _lint_tree(tmp_path, {"lifecycle.py": src})
+    assert not _by_rule(result, "tax-retryable-missing")
+    assert not _by_rule(result, "tax-retryable-mismatch")
+    hits = _by_rule(result, "lock-unguarded")
+    assert [f.line for f in hits] == [25]  # the annotated lock counted
+
+
+def test_attribute_base_subclass_still_audited(tmp_path):
+    """`class X(lifecycle.StatementError)` cannot dodge the stamp
+    rules by importing the module instead of the class."""
+    src = """
+    _RETRYABLE_NAMES = frozenset({"StatementTimeout"})
+
+
+    class StatementError(RuntimeError):
+        retryable = False
+
+
+    class StatementTimeout(StatementError):
+        retryable = True
+    """
+    other = """
+    from pkg import lifecycle
+
+
+    class NodeGone(lifecycle.StatementError):
+        pass
+    """
+    result = _lint_tree(tmp_path, {"lifecycle.py": src,
+                                   "errs.py": other})
+    hits = _by_rule(result, "tax-retryable-missing")
+    assert len(hits) == 1 and "NodeGone" in hits[0].message
+
+
+def test_suppression_silences_only_its_site(tmp_path):
+    src = """
+    import threading
+
+
+    class Dispatcher:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.stats = {"a": 0, "b": 0}
+
+        def locked_write(self):
+            with self._cond:
+                self.stats["a"] += 1
+
+        def bare_one(self):
+            # graftlint: ignore[lock-unguarded] single-owner worker field
+            self.stats["a"] += 1
+
+        def bare_two(self):
+            self.stats["b"] += 1
+    """
+    result = _lint_tree(tmp_path, {"disp.py": src})
+    hits = _by_rule(result, "lock-unguarded")
+    assert len(hits) == 1 and hits[0].line == 19
+    sup = [f for f in result.suppressed if f.rule == "lock-unguarded"]
+    assert len(sup) == 1 and sup[0].line == 16
+    assert sup[0].justification == "single-owner worker field"
+
+
+def test_bare_suppression_tag_fails_the_gate(tmp_path):
+    """A matching suppression WITHOUT a justification is itself a
+    finding — the CLI/CI gate enforces the policy, not just the test
+    suite (they must never disagree about a tree)."""
+    src = """
+    import threading
+
+
+    class C:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.n = 0
+
+        def locked(self):
+            with self._cond:
+                self.n += 1
+
+        def bare(self):
+            # graftlint: ignore[lock-unguarded]
+            self.n += 1
+    """
+    result = _lint_tree(tmp_path, {"c.py": src})
+    assert not _by_rule(result, "lock-unguarded")  # suppression holds
+    hits = _by_rule(result, "unjustified-suppression")
+    assert len(hits) == 1 and hits[0].line == 15  # the comment's line
+    assert result.unsuppressed  # → CLI exit 1, gate ok:false
+
+
+def test_stale_suppression_is_a_finding(tmp_path):
+    src = """
+    import threading
+
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            # graftlint: ignore[lock-unguarded] was racy once, fixed
+            self.n += 1
+    """
+    result = _lint_tree(tmp_path, {"c.py": src})
+    hits = _by_rule(result, "unused-suppression")
+    assert len(hits) == 1 and hits[0].line == 11  # the comment's line
+    assert "lock-unguarded" in hits[0].message
+
+
+# ---------------------------------------------------------- purity pass
+
+
+def test_tracer_item_detected(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @jax.jit
+    def bad_kernel(x):
+        total = jnp.sum(x)
+        return total.item()
+    """
+    result = _lint_tree(tmp_path, {"exec/kernels.py": src})
+    hits = _by_rule(result, "purity-coerce")
+    assert hits, [f.render() for f in result.findings]
+    assert hits[0].line == 10
+    assert hits[0].file.endswith("exec/kernels.py")
+
+
+def test_host_np_and_tracer_branch_detected(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @jax.jit
+    def bad(x):
+        y = np.cumsum(x)
+        if jnp.any(x > 0):
+            y = y + 1
+        return y
+    """
+    result = _lint_tree(tmp_path, {"exec/kernels.py": src})
+    assert [f.line for f in _by_rule(result, "purity-host-np")] == [9]
+    assert [f.line for f in _by_rule(result, "purity-branch")] == [10]
+
+
+def test_f32_accum_of_int64_detected_and_limb_exempt(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+
+    @jax.jit
+    def sum_money(vals_int64):
+        return jnp.sum(vals_int64.astype(jnp.float32))
+
+
+    @jax.jit
+    def sum_money_limbs(vals_int64):
+        return jnp.sum(vals_int64.astype(jnp.float32))
+    """
+    result = _lint_tree(tmp_path, {"exec/kernels.py": src})
+    hits = _by_rule(result, "purity-f32-accum")
+    assert [f.line for f in hits] == [8]  # the limb variant is exempt
+
+
+def test_host_function_in_kernel_module_not_flagged(tmp_path):
+    """np.* in a plain host helper (the joinindex numpy mirror) is
+    legal — only traced bodies are kernel scope."""
+    src = """
+    import numpy as np
+
+
+    def host_mirror(arr):
+        order = np.argsort(arr)
+        return float(order[0])
+    """
+    result = _lint_tree(tmp_path, {"exec/kernels.py": src})
+    assert not _by_rule(result, "purity-host-np")
+    assert not _by_rule(result, "purity-coerce")
+
+
+# -------------------------------------------------------- taxonomy pass
+
+
+def test_unstamped_wire_error_detected(tmp_path):
+    src = """
+    def refuse(reason):
+        return {"ok": False, "etype": "ValueError",
+                "error": f"refused: {reason}"}
+
+
+    def refuse_stamped(reason):
+        return {"ok": False, "etype": "ValueError", "retryable": False,
+                "error": f"refused: {reason}"}
+    """
+    result = _lint_tree(tmp_path, {"serve/server.py": src})
+    hits = _by_rule(result, "tax-unstamped")
+    assert [f.line for f in hits] == [3]
+
+
+def test_retryable_name_must_exist(tmp_path):
+    src = """
+    _RETRYABLE_NAMES = frozenset({
+        "StatementTimeout", "NoSuchError",
+    })
+
+
+    class StatementError(RuntimeError):
+        retryable = False
+
+
+    class StatementTimeout(StatementError):
+        retryable = True
+    """
+    result = _lint_tree(tmp_path, {"lifecycle.py": src})
+    hits = _by_rule(result, "tax-name-unknown")
+    assert len(hits) == 1
+    assert "NoSuchError" in hits[0].message
+
+
+def test_retryable_stamp_registry_mismatch(tmp_path):
+    src = """
+    _RETRYABLE_NAMES = frozenset({"StatementTimeout"})
+
+
+    class StatementError(RuntimeError):
+        retryable = False
+
+
+    class StatementTimeout(StatementError):
+        retryable = True
+
+
+    class ServerDraining(StatementError):
+        retryable = True  # but NOT in the registry
+
+
+    class Unstamped(StatementError):
+        pass
+    """
+    result = _lint_tree(tmp_path, {"lifecycle.py": src})
+    mism = _by_rule(result, "tax-retryable-mismatch")
+    assert len(mism) == 1 and "ServerDraining" in mism[0].message
+    missing = _by_rule(result, "tax-retryable-missing")
+    assert len(missing) == 1 and "Unstamped" in missing[0].message
+
+
+# ------------------------------------------------------------ seam pass
+
+
+def test_orphan_fault_point_detected(tmp_path):
+    files = {
+        "utils/faultinject.py": """
+            INVENTORY = frozenset({"known_seam", "stale_seam"})
+
+
+            def fault_point(name):
+                return False
+        """,
+        "exec/thing.py": """
+            from pkg.utils.faultinject import fault_point
+
+
+            def step():
+                fault_point("known_seam")
+                fault_point("orphan_seam")
+        """,
+    }
+    result = _lint_tree(tmp_path, files)
+    unknown = _by_rule(result, "seam-unknown")
+    assert len(unknown) == 1
+    assert "orphan_seam" in unknown[0].message
+    assert unknown[0].file.endswith("exec/thing.py")
+    assert unknown[0].line == 7
+    stale = _by_rule(result, "seam-stale")
+    assert len(stale) == 1 and "stale_seam" in stale[0].message
+
+
+def test_unbounded_loop_without_cancel_seam(tmp_path):
+    src = """
+    def run_adaptive(execute, check_cancel):
+        while True:
+            try:
+                return execute()
+            except RuntimeError:
+                continue
+
+
+    def run_adaptive_good(execute, check_cancel):
+        while True:
+            check_cancel()
+            try:
+                return execute()
+            except RuntimeError:
+                continue
+
+
+    def plan_walk(node):
+        out = []
+        while True:
+            if isinstance(node, tuple):
+                out.append(node)
+                node = node[0]
+            else:
+                return out
+
+
+    def busy_spin(flag):
+        while True:
+            if flag[0]:
+                break
+    """
+    result = _lint_tree(tmp_path, {"exec/tiled.py": src})
+    hits = _by_rule(result, "seam-loop")
+    # good loop + pure walk exempt; the call-free spin is NOT a walk
+    assert [f.line for f in hits] == [3, 30]
+
+
+# ------------------------------------------------------- driver behavior
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    result = _lint_tree(tmp_path, {
+        "broken.py": """
+            def f(:
+                return 1
+        """,
+        "fine.py": "X = 1\n",
+    })
+    hits = _by_rule(result, "syntax")
+    assert len(hits) == 1
+    assert hits[0].file.endswith("broken.py")
+    assert hits[0].line >= 1
+
+
+def test_default_scope_excludes_tests_and_pycache(tmp_path):
+    root = tmp_path / "pkg"
+    (root / "tests").mkdir(parents=True)
+    (root / "__pycache__").mkdir()
+    (root / "tests" / "test_x.py").write_text("def f(:\n")
+    (root / "__pycache__" / "junk.py").write_text("def f(:\n")
+    (root / "ok.py").write_text("X = 1\n")
+    result = run_lint([str(root)])  # DEFAULT config
+    assert [m.relpath for m in result.modules] == ["pkg/ok.py"]
+    assert not result.findings
+
+
+def test_cli_exit_codes(tmp_path):
+    from cloudberry_tpu.lint.__main__ import main
+
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    (clean / "a.py").write_text("X = 1\n")
+    assert main([str(clean)]) == 0
+    dirty = tmp_path / "dirty"
+    dirty.mkdir()
+    (dirty / "b.py").write_text("def f(:\n")
+    assert main([str(dirty), "--json"]) == 1
+    assert main([str(tmp_path / "nope")]) == 2
+
+
+# ---------------------------------------------------------- the witness
+
+
+def test_witness_fires_on_constructed_violation():
+    """A reversed acquisition of two DECLARED locks is recorded with
+    the offending pair; correct order stays silent."""
+    from cloudberry_tpu.exec.instrument import StatementLog
+    from cloudberry_tpu.lifecycle import CancelToken, CircuitBreaker
+    from cloudberry_tpu.lint import witness
+
+    witness.install()
+    try:
+        witness.reset_violations()
+        assert witness.witnessed_site_count() > 0
+        cb = CircuitBreaker()           # rank 2
+        log = StatementLog()            # rank 3
+        tok = CancelToken()             # rank 4
+        with cb._lock:
+            with log._lock:
+                with tok._lock:
+                    pass
+        assert witness.violations() == []
+        with tok._lock:
+            with cb._lock:
+                pass
+        vs = witness.violations()
+        assert len(vs) == 1
+        assert vs[0].acquiring == "CircuitBreaker._lock"
+        assert vs[0].holding[-1][0] == "CancelToken._lock"
+        # cascade visibility: with the stack already non-monotonic,
+        # a further same-rank acquisition is STILL recorded (the check
+        # compares against every held lock, not just the top)
+        witness.reset_violations()
+        log2 = StatementLog()           # rank 3
+        with tok._lock:                 # r4
+            with log._lock:             # r3 — violation 1
+                with log2._lock:        # r3 vs held r4/r3 — violation 2
+                    pass
+        assert len(witness.violations()) == 2
+    finally:
+        witness.uninstall()
+        witness.reset_violations()
+
+
+def test_witness_condition_wait_reacquire_is_clean():
+    """Condition.wait releases and re-acquires through the proxy: no
+    phantom violations, and the held stack stays balanced."""
+    import threading as _t
+
+    from cloudberry_tpu.lint import witness
+    from cloudberry_tpu.sched.tenancy import TenantScheduler
+
+    witness.install()
+    try:
+        witness.reset_violations()
+        from cloudberry_tpu.config import get_config
+
+        sched = TenantScheduler(get_config().tenancy)
+        done = []
+
+        def consumer():
+            for _ in range(20):
+                got = sched.pick(4)
+                done.extend(got)
+
+        threads = [_t.Thread(target=consumer) for _ in range(2)]
+        for th in threads:
+            th.start()
+        for i in range(10):
+            sched.enqueue("gold", f"item{i}")
+        for th in threads:
+            th.join()
+        assert witness.violations() == []
+    finally:
+        witness.uninstall()
+        witness.reset_violations()
+
+
+def test_witness_wraps_import_time_module_locks():
+    """Module-global locks (faultinject._lock, sharedcache._tier_lock)
+    exist before install() can patch threading — the witness swaps the
+    module attribute in place, so their rank-4 leaf discipline is
+    runtime-enforced too, and uninstall() restores the raw lock."""
+    from cloudberry_tpu.lint import witness
+    from cloudberry_tpu.lint.witness import WitnessedLock
+    from cloudberry_tpu.utils import faultinject
+
+    witness.install()
+    try:
+        witness.reset_violations()
+        assert isinstance(faultinject._lock, WitnessedLock)
+        # the seam still works through the proxy
+        faultinject.fault_point("lint_witness_probe_seam")
+        assert "lint_witness_probe_seam" in faultinject.known_fault_points()
+        # holding the leaf lock while taking a higher-tier lock fires
+        from cloudberry_tpu.lifecycle import CircuitBreaker
+
+        cb = CircuitBreaker()
+        with faultinject._lock:
+            with cb._lock:
+                pass
+        assert any(v.acquiring == "CircuitBreaker._lock"
+                   for v in witness.violations())
+    finally:
+        witness.uninstall()
+        witness.reset_violations()
+    assert not isinstance(faultinject._lock, WitnessedLock)
+
+
+def test_witness_rlock_reentry_allowed():
+    import _thread
+
+    from cloudberry_tpu.lint import witness
+
+    witness.install()
+    try:
+        witness.reset_violations()
+        # an RLock created at a declared site; re-entry must not trip
+        from cloudberry_tpu.lint.witness import WitnessedLock
+
+        wl = WitnessedLock(_thread.RLock(), "X", 2, reentrant=True)
+        with wl:
+            with wl:
+                pass
+        assert witness.violations() == []
+    finally:
+        witness.uninstall()
+        witness.reset_violations()

@@ -5,9 +5,20 @@ thread-stress on the shared LRUs (ISSUE-7 satellite)."""
 import threading
 
 import numpy as np
+import pytest
 
 import cloudberry_tpu as cb
 from cloudberry_tpu.config import Config
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_witness():
+    # runtime lock-order witness (lint/witness.py): the shared-LRU
+    # stress below runs its lock traffic under declared-order checking
+    from cloudberry_tpu.lint import witness
+
+    with witness.watching():
+        yield
 
 
 def _store_cfg(tmp_path):

@@ -1,0 +1,134 @@
+"""Wire-error taxonomy round-trip — every class, both transports.
+
+Raises each wire-error class through a serving stack on BOTH transports
+(event-loop asyncore default + threaded fallback) and asserts:
+
+- the response carries the ``retryable`` stamp and it equals
+  ``lifecycle.is_retryable``'s verdict (one classifier, both sides);
+- ``etype`` round-trips the class NAME (the client's by-name channel);
+- a ``retry_reads`` client actually retries exactly the retryable
+  verdicts (transient failure → success) and gives up immediately on
+  semantic ones;
+- the runtime registry matches the STATIC model graftlint's taxonomy
+  pass extracts from lifecycle.py — the lint gate and the live server
+  can never disagree about what is retryable.
+"""
+
+import ast
+import os
+
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu import lifecycle
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.exec.resource import TenantQueueFull
+from cloudberry_tpu.sched.dispatcher import SchedDeadline, SchedQueueFull
+from cloudberry_tpu.serve import Client, Server, ServerError
+
+WIRE_ERRORS = [
+    # (class, expected retryable)
+    (lifecycle.StatementTimeout, True),
+    (lifecycle.ServerDraining, True),
+    (lifecycle.BreakerOpen, True),
+    (lifecycle.ServerBusy, True),
+    (lifecycle.StatementCancelled, False),
+    (SchedQueueFull, True),
+    (SchedDeadline, True),
+    (TenantQueueFull, True),
+    (ValueError, False),          # ordinary semantic failure
+]
+
+
+class _FakeResult:
+    def decoded_columns(self):
+        return {"a": [1]}
+
+
+@pytest.fixture(scope="module", params=["asyncore", "threaded"])
+def wire(request):
+    over = {"serve.threaded": request.param == "threaded"}
+    sess = cb.Session(Config().with_overrides(**over))
+    srv = Server(session=sess).start()
+    yield sess, srv
+    srv.stop()
+
+
+@pytest.mark.parametrize("err_cls,expect_retryable",
+                         WIRE_ERRORS, ids=lambda v: getattr(
+                             v, "__name__", str(v)))
+def test_stamp_and_etype_round_trip(wire, err_cls, expect_retryable):
+    sess, srv = wire
+    orig = sess.sql
+    sess.sql = lambda q, **kw: (_ for _ in ()).throw(
+        err_cls(f"injected {err_cls.__name__}"))
+    try:
+        with Client(srv.host, srv.port) as c:
+            with pytest.raises(ServerError) as ei:
+                c.sql("select a from nowhere")
+        assert ei.value.etype == err_cls.__name__
+        assert ei.value.retryable is expect_retryable
+        # one classifier for both sides: the stamp is exactly
+        # is_retryable — as an instance AND by name
+        assert lifecycle.is_retryable(err_cls("x")) is expect_retryable
+        assert lifecycle.is_retryable(err_cls.__name__) \
+            is expect_retryable
+    finally:
+        sess.sql = orig
+
+
+@pytest.mark.parametrize("err_cls,expect_retryable",
+                         WIRE_ERRORS, ids=lambda v: getattr(
+                             v, "__name__", str(v)))
+def test_client_retry_follows_the_taxonomy(wire, err_cls,
+                                           expect_retryable):
+    """Transient failure (fails once, then succeeds): a retry_reads
+    client recovers exactly when the taxonomy says retry."""
+    sess, srv = wire
+    orig = sess.sql
+    calls = {"n": 0}
+
+    def flaky(q, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise err_cls(f"injected {err_cls.__name__}")
+        return _FakeResult()
+
+    sess.sql = flaky
+    try:
+        with Client(srv.host, srv.port, retry_reads=True,
+                    max_retries=2, backoff_s=0.01) as c:
+            if expect_retryable:
+                out = c.sql("select a from nowhere")
+                assert out["rows"] == [[1]]
+                assert calls["n"] == 2  # failed once, retried once
+            else:
+                with pytest.raises(ServerError) as ei:
+                    c.sql("select a from nowhere")
+                assert ei.value.etype == err_cls.__name__
+                assert calls["n"] == 1  # semantic: no retry
+    finally:
+        sess.sql = orig
+
+
+def test_runtime_registry_matches_lint_static_model():
+    """The set the lint taxonomy pass reads out of lifecycle.py IS the
+    runtime set — the gate's model can never drift from the server's."""
+    from cloudberry_tpu.lint.passes.taxonomy import _str_set_literal
+
+    path = os.path.join(os.path.dirname(os.path.abspath(cb.__file__)),
+                        "lifecycle.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    static = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and getattr(node.targets[0], "id", "") \
+                == "_RETRYABLE_NAMES":
+            static = _str_set_literal(node.value)
+    assert static == set(lifecycle._RETRYABLE_NAMES)
+    # and every expectation this test file pins agrees with it
+    for err_cls, expect in WIRE_ERRORS:
+        if err_cls is ValueError:
+            continue
+        assert (err_cls.__name__ in static) is expect

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""CI gate runner for graftlint — findings as one JSON document.
+
+``python tools/lint_gate.py [paths...]`` runs the analyzer (default: the
+cloudberry_tpu package) and prints a single JSON object:
+
+    {"ok": true|false,
+     "findings": [...unsuppressed, file/line/rule/message...],
+     "rule_counts": {"lock-unguarded": 2, ...},
+     "suppressions": N,
+     "suppression_sites": [{"file", "line", "rule", "justification"}],
+     "files": N}
+
+Exit code mirrors ``python -m cloudberry_tpu.lint``: 0 clean, 1 findings.
+The bench harness embeds the same counts as its "lint" record
+(bench.py lint_context) so rule/suppression drift shows up in the bench
+trajectory next to the perf numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def gate_record(paths=None) -> dict:
+    """The machine-readable gate document (shared with bench.py)."""
+    import cloudberry_tpu
+    from cloudberry_tpu.lint import run_lint
+
+    if not paths:
+        paths = [os.path.dirname(os.path.abspath(
+            cloudberry_tpu.__file__))]
+    result = run_lint(paths)
+    sup = [{"file": f.file, "line": f.line, "rule": f.rule,
+            "justification": f.justification}
+           for f in result.suppressed]
+    return {
+        "ok": not result.unsuppressed,
+        "findings": [f.as_dict() for f in result.unsuppressed],
+        "rule_counts": result.rule_counts(),
+        "suppressions": len(result.suppressed),
+        "suppression_sites": sup,
+        "files": len(result.modules),
+    }
+
+
+def main() -> int:
+    rec = gate_record([p for p in sys.argv[1:] if not p.startswith("-")])
+    print(json.dumps(rec, indent=1))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
