@@ -362,6 +362,30 @@ class HealthConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability plane (cloudberry_tpu/obs/): statement trace spans,
+    the engine-wide metrics registry, and the pg_stat_statements-class
+    aggregate table. ON by default — the budget is <3% on the TPC-H
+    bench (bench.py's "obs" record measures it every run) and every
+    ring/table below is explicitly bounded."""
+
+    # Master switch for the OPTIONAL telemetry (trace spans, stage
+    # histograms, per-skeleton aggregates). The counter registry itself
+    # stays on — engine counters pre-date this subsystem and other
+    # features read them.
+    enabled: bool = True
+    # Keep every Nth statement's span tree (1 = all). Sampling bounds
+    # tracing cost under high QPS without losing the aggregate plane.
+    trace_sample: int = 1
+    # Completed traces retained in the server-wide ring (meta "trace").
+    trace_ring: int = 64
+    # Spans per statement trace; past it spans drop (counted).
+    max_spans: int = 512
+    # Skeleton rows in the pg_stat_statements analog (LRU dealloc).
+    statements_max: int = 256
+
+
+@dataclass(frozen=True)
 class Config:
     n_segments: int = 1
     # Per-statement wall-clock limit in seconds (the statement_timeout
@@ -382,6 +406,7 @@ class Config:
     recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":
         """Return a copy with dotted-path overrides, e.g.

@@ -69,13 +69,18 @@ def prepare_dist_inputs(plan: N.PlanNode, session, names=None):
     return inputs, in_specs
 
 
-def compile_distributed(plan: N.PlanNode, session, param_keys=None):
+def compile_distributed(plan: N.PlanNode, session, param_keys=None,
+                        instrument=False):
     """Build the jitted SPMD program once; reusable across calls (the
     prepared-statement analog — inputs are re-prepared per call from the
     session's sharded-table cache). ``param_keys`` (generic plans,
     sched/paramplan.py) adds a replicated "$params" input: "$prm<slot>"
     scalars every segment reads identically, so literal rebinding never
-    retraces the SPMD program."""
+    retraces the SPMD program. ``instrument=True`` (EXPLAIN ANALYZE's
+    pipeline path) records per-node row counts into the existing
+    replicated stats channel — partitioned-node counts psum across
+    segments, replicated nodes report segment 0's — so the instrumented
+    program is this same entry point's program, not a side path's."""
     from cloudberry_tpu.parallel.transport import make_transport
 
     nseg = session.config.n_segments
@@ -88,9 +93,10 @@ def compile_distributed(plan: N.PlanNode, session, param_keys=None):
     if param_keys:
         in_specs["$params"] = {k: P() for k in param_keys}
     X.count_compile(session)
+    lowerer_cls = _InstrumentedDistLowerer if instrument else DistLowerer
 
     def seg_fn(tables):
-        low = DistLowerer(tables, nseg, tx=tx, packed=packed,
+        low = lowerer_cls(tables, nseg, tx=tx, packed=packed,
                           params=tables.get("$params"))
         cols, sel = low.lower(plan)
         out = {f.name: cols[f.name][None] for f in plan.fields}
@@ -164,7 +170,11 @@ def execute_distributed(plan: N.PlanNode, session,
         fn = compile_distributed(plan, session)
     inputs, _ = prepare_dist_inputs(plan, session)
     fault_point("dist_execute_start")
-    cols, sel, checks, stats = fn(inputs)
+    from cloudberry_tpu.obs import trace as OT
+
+    with OT.span("launch", mode="dist"), \
+            OT.device_annotation("launch-dist"):
+        cols, sel, checks, stats = fn(inputs)
     record_motion_stats(plan, stats)
     X.raise_checks(checks)
     record_jf_counters(stats, getattr(session, "stmt_log", None))
@@ -423,3 +433,47 @@ class DistLowerer(X.Lowerer):
         recv_sel = self.tx.all_to_all(selbuf.reshape(nseg, B),
                                       SEG_AXIS)
         return out, recv_sel.reshape(nseg * B)
+
+
+class _InstrumentedDistLowerer(DistLowerer):
+    """EXPLAIN ANALYZE's per-node row counts over the SAME distributed
+    lowering (instrument.py run_pipeline): each node's selected-row
+    count rides the existing replicated stats channel — the global sum
+    for partitioned nodes and segment 0's count for replicated ones
+    (post-gather nodes must count once, not nseg times)."""
+
+    def lower(self, node):
+        cols, sel = super().lower(node)
+        cnt = jnp.sum(sel.astype(jnp.int64))
+        is_seg0 = jnp.equal(jax.lax.axis_index(SEG_AXIS), 0)
+        self.stats[f"node_rows_sum (node {id(node)})"] = \
+            self.tx.psum(cnt, SEG_AXIS)
+        self.stats[f"node_rows_one (node {id(node)})"] = \
+            self.tx.psum(jnp.where(is_seg0, cnt, 0), SEG_AXIS)
+        return cols, sel
+
+
+def instrument_counts(plan: N.PlanNode, stats: dict) -> dict:
+    """Host-side per-node counts from an instrumented program's stats:
+    pick the cross-segment sum for partitioned nodes, segment 0's count
+    for replicated ones (the same rule the legacy instrumented path
+    applies to its per-seg arrays)."""
+    import re
+
+    sums, ones = {}, {}
+    for key, v in stats.items():
+        m = re.search(r"node_rows_(sum|one) \(node (\d+)\)", key)
+        if m is None:
+            continue
+        (sums if m.group(1) == "sum" else ones)[int(m.group(2))] = \
+            int(np.asarray(v))
+    nodes = {id(n): n for n in X.all_nodes(plan)}
+    out = {}
+    for nid, n in nodes.items():
+        if nid not in sums:
+            continue
+        if n.sharding is not None and n.sharding.is_partitioned:
+            out[nid] = sums[nid]
+        else:
+            out[nid] = ones.get(nid, sums[nid])
+    return out

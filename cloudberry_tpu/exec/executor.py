@@ -50,6 +50,9 @@ class Executable:
     # the unjitted trace function — the micro-batch dispatcher vmaps it
     # into stacked-parameter executables (sched/paramplan.py rung_fn)
     raw_fn: Callable = None  # type: ignore[assignment]
+    # instrumented programs return a 4th output (per-node row counts);
+    # EXPLAIN ANALYZE's pipeline path runs them directly (instrument.py)
+    instrumented: bool = False
 
 
 def execute(plan: N.PlanNode, session) -> ColumnBatch:
@@ -79,7 +82,12 @@ def count_compile(session) -> None:
 
 
 def compile_plan(plan: N.PlanNode, session,
-                 platform: str | None = None) -> Executable:
+                 platform: str | None = None,
+                 instrument: bool = False) -> Executable:
+    """``instrument=True`` (EXPLAIN ANALYZE's pipeline path,
+    exec/instrument.py run_pipeline) compiles THE SAME program through
+    this same entry point with per-node row counts as a 4th output —
+    no private lowerer."""
     scans = list(scans_of(plan))
     store_scans = [s for s in scans if keyed_scan(s)]
     table_names = sorted({s.table_name for s in scans
@@ -87,6 +95,25 @@ def compile_plan(plan: N.PlanNode, session,
     platform = platform or jax.default_backend()
     use_pallas = session.config.exec.use_pallas
     count_compile(session)
+
+    if instrument:
+        from cloudberry_tpu.exec.instrument import InstrumentingMixin
+
+        class _InstrLowerer(InstrumentingMixin, Lowerer):
+            def __init__(self, *a, **kw):
+                Lowerer.__init__(self, *a, **kw)
+                self.__init_instrument__()
+
+        def run(tables):
+            low = _InstrLowerer(tables, platform=platform,
+                                use_pallas=use_pallas,
+                                params=tables.get("$params"))
+            cols, sel = low.lower(plan)
+            out = {f.name: cols[f.name] for f in plan.fields}
+            return out, sel, low.checks, low.node_counts
+
+        return Executable(plan, jax.jit(run), table_names, store_scans,
+                          run, instrumented=True)
 
     def run(tables):
         low = Lowerer(tables, platform=platform, use_pallas=use_pallas,
@@ -221,7 +248,14 @@ def _load_store_scan(scan: N.PScan, session) -> dict:
 
 
 def run_executable(exe: Executable, tables: dict) -> ColumnBatch:
-    cols, sel, checks = exe.fn(tables)
+    # device launch under the statement's trace span + a jax.profiler
+    # annotation (obs/trace.py): an XLA profile of a traced statement
+    # correlates with the host span names; both are no-ops untraced
+    from cloudberry_tpu.obs import trace as OT
+
+    with OT.span("launch", plan=type(exe.plan).__name__), \
+            OT.device_annotation("launch"):
+        cols, sel, checks = exe.fn(tables)
     raise_checks(checks)
     return make_batch(exe.plan, cols, sel)
 

@@ -6,11 +6,23 @@ ANALYZE (cdbexplain_sendExecStats, explain_gp.c:384). Here the whole plan is
 ONE fused XLA program, so per-node wall time is not separable — but per-node
 ROW COUNTS are (cheap in-program reductions), and they answer the questions
 EXPLAIN ANALYZE usually answers (selectivity, join fanout, motion width).
-Whole-query compile and execute wall times complete the picture.
+Whole-query compile and execute wall times complete the picture, split
+honestly: the AOT lower→compile API times compilation alone, and the
+fallback two-call method subtracts a warm execution from the cold first
+call (the old code labeled the whole first call ``compile_s`` even though
+that call also executed).
 
-The ``metrics_hook`` list on a Session is the query_info_collect_hook analog
-(src/include/utils/metrics_utils.h:39): every instrumented run emits a
-QueryMetrics record to each registered hook.
+``StatementLog`` is also the engine's telemetry hub (ISSUE 9): its
+counters live on an ``obs.metrics.MetricsRegistry`` (``counters`` is a
+view), finished statements feed the pg_stat_statements-class aggregate
+table (obs/statements.py), and completed trace span trees land in a
+bounded ring (obs/trace.py) — one instance spans every backend of a
+server, so `meta "metrics"/"statements"/"trace"` answer engine-wide.
+
+The ``metrics_hook`` list on a Session is the query_info_collect_hook
+analog (src/include/utils/metrics_utils.h:39): every instrumented run
+emits a QueryMetrics record to each registered hook; a raising hook is
+counted (``metrics_hook_errors``) and never aborts the statement.
 """
 
 from __future__ import annotations
@@ -36,34 +48,89 @@ class StatementLog:
         import itertools
         import threading
 
+        from cloudberry_tpu.obs.metrics import CounterView, MetricsRegistry
+        from cloudberry_tpu.obs.statements import StatementStats
+
         self._recent = collections.deque(maxlen=capacity)
         self._active: dict[int, dict] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
-        # engine-wide scheduler/plan-cache counters (compiles, dispatches,
-        # stmt_cache_hits, generic_hits, generic_builds, param_binds, ...):
-        # the compile-hit / parameterization observability the serving
-        # layer exposes via serve/meta.py "sched"
-        self.counters = collections.Counter()
+        # engine-wide counters (compiles, dispatches, stmt_cache_hits,
+        # generic_hits, ...) re-homed onto the obs metrics registry
+        # (obs/metrics.py): ONE home for counters/gauges/histograms,
+        # with a Prometheus exposition; ``counters`` stays as a mapping
+        # view so pre-registry readers keep working
+        self.registry = MetricsRegistry()
+        self.counters = CounterView(self.registry)
+        # pg_stat_statements analog: per-skeleton aggregates fed by
+        # finish(); bounded (obs/statements.py)
+        self.statements = StatementStats()
+        # completed statement trace span trees, newest last (bounded)
+        self._trace_ring = collections.deque(maxlen=64)
+        self._trace_seq = itertools.count()
+        self.obs_enabled = True
+        self.trace_sample = 1
 
-    def bump(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self.counters[name] += n
+    def configure_obs(self, obs_cfg) -> None:
+        """Apply a session's ObsConfig (config.py). Called once at
+        session construction; server backends share the server's log, so
+        the serving config wins engine-wide."""
+        import collections
+
+        from cloudberry_tpu.obs.statements import StatementStats
+
+        self.obs_enabled = bool(obs_cfg.enabled)
+        self.trace_sample = max(1, int(obs_cfg.trace_sample))
+        self._trace_ring = collections.deque(
+            self._trace_ring, maxlen=max(1, obs_cfg.trace_ring))
+        if self.statements.max_rows != obs_cfg.statements_max:
+            self.statements = StatementStats(max(1, obs_cfg.statements_max))
+        self._max_spans = max(16, obs_cfg.max_spans)
+
+    def bump(self, name: str, n: int = 1, tenant: str | None = None) -> None:
+        self.registry.bump(name, n, tenant=tenant)
 
     def counter(self, name: str) -> int:
-        with self._lock:
-            return int(self.counters.get(name, 0))
+        return self.registry.counter(name)
 
     def counter_snapshot(self) -> dict:
-        with self._lock:
-            return {k: int(v) for k, v in sorted(self.counters.items())}
+        return self.registry.counter_snapshot()
+
+    # ------------------------------------------------------------- tracing
+
+    def trace_this(self) -> bool:
+        """Sampling gate: keep every Nth statement's span tree."""
+        if not self.obs_enabled:
+            return False
+        return next(self._trace_seq) % self.trace_sample == 0
+
+    def start_trace(self, sid: int, sql: str, tenant: str | None = None):
+        """A Trace for statement ``sid`` when tracing is on and the
+        sampler picks it, else None. The caller hangs it on the
+        statement's lifecycle handle (handle.trace) — that is how spans
+        follow the statement across threads."""
+        if not self.trace_this():
+            return None
+        from cloudberry_tpu.obs.trace import Trace
+
+        return Trace(sid, sql, max_spans=getattr(self, "_max_spans", 512),
+                     tenant=tenant)
+
+    def traces(self, limit: int = 16) -> list[dict]:
+        """Most recent completed trace exports, newest first."""
+        out = list(self._trace_ring)[-max(1, limit):]
+        return out[::-1]
 
     def begin(self, sql: str, session_id: int = 0) -> int:
         sid = next(self._ids)
         with self._lock:
             self._active[sid] = {
                 "id": sid, "session": session_id, "state": "running",
-                "sql": sql[:500], "started": time.time()}
+                "sql": sql[:500], "started": time.time(),
+                # durations derive from the MONOTONIC clock (the same
+                # clock lifecycle deadlines use); "started" stays wall
+                # time for the activity view's human timestamps
+                "_t0": time.monotonic()}
         return sid
 
     # ------------------------------------------------ statement lifecycle
@@ -132,10 +199,12 @@ class StatementLog:
             if entry is None:
                 return
             # the handle (and its token) must not outlive the statement
-            # in the history ring
-            entry.pop("handle", None)
+            # in the history ring; its trace closes below, outside the
+            # lock (export walks the span list)
+            handle = entry.pop("handle", None)
             entry.pop("state", None)
-            entry["wall_s"] = round(time.time() - entry["started"], 4)
+            entry["wall_s"] = round(
+                time.monotonic() - entry.pop("_t0"), 4)
             entry["status"] = status
             entry["rows"] = rows
             if error:
@@ -144,18 +213,38 @@ class StatementLog:
             # path, batch membership) rides the history entry
             entry.update(extra)
             self._recent.append(entry)
+        if not self.obs_enabled:
+            return
+        if status == "requeued":
+            # dispatcher bookkeeping, not an execution: the statement
+            # re-runs through session.sql (which logs/traces it for
+            # real) — feeding this stub into the statements table /
+            # latency histogram / trace ring would double-count it
+            return
+        # pg_stat_statements aggregation + trace close ride every finish
+        # path (session.sql, the dispatcher's batched finishes) — one
+        # funnel, so the counters-consistency contract holds engine-wide
+        self.statements.observe(entry)
+        self.registry.observe("statement_seconds", entry["wall_s"])
+        trace = getattr(handle, "trace", None)
+        if trace is not None:
+            trace.finish(status)
+            self._trace_ring.append(trace.export())
+            self.registry.bump("trace_statements")
+            if trace.dropped:
+                self.registry.bump("trace_spans_dropped", trace.dropped)
 
     def activity(self) -> list[dict]:
         """Currently-executing statements (pg_stat_activity role), with
         live lifecycle state: id, state (running/cancelling), elapsed,
         and time left to the deadline when one is set."""
-        now = time.time()
         mono = time.monotonic()
         out = []
         with self._lock:
             for e in self._active.values():
-                row = {k: v for k, v in e.items() if k != "handle"}
-                row["elapsed_s"] = round(now - e["started"], 4)
+                row = {k: v for k, v in e.items()
+                       if k not in ("handle", "_t0")}
+                row["elapsed_s"] = round(mono - e["_t0"], 4)
                 h = e.get("handle")
                 if h is not None and h.deadline is not None:
                     row["deadline_in_s"] = round(h.deadline - mono, 4)
@@ -178,6 +267,9 @@ class QueryMetrics:
     rows_out: int
     # plan-order list of (node title, sharding, rows selected after the node)
     node_rows: list[tuple[str, str, int]] = field(default_factory=list)
+    # XLA program constructions this run charged to the engine counter
+    # (the StatementLog compile counter — the honest-split cross-check)
+    compiles: int = 0
 
 
 class InstrumentingMixin:
@@ -204,36 +296,160 @@ def plan_nodes_in_order(plan: N.PlanNode) -> list[N.PlanNode]:
     return out
 
 
+# ------------------------------------------------------- timing discipline
+
+
+def _timed_compile_run(fn, inputs, log=None):
+    """(result, compile_s, exec_s) for a jitted ``fn`` on ``inputs`` —
+    the honest compile-vs-execute split. Preferred: the AOT API
+    (``fn.lower().compile()``) times compilation ALONE and executes
+    once. Fallback (older jax / non-jit callables): two calls — the
+    first pays compile+execute, the second executes warm, and the split
+    is the difference (never negative). Both legs record trace spans
+    and stage histograms when the thread is inside a traced statement."""
+    import jax
+
+    from cloudberry_tpu.obs import metrics as OM
+    from cloudberry_tpu.obs import trace as OT
+
+    t0 = time.monotonic()
+    compiled = None
+    try:
+        with OT.span("compile"):
+            compiled = fn.lower(inputs).compile()
+    except (AttributeError, TypeError):
+        compiled = None
+    if compiled is not None:
+        compile_s = time.monotonic() - t0
+        OM.observe_stage(log, "compile", compile_s)
+        t1 = time.monotonic()
+        with OT.span("launch", mode="instrumented"), \
+                OT.device_annotation("launch"):
+            result = compiled(inputs)
+            jax.block_until_ready(result)
+        exec_s = time.monotonic() - t1
+        OM.observe_stage(log, "launch", exec_s)
+        return result, compile_s, exec_s
+    with OT.span("compile+launch"):
+        result = fn(inputs)
+        jax.block_until_ready(result)
+    first_s = time.monotonic() - t0
+    t1 = time.monotonic()
+    with OT.span("launch", mode="instrumented"), \
+            OT.device_annotation("launch"):
+        result = fn(inputs)
+        jax.block_until_ready(result)
+    exec_s = time.monotonic() - t1
+    OM.observe_stage(log, "compile", max(first_s - exec_s, 0.0))
+    OM.observe_stage(log, "launch", exec_s)
+    return result, max(first_s - exec_s, 0.0), exec_s
+
+
+# ---------------------------------------------------------- plan annotation
+
+
+def motion_annotations(plan: N.PlanNode, counts: dict,
+                       packed: bool = True) -> dict:
+    """Per-node EXPLAIN ANALYZE annotations beyond row counts:
+
+    - PMotion: collective launches (1 fused on the packed wire, one per
+      column otherwise), estimated wire bytes (rows into the motion ×
+      packed row width), and the capacity rung for redistributes;
+    - PRuntimeFilter: observed jf_rows_in/out when the digest executor
+      recorded them (``_jf_pre``/``_jf_post``, exec/dist_executor.py).
+    """
+    from cloudberry_tpu.exec import kernels as K
+
+    out: dict[int, str] = {}
+    for n in plan_nodes_in_order(plan):
+        if isinstance(n, N.PMotion):
+            fields = n.child.fields
+            dtypes = {f.name: f.type.np_dtype for f in fields}
+            try:
+                row_bytes = K.wire_layout(dtypes).row_bytes()
+            except NotImplementedError:
+                row_bytes = sum(np.dtype(d).itemsize
+                                for d in dtypes.values())
+            launches = 1 if packed else max(1, len(fields))
+            rows = counts.get(id(n.child), -1)
+            bits = [f"launches={launches}"]
+            if rows >= 0:
+                bits.append(f"wire_bytes={rows * row_bytes}")
+            if n.kind == "redistribute":
+                bits.append(f"rung={n.bucket_cap}")
+            out[id(n)] = "  ".join(bits)
+        elif isinstance(n, N.PRuntimeFilter):
+            pre = getattr(n, "_jf_pre", None)
+            post = getattr(n, "_jf_post", None)
+            if pre is not None and post is not None:
+                out[id(n)] = f"jf_rows_in={pre}  jf_rows_out={post}"
+    return out
+
+
+def _tiled_lines(report: dict) -> list[str]:
+    """EXPLAIN ANALYZE trailer for tiled (out-of-core) execution:
+    per-tile time distribution + checkpoint/resume counters from the
+    run's report (exec/tiled.py, exec/recovery.py)."""
+    lines = [f"Tiled execution: {report.get('n_tiles', '?')} tiles of "
+             f"{report.get('tile_rows', '?')} rows "
+             f"(stream {report.get('stream_table', '?')})"]
+    th = report.get("tile_time")
+    if th:
+        lines.append(
+            f"  tile step: mean {th['mean'] * 1000:.2f} ms  "
+            f"p95 {th['p95'] * 1000:.2f} ms  over {th['count']} tiles")
+    ck = {k: report[k] for k in ("checkpoints", "resumed_from_tile",
+                                 "tiles_replayed") if k in report}
+    if ck:
+        lines.append("  recovery: " + "  ".join(
+            f"{k}={v}" for k, v in ck.items()))
+    return lines
+
+
 def explain_analyze_text(plan: N.PlanNode, counts: dict[int, int],
-                         wall_s: float, compile_s: float) -> str:
-    """Render the plan tree with actual row counts (EXPLAIN ANALYZE)."""
+                         wall_s: float, compile_s: float,
+                         annotations: dict | None = None,
+                         tiled_report: dict | None = None) -> str:
+    """Render the plan tree with actual row counts (EXPLAIN ANALYZE)
+    plus the motion/join annotations and the tiled-execution trailer."""
+    annotations = annotations or {}
 
     def rec(n: N.PlanNode, indent: int) -> list[str]:
         rows = counts.get(id(n))
         extra = f"  rows={rows}" if rows is not None else ""
         sh = f"  [{n.sharding}]" if n.sharding else ""
-        lines = [" " * indent + "-> " + n.title() + sh + extra]
+        ann = annotations.get(id(n))
+        lines = [" " * indent + "-> " + n.title() + sh + extra
+                 + (f"  ({ann})" if ann else "")]
         for c in n.children():
             lines += rec(c, indent + 3)
         return lines
 
     lines = rec(plan, 0)
+    if tiled_report:
+        lines += _tiled_lines(tiled_report)
     lines.append(f"Execution time: {wall_s * 1000:.2f} ms "
                  f"(compile {compile_s * 1000:.2f} ms)")
     return "\n".join(lines)
 
 
+# --------------------------------------------------- the instrumented runs
+
+
 def run_instrumented(plan: N.PlanNode, session, query: str = ""):
     """Execute with instrumentation; returns (ColumnBatch, QueryMetrics).
 
-    Single-segment path; distributed instrumentation sums per-segment counts.
+    The LEGACY side path: a private jitted program outside the statement
+    pipeline (no lifecycle handle, no admission, no generic-plan form).
+    Kept as the parity oracle for run_pipeline and for library callers
+    that want counts without pipeline semantics.
     """
-    import jax
-
     from cloudberry_tpu.exec import executor as X
 
     if session.config.n_segments > 1:
         return _run_instrumented_dist(plan, session, query)
+
+    import jax
 
     class InstrLowerer(InstrumentingMixin, X.Lowerer):
         def __init__(self, tables, platform=None):
@@ -248,14 +464,8 @@ def run_instrumented(plan: N.PlanNode, session, query: str = ""):
 
     fn = jax.jit(run)
     tables = X.prepare_plan_inputs(plan, session)
-    t0 = time.time()
-    result = fn(tables)
-    jax.block_until_ready(result)
-    compile_s = time.time() - t0
-    t1 = time.time()
-    cols, sel, checks, counts = fn(tables)
-    jax.block_until_ready(sel)
-    wall_s = time.time() - t1
+    (cols, sel, checks, counts), compile_s, wall_s = \
+        _timed_compile_run(fn, tables)
     X.raise_checks(checks)
     batch = X.make_batch(plan, cols, sel)
 
@@ -305,22 +515,25 @@ def _run_instrumented_dist(plan: N.PlanNode, session, query: str):
     out_specs = ({f.name: P(DX.SEG_AXIS) for f in plan.fields},
                  P(DX.SEG_AXIS), P(DX.SEG_AXIS), P(DX.SEG_AXIS))
     fn = jax.jit(DX._shard_map(seg_fn, mesh, (in_specs,), out_specs))
-    t0 = time.time()
-    result = fn(inputs)
-    jax.block_until_ready(result)
-    compile_s = time.time() - t0
-    t1 = time.time()
-    cols, sel, checks, counts = fn(inputs)
-    jax.block_until_ready(sel)
-    wall_s = time.time() - t1
+    (cols, sel, checks, counts), compile_s, wall_s = \
+        _timed_compile_run(fn, inputs)
     X.raise_checks(checks)
     host_cols = {k: np.asarray(v)[0] for k, v in cols.items()}
     host_sel = np.asarray(sel)[0]
     batch = X.make_batch(plan, host_cols, host_sel)
 
-    nodes = plan_nodes_in_order(plan)
+    counts_host = _dist_counts_host(plan, counts)
+    metrics = _metrics(plan, counts_host, query, wall_s, compile_s,
+                       int(host_sel.sum()))
+    _emit(session, metrics)
+    return batch, metrics
+
+
+def _dist_counts_host(plan, counts) -> dict:
+    """Per-seg count arrays → one number per node: partitioned nodes sum
+    across segments, replicated nodes count once (segment 0)."""
     counts_host = {}
-    for n in nodes:
+    for n in plan_nodes_in_order(plan):
         arr = counts.get(id(n))
         if arr is None:
             continue
@@ -329,10 +542,137 @@ def _run_instrumented_dist(plan: N.PlanNode, session, query: str):
             counts_host[id(n)] = int(per_seg.sum())
         else:
             counts_host[id(n)] = int(per_seg[0])  # replicated: count once
-    metrics = _metrics(plan, counts_host, query, wall_s, compile_s,
-                       int(host_sel.sum()))
+    return counts_host
+
+
+# --------------------------------------- EXPLAIN ANALYZE via the pipeline
+
+
+def run_pipeline(plan: N.PlanNode, session, query: str):
+    """EXPLAIN ANALYZE through the STATEMENT PIPELINE (ISSUE 9): the
+    same lifecycle bracket (handle + scope + StatementLog entry), the
+    same dispatch seams and admission gate, the shared compile entry
+    points (executor.compile_plan / dist_executor.compile_distributed
+    with ``instrument=True``) and — when the plan parameterizes — the
+    GENERIC-PLAN FORM: literals rewritten to ``$params`` slots exactly
+    as sched/paramplan.py compiles them, so what EXPLAIN ANALYZE times
+    is the program the serving path actually runs, not a private
+    lowerer's variant.
+
+    Returns (batch, QueryMetrics, annotations): per-node row counts plus
+    the motion/join annotations for explain_analyze_text."""
+    from cloudberry_tpu import lifecycle
+    from cloudberry_tpu.exec import executor as X
+    from cloudberry_tpu.utils.faultinject import fault_point
+
+    log = session.stmt_log
+    log_id = log.begin(query, session._session_id)
+    deadline = None
+    timeout = session.config.statement_timeout_s
+    if timeout:
+        deadline = time.monotonic() + timeout
+    handle = lifecycle.StatementHandle(log_id, deadline=deadline)
+    handle.trace = log.start_trace(log_id, query)
+    log.attach(log_id, handle)
+    compiles_before = log.counter("compiles")
+    try:
+        with lifecycle.statement_scope(handle):
+            log.bump("dispatches")
+            session._dispatch_seams(fault_point)
+            batch, metrics, annotations = _pipeline_once(
+                plan, session, query)
+    except BaseException as e:
+        log.finish(log_id, "error", error=f"{type(e).__name__}: {e}")
+        raise
+    metrics.compiles = log.counter("compiles") - compiles_before
+    log.finish(log_id, "ok", rows=batch.num_rows(),
+               compiles=metrics.compiles)
     _emit(session, metrics)
-    return batch, metrics
+    return batch, metrics, annotations
+
+
+def _generic_form(session, plan):
+    """Rewrite the plan to its generic form (literals → $params slots,
+    scan row counts → $nrw slots) and return the bindings — the same
+    walk the plan cache performs (sched/paramplan.analyze). Plans the
+    walker does not model keep their baked literals (bindings = {})."""
+    from cloudberry_tpu.sched import paramplan
+
+    if not session.config.sched.generic_plans \
+            or getattr(plan, "_no_stmt_cache", False):
+        return {}
+    try:
+        _sig, bindings, _keyed, _slots = paramplan.analyze(
+            session, plan, rewrite=True)
+    except paramplan.UnsupportedPlan:
+        return {}
+    return bindings
+
+
+def _pipeline_once(plan, session, query):
+    from cloudberry_tpu.exec import executor as X
+    from cloudberry_tpu.exec.resource import ResourceError, check_admission
+
+    session.last_tiled_report = None  # set again by the tiled fallback
+    packed = session.config.interconnect.packed_wire
+    try:
+        est = check_admission(plan, session)
+    except ResourceError:
+        # over-budget plans take the tiled (out-of-core) path like any
+        # statement would; per-node counts are not separable there, but
+        # the tiled report (per-tile time histogram, checkpoint/resume
+        # counters) rides the rendered output instead
+        from cloudberry_tpu.exec.tiled import plan_tiled
+
+        texe = plan_tiled(plan, session)
+        if texe is None:
+            raise
+        t0 = time.monotonic()
+        with session._gate, session._admitted(
+                session.config.resource.query_mem_bytes):
+            batch = texe.run()
+        wall_s = time.monotonic() - t0
+        metrics = _metrics(plan, {}, query, wall_s, 0.0,
+                           batch.num_rows())
+        return batch, metrics, motion_annotations(plan, {}, packed)
+    bindings = _generic_form(session, plan)
+    seg = getattr(plan, "_direct_segment", None)
+    with session._gate, session._admitted(est.peak_bytes):
+        if session.config.n_segments > 1 and seg is None:
+            from cloudberry_tpu.exec import dist_executor as DX
+
+            fn = DX.compile_distributed(
+                plan, session,
+                param_keys=sorted(bindings) if bindings else None,
+                instrument=True)
+            inputs, _ = DX.prepare_dist_inputs(plan, session)
+            if bindings:
+                inputs["$params"] = dict(bindings)
+            (cols, sel, checks, stats), compile_s, wall_s = \
+                _timed_compile_run(fn, inputs, log=session.stmt_log)
+            DX.record_motion_stats(plan, stats)
+            X.raise_checks(checks)
+            DX.record_jf_counters(stats, session.stmt_log)
+            counts_host = DX.instrument_counts(plan, stats)
+            host_cols = {k: DX._local_row(v) for k, v in cols.items()}
+            host_sel = DX._local_row(sel)
+            batch = X.make_batch(plan, host_cols, host_sel)
+            rows_out = int(host_sel.sum())
+        else:
+            exe = X.compile_plan(plan, session, instrument=True)
+            inputs = X.prepare_inputs(exe, session, segment=seg)
+            if bindings:
+                inputs["$params"] = dict(bindings)
+            (cols, sel, checks, counts), compile_s, wall_s = \
+                _timed_compile_run(exe.fn, inputs, log=session.stmt_log)
+            X.raise_checks(checks)
+            batch = X.make_batch(plan, cols, sel)
+            counts_host = {k: int(np.asarray(v))
+                           for k, v in counts.items()}
+            rows_out = int(np.asarray(sel).sum())
+    metrics = _metrics(plan, counts_host, query, wall_s, compile_s,
+                       rows_out)
+    return batch, metrics, motion_annotations(plan, counts_host, packed)
 
 
 def _metrics(plan, counts_host, query, wall_s, compile_s, rows_out):
@@ -344,5 +684,14 @@ def _metrics(plan, counts_host, query, wall_s, compile_s, rows_out):
 
 
 def _emit(session, metrics: QueryMetrics) -> None:
+    """Deliver to every metrics hook, exception-safely: a raising hook
+    is the OBSERVER's bug — it is counted (metrics_hook_errors) and must
+    never abort the observed statement (the reference likewise shields
+    the executor from a broken query_info_collect_hook)."""
     for hook in getattr(session, "metrics_hooks", []):
-        hook(metrics)
+        try:
+            hook(metrics)
+        except Exception:
+            log = getattr(session, "stmt_log", None)
+            if log is not None:
+                log.bump("metrics_hook_errors")

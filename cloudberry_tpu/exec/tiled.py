@@ -576,6 +576,49 @@ class _TileLowerer(_ReplacingLowerer):
 # --------------------------------------------------------------- execution
 
 
+class _TileTimer:
+    """Per-tile step timing (the ISSUE-9 tiled telemetry): each step's
+    wall feeds the engine ``tile_step_seconds`` histogram and — when the
+    statement is traced — a per-tile span; ``stamp()`` summarizes the
+    distribution onto the run report for EXPLAIN ANALYZE's tiled
+    trailer. Bounded by construction: one fixed-size histogram, and
+    spans ride the trace's own cap."""
+
+    def __init__(self, session):
+        from cloudberry_tpu.obs.metrics import _Hist
+
+        self._log = getattr(session, "stmt_log", None)
+        self._h = _Hist()
+
+    def step(self, idx: int):
+        import contextlib
+        import time as _t
+
+        from cloudberry_tpu.obs import trace as OT
+
+        @contextlib.contextmanager
+        def _cm():
+            t0 = _t.perf_counter()
+            try:
+                yield
+            finally:
+                dt = _t.perf_counter() - t0
+                self._h.add(dt)
+                if self._log is not None and self._log.obs_enabled:
+                    self._log.registry.observe("tile_step_seconds", dt)
+                OT.mark("tile-step", t0, tile=idx)
+
+        return _cm()
+
+    def stamp(self, report: dict) -> None:
+        if self._h.n:
+            report["tile_time"] = {
+                "count": self._h.n,
+                "mean": round(self._h.total / self._h.n, 6),
+                "p95": self._h.quantile(0.95),
+            }
+
+
 class AdaptiveTiledMixin:
     """Shared adaptive-retry discipline for tiled executables (single-node
     and distributed): classify a detected overflow, grow the guilty buffer
@@ -842,17 +885,21 @@ class TiledExecutable(AdaptiveTiledMixin):
         skip = ctx.skip_rows if ctx is not None else 0
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
+        timer = _TileTimer(self.session)
         for tile, tile_n in _tile_feed(self.shape.stream, self.session,
                                        self.tile_rows, skip_rows=skip):
             fault_point("tile_step")
             fault_point("tile_device_lost")
-            acc, checks = step_fn(resident, prelude, tile,
-                                  jnp.asarray(tile_n, dtype=jnp.int32), acc)
-            _raise_tile_checks(checks, n_base + n_local)
+            with timer.step(n_base + n_local):
+                acc, checks = step_fn(resident, prelude, tile,
+                                      jnp.asarray(tile_n,
+                                                  dtype=jnp.int32), acc)
+                _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
             if ctx is not None:
                 ctx.tick(n_local, lambda: R.acc_payload(acc))
         n_tiles = n_base + n_local
+        timer.stamp(self.report)
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
             empty = _empty_tile(self.shape.stream, self.tile_rows)
             acc, checks = step_fn(resident, prelude, empty,
@@ -1031,14 +1078,16 @@ class SortTiledExecutable(TiledExecutable):
         skip = ctx.skip_rows if ctx is not None else 0
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
+        timer = _TileTimer(self.session)
         for tile, tile_n in _tile_feed(shape.stream, self.session,
                                        self.tile_rows, skip_rows=skip):
             fault_point("tile_step")
             fault_point("tile_device_lost")
-            (pcols, psel, keys), checks = step_fn(
-                resident, prelude, tile,
-                jnp.asarray(tile_n, dtype=jnp.int32))
-            _raise_tile_checks(checks, n_base + n_local)
+            with timer.step(n_base + n_local):
+                (pcols, psel, keys), checks = step_fn(
+                    resident, prelude, tile,
+                    jnp.asarray(tile_n, dtype=jnp.int32))
+                _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
             mask = np.asarray(psel)
             for nm in names:
@@ -1047,6 +1096,7 @@ class SortTiledExecutable(TiledExecutable):
                 key_runs[i].append(np.asarray(k)[mask])
             if ctx is not None:
                 ctx.tick(n_local, lambda: R.runs_payload(runs, key_runs))
+        timer.stamp(self.report)
 
         fault_point("tiled_finalize")
         from cloudberry_tpu.lifecycle import check_cancel

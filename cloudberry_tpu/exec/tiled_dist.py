@@ -750,14 +750,20 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                                self.tile_rows)
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
+        from cloudberry_tpu.exec.tiled import _TileTimer
+
+        timer = _TileTimer(self.session)
         for tile, tile_ns in feed:
             fault_point("tile_step_dist")
             fault_point("tile_device_lost")
-            acc, checks = step_fn(resident, prelude, tile, tile_ns, acc)
-            _raise_tile_checks(checks, n_base + n_local)
+            with timer.step(n_base + n_local):
+                acc, checks = step_fn(resident, prelude, tile, tile_ns,
+                                      acc)
+                _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
             if ctx is not None:
                 ctx.tick(n_local, lambda: R.acc_payload(acc))
+        timer.stamp(self.report)
         n_tiles = n_base + n_local
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
             tile, _ = _empty_dist_tile(self.shape.stream, self.tile_rows,
@@ -954,12 +960,16 @@ class DistSortTiledExecutable(DistTiledExecutable):
             or _dist_tile_feed(shape.stream, self.session, self.tile_rows)
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
+        from cloudberry_tpu.exec.tiled import _TileTimer
+
+        timer = _TileTimer(self.session)
         for tile, tile_ns in feed:
             fault_point("tile_step_dist")
             fault_point("tile_device_lost")
-            (pcols, psel, keys), checks = step_fn(resident, prelude,
-                                                  tile, tile_ns)
-            _raise_tile_checks(checks, n_base + n_local)
+            with timer.step(n_base + n_local):
+                (pcols, psel, keys), checks = step_fn(resident, prelude,
+                                                      tile, tile_ns)
+                _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
             selnp = np.asarray(psel)
             for s in range(self.nseg):
@@ -970,6 +980,7 @@ class DistSortTiledExecutable(DistTiledExecutable):
                     key_runs[i].append(np.asarray(k[s])[m])
             if ctx is not None:
                 ctx.tick(n_local, lambda: R.runs_payload(runs, key_runs))
+        timer.stamp(self.report)
         from cloudberry_tpu.exec.tiled import merge_sorted_runs
 
         cols, karr = merge_sorted_runs(runs, key_runs,

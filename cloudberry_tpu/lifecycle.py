@@ -174,6 +174,11 @@ class StatementHandle:
         self.deadline = deadline
         self.token = token if token is not None else CancelToken()
         self.started = time.monotonic()
+        # the statement's trace span collection (obs/trace.py), set by
+        # whoever begins the statement; spans follow the handle across
+        # threads exactly like cancellation does (obs.trace reads it via
+        # current_handle())
+        self.trace = None
 
     def remaining(self) -> Optional[float]:
         if self.deadline is None:
@@ -207,6 +212,12 @@ class CompositeHandle:
 
     def __init__(self, handles):
         self.handles = list(handles)
+        # the batch head's trace records the stacked launch's spans (one
+        # launch, many statements — attributing it to the head matches
+        # how the compile counter attributes batch compiles)
+        self.trace = next((h.trace for h in self.handles
+                           if getattr(h, "trace", None) is not None),
+                          None)
 
     def check(self) -> None:
         for h in self.handles:

@@ -30,6 +30,7 @@ CONCURRENT_CLASSES = frozenset({
     "Dispatcher", "TenantScheduler", "CacheScope", "StatementLog",
     "RecoveryStore", "CircuitBreaker", "CancelToken", "Watchdog",
     "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
+    "MetricsRegistry", "StatementStats", "Trace",
 })
 
 # attribute-name → class-name hints for cross-class lock edges: when a
@@ -53,6 +54,8 @@ ATTR_CLASS_HINTS = {
     "token": "CancelToken",
     "_cache_scope": "CacheScope",
     "scope": "CacheScope",
+    "registry": "MetricsRegistry",
+    "statements": "StatementStats",
     "session": "Session",
     "sess": "Session",
     "_sched": "TenantScheduler",
@@ -103,6 +106,10 @@ RETRYABLE_NAMES_CONST = "_RETRYABLE_NAMES"
 FAULTINJECT_MODULE = "utils/faultinject.py"
 INVENTORY_CONST = "INVENTORY"
 
+# where the wire metadata verbs live (the obs pass pins describe()'s
+# documented Kinds list to its implemented kind == "..." branches)
+META_MODULE = "serve/meta.py"
+
 # ---------------------------------------------------------------- witness
 
 # The DECLARED lock acquisition order (coarse ranks; acquiring a lock of
@@ -130,7 +137,8 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     # under the session rung lock)
     ("StatementLog._lock", "GenericPlan._rung_lock"),
     # rank 4 — innermost leaves (never call out while held)
-    ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock"),
+    ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock",
+     "MetricsRegistry._lock", "StatementStats._lock", "Trace._lock"),
 )
 
 
@@ -157,6 +165,7 @@ class LintConfig:
     wire_modules: tuple = WIRE_MODULES
     taxonomy_module: str = TAXONOMY_MODULE
     faultinject_module: str = FAULTINJECT_MODULE
+    meta_module: str = META_MODULE
     # seam names armed only from tests/tools (not declared at an engine
     # call site) that the inventory still documents
     inventory_extra_ok: frozenset = frozenset()

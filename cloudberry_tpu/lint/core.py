@@ -178,7 +178,7 @@ def run_lint(paths, cfg=None) -> LintResult:
     errors in the passes themselves do propagate — the gate must fail
     loudly, not mask itself."""
     from cloudberry_tpu.lint.config import LintConfig
-    from cloudberry_tpu.lint.passes import locks, seams, taxonomy
+    from cloudberry_tpu.lint.passes import locks, obs, seams, taxonomy
     from cloudberry_tpu.lint.passes import tracepurity
 
     cfg = cfg if cfg is not None else LintConfig()
@@ -194,6 +194,7 @@ def run_lint(paths, cfg=None) -> LintResult:
     raw += tracepurity.run(parsed, cfg)
     raw += taxonomy.run(parsed, cfg)
     raw += seams.run(parsed, cfg)
+    raw += obs.run(parsed, cfg)
 
     by_file = {m.relpath: m for m in result.modules}
     for f in raw:

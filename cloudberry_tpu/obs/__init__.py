@@ -1,0 +1,13 @@
+"""Unified observability plane (ISSUE 9): statement trace spans
+(obs/trace.py), the engine-wide metrics registry (obs/metrics.py), and
+per-skeleton statement aggregates (obs/statements.py). The shared
+StatementLog (exec/instrument.py) owns one instance of each, so a
+server's backends write one telemetry plane; ``meta
+"metrics"/"statements"/"trace"`` ship snapshots over the wire."""
+
+from cloudberry_tpu.obs.metrics import (CounterView,  # noqa: F401
+                                        MetricsRegistry, observe_stage)
+from cloudberry_tpu.obs.statements import StatementStats  # noqa: F401
+from cloudberry_tpu.obs.trace import (Trace, chrome_trace,  # noqa: F401
+                                      current_trace, device_annotation,
+                                      mark, span)

@@ -184,19 +184,26 @@ def run_with_retry(fn: Callable, retries: int = 1,
             if on_retry is not None:
                 on_retry(e, delay)
             from cloudberry_tpu.lifecycle import current_handle
+            from cloudberry_tpu.obs import trace as OT
 
             h = current_handle()
             token = getattr(h, "token", None)
-            if token is not None:
-                rem = h.remaining()
-                if rem is not None:
-                    delay = min(delay, max(rem, 0.0))
-                if delay > 0:
-                    token.wait(delay)
-                # raises StatementTimeout/StatementCancelled when the
-                # deadline passed (or a cancel landed) during the wait:
-                # the statement dies of its deadline, not as a "hang"
-                h.check()
-            elif delay > 0:
-                time.sleep(delay)
+            # the recovery attempt + its backoff are spans on the
+            # statement's trace: a recovery storm reads as exactly that
+            # in the exported timeline, not as unexplained dead time
+            with OT.span("recovery-backoff", attempt=attempt + 1,
+                         error=type(e).__name__):
+                if token is not None:
+                    rem = h.remaining()
+                    if rem is not None:
+                        delay = min(delay, max(rem, 0.0))
+                    if delay > 0:
+                        token.wait(delay)
+                    # raises StatementTimeout/StatementCancelled when
+                    # the deadline passed (or a cancel landed) during
+                    # the wait: the statement dies of its deadline, not
+                    # as a "hang"
+                    h.check()
+                elif delay > 0:
+                    time.sleep(delay)
     raise last  # unreachable

@@ -48,6 +48,9 @@ class SchedDeadline(RuntimeError):
 class _Request:
     sql: str
     deadline: float                  # monotonic absolute
+    # enqueue timestamp (perf_counter): the dispatch-queue-wait span /
+    # stage histogram measures pick-time minus this (obs/trace.py)
+    t_enq: float = field(default_factory=time.perf_counter)
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[BaseException] = None
@@ -160,9 +163,22 @@ class Dispatcher:
     def _bump(self, name: str, n=1) -> None:
         """Worker-side stats updates take the lock too: handler threads
         bump enqueued/rejected under _cond, and snapshot() copies under
-        it — a bare += here would be a racy read-modify-write."""
+        it — a bare += here would be a racy read-modify-write. Counters
+        mirror onto the engine metrics registry (``disp_<name>``) so the
+        Prometheus exposition sees dispatcher traffic without a snapshot
+        call; the stats dict stays authoritative for snapshot()."""
         with self._cond:
             self.stats[name] += n
+        self.session.stmt_log.bump(f"disp_{name}", n)
+
+    def _mirror(self, name: str, n: int = 1) -> None:
+        """Registry mirror for counters whose stats-dict update happens
+        inline under _cond (enqueued/rejected/batches/...): the metric
+        plane must see queue traffic and backpressure, not just the
+        worker-side names _bump covers. The registry lock is a leaf
+        below _cond in the declared order, so calling under _cond is
+        safe."""
+        self.session.stmt_log.bump(f"disp_{name}", n)
 
     def queue_depth(self) -> int:
         with self._cond:
@@ -216,7 +232,9 @@ class Dispatcher:
             except Exception:
                 with self._cond:
                     self.stats["rejected"] += 1
+                self._mirror("rejected")
                 raise
+            self._mirror("enqueued")
             with self._cond:
                 self.stats["enqueued"] += 1
                 self.stats["max_depth"] = max(self.stats["max_depth"],
@@ -236,6 +254,7 @@ class Dispatcher:
                 left = end - time.monotonic()
                 if left <= 0:
                     self.stats["rejected"] += 1
+                    self._mirror("rejected")
                     raise SchedQueueFull(
                         f"dispatcher queue full ({self.max_queue} "
                         "requests waiting); retry or raise "
@@ -248,6 +267,7 @@ class Dispatcher:
             self.stats["max_depth"] = max(self.stats["max_depth"],
                                           len(self._q))
             self._cond.notify_all()
+        self._mirror("enqueued")
 
     def submit(self, sql: str, deadline_s: Optional[float] = None,
                enqueue_wait_s: float = 0.25,
@@ -394,9 +414,24 @@ class Dispatcher:
             sids = [log.begin(r.sql) for r in group]
             handles = [lifecycle.StatementHandle(sid, deadline=_dl(r))
                        for sid, r in zip(sids, group)]
-            for sid, h in zip(sids, handles):
+            now = time.perf_counter()
+            from cloudberry_tpu.obs import metrics as OM
+
+            for sid, h, r in zip(sids, handles, group):
                 log.attach(sid, h)
+                # batched statements bypass session.sql, so their traces
+                # start here; the queue wait each member just finished is
+                # its first span (recorded on the member's own trace)
+                h.trace = log.start_trace(sid, r.sql)
+                if h.trace is not None:
+                    # ends exactly at the trace's root start, so the
+                    # wait renders as the root's sibling, never a
+                    # partial overlap
+                    h.trace.add("dispatch-queue-wait", r.t_enq,
+                                max(h.trace.t0 - r.t_enq, 0.0))
+                OM.observe_stage(log, "queue_wait", now - r.t_enq)
             c0 = log.counter("compiles")
+            g0 = log.counter("generic_hits")
             try:
                 with self._exec_scope(), lifecycle.statement_scope(
                         lifecycle.CompositeHandle(handles)):
@@ -440,15 +475,24 @@ class Dispatcher:
                     self.stats["batched_requests"] += len(group)
                     self.stats["occupancy_sum"] += \
                         len(group) / paramplan._next_pow2(len(group))
+                self._mirror("batches")
+                self._mirror("batched_requests", len(group))
                 # a flush that built a generic plan or a new rung DID
                 # compile — attribute the delta to the batch head so the
-                # per-statement compiles= field never under-reports
+                # per-statement compiles= field never under-reports.
+                # generic_hits attribute the same way: every non-head
+                # member is exactly one reuse (fast or re-planned), the
+                # head gets the remainder (0 when it built the plan) —
+                # per-statement sums stay equal to the engine counter
                 compiled = log.counter("compiles") - c0
+                ghead = max(log.counter("generic_hits") - g0
+                            - (len(group) - 1), 0)
                 for i, (r, sid, batch) in enumerate(zip(group, sids,
                                                         out)):
                     log.finish(sid, "ok", rows=batch.num_rows(),
                                batch=len(group),
-                               compiles=compiled if i == 0 else 0)
+                               compiles=compiled if i == 0 else 0,
+                               generic_hits=ghead if i == 0 else 1)
                     r.finish(result=batch)
                 return
             self._bump("seq_fallbacks")
@@ -458,6 +502,8 @@ class Dispatcher:
 
     def _run_sequential(self, group: list[_Request]) -> None:
         """Ordinary dispatch, one statement at a time."""
+        from cloudberry_tpu.obs import metrics as OM
+
         for r in group:
             if time.monotonic() > r.deadline:
                 self._bump("expired")
@@ -465,6 +511,8 @@ class Dispatcher:
                     "deadline expired before dispatch"))
                 continue
             self._bump("singles")
+            OM.observe_stage(self.session.stmt_log, "queue_wait",
+                             time.perf_counter() - r.t_enq)
             try:
                 with self._exec_scope():
                     # the request's deadline governs EXECUTION too (the

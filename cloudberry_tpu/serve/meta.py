@@ -29,10 +29,26 @@ def _table_row(name: str, t) -> dict:
     }
 
 
+def _int_arg(kind: str, arg, default: int) -> int:
+    """Integer limit argument with a clear wire error for bad input (a
+    client copying the "prom" arg onto the wrong verb should read WHY)."""
+    if arg is None or arg == "":
+        return default
+    try:
+        return int(arg)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"meta {kind!r} takes an integer limit argument, "
+            f"got {arg!r}") from None
+
+
 def describe(session, kind: str, arg=None):
     """One metadata answer. Kinds: tables | columns | stats | views |
     matviews | sequences | info | activity | sched | tenants |
-    summary."""
+    metrics | statements | trace | summary.
+
+    (graftlint's ``obs-meta-verbs`` rule pins this docstring list to the
+    implemented kinds BOTH ways — document new verbs here.)"""
     # metadata must see other sessions' committed DDL — a thin client may
     # only ever ask metadata questions, so sync here, not just in sql()
     session._sync_store()
@@ -116,6 +132,28 @@ def describe(session, kind: str, arg=None):
         return {"enabled": True,
                 "groups": sched.snapshot(),
                 "fairness_index": round(sched.fairness_index(), 4)}
+    if kind == "metrics":
+        # engine-wide metrics registry (obs/metrics.py): counters,
+        # gauges, log2-bucket histograms. arg="prom" returns the
+        # Prometheus-style text exposition instead of the JSON snapshot.
+        if arg == "prom":
+            return session.stmt_log.registry.exposition()
+        return session.stmt_log.registry.snapshot()
+    if kind == "statements":
+        # pg_stat_statements analog (obs/statements.py): per-skeleton
+        # calls / wall / rows / compiles / generic-hit rate / wire
+        # bytes, heaviest first; arg bounds the row count
+        return session.stmt_log.statements.snapshot(
+            _int_arg(kind, arg, 50))
+    if kind == "trace":
+        # statement trace spans (obs/trace.py): the most recent
+        # completed span trees, newest first, plus the assembled
+        # Chrome-trace document (Perfetto-loadable); arg bounds how
+        # many traces ship
+        from cloudberry_tpu.obs.trace import chrome_trace
+
+        traces = session.stmt_log.traces(_int_arg(kind, arg, 8))
+        return {"traces": traces, "chrome": chrome_trace(traces)}
     if kind == "activity":
         # pg_stat_activity role: running + recent statements across every
         # backend of this server (one shared StatementLog)

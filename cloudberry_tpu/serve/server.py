@@ -94,6 +94,25 @@ class _RWLock:
             self._cond.notify_all()
 
 
+def _resp_bytes(resp: dict) -> int:
+    """Estimated wire bytes of one response WITHOUT re-serializing big
+    row sets: sample the first rows and scale (the transport serializes
+    exactly once; this estimate feeds the statements table's wire_bytes
+    aggregate, where ±a few percent on huge results is fine)."""
+    rows = resp.get("rows")
+    if not rows:
+        try:
+            return len(json.dumps(resp))
+        except (TypeError, ValueError):
+            return 0
+    k = min(len(rows), 64)
+    try:
+        per = len(json.dumps(rows[:k])) / k
+    except (TypeError, ValueError):
+        return 0
+    return int(per * len(rows)) + 64
+
+
 def _json_safe(v):
     if v is None:
         return None
@@ -588,7 +607,8 @@ class Server:
                         async_cb(self._error_resp(r.error))
                         return
                     try:
-                        async_cb(self._render(r.result))
+                        async_cb(self._finish_render(sql, r.result,
+                                                     tenant=tenant))
                     except Exception as e:
                         async_cb(self._error_resp(e))
 
@@ -620,7 +640,28 @@ class Server:
             with self._tenant_slot(tenant), \
                     self._locked(write=not _is_read(sql)):
                 result = sess.sql(sql, _deadline=deadline)
-        return self._render(result)
+        return self._finish_render(sql, result, tenant=tenant)
+
+    def _finish_render(self, sql: str, result, tenant=None) -> dict:
+        """Render one SQL result with serving-side observability
+        (ISSUE 9): render time feeds the stage histogram and the
+        response's estimated wire bytes feed the per-skeleton
+        statements table (obs/statements.py)."""
+        import time as _t
+
+        t0 = _t.perf_counter()
+        resp = self._render(result)
+        log = self.session.stmt_log
+        if log.obs_enabled:
+            from cloudberry_tpu.obs.metrics import observe_stage
+
+            observe_stage(log, "render", _t.perf_counter() - t0)
+            log.statements.add_wire(sql, _resp_bytes(resp))
+            # tenant-labeled served counter: the registry's per-tenant
+            # attribution (obs/metrics.py bump tenant=) without a new
+            # snapshot surface
+            log.bump("requests_served", tenant=tenant)
+        return resp
 
     def _render(self, result) -> dict:
         """One execution result → the wire response dict (shared by the

@@ -120,6 +120,10 @@ class Session:
         from cloudberry_tpu.exec.instrument import StatementLog
 
         self.stmt_log = StatementLog()
+        # observability plane (cloudberry_tpu/obs/): the log carries the
+        # engine's metrics registry, trace ring, and statement-stats
+        # table; the session's ObsConfig sizes/gates them
+        self.stmt_log.configure_obs(self.config.obs)
         # admission circuit breaker (lifecycle.py): K consecutive
         # device-loss recoveries trip writes to read-only-degraded; a
         # server shares ONE across its connection sessions, like the gate
@@ -224,6 +228,10 @@ class Session:
             t_dl = _t.monotonic() + timeout
             deadline = t_dl if deadline is None else min(deadline, t_dl)
         handle = lifecycle.StatementHandle(log_id, deadline=deadline)
+        # statement trace (obs/trace.py): the span tree rides the handle
+        # so every thread serving this statement records against it; the
+        # sampler (config.obs.trace_sample) bounds tracing under load
+        handle.trace = self.stmt_log.start_trace(log_id, query)
         self.stmt_log.attach(log_id, handle)
         is_read = _read_only(query)
         # device-loss recoveries THIS statement needed — the circuit
@@ -237,6 +245,8 @@ class Session:
             recoveries[0] += 1
             if not t_first_fail[0]:
                 t_first_fail[0] = _t.monotonic()
+            if handle.trace is not None:
+                handle.trace.attempt = recoveries[0]
             # recovery observability: the activity row shows the attempt
             # count + planned backoff, and the state flips to
             # 'recovering' so a stalled row reads as a retry in
@@ -256,6 +266,10 @@ class Session:
         # upper bound under concurrency) — "zero after warmup" is the
         # generic-plan acceptance contract
         compiles_before = self.stmt_log.counter("compiles")
+        # per-statement generic-plan observability, same delta discipline
+        # as the compile counter: the statements table aggregates the
+        # generic-hit rate per skeleton from these (obs/statements.py)
+        generic_before = self.stmt_log.counter("generic_hits")
         head = query.lstrip()[:10].split(None, 1)
         is_txn_control = bool(head) and head[0].lower() in (
             "begin", "commit", "rollback", "abort", "start", "end")
@@ -339,7 +353,9 @@ class Session:
         self.stmt_log.finish(
             log_id, "ok" if is_batch else str(out)[:80],
             rows=out.num_rows() if is_batch else -1,
-            compiles=self.stmt_log.counter("compiles") - compiles_before)
+            compiles=self.stmt_log.counter("compiles") - compiles_before,
+            generic_hits=self.stmt_log.counter("generic_hits")
+            - generic_before)
         return out
 
     def _recover_mesh(self, e: Exception) -> None:
@@ -417,7 +433,10 @@ class Session:
         return query + "\x00" + repr(sorted(params.items()))
 
     def _sql_once(self, query: str, **params: Any):
+        import time as _t
+
         from cloudberry_tpu.exec.resource import check_admission
+        from cloudberry_tpu.obs import trace as OT
         from cloudberry_tpu.plan.planner import plan_statement
         from cloudberry_tpu.sql.parser import parse_sql
         from cloudberry_tpu.utils.faultinject import fault_point
@@ -431,11 +450,23 @@ class Session:
             self.stmt_log.bump("stmt_cache_hits")
             self.stmt_log.bump("dispatches")
             self._dispatch_seams(fault_point)
+            t_wait = _t.perf_counter()
             with self._gate, self._admitted(cost):
-                return runner()
+                # the admission wait is the direct path's queue-wait:
+                # span from requesting the slot to holding it
+                self._obs_wait(t_wait)
+                return self._obs_launch(runner)
 
-        stmt = parse_sql(query)
-        result = plan_statement(stmt, self, params)
+        from cloudberry_tpu.obs import metrics as OM
+
+        t0 = _t.perf_counter()
+        with OT.span("parse"):
+            stmt = parse_sql(query)
+        t1 = _t.perf_counter()
+        OM.observe_stage(self.stmt_log, "parse", t1 - t0)
+        with OT.span("plan"):
+            result = plan_statement(stmt, self, params)
+        OM.observe_stage(self.stmt_log, "plan", _t.perf_counter() - t1)
         if result.is_ddl:
             return result.ddl_result
         # admission control: memory budget check + queue slot + vmem
@@ -475,13 +506,42 @@ class Session:
                 raise
             self.stmt_log.bump("dispatches")
             self._dispatch_seams(fault_point)
+            t_wait = _t.perf_counter()
             with self._gate, self._admitted(
                     self.config.resource.query_mem_bytes):
+                self._obs_wait(t_wait)
                 return self._run_cached_tiled(ckey, texe)
         self.stmt_log.bump("dispatches")
         self._dispatch_seams(fault_point)
+        t_wait = _t.perf_counter()
         with self._gate, self._admitted(est.peak_bytes) as sid:
+            self._obs_wait(t_wait)
             return self._run_with_growth(ckey, query, result.plan, sid)
+
+    def _obs_wait(self, t0: float) -> None:
+        """Record the admission/queue wait that just ended (span +
+        stage histogram) — called immediately after entering the gate."""
+        import time as _t
+
+        from cloudberry_tpu.obs import metrics as OM
+        from cloudberry_tpu.obs import trace as OT
+
+        dt = _t.perf_counter() - t0
+        OT.mark("queue-wait", t0)
+        OM.observe_stage(self.stmt_log, "queue_wait", dt)
+
+    def _obs_launch(self, runner):
+        """Run a compiled statement runner, recording the launch stage
+        (histogram; the precise device span records inside
+        run_executable/execute_distributed)."""
+        import time as _t
+
+        from cloudberry_tpu.obs import metrics as OM
+
+        t0 = _t.perf_counter()
+        out = runner()
+        OM.observe_stage(self.stmt_log, "launch", _t.perf_counter() - t0)
+        return out
 
     def _admitted(self, cost: int):
         """Queue slot (bounded active statements, MAX_COST, priority wake
@@ -553,7 +613,7 @@ class Session:
         if not self._any_external(names):
             self._cache_statement(ckey, names, texe.run,
                                   self.config.resource.query_mem_bytes)
-        return texe.run()
+        return self._obs_launch(texe.run)
 
     def _any_external(self, names) -> bool:
         # foreign (FDW) and directory tables count: their rows change
@@ -827,7 +887,7 @@ class Session:
 
             self._cache_statement(ckey, names, runner,
                                   estimate_plan_memory(plan).peak_bytes)
-        return runner()
+        return self._obs_launch(runner)
 
     def _cache_statement(self, ckey: str, names, runner,
                          cost: int = 0) -> None:
@@ -925,9 +985,18 @@ class Session:
 
     def explain_analyze(self, query: str) -> str:
         """Execute with instrumentation; returns the annotated plan (the
-        distributed EXPLAIN ANALYZE analog, explain_gp.c)."""
+        distributed EXPLAIN ANALYZE analog, explain_gp.c).
+
+        Runs THROUGH the statement pipeline (instrument.run_pipeline):
+        lifecycle handle + activity entry, dispatch seams, admission
+        gate, and the generic-plan form of the program — the same
+        program the serving path runs, with per-node row counts as an
+        extra output. Motion nodes annotate with collective launches /
+        wire bytes / capacity rung, runtime filters with observed
+        jf_rows_in/out, and tiled execution appends its per-tile time
+        histogram + checkpoint/resume counters."""
         from cloudberry_tpu.exec.instrument import (
-            explain_analyze_text, plan_nodes_in_order, run_instrumented)
+            explain_analyze_text, plan_nodes_in_order, run_pipeline)
         from cloudberry_tpu.plan.planner import plan_statement
         from cloudberry_tpu.sql.parser import parse_sql
 
@@ -936,12 +1005,14 @@ class Session:
         result = plan_statement(stmt, self, {})
         if result.is_ddl:
             return str(result.ddl_result)
-        _, metrics = run_instrumented(result.plan, self, query)
+        _, metrics, annotations = run_pipeline(result.plan, self, query)
         counts = {id(n): r for n, (_, _, r) in
                   zip(plan_nodes_in_order(result.plan), metrics.node_rows)
                   if r >= 0}
         return explain_analyze_text(result.plan, counts,
-                                    metrics.wall_s, metrics.compile_s)
+                                    metrics.wall_s, metrics.compile_s,
+                                    annotations=annotations,
+                                    tiled_report=self.last_tiled_report)
 
     # ------------------------------------------------------- data placement
 

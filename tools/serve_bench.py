@@ -60,7 +60,13 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               "compiles,dispatches,batches,batched_requests,avg_occupancy,"
               "deadline_misses,cancels,recovery_count,tiles_replayed,"
               "recovery_ms,tenant,tenant_qps,tenant_p50_ms,tenant_p99_ms,"
-              "tenant_queue_depth,fairness_index")
+              "tenant_queue_depth,fairness_index,"
+              # ISSUE 9: server-side latency percentiles from the obs
+              # registry's statement_seconds histogram (engine clocks,
+              # not client clocks) + per-stage time shares + sampled
+              # trace span counts
+              "srv_p50_ms,srv_p95_ms,srv_p99_ms,queue_wait_share,"
+              "compile_share,launch_share,render_share,trace_spans")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -89,7 +95,8 @@ def parse_tenantspec(spec: str, clients: int):
 def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
                   mix: str = "point", chaos: float = 0.0,
                   tenants=None, server_core: str = "async",
-                  clients: int = 16, aging_s: float = None):
+                  clients: int = 16, aging_s: float = None,
+                  trace_sample: int = 0):
     import numpy as np
 
     import cloudberry_tpu as cb
@@ -121,6 +128,11 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         # probabilistic device loss compounds per tile: give recovery
         # more re-dispatches than the default flap allowance
         over["health.retries"] = 4
+    if trace_sample:
+        # --trace-sample N: keep every Nth statement's span tree; the
+        # run dumps the ring as ONE perfetto-loadable file at the end
+        over["obs.trace_sample"] = max(1, trace_sample)
+        over["obs.trace_ring"] = 512
     cfg = Config().with_overrides(**over)
     s = cb.Session(cfg)
     s.sql("create table pts (k bigint, v bigint, w double) "
@@ -253,12 +265,29 @@ def _pct(lats, p: float) -> float:
     return lats[min(len(lats) - 1, int(p * len(lats)))] * 1000
 
 
+def _stage_shares(registry) -> tuple[dict, int]:
+    """(per-stage time shares, sampled span count) from the obs
+    registry: each stage_seconds.<stage> histogram's SUM over the total
+    across stages — where a served statement's time actually went,
+    measured server-side."""
+    snap = registry.snapshot()
+    hists = snap.get("histograms", {})
+    sums = {name.split(".", 1)[1]: h["sum"]
+            for name, h in hists.items()
+            if name.startswith("stage_seconds.")}
+    total = sum(sums.values()) or 1.0
+    shares = {f"{k}_share": round(v / total, 4) for k, v in sums.items()}
+    spans = snap.get("counters", {}).get("trace_statements", 0)
+    return shares, spans
+
+
 def run_mode(mode: str, mix: str, clients: int, duration_s: float,
              rows: int, tick_s: float, max_batch: int,
              cancel_mix: float = 0.0, deadline_s: float = 0.005,
              chaos: float = 0.0, tenants=None,
              server_core: str = "async",
-             driver_threads: int = 16, aging_s: float = None) -> dict:
+             driver_threads: int = 16, aging_s: float = None,
+             trace_sample: int = 0, trace_out: str = None) -> dict:
     """One closed-loop run; returns the CSV row fields.
 
     ``cancel_mix``: fraction of requests carrying a TIGHT per-request
@@ -280,7 +309,7 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     session = build_session(mode, rows, tick_s, max_batch,
                             mix=mix, chaos=chaos, tenants=tenants,
                             server_core=server_core, clients=clients,
-                            aging_s=aging_s)
+                            aging_s=aging_s, trace_sample=trace_sample)
     # warm the compile caches OUTSIDE the measured window: the bench
     # compares steady-state dispatch, not first-compile latency
     session.sql(_point_sql(0, rows))
@@ -415,6 +444,26 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         # non-CSV extras for programmatic callers
         "_backpressure": rejects[0],
     }
+    # server-side percentiles + stage time shares (obs registry): the
+    # engine's own statement_seconds histogram, immune to client-side
+    # queuing in the bench drivers
+    reg = session.stmt_log.registry
+    sh = reg.hist("statement_seconds") or {}
+    shares, spans = _stage_shares(reg)
+    out["srv_p50_ms"] = round(sh.get("p50", 0.0) * 1000, 3)
+    out["srv_p95_ms"] = round(sh.get("p95", 0.0) * 1000, 3)
+    out["srv_p99_ms"] = round(sh.get("p99", 0.0) * 1000, 3)
+    for col in ("queue_wait_share", "compile_share", "launch_share",
+                "render_share"):
+        out[col] = shares.get(col, 0.0)
+    out["trace_spans"] = spans
+    if trace_sample and trace_out:
+        from cloudberry_tpu.obs.trace import chrome_trace
+
+        with open(trace_out, "w") as fh:
+            json.dump(chrome_trace(session.stmt_log.traces(512)), fh)
+        print(f"# trace written to {trace_out} "
+              f"({spans} sampled statements)", file=sys.stderr)
     if tenant_names:
         # one CSV row per tenant, riding the aggregate's shared columns
         trs = []
@@ -473,6 +522,12 @@ def main(argv=None) -> list[dict]:
                     help="tenancy starvation bound override (waits past "
                          "it are served oldest-first, trading weight "
                          "proportionality for bounded p99)")
+    ap.add_argument("--trace-sample", type=int, default=0,
+                    help="sample every Nth statement's span tree into "
+                         "--trace-out (perfetto-loadable) and report "
+                         "per-stage time-share columns")
+    ap.add_argument("--trace-out", default="serve_trace.json",
+                    help="chrome-trace output path for --trace-sample")
     ap.add_argument("--csv", default=None,
                     help="append CSV rows to this file")
     args = ap.parse_args(argv)
@@ -502,7 +557,9 @@ def main(argv=None) -> list[dict]:
                      deadline_s=args.deadline_s, chaos=args.chaos,
                      tenants=tenants, server_core=args.server_core,
                      driver_threads=args.driver_threads,
-                     aging_s=args.aging_s)
+                     aging_s=args.aging_s,
+                     trace_sample=args.trace_sample,
+                     trace_out=args.trace_out)
         out.append(r)
         rows_out.append(r)
         rows_out.extend(r.get("_tenants", ()))
