@@ -226,6 +226,26 @@ def lint_context() -> dict:
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 
+def planverify_context() -> dict:
+    """The plan-soundness record next to the perf ones (ISSUE 11): run
+    the planck verifier (plan/verify.py) over the whole TPC-H + TPC-DS
+    golden corpus at 1 and 8 segments — nodes checked, rule-table rows
+    hit, findings, wall. Plans only, never compiles or executes, so it
+    runs identically on live and replay rounds."""
+    try:
+        from tools.golden_plans import verify_corpus
+
+        rec = verify_corpus()
+        return {"ok": not rec["findings"],
+                "plans": rec["plans"],
+                "nodes": rec["nodes"],
+                "rules_hit": len(rec["rules_hit"]),
+                "findings": len(rec["findings"]),
+                "wall_s": round(rec["wall_s"], 3)}
+    except Exception as e:  # the bench must never die on its metadata
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
 def recovery_context(session) -> dict:
     """The robustness record next to the lifecycle/join-path ones: the
     mid-statement recovery configuration (exec/recovery.py) and what
@@ -456,6 +476,7 @@ def replay_last_good(reason: str) -> None:
             "join_filter": lg.get("join_filter"),
             "recovery": lg.get("recovery"),
             "lint": lint_context(),
+            "planverify": planverify_context(),
             "obs": obs_context(),
         })
     except Exception:
@@ -467,6 +488,7 @@ def replay_last_good(reason: str) -> None:
             "roofline": roofline_context(
                 bench_queries(), float(os.environ.get("BENCH_SF", "1.0"))),
             "lint": lint_context(),
+            "planverify": planverify_context(),
             "obs": obs_context(),
         })
 
@@ -676,6 +698,7 @@ def measure() -> None:
         "join_filter": join_filter,
         "recovery": recovery,
         "lint": lint_context(),
+        "planverify": planverify_context(),
         "obs": obs,
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},

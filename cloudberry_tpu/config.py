@@ -386,6 +386,24 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class DebugConfig:
+    """Engine self-checks (cost wall clock; default-on only in tests).
+
+    ``verify_plans`` is the planck gate (plan/verify.py): every plan
+    the planner or memo emits is verified — derived vs required
+    distribution properties, capacity-rung discipline, param-slot and
+    runtime-filter placement contracts — right before compile, and a
+    finding raises PlanVerifyError instead of executing a plan whose
+    sharding assumptions are wrong (a silently-wrong answer at 8
+    segments). The memo/distributed/golden test suites run with it ON;
+    measured overhead is a few percent of PLANNING time, so production
+    sessions may enable it too when plan provenance matters more than
+    the margin."""
+
+    verify_plans: bool = False
+
+
+@dataclass(frozen=True)
 class Config:
     n_segments: int = 1
     # Per-statement wall-clock limit in seconds (the statement_timeout
@@ -407,6 +425,7 @@ class Config:
     serve: ServeConfig = field(default_factory=ServeConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    debug: DebugConfig = field(default_factory=DebugConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":
         """Return a copy with dotted-path overrides, e.g.

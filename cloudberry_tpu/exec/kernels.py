@@ -702,6 +702,15 @@ class WireLayout:
         return total + (bits + 7) // 8
 
 
+# the packed wire's declared dtype contract (the int64/DECIMAL limb
+# convention bitcasts whole u32 words): a motion may ship bool columns
+# (flag bits) and columns of exactly these byte widths. The plan
+# verifier (plan/verify.py motion-wire-dtype) checks every motion's
+# schema against this BEFORE execution; wire_layout enforces it at
+# lowering time.
+WIRE_ITEMSIZES = (4, 8)
+
+
 def wire_layout(col_dtypes: dict) -> WireLayout:
     """Layout for a column dict (name -> dtype). Deterministic: bools in
     sorted order take flag bits, then the remaining columns in sorted
@@ -718,7 +727,7 @@ def wire_layout(col_dtypes: dict) -> WireLayout:
     w = n_flag_words
     for n in wides:
         size = np.dtype(col_dtypes[n]).itemsize
-        if size not in (4, 8):
+        if size not in WIRE_ITEMSIZES:
             raise NotImplementedError(
                 f"wire pack: column {n!r} has {size}-byte dtype "
                 f"{col_dtypes[n]}; only 4/8-byte dtypes and bool ship")

@@ -60,6 +60,28 @@ import numpy as np
 from cloudberry_tpu.utils.faultinject import fault_point
 
 
+# The declared re-placement rule per checkpointed mode — HOW a
+# snapshot's carried state re-places onto a changed (degraded) mesh.
+# Keys must equal exec/tiled.py CHECKPOINT_MODES (the plan verifier's
+# recovery-mode-unreplaceable rule and graftlint's planprops pass hold
+# the two tables together both ways); _accept() consults this registry
+# — both membership AND the placement_free flag — so an undeclared
+# mode can never resume from a checkpoint, and a placement-free mode
+# is data here, not a literal buried in the acceptance logic.
+REPLACEABLE = {
+    "agg": {"placement_free": False,
+            "rule": "round-robin partials ahead of the merge motion "
+                    "(colocated one-stage at changed nseg DECLINES)"},
+    "topn": {"placement_free": False,
+             "rule": "host-side global top-m via sort_key_u64, "
+                     "then round-robin"},
+    "sort": {"placement_free": True,
+             "rule": "run stores are pooled already"},
+    "window": {"placement_free": True,
+               "rule": "run stores are pooled already"},
+}
+
+
 @dataclass
 class TileCheckpoint:
     """One statement's resumable state at a tile boundary."""
@@ -407,8 +429,11 @@ class RecoveryCtx:
     def _accept(self, ckpt: TileCheckpoint) -> bool:
         exe, shape = self.exe, self.exe.shape
         mode = shape.mode
-        if mode in ("sort", "window"):
-            return True  # host run stores are placement-free
+        spec = REPLACEABLE.get(mode)
+        if spec is None:
+            return False  # no declared re-placement rule: never resume
+        if spec["placement_free"]:
+            return True  # host run stores need no re-placement
         cur_cap = self._current_cap()
         if self.dist:
             nseg = exe.nseg

@@ -49,6 +49,15 @@ from cloudberry_tpu.plan.distribute import (_all_exprs, _finalize_project,
 _MAX_TILE = 1 << 22
 _MIN_TILE = 1 << 12
 
+# The declared set of tiled executor modes that snapshot carried state
+# into the recovery store (_TileShape.mode values whose tick() paths
+# checkpoint). exec/recovery.py REPLACEABLE must cover every entry —
+# the plan verifier (plan/verify.py recovery-mode-unreplaceable) and
+# graftlint's planprops pass pin the two tables together BOTH ways, so
+# a new checkpointing mode cannot ship without a degraded-mesh
+# re-placement rule.
+CHECKPOINT_MODES = ("agg", "topn", "sort", "window")
+
 
 class _AccLeaf(N.PlanNode):
     """Plan leaf standing for the accumulator in the finalize program."""

@@ -110,6 +110,12 @@ INVENTORY_CONST = "INVENTORY"
 # documented Kinds list to its implemented kind == "..." branches)
 META_MODULE = "serve/meta.py"
 
+# planprops pass anchors: the plan verifier's rule table of record,
+# and the checkpointing/re-placement mode tables it pins together
+PLAN_VERIFY_MODULE = "plan/verify.py"
+TILED_MODULE = "exec/tiled.py"
+RECOVERY_MODULE = "exec/recovery.py"
+
 # ---------------------------------------------------------------- witness
 
 # The DECLARED lock acquisition order (coarse ranks; acquiring a lock of
@@ -166,6 +172,9 @@ class LintConfig:
     taxonomy_module: str = TAXONOMY_MODULE
     faultinject_module: str = FAULTINJECT_MODULE
     meta_module: str = META_MODULE
+    plan_verify_module: str = PLAN_VERIFY_MODULE
+    tiled_module: str = TILED_MODULE
+    recovery_module: str = RECOVERY_MODULE
     # seam names armed only from tests/tools (not declared at an engine
     # call site) that the inventory still documents
     inventory_extra_ok: frozenset = frozenset()

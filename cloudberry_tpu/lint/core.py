@@ -178,8 +178,8 @@ def run_lint(paths, cfg=None) -> LintResult:
     errors in the passes themselves do propagate — the gate must fail
     loudly, not mask itself."""
     from cloudberry_tpu.lint.config import LintConfig
-    from cloudberry_tpu.lint.passes import locks, obs, seams, taxonomy
-    from cloudberry_tpu.lint.passes import tracepurity
+    from cloudberry_tpu.lint.passes import locks, obs, planprops, seams
+    from cloudberry_tpu.lint.passes import taxonomy, tracepurity
 
     cfg = cfg if cfg is not None else LintConfig()
     result = LintResult()
@@ -195,6 +195,7 @@ def run_lint(paths, cfg=None) -> LintResult:
     raw += taxonomy.run(parsed, cfg)
     raw += seams.run(parsed, cfg)
     raw += obs.run(parsed, cfg)
+    raw += planprops.run(parsed, cfg)
 
     by_file = {m.relpath: m for m in result.modules}
     for f in raw:

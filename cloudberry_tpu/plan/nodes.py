@@ -75,8 +75,15 @@ class PlanNode:
         mv = getattr(self, "_aqumv", None)
         if mv is not None and indent == 0:
             lines.append(f"AQUMV: answered from materialized view {mv}")
+        # the verifier's DERIVED distribution (plan/verify.py
+        # annotate_derived) — printed NEXT TO the stamped locus so plan
+        # reviews and golden diffs show sharding explicitly, and a
+        # derivation change is a visible diff even when the stamp
+        # agrees
+        vd = getattr(self, "_vdist", None)
         lines.append(" " * indent + "-> " + self.title()
                      + (f"  [{self.sharding}]" if self.sharding else "")
+                     + (f"  dist:{vd}" if vd is not None else "")
                      # memo exploration abstained on this region root —
                      # its joins fell back to the greedy cdbpath rules
                      # (plan/memo.py annotate_distribution); pinned in

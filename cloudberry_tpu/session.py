@@ -469,6 +469,11 @@ class Session:
         OM.observe_stage(self.stmt_log, "plan", _t.perf_counter() - t1)
         if result.is_ddl:
             return result.ddl_result
+        # the planck gate (config.debug.verify_plans): every plan the
+        # planner or memo emitted is verified against the derived-vs-
+        # required property rules RIGHT BEFORE compile — a finding is a
+        # refusal, not a silently wrong answer at 8 segments
+        self._verify_plan(result.plan, "session")
         # admission control: memory budget check + queue slot + vmem
         # reservation (vmem-tracker / resqueue analogs, exec/resource.py);
         # an over-budget plan falls back to tiled out-of-core execution
@@ -497,6 +502,7 @@ class Session:
                 clone.config = self.config.with_overrides(
                     **{"planner.enable_memo": False})
                 result2 = plan_statement(stmt, clone, params)
+                self._verify_plan(result2.plan, "greedy-replan")
                 texe = plan_tiled(result2.plan, clone)
                 if texe is not None:
                     # the clone only existed to plan greedy: runs must
@@ -972,6 +978,16 @@ class Session:
             self._rung_cache[key] = fn
         return fn
 
+    def _verify_plan(self, plan, context: str) -> None:
+        """config.debug.verify_plans gate (plan/verify.py): verify a
+        freshly planned statement and raise PlanVerifyError with
+        node-path findings instead of compiling a broken plan."""
+        if plan is None or not self.config.debug.verify_plans:
+            return
+        from cloudberry_tpu.plan.verify import check_plan
+
+        check_plan(plan, self, context)
+
     def explain(self, query: str) -> str:
         from cloudberry_tpu.sql.parser import parse_sql
         from cloudberry_tpu.plan.planner import plan_statement
@@ -981,6 +997,22 @@ class Session:
         result = plan_statement(stmt, self, {}, explain_only=True)
         if result.is_ddl:
             return str(result.ddl_result)
+        if self.config.n_segments > 1 \
+                and getattr(result.plan, "_direct_segment", None) is None:
+            # stamp the verifier's DERIVED distribution on every node
+            # so the plan text shows sharding explicitly (``dist:``):
+            # the bracketed locus is what the distributor STAMPED, the
+            # dist: suffix is what the rule table DERIVES — golden
+            # diffs pin both, independently. The annotation walk IS a
+            # verification, so the debug gate rides it for free.
+            from cloudberry_tpu.plan.verify import (PlanVerifyError,
+                                                    annotate_derived)
+
+            findings = annotate_derived(result.plan, self)
+            if findings and self.config.debug.verify_plans:
+                raise PlanVerifyError(findings, "explain")
+        else:
+            self._verify_plan(result.plan, "explain")
         return result.plan.explain()
 
     def explain_analyze(self, query: str) -> str:
@@ -1005,6 +1037,7 @@ class Session:
         result = plan_statement(stmt, self, {})
         if result.is_ddl:
             return str(result.ddl_result)
+        self._verify_plan(result.plan, "explain-analyze")
         _, metrics, annotations = run_pipeline(result.plan, self, query)
         counts = {id(n): r for n, (_, _, r) in
                   zip(plan_nodes_in_order(result.plan), metrics.node_rows)

@@ -17,7 +17,12 @@ from tests.test_tpch import assert_frames_match
 
 @pytest.fixture(scope="module")
 def dist_session():
-    s = cb.Session(Config(n_segments=8))
+    # verify_plans: every distributed plan in this suite runs the
+    # planck gate (plan/verify.py) before compiling — derived
+    # distribution properties must match the stamps or the test fails
+    # with a node-path diagnostic instead of a wrong answer
+    s = cb.Session(Config(n_segments=8).with_overrides(
+        **{"debug.verify_plans": True}))
     load_tpch(s, sf=0.01, seed=7)
     tables = {n: t.to_pandas() for n, t in s.catalog.tables.items()}
     return s, tables

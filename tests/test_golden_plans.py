@@ -1,38 +1,72 @@
 """Plan-shape regression tests (the ORCA minidump-replay analog).
 
-Every TPC-H query's optimized plan — join order, motion placement,
-capacities, share nodes — must match its committed snapshot in
-tests/golden/. A legitimate planner change regenerates them with
+Every TPC-H and TPC-DS query's optimized plan — join order, motion
+placement, capacities, share nodes, the ``dist:`` derived-distribution
+annotations — must match its committed snapshot in tests/golden/. A
+legitimate planner change regenerates them with
 `python -m tools.golden_plans` and the diff is reviewed like any code.
+
+The sessions here run with ``config.debug.verify_plans`` ON: every
+plan is additionally checked by the planck verifier (plan/verify.py)
+while planning, so a plan whose derived distribution properties no
+longer match its stamps fails with a node-path diagnostic even before
+the text comparison — a corrupted golden corpus is a loud failure, not
+a silent replan.
 """
 
 import os
 
 import pytest
 
-from tools.golden_plans import (GOLDEN_DIR, make_session, plan_text,
+from tools.golden_plans import (GOLDEN_DIR, corpus, plan_text,
                                 snapshot_name)
+from tools.tpcds_queries import DS_QUERIES
 from tools.tpch_queries import QUERIES
 
 _SESSIONS = {}
+_FACTORIES = {suite: factory for suite, factory, _ in corpus()}
 
 
-def _session(nseg):
-    if nseg not in _SESSIONS:
-        _SESSIONS[nseg] = make_session(nseg)
-    return _SESSIONS[nseg]
+def _session(suite, nseg):
+    key = (suite, nseg)
+    if key not in _SESSIONS:
+        _SESSIONS[key] = _FACTORIES[suite](nseg)
+    return _SESSIONS[key]
+
+
+def _check(suite, queries, qname, nseg):
+    path = os.path.join(GOLDEN_DIR, snapshot_name(qname, nseg, suite))
+    assert os.path.exists(path), \
+        f"missing golden plan {path}; run python -m tools.golden_plans"
+    with open(path) as fh:
+        expected = fh.read()
+    # plan_text verifies (debug.verify_plans session) AND snapshots
+    got = plan_text(_session(suite, nseg), queries[qname])
+    assert got == expected, (
+        f"plan shape changed for {suite} {qname} (nseg={nseg}).\n"
+        f"--- expected ---\n{expected}\n--- got ---\n{got}\n"
+        "If intentional, regenerate: python -m tools.golden_plans")
 
 
 @pytest.mark.parametrize("nseg", [1, 8], ids=["single", "dist8"])
 @pytest.mark.parametrize("qname", sorted(QUERIES))
 def test_plan_shape(qname, nseg):
-    path = os.path.join(GOLDEN_DIR, snapshot_name(qname, nseg))
-    assert os.path.exists(path), \
-        f"missing golden plan {path}; run python -m tools.golden_plans"
-    with open(path) as fh:
-        expected = fh.read()
-    got = plan_text(_session(nseg), QUERIES[qname])
-    assert got == expected, (
-        f"plan shape changed for {qname} (nseg={nseg}).\n"
-        f"--- expected ---\n{expected}\n--- got ---\n{got}\n"
-        "If intentional, regenerate: python -m tools.golden_plans")
+    _check("tpch", QUERIES, qname, nseg)
+
+
+@pytest.mark.parametrize("nseg", [1, 8], ids=["single", "dist8"])
+@pytest.mark.parametrize("qname", sorted(DS_QUERIES))
+def test_ds_plan_shape(qname, nseg):
+    _check("tpcds", DS_QUERIES, qname, nseg)
+
+
+def test_golden_corpus_has_no_strays():
+    """Every committed .plan file corresponds to a live corpus entry —
+    a renamed query must not leave a stale snapshot that silently
+    stops being compared."""
+    want = {snapshot_name(q, nseg) for q in QUERIES for nseg in (1, 8)}
+    want |= {snapshot_name(q, nseg, "tpcds")
+             for q in DS_QUERIES for nseg in (1, 8)}
+    have = {f for f in os.listdir(GOLDEN_DIR) if f.endswith(".plan")}
+    assert have == want, (
+        f"stale: {sorted(have - want)}; missing: {sorted(want - have)}")

@@ -711,3 +711,121 @@ def test_witness_rlock_reentry_allowed():
     finally:
         witness.uninstall()
         witness.reset_violations()
+
+
+# ------------------------------------------------------- planprops pass
+
+
+PLANPROPS_NODES_SRC = """
+    class PlanNode:
+        pass
+
+
+    class PGood(PlanNode):
+        pass
+
+
+    class PRogue(PlanNode):
+        pass
+"""
+
+PLANPROPS_VERIFY_SRC = """
+    RULES = {}
+
+
+    def rule(*names, doc=""):
+        def deco(fn):
+            for n in names:
+                RULES[n] = fn
+            return fn
+        return deco
+
+
+    @rule("PGood")
+    def _r_good(v, node, kids, path):
+        return None
+
+
+    @rule("PGone", doc="stale")
+    def _r_gone(v, node, kids, path):
+        return None
+"""
+
+
+def test_planprops_unruled_and_orphan_detected(tmp_path):
+    result = _lint_tree(tmp_path, {
+        "plan/nodes.py": PLANPROPS_NODES_SRC,
+        "plan/verify.py": PLANPROPS_VERIFY_SRC,
+    })
+    unruled = _by_rule(result, "planprops-unruled")
+    assert len(unruled) == 1, [f.render() for f in result.findings]
+    assert unruled[0].file.endswith("plan/nodes.py")
+    assert "PRogue" in unruled[0].message
+    # anchored at the class definition line
+    src_lines = textwrap.dedent(PLANPROPS_NODES_SRC).splitlines()
+    assert "class PRogue" in src_lines[unruled[0].line - 1]
+    orphan = _by_rule(result, "planprops-orphan-rule")
+    assert len(orphan) == 1
+    assert orphan[0].file.endswith("plan/verify.py")
+    assert "PGone" in orphan[0].message
+
+
+def test_planprops_single_file_invocation_does_not_false_positive(
+        tmp_path):
+    """Linting plan/nodes.py WITHOUT verify.py in the set must not
+    declare every class unruled (and vice versa for the orphan
+    direction)."""
+    result = _lint_tree(tmp_path / "a",
+                        {"plan/nodes.py": PLANPROPS_NODES_SRC})
+    assert not _by_rule(result, "planprops-unruled")
+    result = _lint_tree(tmp_path / "b",
+                        {"plan/verify.py": PLANPROPS_VERIFY_SRC})
+    assert not _by_rule(result, "planprops-orphan-rule")
+
+
+def test_planprops_ckpt_mode_drift_detected(tmp_path):
+    result = _lint_tree(tmp_path, {
+        "exec/tiled.py": """
+            CHECKPOINT_MODES = ("agg", "zap")
+        """,
+        "exec/recovery.py": """
+            REPLACEABLE = {
+                "agg": "round-robin partials",
+                "sort": "pooled",
+            }
+        """,
+    })
+    hits = _by_rule(result, "planprops-ckpt-mode")
+    msgs = " | ".join(f.message for f in hits)
+    assert len(hits) == 2, [f.render() for f in result.findings]
+    assert "'zap'" in msgs          # checkpoints, no re-placement rule
+    assert "'sort'" in msgs         # stale re-placement rule
+
+
+def test_planprops_clean_tables_are_silent(tmp_path):
+    result = _lint_tree(tmp_path, {
+        "plan/nodes.py": """
+            class PlanNode:
+                pass
+
+
+            class PGood(PlanNode):
+                pass
+        """,
+        "plan/verify.py": """
+            def rule(*names, doc=""):
+                def deco(fn):
+                    return fn
+                return deco
+
+
+            @rule("PGood")
+            def _r_good(v, node, kids, path):
+                return None
+        """,
+        "exec/tiled.py": 'CHECKPOINT_MODES = ("agg",)\n',
+        "exec/recovery.py": 'REPLACEABLE = {"agg": "rr"}\n',
+    })
+    for r in ("planprops-unruled", "planprops-orphan-rule",
+              "planprops-ckpt-mode"):
+        assert not _by_rule(result, r), r

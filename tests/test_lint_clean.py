@@ -96,3 +96,29 @@ def test_witness_order_covers_discovered_locks():
             resolved += 1
     # the declared order must cover a healthy majority of real sites
     assert resolved >= 15, (resolved, sorted(result.lock_sites))
+
+
+def test_planprops_rule_table_exhaustive_live():
+    """The live mirror of the planprops pass: plan/verify.py RULES
+    covers every PlanNode subclass actually importable from the
+    package, both ways — so the static rule and the runtime table can
+    never drift apart."""
+    from cloudberry_tpu.exec.tiled import _AccLeaf  # noqa: F401
+    from cloudberry_tpu.plan import nodes as N
+    from cloudberry_tpu.plan.verify import RULES
+
+    def subclasses(cls):
+        for sub in cls.__subclasses__():
+            yield sub
+            yield from subclasses(sub)
+
+    live = {c.__name__ for c in subclasses(N.PlanNode)}
+    assert live <= set(RULES), sorted(live - set(RULES))
+    assert set(RULES) <= live, sorted(set(RULES) - live)
+
+
+def test_planprops_mode_tables_agree_live():
+    from cloudberry_tpu.exec.recovery import REPLACEABLE
+    from cloudberry_tpu.exec.tiled import CHECKPOINT_MODES
+
+    assert set(CHECKPOINT_MODES) == set(REPLACEABLE)

@@ -37,7 +37,9 @@ def _load(s, n_fact=400_000, n_dim=150_000):
 
 
 def _mk(**over):
-    ov = {"n_segments": 8}
+    # every memo-suite session runs the planck gate: a plan the memo
+    # stamps wrong fails verification (plan/verify.py) loudly here
+    ov = {"n_segments": 8, "debug.verify_plans": True}
     ov.update(over)
     return cb.Session(get_config().with_overrides(**ov))
 
@@ -290,3 +292,80 @@ def test_memo_abstention_marked_in_explain():
     clean = s.explain("SELECT a.k, sum(a.v) AS sv FROM a "
                       "JOIN b ON a.k = b.k GROUP BY a.k")
     assert "memo: abstained" not in clean
+
+
+# ------------------------------------------------------------ planck
+# Randomized schema / join-graph sweep: whatever join order and motion
+# strategy the memo's joint_search OR the DP+greedy fallback choose,
+# the emitted plan must verify clean against the derived-vs-required
+# property rules (plan/verify.py). Seeded — a failure names its seed.
+
+
+def _random_join_case(seed):
+    """Build a random star/chain schema + a matching query: 3-5 tables,
+    random distribution keys (sometimes deliberately NOT the join key,
+    sometimes RANDOMLY distributed), random join tree, optional GROUP
+    BY / ORDER BY+LIMIT tops."""
+    rng = np.random.default_rng(seed)
+    nt = int(rng.integers(3, 6))
+    dom = int(rng.integers(50, 2_000))
+    ddls, loads, anas = [], [], []
+    for i in range(nt):
+        n = int(rng.integers(200, 4_000))
+        dist = ["k%d" % i, "p%d" % i, None][int(rng.integers(0, 3))]
+        by = f"DISTRIBUTED BY ({dist})" if dist else ""
+        ddls.append(f"CREATE TABLE t{i} (k{i} BIGINT, p{i} BIGINT, "
+                    f"v{i} BIGINT) {by}")
+        loads.append((f"t{i}", {
+            f"k{i}": np.arange(n, dtype=np.int64) % dom,
+            f"p{i}": rng.integers(0, dom, n),
+            f"v{i}": rng.integers(0, 100, n)}))
+        anas.append(f"analyze t{i}")
+    conds = []
+    for i in range(1, nt):
+        j = int(rng.integers(0, i))
+        conds.append(f"t{j}.p{j} = t{i}.k{i}")
+    frm = ", ".join(f"t{i}" for i in range(nt))
+    where = " AND ".join(conds)
+    shape = int(rng.integers(0, 3))
+    if shape == 0:
+        sql = (f"SELECT t0.k0 AS g, sum(t{nt-1}.v{nt-1}) AS s "
+               f"FROM {frm} WHERE {where} GROUP BY t0.k0")
+    elif shape == 1:
+        sql = (f"SELECT count(*) AS c FROM {frm} WHERE {where}")
+    else:
+        sql = (f"SELECT t0.k0 AS g, t1.v1 AS w FROM {frm} "
+               f"WHERE {where} ORDER BY g, w LIMIT 25")
+    return ddls, loads, anas, sql
+
+
+def _sweep_one(seed):
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.plan.verify import verify_plan
+    from cloudberry_tpu.sql.parser import parse_sql
+
+    ddls, loads, anas, sql = _random_join_case(seed)
+    for memo in (True, False):  # joint_search AND the DP+greedy path
+        s = _mk(**{"planner.enable_memo": memo})
+        for d in ddls:
+            s.sql(d)
+        for name, cols in loads:
+            s.catalog.table(name).set_data(cols)
+        for a in anas:
+            s.sql(a)
+        plan = plan_statement(parse_sql(sql), s, {}).plan
+        findings = verify_plan(plan, s)
+        assert findings == [], (
+            f"seed {seed} memo={memo}: {sql}\n"
+            + "\n".join(f.render() for f in findings))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_join_graphs_verify_clean(seed):
+    _sweep_one(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6, 30))
+def test_random_join_graphs_verify_clean_full(seed):
+    _sweep_one(seed)

@@ -1,11 +1,20 @@
 """Golden plan-shape snapshots — the ORCA minidump-replay analog.
 
 `python -m tools.golden_plans` regenerates tests/golden/*.plan for every
-TPC-H query in single-segment and 8-segment modes; the committed files are
-the expected plans, and tests/test_golden_plans.py fails on any regression
-(capacity changes, motion placement, join order, share nodes...). Like the
-reference's 1,246 .mdp fixtures, this pins optimizer behavior with no
-cluster and no oracle run.
+TPC-H query AND every supported TPC-DS query in single-segment and
+8-segment modes; the committed files are the expected plans, and
+tests/test_golden_plans.py fails on any regression (capacity changes,
+motion placement, join order, share nodes, the ``dist:`` derived-
+distribution annotations...). Like the reference's 1,246 .mdp fixtures,
+this pins optimizer behavior with no cluster and no oracle run.
+
+Every plan in the corpus is additionally run through the planck
+verifier (plan/verify.py) — sessions here carry
+``config.debug.verify_plans``, so regeneration REFUSES to write a
+golden file for a plan that fails its derived-vs-required property
+check, and the test suite re-verifies on every run: a corrupted golden
+plan is a test failure with a node-path diagnostic, not a silent
+replan.
 """
 
 from __future__ import annotations
@@ -17,15 +26,36 @@ GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 SF = 0.01
 SEED = 7
+DS_SCALE = 0.5
+DS_SEED = 11
 
 
-def make_session(nseg: int):
-    import cloudberry_tpu as cb
+def _config(nseg: int, verify: bool = True):
     from cloudberry_tpu.config import Config
+
+    # the golden corpus verifies by default: every planned statement
+    # runs the planck gate (plan/verify.py) before its text is
+    # snapshotted. verify=False serves verify_corpus, which calls the
+    # verifier itself to COLLECT findings instead of raising.
+    return Config(n_segments=nseg).with_overrides(
+        **{"debug.verify_plans": verify})
+
+
+def make_session(nseg: int, verify: bool = True):
+    import cloudberry_tpu as cb
     from tools.tpchgen import load_tpch
 
-    s = cb.Session(Config(n_segments=nseg)) if nseg > 1 else cb.Session()
+    s = cb.Session(_config(nseg, verify))
     load_tpch(s, sf=SF, seed=SEED)
+    return s
+
+
+def make_ds_session(nseg: int, verify: bool = True):
+    import cloudberry_tpu as cb
+    from tools.tpcdsgen import load_tpcds
+
+    s = cb.Session(_config(nseg, verify))
+    load_tpcds(s, scale=DS_SCALE, seed=DS_SEED)
     return s
 
 
@@ -33,23 +63,67 @@ def plan_text(session, sql: str) -> str:
     return session.explain(sql).rstrip() + "\n"
 
 
-def snapshot_name(qname: str, nseg: int) -> str:
-    return f"{qname}_seg{nseg}.plan"
+def snapshot_name(qname: str, nseg: int, suite: str = "tpch") -> str:
+    prefix = "ds_" if suite == "tpcds" else ""
+    return f"{prefix}{qname}_seg{nseg}.plan"
+
+
+def corpus() -> list[tuple[str, object, dict]]:
+    """(suite, session factory, queries) per benchmark corpus — THE
+    one place that knows which loader serves which suite."""
+    from tools.tpcds_queries import DS_QUERIES
+    from tools.tpch_queries import QUERIES
+
+    return [("tpch", make_session, QUERIES),
+            ("tpcds", make_ds_session, DS_QUERIES)]
+
+
+def verify_corpus(nsegs=(1, 8)) -> dict:
+    """Plan + verify the WHOLE golden corpus (no files touched): the
+    tools/lint_gate.py --plans and bench.py ``planverify`` currency.
+    Returns {"plans", "nodes", "rules_hit", "findings", "wall_s"}."""
+    import time
+
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.plan.verify import Verifier
+    from cloudberry_tpu.sql.parser import parse_sql
+
+    t0 = time.perf_counter()
+    plans = nodes = 0
+    rules: set[str] = set()
+    findings: list[dict] = []
+    for nseg in nsegs:
+        for suite, factory, queries in corpus():
+            # ungated session: this sweep runs the Verifier itself to
+            # COLLECT findings (one bad plan reports, never aborts)
+            s = factory(nseg, verify=False)
+            for qname in sorted(queries):
+                r = plan_statement(parse_sql(queries[qname]), s, {})
+                v = Verifier(s, r.plan)
+                for f in v.verify(r.plan):
+                    findings.append({"suite": suite, "query": qname,
+                                     "nseg": nseg, **f.as_dict()})
+                plans += 1
+                nodes += v.nodes_checked
+                rules |= v.rules_hit
+    return {"plans": plans, "nodes": nodes,
+            "rules_hit": sorted(rules), "findings": findings,
+            "wall_s": time.perf_counter() - t0}
 
 
 def regenerate() -> list[str]:
-    from tools.tpch_queries import QUERIES
-
     os.makedirs(GOLDEN_DIR, exist_ok=True)
     written = []
     for nseg in (1, 8):
-        s = make_session(nseg)
-        for qname in sorted(QUERIES):
-            text = plan_text(s, QUERIES[qname])
-            path = os.path.join(GOLDEN_DIR, snapshot_name(qname, nseg))
-            with open(path, "w") as fh:
-                fh.write(text)
-            written.append(path)
+        for suite, factory, queries in corpus():
+            s = factory(nseg)
+            for qname in sorted(queries):
+                text = plan_text(s, queries[qname])
+                path = os.path.join(
+                    GOLDEN_DIR, snapshot_name(qname, nseg, suite))
+                with open(path, "w") as fh:
+                    fh.write(text)
+                written.append(path)
     return written
 
 

@@ -11,10 +11,17 @@ cloudberry_tpu package) and prints a single JSON object:
      "suppression_sites": [{"file", "line", "rule", "justification"}],
      "files": N}
 
-Exit code mirrors ``python -m cloudberry_tpu.lint``: 0 clean, 1 findings.
-The bench harness embeds the same counts as its "lint" record
-(bench.py lint_context) so rule/suppression drift shows up in the bench
-trajectory next to the perf numbers.
+``--plans`` additionally runs the planck plan verifier (plan/verify.py)
+over the whole TPC-H + TPC-DS golden corpus at 1 and 8 segments and
+merges a "plans" record ({"plans", "nodes", "rules_hit", "findings",
+"wall_s"}) — one CI gate for the Python invariants AND the plan-IR
+invariants.
+
+Exit code mirrors ``python -m cloudberry_tpu.lint``: 0 clean, 1 findings
+(from either gate), 2 usage/setup error. The bench harness embeds the
+same counts as its "lint" / "planverify" records (bench.py
+lint_context / planverify_context) so rule/suppression/plan drift shows
+up in the bench trajectory next to the perf numbers.
 """
 
 from __future__ import annotations
@@ -49,8 +56,33 @@ def gate_record(paths=None) -> dict:
     }
 
 
+def plans_record() -> dict:
+    """Golden-corpus plan verification (shared with bench.py's
+    planverify record): every TPC-H + TPC-DS plan at 1 and 8 segments
+    through plan/verify.py."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tools.golden_plans import verify_corpus
+
+    rec = verify_corpus()
+    rec["ok"] = not rec["findings"]
+    rec["rules_hit"] = len(rec["rules_hit"])
+    rec["wall_s"] = round(rec["wall_s"], 3)
+    return rec
+
+
 def main() -> int:
-    rec = gate_record([p for p in sys.argv[1:] if not p.startswith("-")])
+    args = sys.argv[1:]
+    rec = gate_record([p for p in args if not p.startswith("-")])
+    if "--plans" in args:
+        try:
+            rec["plans"] = plans_record()
+        except Exception as e:
+            print(f"plan verification did not run: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+        rec["ok"] = rec["ok"] and rec["plans"]["ok"]
     print(json.dumps(rec, indent=1))
     return 0 if rec["ok"] else 1
 
