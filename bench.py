@@ -151,6 +151,16 @@ def interconnect_context(session, qnames, nseg: int = 8) -> dict:
                 sum(np.dtype(f.type.np_dtype).itemsize
                     for f in node.fields) + 1)
         out["per_query"][qn] = rec
+    # live skew telemetry (ISSUE 12): what THIS process's distributed
+    # executions observed per redistribute — rows-per-destination
+    # max/mean ratio histogram + the skew_events alarm counter
+    # (config.obs.skew_ratio), riding next to the static wire totals
+    log_ = session.stmt_log
+    out["skew"] = {
+        "skew_events": log_.counter("skew_events"),
+        "ratio_hist": log_.registry.hist("motion_skew_ratio"),
+        "seg_rows_max_hist": log_.registry.hist("motion_seg_rows_max"),
+    }
     return out
 
 
@@ -294,6 +304,15 @@ def obs_context(session=None) -> dict:
             "trace_statements": snap["counters"].get(
                 "trace_statements", 0),
             "statement_rows": len(session.stmt_log.statements),
+            # capacity & forensics plane (ISSUE 12): statement memory
+            # accounting + skew alarms + flight captures over the run
+            "stmt_device_bytes": session.stmt_log.registry.hist(
+                "stmt_device_bytes"),
+            "peak_stmt_bytes": snap["gauges"].get(
+                "stmt_device_bytes_peak", 0.0),
+            "skew_events": snap["counters"].get("skew_events", 0),
+            "flight_captures": snap["counters"].get(
+                "flight_captures", 0),
         })
 
     def build_side(enabled: bool):

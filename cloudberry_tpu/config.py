@@ -319,6 +319,12 @@ class RecoveryConfig:
     # Statements whose checkpoints the store retains at once (LRU;
     # entries are discarded when their statement finishes anyway).
     max_statements: int = 8
+    # Host bytes the checkpoint store may pin across ALL statements
+    # (LRU by bytes; 0 = unbounded). Recovery is an optimization, so an
+    # eviction only costs the victim a full replay on its next device
+    # loss — counted as ``ckpt_evictions``, and the live pin total shows
+    # as the ``mem_recovery_pins_bytes`` gauge (obs/capacity.py).
+    max_bytes: int = 256 << 20
 
 
 @dataclass(frozen=True)
@@ -383,6 +389,20 @@ class ObsConfig:
     max_spans: int = 512
     # Skeleton rows in the pg_stat_statements analog (LRU dealloc).
     statements_max: int = 256
+    # Slow-statement flight recorder (obs/flightrec.py): a statement
+    # slower than this many milliseconds — or one that errors — captures
+    # a bounded debug bundle (trace spans, plan, skeleton + param
+    # fingerprint, counter deltas, config epoch, result digest) into the
+    # engine-wide ring read by ``meta "flight"`` and replayed offline by
+    # tools/flight_replay.py. 0 disables capture.
+    slow_ms: float = 5000.0
+    # Flight bundles retained engine-wide (ring; oldest drop).
+    flight_ring: int = 16
+    # Per-motion skew alarm (obs capacity plane): a redistribute whose
+    # global rows-per-destination max/mean ratio reaches this bumps
+    # ``skew_events`` and stamps the ratio on EXPLAIN ANALYZE's motion
+    # annotation. 0 disables the counter (histograms still record).
+    skew_ratio: float = 3.0
 
 
 @dataclass(frozen=True)

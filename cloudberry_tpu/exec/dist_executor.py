@@ -115,13 +115,19 @@ def compile_distributed(plan: N.PlanNode, session, param_keys=None,
                               _out_specs_like(plan)))
 
 
-def record_motion_stats(plan: N.PlanNode, stats: dict) -> None:
+def record_motion_stats(plan: N.PlanNode, stats: dict,
+                        session=None) -> None:
     """Pin each redistribute's observed global bucket demand onto its
     motion node (``_observed_bucket``): on overflow the retry promotes
     straight to the rung that fits instead of probing rung by rung.
-    Runtime-filter row counts pin the same way (``_jf_pre``/``_jf_post``).
-    Engine-counter accumulation lives in record_jf_counters — called
-    separately, only once raise_checks passed."""
+    Runtime-filter row counts pin the same way (``_jf_pre``/``_jf_post``),
+    and the per-destination demand vector pins as ``_seg_rows`` with its
+    derived max/mean ``_skew_ratio`` — the skew telemetry EXPLAIN
+    ANALYZE's motion annotations render. With a ``session``, skew also
+    feeds the engine registry (obs histograms + the ``skew_events``
+    counter past ``config.obs.skew_ratio``). Engine-counter accumulation
+    for join filters lives in record_jf_counters — called separately,
+    only once raise_checks passed."""
     import re
 
     # redistribute-only by construction; the kind filter also guards the
@@ -139,12 +145,55 @@ def record_motion_stats(plan: N.PlanNode, stats: dict) -> None:
             if node is not None:
                 node._observed_bucket = int(np.asarray(v))
             continue
+        m = re.search(r"seg rows \(node (\d+)\)", key)
+        if m is not None:
+            node = motions.get(int(m.group(1)))
+            if node is not None:
+                node._seg_rows = np.asarray(v).astype(np.int64)
+            continue
         m = re.search(r"join_filter (pre|post) \(node (\d+)\)", key)
         if m is not None:
             node = filters.get(int(m.group(2)))
             if node is not None:
                 which = "_jf_pre" if m.group(1) == "pre" else "_jf_post"
                 setattr(node, which, int(np.asarray(v)))
+    _record_skew(motions.values(), session)
+
+
+def _record_skew(motions, session) -> None:
+    """Per-motion skew observability (the capacity plane, ISSUE 12):
+    from each redistribute's per-destination demand vector derive the
+    max/mean skew ratio, record rows-per-segment and wire-bytes-per-
+    segment histograms, and bump ``skew_events`` when a shuffle crosses
+    ``config.obs.skew_ratio`` — hot destinations are the binding
+    constraint the rung ladder pays for, and they must be visible in
+    ``meta "metrics"`` before they become overflow retries."""
+    from cloudberry_tpu.obs.capacity import _wire_row_bytes
+
+    log = getattr(session, "stmt_log", None) if session is not None \
+        else None
+    threshold = float(session.config.obs.skew_ratio) \
+        if session is not None else 0.0
+    for node in motions:
+        rows = getattr(node, "_seg_rows", None)
+        if rows is None:
+            continue
+        total = int(rows.sum())
+        if total <= 0 or rows.shape[0] == 0:
+            node._skew_ratio = None
+            continue
+        mean = total / rows.shape[0]
+        ratio = float(rows.max() / mean)
+        node._skew_ratio = ratio
+        if log is None or not log.obs_enabled:
+            continue
+        reg = log.registry
+        reg.observe("motion_skew_ratio", ratio)
+        reg.observe("motion_seg_rows_max", int(rows.max()))
+        reg.observe("motion_seg_wire_bytes_max",
+                    int(rows.max()) * _wire_row_bytes(node))
+        if threshold > 0 and ratio >= threshold:
+            log.bump("skew_events")
 
 
 def record_jf_counters(stats: dict, log) -> None:
@@ -175,7 +224,7 @@ def execute_distributed(plan: N.PlanNode, session,
     with OT.span("launch", mode="dist"), \
             OT.device_annotation("launch-dist"):
         cols, sel, checks, stats = fn(inputs)
-    record_motion_stats(plan, stats)
+    record_motion_stats(plan, stats, session=session)
     X.raise_checks(checks)
     record_jf_counters(stats, getattr(session, "stmt_log", None))
     # every segment computed the (gathered) final result; read the first
@@ -398,6 +447,12 @@ class DistLowerer(X.Lowerer):
         # rung that fits — one retry, not a probe up the ladder
         self.stats[f"required bucket (node {id(node)})"] = \
             self.tx.pmax(jnp.max(counts), SEG_AXIS)
+        # per-destination GLOBAL demand (replicated vector): the same
+        # psum the rung adaptation rides, promoted to skew telemetry —
+        # the host derives rows-per-segment / wire-bytes-per-segment
+        # skew ratios (max/mean) from it (record_motion_stats)
+        self.stats[f"seg rows (node {id(node)})"] = \
+            self.tx.psum(counts, SEG_AXIS)
 
         order = jnp.argsort(dest)
         sorted_dest = dest[order]

@@ -96,7 +96,7 @@ def declare(session, name: str, query_ast) -> dict:
                 fn = compile_distributed(stripped, session)
                 inputs, _ = prepare_dist_inputs(stripped, session)
                 cols, sel, checks, stats = fn(inputs)
-                record_motion_stats(stripped, stats)
+                record_motion_stats(stripped, stats, session=session)
                 X.raise_checks(checks)
                 record_jf_counters(stats,
                                    getattr(session, "stmt_log", None))

@@ -68,8 +68,12 @@ class StatementLog:
         # completed statement trace span trees, newest last (bounded)
         self._trace_ring = collections.deque(maxlen=64)
         self._trace_seq = itertools.count()
+        # slow-statement flight bundles, newest last (obs/flightrec.py;
+        # bounded — the forensics plane must never become the leak)
+        self._flight_ring = collections.deque(maxlen=16)
         self.obs_enabled = True
         self.trace_sample = 1
+        self.slow_ms = 5000.0
 
     def configure_obs(self, obs_cfg) -> None:
         """Apply a session's ObsConfig (config.py). Called once at
@@ -83,6 +87,9 @@ class StatementLog:
         self.trace_sample = max(1, int(obs_cfg.trace_sample))
         self._trace_ring = collections.deque(
             self._trace_ring, maxlen=max(1, obs_cfg.trace_ring))
+        self._flight_ring = collections.deque(
+            self._flight_ring, maxlen=max(1, obs_cfg.flight_ring))
+        self.slow_ms = float(getattr(obs_cfg, "slow_ms", 0.0))
         if self.statements.max_rows != obs_cfg.statements_max:
             self.statements = StatementStats(max(1, obs_cfg.statements_max))
         self._max_spans = max(16, obs_cfg.max_spans)
@@ -120,6 +127,24 @@ class StatementLog:
         """Most recent completed trace exports, newest first."""
         out = list(self._trace_ring)[-max(1, limit):]
         return out[::-1]
+
+    # ------------------------------------------------------ flight ring
+
+    def add_flight(self, bundle: dict) -> None:
+        """Record one flight-recorder bundle (obs/flightrec.py); deque
+        appends are GIL-atomic, like the trace ring's."""
+        self._flight_ring.append(bundle)
+        self.registry.bump("flight_captures")
+
+    def flights(self, limit: int = 8) -> list[dict]:
+        """Most recent flight bundles, newest first (``meta "flight"``)."""
+        out = list(self._flight_ring)[-max(1, limit):]
+        return out[::-1]
+
+    def ring_sizes(self) -> dict:
+        """Current ring occupancy — the capacity plane's gauge feed."""
+        return {"traces": len(self._trace_ring),
+                "flights": len(self._flight_ring)}
 
     def begin(self, sql: str, session_id: int = 0) -> int:
         sid = next(self._ids)
@@ -221,6 +246,15 @@ class StatementLog:
             # real) — feeding this stub into the statements table /
             # latency histogram / trace ring would double-count it
             return
+        # live progress closes with the statement: success is EXACTLY
+        # 1.0 (the monotone contract's endpoint), and the final
+        # fraction rides the history entry so a failed statement's
+        # partial progress stays inspectable after the fact
+        prog = getattr(handle, "progress", None)
+        if prog is not None:
+            if status != "error":
+                prog.complete()
+            entry["progress"] = prog.fraction
         # pg_stat_statements aggregation + trace close ride every finish
         # path (session.sql, the dispatcher's batched finishes) — one
         # funnel, so the counters-consistency contract holds engine-wide
@@ -248,7 +282,30 @@ class StatementLog:
                 h = e.get("handle")
                 if h is not None and h.deadline is not None:
                     row["deadline_in_s"] = round(h.deadline - mono, 4)
+                p = getattr(h, "progress", None)
+                if p is not None:
+                    # Progress._lock is a declared leaf below this lock
+                    row["progress"] = round(p.fraction, 4)
                 out.append(row)
+        return out
+
+    def progress_rows(self) -> list[dict]:
+        """Live per-statement progress (``meta "progress"``): every
+        active statement's monotone fraction + tile/row positions, with
+        enough identity (id, sql, state, elapsed) to act on — the
+        pg_stat_progress_* role."""
+        mono = time.monotonic()
+        out = []
+        with self._lock:
+            entries = [(dict(id=e["id"], sql=e["sql"],
+                             state=e.get("state", "running"),
+                             elapsed_s=round(mono - e["_t0"], 4)),
+                        getattr(e.get("handle"), "progress", None))
+                       for e in self._active.values()]
+        for row, p in entries:
+            row.update(p.snapshot() if p is not None
+                       else {"fraction": None})
+            out.append(row)
         return out
 
     def recent(self, limit: int = 50) -> list[dict]:
@@ -354,22 +411,20 @@ def motion_annotations(plan: N.PlanNode, counts: dict,
 
     - PMotion: collective launches (1 fused on the packed wire, one per
       column otherwise), estimated wire bytes (rows into the motion ×
-      packed row width), and the capacity rung for redistributes;
+      packed row width), the capacity rung for redistributes, and —
+      when the run recorded per-destination demand (``_seg_rows``,
+      exec/dist_executor.py) — the observed skew ratio (max/mean rows
+      per destination) with the hottest destination's row count;
     - PRuntimeFilter: observed jf_rows_in/out when the digest executor
       recorded them (``_jf_pre``/``_jf_post``, exec/dist_executor.py).
     """
-    from cloudberry_tpu.exec import kernels as K
+    from cloudberry_tpu.obs.capacity import _wire_row_bytes
 
     out: dict[int, str] = {}
     for n in plan_nodes_in_order(plan):
         if isinstance(n, N.PMotion):
             fields = n.child.fields
-            dtypes = {f.name: f.type.np_dtype for f in fields}
-            try:
-                row_bytes = K.wire_layout(dtypes).row_bytes()
-            except NotImplementedError:
-                row_bytes = sum(np.dtype(d).itemsize
-                                for d in dtypes.values())
+            row_bytes = _wire_row_bytes(n)
             launches = 1 if packed else max(1, len(fields))
             rows = counts.get(id(n.child), -1)
             bits = [f"launches={launches}"]
@@ -377,6 +432,13 @@ def motion_annotations(plan: N.PlanNode, counts: dict,
                 bits.append(f"wire_bytes={rows * row_bytes}")
             if n.kind == "redistribute":
                 bits.append(f"rung={n.bucket_cap}")
+                ratio = getattr(n, "_skew_ratio", None)
+                if ratio is not None:
+                    bits.append(f"skew={ratio:.2f}")
+                    seg_rows = getattr(n, "_seg_rows", None)
+                    if seg_rows is not None:
+                        bits.append(
+                            f"hot_seg_rows={int(np.max(seg_rows))}")
             out[id(n)] = "  ".join(bits)
         elif isinstance(n, N.PRuntimeFilter):
             pre = getattr(n, "_jf_pre", None)
@@ -573,6 +635,10 @@ def run_pipeline(plan: N.PlanNode, session, query: str):
         deadline = time.monotonic() + timeout
     handle = lifecycle.StatementHandle(log_id, deadline=deadline)
     handle.trace = log.start_trace(log_id, query)
+    if log.obs_enabled:
+        from cloudberry_tpu.obs.progress import Progress
+
+        handle.progress = Progress()
     log.attach(log_id, handle)
     compiles_before = log.counter("compiles")
     try:
@@ -627,6 +693,9 @@ def _pipeline_once(plan, session, query):
         texe = plan_tiled(plan, session)
         if texe is None:
             raise
+        from cloudberry_tpu.obs import capacity as OC
+
+        OC.record_tiled(session.stmt_log, texe.report)
         t0 = time.monotonic()
         with session._gate, session._admitted(
                 session.config.resource.query_mem_bytes):
@@ -636,6 +705,9 @@ def _pipeline_once(plan, session, query):
                            batch.num_rows())
         return batch, metrics, motion_annotations(plan, {}, packed)
     bindings = _generic_form(session, plan)
+    from cloudberry_tpu.obs import capacity as OC
+
+    OC.record_statement(session.stmt_log, plan, session, est=est)
     seg = getattr(plan, "_direct_segment", None)
     with session._gate, session._admitted(est.peak_bytes):
         if session.config.n_segments > 1 and seg is None:
@@ -650,7 +722,7 @@ def _pipeline_once(plan, session, query):
                 inputs["$params"] = dict(bindings)
             (cols, sel, checks, stats), compile_s, wall_s = \
                 _timed_compile_run(fn, inputs, log=session.stmt_log)
-            DX.record_motion_stats(plan, stats)
+            DX.record_motion_stats(plan, stats, session=session)
             X.raise_checks(checks)
             DX.record_jf_counters(stats, session.stmt_log)
             counts_host = DX.instrument_counts(plan, stats)

@@ -100,10 +100,17 @@ class TileCheckpoint:
 class RecoveryStore:
     """Host-side, statement-scoped checkpoint store (one per session
     tree; server connection sessions share the owning session's).
-    Bounded LRU — checkpoints also die with their statement
+    Bounded LRU two ways: by statement count AND by pinned host BYTES
+    (``config.recovery.max_bytes``) — a long statement with many big
+    checkpoints must not pin unbounded host memory. Evicting a victim
+    only costs it a full replay on its next device loss (recovery is an
+    optimization by contract); evictions count as ``ckpt_evictions``
+    and the live pin total feeds the ``mem_recovery_pins_bytes`` gauge
+    (obs/capacity.py). Checkpoints also die with their statement
     (session.sql discards on completion)."""
 
-    def __init__(self, max_statements: int = 8):
+    def __init__(self, max_statements: int = 8, max_bytes: int = 0,
+                 log=None):
         self._lock = threading.Lock()
         self._ckpts: dict[int, TileCheckpoint] = {}
         # tiles the CURRENT attempt of a statement has completed — the
@@ -111,13 +118,56 @@ class RecoveryStore:
         # lost since its last snapshot (tiles_replayed)
         self._progress: dict[int, int] = {}
         self.max_statements = max_statements
+        self.max_bytes = int(max_bytes)
+        self._bytes = 0
+        self._log = log
+
+    @staticmethod
+    def _ckpt_nbytes(ckpt: TileCheckpoint) -> int:
+        from cloudberry_tpu.obs.capacity import nbytes_of
+
+        return nbytes_of(ckpt.payload) + nbytes_of(ckpt.consumed)
 
     def save(self, sid: int, ckpt: TileCheckpoint) -> None:
+        nb = self._ckpt_nbytes(ckpt)
+        evicted = 0
+        refused = 0
+        if self.max_bytes > 0 and nb > self.max_bytes:
+            # one snapshot alone over the budget: refuse the pin
+            # outright — evicting innocents would not make it fit, and
+            # the statement's own EARLIER (within-budget) checkpoint
+            # stays pinned so a loss still resumes from there
+            refused = 1
+        else:
+            with self._lock:
+                old = self._ckpts.pop(sid, None)
+                if old is not None:
+                    self._bytes -= getattr(old, "_nbytes", 0)
+                ckpt._nbytes = nb
+                while self._ckpts and (
+                        len(self._ckpts) >= self.max_statements
+                        or (self.max_bytes > 0
+                            and self._bytes + nb > self.max_bytes)):
+                    victim = self._ckpts.pop(next(iter(self._ckpts)))
+                    self._bytes -= getattr(victim, "_nbytes", 0)
+                    evicted += 1
+                self._ckpts[sid] = ckpt
+                self._bytes += nb
+        # counter bumps outside the store lock: the store lock stays a
+        # near-leaf that never calls out while held
+        if self._log is not None:
+            if evicted:
+                self._log.bump("ckpt_evictions", evicted)
+            if refused:
+                self._log.bump("ckpt_oversize_refused", refused)
+
+    def pinned_bytes(self) -> int:
         with self._lock:
-            self._ckpts.pop(sid, None)
-            while len(self._ckpts) >= self.max_statements:
-                self._ckpts.pop(next(iter(self._ckpts)))
-            self._ckpts[sid] = ckpt
+            return int(self._bytes)
+
+    def pinned_count(self) -> int:
+        with self._lock:
+            return len(self._ckpts)
 
     def load(self, sid: int, signature: tuple) -> Optional[TileCheckpoint]:
         with self._lock:
@@ -144,7 +194,9 @@ class RecoveryStore:
 
     def discard(self, sid: int) -> None:
         with self._lock:
-            self._ckpts.pop(sid, None)
+            ckpt = self._ckpts.pop(sid, None)
+            if ckpt is not None:
+                self._bytes -= getattr(ckpt, "_nbytes", 0)
             self._progress.pop(sid, None)
 
 

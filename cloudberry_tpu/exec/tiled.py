@@ -587,11 +587,12 @@ class _TileLowerer(_ReplacingLowerer):
 
 class _TileTimer:
     """Per-tile step timing (the ISSUE-9 tiled telemetry): each step's
-    wall feeds the engine ``tile_step_seconds`` histogram and — when the
-    statement is traced — a per-tile span; ``stamp()`` summarizes the
-    distribution onto the run report for EXPLAIN ANALYZE's tiled
-    trailer. Bounded by construction: one fixed-size histogram, and
-    spans ride the trace's own cap."""
+    wall feeds the engine ``tile_seconds`` histogram — so tile-time
+    regressions show in ``meta "metrics"`` without an instrumented
+    rerun — and, when the statement is traced, a per-tile span;
+    ``stamp()`` summarizes the distribution onto the run report for
+    EXPLAIN ANALYZE's tiled trailer. Bounded by construction: one
+    fixed-size histogram, and spans ride the trace's own cap."""
 
     def __init__(self, session):
         from cloudberry_tpu.obs.metrics import _Hist
@@ -614,7 +615,7 @@ class _TileTimer:
                 dt = _t.perf_counter() - t0
                 self._h.add(dt)
                 if self._log is not None and self._log.obs_enabled:
-                    self._log.registry.observe("tile_step_seconds", dt)
+                    self._log.registry.observe("tile_seconds", dt)
                 OT.mark("tile-step", t0, tile=idx)
 
         return _cm()
@@ -626,6 +627,19 @@ class _TileTimer:
                 "mean": round(self._h.total / self._h.n, 6),
                 "p95": self._h.quantile(0.95),
             }
+
+
+def _progress_tracker(exe, n_base: int, skip: int):
+    """Live-progress feeder for a single-node tile loop
+    (obs/progress.py): one lane — the remaining row prefix of the
+    deterministic stream. A no-op object when the statement carries no
+    Progress (obs off, or no lifecycle scope)."""
+    from cloudberry_tpu.obs.progress import TileTracker, stream_rows
+
+    total = stream_rows(exe.shape.stream, exe.session)
+    return TileTracker(max(total - skip, 0), exe.tile_rows,
+                       n_base=n_base, base_rows=min(skip, total),
+                       rows_total=total)
 
 
 class AdaptiveTiledMixin:
@@ -895,6 +909,7 @@ class TiledExecutable(AdaptiveTiledMixin):
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
         timer = _TileTimer(self.session)
+        tracker = _progress_tracker(self, n_base, skip)
         for tile, tile_n in _tile_feed(self.shape.stream, self.session,
                                        self.tile_rows, skip_rows=skip):
             fault_point("tile_step")
@@ -905,6 +920,7 @@ class TiledExecutable(AdaptiveTiledMixin):
                                                   dtype=jnp.int32), acc)
                 _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
+            tracker.step(n_local)
             if ctx is not None:
                 ctx.tick(n_local, lambda: R.acc_payload(acc))
         n_tiles = n_base + n_local
@@ -1088,6 +1104,7 @@ class SortTiledExecutable(TiledExecutable):
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
         timer = _TileTimer(self.session)
+        tracker = _progress_tracker(self, n_base, skip)
         for tile, tile_n in _tile_feed(shape.stream, self.session,
                                        self.tile_rows, skip_rows=skip):
             fault_point("tile_step")
@@ -1098,6 +1115,7 @@ class SortTiledExecutable(TiledExecutable):
                     jnp.asarray(tile_n, dtype=jnp.int32))
                 _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
+            tracker.step(n_local)
             mask = np.asarray(psel)
             for nm in names:
                 runs[nm].append(np.asarray(pcols[nm])[mask])

@@ -753,6 +753,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         from cloudberry_tpu.exec.tiled import _TileTimer
 
         timer = _TileTimer(self.session)
+        tracker = _dist_progress_tracker(self, feed, n_base)
         for tile, tile_ns in feed:
             fault_point("tile_step_dist")
             fault_point("tile_device_lost")
@@ -761,6 +762,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                                       acc)
                 _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
+            tracker.step(n_local)
             if ctx is not None:
                 ctx.tick(n_local, lambda: R.acc_payload(acc))
         timer.stamp(self.report)
@@ -963,6 +965,7 @@ class DistSortTiledExecutable(DistTiledExecutable):
         from cloudberry_tpu.exec.tiled import _TileTimer
 
         timer = _TileTimer(self.session)
+        tracker = _dist_progress_tracker(self, feed, n_base)
         for tile, tile_ns in feed:
             fault_point("tile_step_dist")
             fault_point("tile_device_lost")
@@ -971,6 +974,7 @@ class DistSortTiledExecutable(DistTiledExecutable):
                                                       tile, tile_ns)
                 _raise_tile_checks(checks, n_base + n_local)
             n_local += 1
+            tracker.step(n_local)
             selnp = np.asarray(psel)
             for s in range(self.nseg):
                 m = selnp[s]
@@ -1073,6 +1077,31 @@ def _empty_dist_tile(scan: N.PScan, tile_rows: int, nseg: int):
     for phys in scan.mask_map:
         t[f"$nn:{phys}"] = np.zeros((nseg, tile_rows), dtype=np.bool_)
     return t, np.zeros((nseg,), dtype=np.int64)
+
+
+def _dist_progress_tracker(exe, feed, n_base: int):
+    """Live-progress feeder for a distributed tile loop
+    (obs/progress.py): one lane per segment — the loop runs lock-step,
+    so the longest shard sets the tile count. A resumed feed
+    (_ResumedDistFeed) contributes its remaining per-shard counts and
+    the consumed-mask population as the base; the fresh feed derives
+    lanes from the counts-only shard layout."""
+    from cloudberry_tpu.obs.progress import TileTracker, stream_rows
+
+    session = exe.session
+    total = stream_rows(exe.shape.stream, session)
+    base_rows = 0
+    if hasattr(feed, "counts") and hasattr(feed, "base_mask"):
+        lanes = np.asarray(feed.counts)
+        base_rows = int(np.asarray(feed.base_mask).sum())
+    else:
+        try:
+            lanes = np.asarray(session.shard_counts(
+                exe.shape.stream.table_name))
+        except KeyError:
+            lanes = np.asarray([total])
+    return TileTracker(lanes, exe.tile_rows, n_base=n_base,
+                       base_rows=base_rows, rows_total=total)
 
 
 def _dist_tile_feed(scan: N.PScan, session, tile_rows: int):

@@ -179,6 +179,11 @@ class StatementHandle:
         # threads exactly like cancellation does (obs.trace reads it via
         # current_handle())
         self.trace = None
+        # the statement's live progress gauge (obs/progress.py), set by
+        # whoever begins the statement when the telemetry plane is on;
+        # the tiled executors' tile loops feed it through the same
+        # thread-local scope channel
+        self.progress = None
 
     def remaining(self) -> Optional[float]:
         if self.deadline is None:
@@ -218,6 +223,10 @@ class CompositeHandle:
         self.trace = next((h.trace for h in self.handles
                            if getattr(h, "trace", None) is not None),
                           None)
+        # batched statements are stacked point reads — no tile loop, so
+        # the composite scope carries no progress feed of its own (each
+        # member's Progress still completes at its finish)
+        self.progress = None
 
     def check(self) -> None:
         for h in self.handles:

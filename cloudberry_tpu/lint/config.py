@@ -30,7 +30,7 @@ CONCURRENT_CLASSES = frozenset({
     "Dispatcher", "TenantScheduler", "CacheScope", "StatementLog",
     "RecoveryStore", "CircuitBreaker", "CancelToken", "Watchdog",
     "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
-    "MetricsRegistry", "StatementStats", "Trace",
+    "MetricsRegistry", "StatementStats", "Trace", "Progress",
 })
 
 # attribute-name → class-name hints for cross-class lock edges: when a
@@ -144,7 +144,8 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     ("StatementLog._lock", "GenericPlan._rung_lock"),
     # rank 4 — innermost leaves (never call out while held)
     ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock",
-     "MetricsRegistry._lock", "StatementStats._lock", "Trace._lock"),
+     "MetricsRegistry._lock", "StatementStats._lock", "Trace._lock",
+     "Progress._lock"),
 )
 
 

@@ -10,6 +10,12 @@ Rules:
   plane (no exposition, no ``meta "metrics"`` visibility, no bound).
   Count on the registry (``stmt_log.bump`` / ``registry.bump``) or a
   plain dict with an explicit snapshot surface instead.
+- ``obs-gauge-home``: a ``gauge(...)``/``gauge_max(...)`` write outside
+  ``obs/`` (ISSUE 12, same contract as ``obs-counter-home``). Gauges
+  are point-in-time values: one scattered across the engine goes stale
+  invisibly the day its call site stops running. They live in
+  obs/capacity.py's read-time refresh (or another obs/ module), where
+  staleness is structurally impossible.
 - ``obs-meta-verbs``: ``serve/meta.py``'s describe() docstring lists
   its kinds ("Kinds: a | b | ..."); the implemented ``kind == "..."``
   comparisons must match the documented list BOTH ways — an
@@ -32,6 +38,16 @@ def _counter_calls(tree: ast.AST):
         f = node.func
         name = f.id if isinstance(f, ast.Name) else getattr(f, "attr", "")
         if name == "Counter":
+            yield node
+
+
+def _gauge_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else getattr(f, "attr", "")
+        if name in ("gauge", "gauge_max"):
             yield node
 
 
@@ -72,6 +88,13 @@ def run(modules, cfg) -> list[Finding]:
                     "(stmt_log.bump / registry.bump); an ad-hoc Counter "
                     "is invisible to meta \"metrics\" and the "
                     "Prometheus exposition"))
+            for call in _gauge_calls(mod.tree):
+                findings.append(Finding(
+                    "obs-gauge-home", mod.relpath, call.lineno,
+                    "gauge written outside obs/ — gauges are "
+                    "point-in-time values that go stale invisibly when "
+                    "scattered; set them from obs/capacity.py's "
+                    "read-time refresh (or another obs/ module)"))
         if mod.relpath.endswith(cfg.meta_module):
             findings += _check_meta_verbs(mod)
     return findings

@@ -122,6 +122,18 @@ class MetricsRegistry:
             if self._admit(self._gauges, name):
                 self._gauges[name] = float(value)
 
+    def gauge_max(self, name: str, value: float) -> None:
+        """High-water-mark gauge: keeps the max ever written (the
+        peak-statement-memory gauge the capacity plane maintains).
+        Atomic under the registry lock — concurrent writers cannot
+        lose a peak to a read-modify-write race."""
+        with self._lock:
+            if self._admit(self._gauges, name):
+                v = float(value)
+                cur = self._gauges.get(name)
+                if cur is None or v > cur:
+                    self._gauges[name] = v
+
     def observe(self, name: str, value: float,
                 tenant: str | None = None) -> None:
         """One histogram sample (seconds or bytes). The tenant label

@@ -392,6 +392,19 @@ class Dispatcher:
                 chunk, live = live[:self.max_batch], live[self.max_batch:]
                 self._run_group(chunk)
 
+    def _flight(self, req: _Request, handle, status: str,
+                error=None, result=None) -> None:
+        """Flight-recorder seam for the batched path (obs/flightrec.py):
+        batched statements finish here, not in session.sql, so the
+        slow/error capture contract must fire here too. The wall is the
+        handle's own clock — pick-to-finish, the window the member's
+        deadline governs."""
+        from cloudberry_tpu.obs import flightrec as OF
+
+        OF.maybe_capture(self.session, req.sql, status,
+                         time.monotonic() - handle.started, handle,
+                         error=error, result=result)
+
     def _run_group(self, group: list[_Request]) -> None:
         from cloudberry_tpu import lifecycle
 
@@ -417,12 +430,19 @@ class Dispatcher:
             now = time.perf_counter()
             from cloudberry_tpu.obs import metrics as OM
 
+            from cloudberry_tpu.obs.progress import Progress
+
             for sid, h, r in zip(sids, handles, group):
                 log.attach(sid, h)
                 # batched statements bypass session.sql, so their traces
                 # start here; the queue wait each member just finished is
-                # its first span (recorded on the member's own trace)
+                # its first span (recorded on the member's own trace).
+                # Each member gets its own Progress too — stacked point
+                # reads have no tile loop, but the 0→1 completion keeps
+                # meta "progress" rows uniform across dispatch paths
                 h.trace = log.start_trace(sid, r.sql)
+                if log.obs_enabled:
+                    h.progress = Progress()
                 if h.trace is not None:
                     # ends exactly at the trace's root start, so the
                     # wait renders as the root's sibling, never a
@@ -452,6 +472,7 @@ class Dispatcher:
                         self._bump("cancelled")
                         log.finish(sid, "error",
                                    error=f"{type(err).__name__}: {err}")
+                        self._flight(r, h, "error", error=err)
                         r.finish(error=err)
                     else:
                         log.finish(sid, "requeued")
@@ -463,10 +484,10 @@ class Dispatcher:
                     self._run_sequential(survivors)
                 return
             except BaseException as e:
-                for sid in sids:
+                for r, sid, h in zip(group, sids, handles):
                     log.finish(sid, "error",
                                error=f"{type(e).__name__}: {e}")
-                for r in group:
+                    self._flight(r, h, "error", error=e)
                     r.finish(error=e)
                 return
             if out is not None:
@@ -487,12 +508,13 @@ class Dispatcher:
                 compiled = log.counter("compiles") - c0
                 ghead = max(log.counter("generic_hits") - g0
                             - (len(group) - 1), 0)
-                for i, (r, sid, batch) in enumerate(zip(group, sids,
-                                                        out)):
+                for i, (r, sid, h, batch) in enumerate(
+                        zip(group, sids, handles, out)):
                     log.finish(sid, "ok", rows=batch.num_rows(),
                                batch=len(group),
                                compiles=compiled if i == 0 else 0,
                                generic_hits=ghead if i == 0 else 1)
+                    self._flight(r, h, "ok", result=batch)
                     r.finish(result=batch)
                 return
             self._bump("seq_fallbacks")

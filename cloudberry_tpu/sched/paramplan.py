@@ -615,7 +615,7 @@ class GenericPlan:
             # observed bucket demand there, then copy onto the rebind's
             # motions (signature-equal plans walk identically), so a skew
             # overflow still promotes straight to the fitting rung
-            DX.record_motion_stats(self.plan, stats)
+            DX.record_motion_stats(self.plan, stats, session=session)
             for a, b in zip(_redistributes(self.plan),
                             _redistributes(planB)):
                 ob = getattr(a, "_observed_bucket", None)

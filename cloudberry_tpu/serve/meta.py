@@ -45,7 +45,7 @@ def _int_arg(kind: str, arg, default: int) -> int:
 def describe(session, kind: str, arg=None):
     """One metadata answer. Kinds: tables | columns | stats | views |
     matviews | sequences | info | activity | sched | tenants |
-    metrics | statements | trace | summary.
+    metrics | statements | trace | progress | flight | summary.
 
     (graftlint's ``obs-meta-verbs`` rule pins this docstring list to the
     implemented kinds BOTH ways — document new verbs here.)"""
@@ -134,11 +134,28 @@ def describe(session, kind: str, arg=None):
                 "fairness_index": round(sched.fairness_index(), 4)}
     if kind == "metrics":
         # engine-wide metrics registry (obs/metrics.py): counters,
-        # gauges, log2-bucket histograms. arg="prom" returns the
-        # Prometheus-style text exposition instead of the JSON snapshot.
+        # gauges, log2-bucket histograms. Every engine memory-holder
+        # gauge refreshes at READ time (obs/capacity.py) so the
+        # snapshot shows where host+device memory actually sits.
+        # arg="prom" returns the Prometheus-style text exposition
+        # instead of the JSON snapshot.
+        from cloudberry_tpu.obs import capacity
+
+        capacity.refresh_gauges(session)
         if arg == "prom":
             return session.stmt_log.registry.exposition()
         return session.stmt_log.registry.snapshot()
+    if kind == "progress":
+        # live statement progress (obs/progress.py): every active
+        # statement's monotone tiles/rows fraction — the
+        # pg_stat_progress_* role
+        return {"statements": session.stmt_log.progress_rows()}
+    if kind == "flight":
+        # slow-statement flight recorder (obs/flightrec.py): the most
+        # recent captured debug bundles, newest first; arg bounds how
+        # many ship (bundles embed plans + traces — they are not small)
+        return {"flights": session.stmt_log.flights(
+            _int_arg(kind, arg, 8))}
     if kind == "statements":
         # pg_stat_statements analog (obs/statements.py): per-skeleton
         # calls / wall / rows / compiles / generic-hit rate / wire

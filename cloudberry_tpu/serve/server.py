@@ -399,6 +399,11 @@ class Server:
         # ENGINE's held checkpoints, not each backend's (statement ids
         # come from the shared stmt_log, so keys never collide)
         s._recovery = self.session._recovery
+        # memory-gauge anchor (obs/capacity.refresh_gauges): session-
+        # private holders (stmt/store-scan caches) report the SERVING
+        # session's, not whichever backend answered meta "metrics" —
+        # stable values instead of per-connection flapping
+        s._obs_root = self.session
         return s
 
     def _end_connection(self, sess) -> None:

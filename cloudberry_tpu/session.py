@@ -140,7 +140,9 @@ class Session:
         from cloudberry_tpu.exec.recovery import RecoveryStore
 
         self._recovery = RecoveryStore(
-            self.config.recovery.max_statements)
+            self.config.recovery.max_statements,
+            max_bytes=self.config.recovery.max_bytes,
+            log=self.stmt_log)
         self._session_id = id(self) & 0xFFFF
         # COPY ... LOG ERRORS row rejects, per table (the error-log /
         # gp_read_error_log analog, cdbsreh.c)
@@ -232,7 +234,15 @@ class Session:
         # so every thread serving this statement records against it; the
         # sampler (config.obs.trace_sample) bounds tracing under load
         handle.trace = self.stmt_log.start_trace(log_id, query)
+        # live progress (obs/progress.py): the tiled executors' tile
+        # loops feed it through the same handle channel; meta
+        # "progress" and the activity rows read it
+        if self.stmt_log.obs_enabled:
+            from cloudberry_tpu.obs.progress import Progress
+
+            handle.progress = Progress()
         self.stmt_log.attach(log_id, handle)
+        t_begin = _t.monotonic()
         is_read = _read_only(query)
         # device-loss recoveries THIS statement needed — the circuit
         # breaker's consecutive-recovery signal; trial = this write is
@@ -332,6 +342,19 @@ class Session:
                     self.stmt_log.bump("duplicate_build_key_errors")
             self.stmt_log.finish(log_id, "error",
                                  error=f"{type(e).__name__}: {e}")
+            # flight recorder (obs/flightrec.py): an erroring statement
+            # auto-captures its debug bundle — after finish, so the
+            # trace is closed and the bundle ships complete spans
+            from cloudberry_tpu.obs import flightrec as OF
+
+            OF.maybe_capture(
+                self, query, "error", _t.monotonic() - t_begin, handle,
+                params=params, error=e, counters={
+                    "compiles": self.stmt_log.counter("compiles")
+                    - compiles_before,
+                    "generic_hits": self.stmt_log.counter("generic_hits")
+                    - generic_before,
+                    "recoveries": recoveries[0]})
             raise
         finally:
             # statement-scoped checkpoints die with their statement:
@@ -350,12 +373,22 @@ class Session:
         else:
             self._breaker.record_success()
         is_batch = hasattr(out, "num_rows")
+        compiles_d = self.stmt_log.counter("compiles") - compiles_before
+        generic_d = self.stmt_log.counter("generic_hits") - generic_before
         self.stmt_log.finish(
             log_id, "ok" if is_batch else str(out)[:80],
             rows=out.num_rows() if is_batch else -1,
-            compiles=self.stmt_log.counter("compiles") - compiles_before,
-            generic_hits=self.stmt_log.counter("generic_hits")
-            - generic_before)
+            compiles=compiles_d, generic_hits=generic_d)
+        # flight recorder (obs/flightrec.py): a statement crossing
+        # config.obs.slow_ms auto-captures its debug bundle — including
+        # the result digest tools/flight_replay.py re-checks offline
+        from cloudberry_tpu.obs import flightrec as OF
+
+        OF.maybe_capture(
+            self, query, "ok", _t.monotonic() - t_begin, handle,
+            params=params, result=out if is_batch else None,
+            counters={"compiles": compiles_d, "generic_hits": generic_d,
+                      "recoveries": recoveries[0]})
         return out
 
     def _recover_mesh(self, e: Exception) -> None:
@@ -446,9 +479,19 @@ class Session:
         ckey = self._stmt_cache_key(query, params)
         cached = self._cached_statement(ckey)
         if cached is not None:
-            runner, cost = cached
+            runner, cost, obs_bytes = cached
             self.stmt_log.bump("stmt_cache_hits")
             self.stmt_log.bump("dispatches")
+            # capacity plane (obs/capacity.py): the cached DEVICE-BYTE
+            # estimate — one histogram sample, no plan walk on the hot
+            # path. Kept separate from the admission cost: a tiled
+            # runner admits against the whole per-query budget but its
+            # measured working set is the step estimate, and feeding
+            # the budget constant here would pin the peak gauge at
+            # config forever
+            from cloudberry_tpu.obs import capacity as OC
+
+            OC.observe_stmt_bytes(self.stmt_log, obs_bytes)
             self._dispatch_seams(fault_point)
             t_wait = _t.perf_counter()
             with self._gate, self._admitted(cost):
@@ -510,6 +553,9 @@ class Session:
                     texe.session = self
             if texe is None:
                 raise
+            from cloudberry_tpu.obs import capacity as OC
+
+            OC.record_tiled(self.stmt_log, texe.report)
             self.stmt_log.bump("dispatches")
             self._dispatch_seams(fault_point)
             t_wait = _t.perf_counter()
@@ -517,6 +563,11 @@ class Session:
                     self.config.resource.query_mem_bytes):
                 self._obs_wait(t_wait)
                 return self._run_cached_tiled(ckey, texe)
+        from cloudberry_tpu.obs import capacity as OC
+
+        # capacity plane: itemized device-byte estimate (intermediates
+        # + wire buffers + rung capacities) for every fresh plan
+        OC.record_statement(self.stmt_log, result.plan, self, est=est)
         self.stmt_log.bump("dispatches")
         self._dispatch_seams(fault_point)
         t_wait = _t.perf_counter()
@@ -617,8 +668,12 @@ class Session:
         names = sorted({s.table_name
                         for s in X.scans_of(texe._whole_plan())})
         if not self._any_external(names):
-            self._cache_statement(ckey, names, texe.run,
-                                  self.config.resource.query_mem_bytes)
+            report = texe.report
+            self._cache_statement(
+                ckey, names, texe.run,
+                self.config.resource.query_mem_bytes,
+                obs_bytes=max(int(report.get("est_step_bytes", 0)),
+                              int(report.get("est_finalize_bytes", 0))))
         return self._obs_launch(texe.run)
 
     def _any_external(self, names) -> bool:
@@ -822,11 +877,12 @@ class Session:
     _STMT_CACHE_MAX = 64
 
     def _cached_statement(self, ckey: str):
-        """(runner, cost) from a live cache entry, else None — returned
-        together so the caller never re-indexes an entry a concurrent
-        thread may have evicted. LRU: a hit moves the entry to the
-        dict's end (under the lock — hits MUTATE the dict) so hot
-        prepared statements survive bursts of one-off queries."""
+        """(runner, admission cost, obs device-byte estimate) from a
+        live cache entry, else None — returned together so the caller
+        never re-indexes an entry a concurrent thread may have evicted.
+        LRU: a hit moves the entry to the dict's end (under the lock —
+        hits MUTATE the dict) so hot prepared statements survive bursts
+        of one-off queries."""
         with self._stmt_lock:
             entry = self._stmt_cache.pop(ckey, None)
             if entry is not None:
@@ -835,7 +891,7 @@ class Session:
             return None
         from cloudberry_tpu.exec.udf import registry_version
 
-        names, versions, cfg, ddlv, runner, cost = entry
+        names, versions, cfg, ddlv, runner, cost, obs_bytes = entry
         # ddlv pairs the catalog DDL version with the UDF registry
         # version: re-registering a function must drop plans that baked
         # its OLD results in at bind time. The config IDENTITY check is
@@ -854,7 +910,7 @@ class Session:
             with self._stmt_lock:  # free the compiled program
                 self._stmt_cache.pop(ckey, None)
             return None
-        return runner, cost
+        return runner, cost, obs_bytes
 
     def _execute_and_cache(self, ckey: str, query: str, plan):
         from cloudberry_tpu.exec import executor as X
@@ -896,13 +952,18 @@ class Session:
         return self._obs_launch(runner)
 
     def _cache_statement(self, ckey: str, names, runner,
-                         cost: int = 0) -> None:
+                         cost: int = 0, obs_bytes: int | None = None) -> None:
+        """``cost`` is the ADMISSION reservation for cache hits;
+        ``obs_bytes`` (defaults to cost) is the device-byte estimate the
+        capacity plane observes — tiled runners reserve the whole
+        budget but measure their step working set."""
         from cloudberry_tpu.exec.udf import registry_version
 
         entry = (
             names, self._table_versions(names),
             self.config,
-            (self.catalog.ddl_version, registry_version()), runner, cost)
+            (self.catalog.ddl_version, registry_version()), runner, cost,
+            cost if obs_bytes is None else int(obs_bytes))
         with self._stmt_lock:
             self._stmt_cache.pop(ckey, None)  # re-insert at the tail
             while len(self._stmt_cache) >= self._STMT_CACHE_MAX:

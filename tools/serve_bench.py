@@ -66,7 +66,12 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               # not client clocks) + per-stage time shares + sampled
               # trace span counts
               "srv_p50_ms,srv_p95_ms,srv_p99_ms,queue_wait_share,"
-              "compile_share,launch_share,render_share,trace_spans")
+              "compile_share,launch_share,render_share,trace_spans,"
+              # ISSUE 12 (capacity & forensics plane): flight-recorder
+              # captures over the run (--slow-ms arms the threshold),
+              # skew alarms from the motion telemetry, and the peak
+              # per-statement device-byte estimate
+              "flight_captures,skew_events,peak_stmt_mb")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -96,7 +101,7 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
                   mix: str = "point", chaos: float = 0.0,
                   tenants=None, server_core: str = "async",
                   clients: int = 16, aging_s: float = None,
-                  trace_sample: int = 0):
+                  trace_sample: int = 0, slow_ms: float = None):
     import numpy as np
 
     import cloudberry_tpu as cb
@@ -133,6 +138,10 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         # run dumps the ring as ONE perfetto-loadable file at the end
         over["obs.trace_sample"] = max(1, trace_sample)
         over["obs.trace_ring"] = 512
+    if slow_ms is not None:
+        # --slow-ms N: arm the flight recorder at this threshold so the
+        # run's slow-statement captures show up in the CSV
+        over["obs.slow_ms"] = float(slow_ms)
     cfg = Config().with_overrides(**over)
     s = cb.Session(cfg)
     s.sql("create table pts (k bigint, v bigint, w double) "
@@ -287,7 +296,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
              chaos: float = 0.0, tenants=None,
              server_core: str = "async",
              driver_threads: int = 16, aging_s: float = None,
-             trace_sample: int = 0, trace_out: str = None) -> dict:
+             trace_sample: int = 0, trace_out: str = None,
+             slow_ms: float = None) -> dict:
     """One closed-loop run; returns the CSV row fields.
 
     ``cancel_mix``: fraction of requests carrying a TIGHT per-request
@@ -309,7 +319,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     session = build_session(mode, rows, tick_s, max_batch,
                             mix=mix, chaos=chaos, tenants=tenants,
                             server_core=server_core, clients=clients,
-                            aging_s=aging_s, trace_sample=trace_sample)
+                            aging_s=aging_s, trace_sample=trace_sample,
+                            slow_ms=slow_ms)
     # warm the compile caches OUTSIDE the measured window: the bench
     # compares steady-state dispatch, not first-compile latency
     session.sql(_point_sql(0, rows))
@@ -323,6 +334,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     r_before = session.stmt_log.counter("recoveries")
     tr_before = session.stmt_log.counter("tiles_replayed")
     rw_before = session.stmt_log.counter("recovery_wall_ms")
+    fl_before = session.stmt_log.counter("flight_captures")
+    sk_before = session.stmt_log.counter("skew_events")
 
     _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
                     "SchedDeadline")
@@ -457,6 +470,13 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
                 "render_share"):
         out[col] = shares.get(col, 0.0)
     out["trace_spans"] = spans
+    # capacity & forensics columns (ISSUE 12): flight captures over the
+    # run, skew alarms from the motion telemetry, and the peak
+    # per-statement device-byte estimate (high-water gauge)
+    out["flight_captures"] = disp.counter("flight_captures") - fl_before
+    out["skew_events"] = disp.counter("skew_events") - sk_before
+    peak = reg.snapshot()["gauges"].get("stmt_device_bytes_peak", 0.0)
+    out["peak_stmt_mb"] = round(peak / (1 << 20), 3)
     if trace_sample and trace_out:
         from cloudberry_tpu.obs.trace import chrome_trace
 
@@ -528,6 +548,11 @@ def main(argv=None) -> list[dict]:
                          "per-stage time-share columns")
     ap.add_argument("--trace-out", default="serve_trace.json",
                     help="chrome-trace output path for --trace-sample")
+    ap.add_argument("--slow-ms", type=float, default=None,
+                    help="flight-recorder threshold for the run "
+                         "(config.obs.slow_ms): statements slower than "
+                         "this capture debug bundles, counted in the "
+                         "flight_captures CSV column")
     ap.add_argument("--csv", default=None,
                     help="append CSV rows to this file")
     args = ap.parse_args(argv)
@@ -559,7 +584,8 @@ def main(argv=None) -> list[dict]:
                      driver_threads=args.driver_threads,
                      aging_s=args.aging_s,
                      trace_sample=args.trace_sample,
-                     trace_out=args.trace_out)
+                     trace_out=args.trace_out,
+                     slow_ms=args.slow_ms)
         out.append(r)
         rows_out.append(r)
         rows_out.extend(r.get("_tenants", ()))
