@@ -332,6 +332,16 @@ class Table:
         n = self.stats.row_count
         if self.policy.kind == "random":
             return (np.arange(n) % n_segments).astype(np.int32)
+        # staged successor-epoch assignment (parallel/topology.py): the
+        # background rebalancer pre-hashes the table at the pending
+        # epoch's segment count so cutover's first shard layout skips
+        # the full re-hash; version+nseg key it, so a stale stage can
+        # never serve
+        staged = getattr(self, "_topo_assign", None)
+        if staged is not None and staged[1] == n_segments \
+                and staged[0] == getattr(self, "_version", 0) \
+                and len(staged[2]) == n:
+            return staged[2]
         cols = [self.data[k] for k in self.policy.keys]
         h = hashing.hash_columns_np([np.asarray(c) for c in cols])
         return hashing.jump_consistent_hash_np(h, n_segments)

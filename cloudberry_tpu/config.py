@@ -368,6 +368,44 @@ class HealthConfig:
 
 
 @dataclass(frozen=True)
+class TopologyConfig:
+    """Online topology changes (parallel/topology.py): epoch-versioned
+    placement, background minimal-movement rebalance, breaker-guarded
+    cutover, and failover-as-shrink (the gpexpand + FTS-promotion pair
+    made online). Statements pin a TopologyEpoch at dispatch; an
+    expand/shrink creates a successor epoch and statements keep serving
+    on the old one until cutover."""
+
+    # Consecutive probe observations of the SAME survivor set before the
+    # per-statement degrade is promoted to a formal failover-shrink
+    # epoch (the FTS mark-down hysteresis; 1 = promote on first loss).
+    promote_after: int = 2
+    # Consecutive clean probes (devices back) before a failover-shrunk
+    # cluster expands back to its pre-failover segment count.
+    recover_after: int = 2
+    # Automatic expand-back on device recovery (the symmetric half of
+    # failover-as-shrink). Off leaves the shrunken epoch serving until
+    # an operator resizes.
+    auto_recover: bool = True
+    # Seconds a planned cutover waits for statements pinned to the old
+    # epoch to finish before flipping anyway (stragglers stay correct —
+    # placement is derived — or resume through the degraded re-shard
+    # path). Failover promotion never waits: the devices are gone.
+    cutover_wait_s: float = 5.0
+    # Rows hashed per rebalance chunk (the throttle/fault-seam unit for
+    # in-RAM staging; store-backed tables chunk per micro-partition).
+    rebalance_chunk_rows: int = 1 << 16
+    # Sleep between rebalance chunks — the background-rebalance throttle
+    # (a serving cluster's foreground traffic outranks the move).
+    throttle_s: float = 0.0
+    # Fresh plans verified by the planck gate (plan/verify.py) right
+    # after an epoch adoption, even when config.debug.verify_plans is
+    # off — a topology flip is exactly when a stale sharding assumption
+    # would produce a silently wrong answer. 0 disables.
+    verify_replans: int = 4
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """Observability plane (cloudberry_tpu/obs/): statement trace spans,
     the engine-wide metrics registry, and the pg_stat_statements-class
@@ -445,6 +483,7 @@ class Config:
     serve: ServeConfig = field(default_factory=ServeConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    topology: TopologyConfig = field(default_factory=TopologyConfig)
     debug: DebugConfig = field(default_factory=DebugConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":

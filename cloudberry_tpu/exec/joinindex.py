@@ -220,8 +220,12 @@ def _cached_index(session, spec: JoinIndexSpec, segment) -> dict:
     t = session.catalog.table(spec.table)
     t.ensure_loaded()
     nseg = session.config.n_segments
+    # the topology-epoch token rides every shared-tier key: an index
+    # laid out under a pre-cutover epoch (shard-mode arrays follow the
+    # epoch's placement) can never serve after the flip
     key = (sharedcache.table_key(session, spec.table), spec.phys,
-           spec.bits, spec.mode, nseg, segment)
+           spec.bits, spec.mode, nseg, segment,
+           sharedcache.topology_token(session))
     cache, lock = _cache(session)
     with lock:
         hit = cache.pop(key, None)

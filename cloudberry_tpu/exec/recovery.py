@@ -27,8 +27,11 @@ make it so:
   stored per tile);
 - partial merges are associative (the two-stage agg discipline,
   plan/distribute.py:_split_aggs), so the remaining rows may be re-tiled
-  — and re-SHARDED, when the survivor mesh is smaller — without changing
-  the answer;
+  — and re-SHARDED, when the mesh CHANGED between attempts (smaller
+  after a device loss, larger or smaller after an online topology
+  cutover landed mid-statement, parallel/topology.py) — without
+  changing the answer; cross-epoch resumes count as
+  ``topo_resharded_resumes``;
 - on a degraded resume the remaining rows re-shard by the SAME jump hash
   the placement layer uses at the new segment count, so every plan
   invariant (colocation, direct dispatch) holds on the survivor mesh;
@@ -468,6 +471,13 @@ class RecoveryCtx:
             if not dist:
                 self.skip_rows = int(ckpt.consumed)
             self.log.bump("tile_resumes")
+            if dist and ckpt.nseg != exe.nseg:
+                # the checkpoint crossed a topology change (failover
+                # shrink or an online expand cutover landing mid-
+                # statement): the remaining rows re-shard at the new
+                # segment count — counted so a flip's mid-statement
+                # cost is visible next to the epoch counters
+                self.log.bump("topo_resharded_resumes")
         # tiles the failed (or overflowed) attempt completed past the
         # checkpoint are the replay cost of this attempt — ≤ K when a
         # snapshot existed, the whole prior progress when none did

@@ -52,6 +52,8 @@ from cloudberry_tpu.exec.tiled import (_MAX_TILE, _MIN_TILE, _acc_width,
                                        _expr_dict, _merge_bytes, _out_cap,
                                        _raise_tile_checks, AdaptiveTiledMixin)
 from cloudberry_tpu.parallel.mesh import SEG_AXIS, segment_mesh
+from cloudberry_tpu.parallel.topology import \
+    topology_token as _topology_token
 from cloudberry_tpu.plan import expr as ex
 from cloudberry_tpu.plan import nodes as N
 from cloudberry_tpu.utils.faultinject import fault_point
@@ -555,6 +557,10 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             "tiled": True,
             "distributed": True,
             "n_segments": self.nseg,
+            # the topology epoch this executable was (re)built under
+            # (parallel/topology.py): a report whose epoch differs from
+            # the statement's pinned one is the cross-epoch-resume case
+            "topology_epoch": _topology_token(self.session),
             "stream_table": shape.stream.table_name,
             "tile_rows": self.tile_rows,
             "acc_capacity": shape.g_cap,

@@ -31,6 +31,7 @@ CONCURRENT_CLASSES = frozenset({
     "RecoveryStore", "CircuitBreaker", "CancelToken", "Watchdog",
     "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
     "MetricsRegistry", "StatementStats", "Trace", "Progress",
+    "TopologyManager",
 })
 
 # attribute-name → class-name hints for cross-class lock edges: when a
@@ -54,6 +55,9 @@ ATTR_CLASS_HINTS = {
     "token": "CancelToken",
     "_cache_scope": "CacheScope",
     "scope": "CacheScope",
+    "_topology": "TopologyManager",
+    "topology": "TopologyManager",
+    "topo": "TopologyManager",
     "registry": "MetricsRegistry",
     "statements": "StatementStats",
     "session": "Session",
@@ -128,8 +132,10 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     # rank 0 — serving front end (outermost)
     ("Server._inflight_cond", "Server._conn_lock", "Server._login_lock",
      "_RWLock._cond", "_Conn.lock", "_IOLoop._tlock"),
-    # rank 1 — scheduling tier + session cache sync
-    ("Dispatcher._cond", "Session._sync_lock"),
+    # rank 1 — scheduling tier + session cache sync + topology epochs
+    # (TopologyManager._lock is never held across the session sync
+    # lock: pin/cutover capture state under it, release, then adopt)
+    ("Dispatcher._cond", "Session._sync_lock", "TopologyManager._lock"),
     # rank 2 — tenancy / breaker / cache-tier locks (Dispatcher._cond
     # and Session._sync_lock callers nest into these)
     ("TenantScheduler._lock", "CircuitBreaker._lock",

@@ -143,6 +143,8 @@ def cmd_expand(args) -> int:
 
     cfg = load_cluster(args.store)
     old_n, new_n = cfg["n_segments"], args.segments
+    if getattr(args, "online", False):
+        return _expand_online(args, cfg, old_n, new_n)
     s, ts = _open_session(args.store)
     moved_frac = []
     for name, t in s.catalog.tables.items():
@@ -161,6 +163,51 @@ def cmd_expand(args) -> int:
     for name, frac in moved_frac:
         print(f"  {name}: {frac * 100:.1f}% of rows move "
               f"(jump-hash minimal movement)")
+    return 0
+
+
+def _expand_online(args, cfg: dict, old_n: int, new_n: int) -> int:
+    """The gpexpand-made-online path (parallel/topology.py): create a
+    successor epoch, move the jump-hash delta rows partition-by-
+    partition (OCC-committed chunks, journal-resumable, throttled), cut
+    over, and report the measured moved-row fraction against the
+    delta/N minimal-movement bound. A server process on the same store
+    adopts the new epoch at its next statement — no downtime. The
+    offline path (no --online) keeps working and lands on the identical
+    derived placement (pinned equivalent by test)."""
+    import cloudberry_tpu as cb
+
+    if new_n == old_n:
+        print(f"cluster already at {new_n} segments")
+        return 0
+    s = cb.Session(cluster_config(args.store))
+    topo = s._topology
+    state = topo.begin(new_n)
+
+    def report(st):
+        frac = st.moved_rows / max(st.total_rows, 1)
+        print(f"  rebalance: {st.tables_done}/{st.tables_total} tables, "
+              f"{st.moved_rows} rows moved ({frac * 100:.1f}%)",
+              flush=True)
+
+    topo.rebalance(chunk_rows=args.chunk_rows or None,
+                   throttle_s=args.throttle_s, progress=report)
+    out = topo.cutover()
+    cfg["n_segments"] = new_n
+    with open(_cluster_path(args.store), "w") as f:
+        json.dump(cfg, f)
+    verb = "expanded" if new_n > old_n else "shrunk"
+    reb = out["rebalance"]
+    frac = reb["moved_rows"] / max(reb["total_rows"], 1)
+    bound = reb["minimal_bound"]
+    print(f"{verb} cluster {old_n} → {new_n} segments ONLINE "
+          f"(epoch {out['epoch']}, cutover {out['cutover_ms']:.1f} ms)")
+    if reb["total_rows"] and bound:
+        print(f"  moved {reb['moved_rows']} of {reb['total_rows']} rows "
+              f"({frac * 100:.1f}%) vs delta/N minimal-movement bound "
+              f"{bound * 100:.1f}% ({frac / bound:.2f}x)")
+    else:
+        print("  no hashed rows to move")
     return 0
 
 
@@ -306,6 +353,17 @@ def main(argv=None) -> int:
 
     pe = sub.add_parser("expand", help="resize segments (gpexpand/gpshrink)")
     pe.add_argument("--segments", type=int, required=True)
+    pe.add_argument("--online", action="store_true",
+                    help="epoch-versioned online resize: background "
+                         "minimal-delta rebalance + atomic cutover; a "
+                         "serving cluster adopts without downtime "
+                         "(resumable if interrupted)")
+    pe.add_argument("--chunk-rows", type=int, default=0,
+                    help="rows per rebalance chunk (0 = config default)")
+    pe.add_argument("--throttle-s", type=float, default=None,
+                    help="sleep between rebalance chunks (background "
+                         "politeness on a serving cluster; default: "
+                         "config.topology.throttle_s)")
     pe.set_defaults(fn=cmd_expand)
 
     pc = sub.add_parser("check", help="storage consistency (gpcheckcat)")

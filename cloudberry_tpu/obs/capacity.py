@@ -197,6 +197,20 @@ def refresh_gauges(session) -> dict:
     if scan_cache is not None:
         vals["mem_store_scan_bytes"] = nbytes_of(
             list(scan_cache.values()))
+    # versioned topology (parallel/topology.py): the serving epoch id,
+    # the in-flight rebalance fraction (1.0 when no change is pending),
+    # and bytes moved by the current/most-recent rebalance — the
+    # gpexpand-progress gauges next to the flip/promotion counters
+    topo = getattr(session, "_topology", None)
+    if topo is not None:
+        snap = topo.snapshot()
+        vals["topo_epoch"] = snap["epoch"]
+        vals["topo_nseg"] = snap["nseg"]
+        reb = snap.get("rebalance")
+        vals["topo_rebalance_fraction"] = (
+            reb["fraction"] if reb else 1.0)
+        vals["topo_moved_bytes"] = float(
+            log.counter("topo_moved_bytes"))
     for name, v in vals.items():
         log.registry.gauge(name, v)
     return vals

@@ -82,6 +82,12 @@ class HealthMonitor:
     on_failure: Optional[Callable[[ProbeResult], None]] = None
     history_maxlen: int = 0
     history: "object" = None
+    # optional TopologyManager (parallel/topology.py): every probe
+    # result — healthy or not — feeds its persistence detector, so a
+    # monitored server promotes PERSISTENT device loss to an automatic
+    # failover-shrink epoch and device recovery to the symmetric expand
+    # back (the FTS probe → configuration-update loop, versioned)
+    topology: Optional[object] = None
     _stop: threading.Event = field(default_factory=threading.Event)
     _thread: Optional[threading.Thread] = None
 
@@ -104,6 +110,8 @@ class HealthMonitor:
             while not self._stop.wait(self.interval_s):
                 r = probe()
                 self.history.append(r)
+                if self.topology is not None:
+                    self.topology.note_probe(r)
                 if not r.ok and self.on_failure is not None:
                     self.on_failure(r)
 
@@ -120,6 +128,8 @@ class HealthMonitor:
     def probe_now(self) -> ProbeResult:
         r = probe()
         self.history.append(r)
+        if self.topology is not None:
+            self.topology.note_probe(r)
         if not r.ok and self.on_failure is not None:
             self.on_failure(r)
         return r
@@ -142,7 +152,8 @@ def run_with_retry(fn: Callable, retries: int = 1,
                    on_retry: Optional[Callable] = None,
                    max_backoff_s: float = 5.0,
                    budget_s: float = 0.0,
-                   jitter: float = 0.5) -> object:
+                   jitter: float = 0.5,
+                   recoverable_fn: Optional[Callable] = None) -> object:
     """Re-dispatch on device/runtime failure (the recovery model: stateless
     segments over immutable storage → failed statements simply re-run;
     mid-statement checkpoints make the re-run incremental,
@@ -163,17 +174,23 @@ def run_with_retry(fn: Callable, retries: int = 1,
       stays enforced (lifecycle.py Watchdog contract);
     - ``on_retry(exc, backoff_s)`` runs between attempts — the Session
       passes its probe-and-degrade hook there (fts.c probe →
-      configuration update) and surfaces both args in the activity row.
+      configuration update) and surfaces both args in the activity row;
+    - ``recoverable_fn`` overrides the re-dispatch classifier — the
+      Session widens it for statements whose pinned topology epoch was
+      cut over mid-flight (parallel/topology.py): a flip between plan
+      and launch can surface as a shape error rather than device loss,
+      and re-planning at the new epoch is exactly the recovery.
     """
     import random
 
+    rec = recoverable if recoverable_fn is None else recoverable_fn
     t0 = time.monotonic()
     last: Exception | None = None
     for attempt in range(retries + 1):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001
-            if not recoverable(e) or attempt == retries:
+            if not rec(e) or attempt == retries:
                 raise
             if budget_s and time.monotonic() - t0 >= budget_s:
                 raise

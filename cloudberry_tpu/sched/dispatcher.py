@@ -427,6 +427,13 @@ class Dispatcher:
             sids = [log.begin(r.sql) for r in group]
             handles = [lifecycle.StatementHandle(sid, deadline=_dl(r))
                        for sid, r in zip(sids, group)]
+            # topology epoch at batch formation (parallel/topology.py):
+            # a cutover/failover landing mid-launch is detected below
+            # and the batch re-routes sequentially instead of failing
+            # every member with a raw shape/device error
+            from cloudberry_tpu.parallel.topology import topology_token
+
+            topo_tok = topology_token(self.session)
             now = time.perf_counter()
             from cloudberry_tpu.obs import metrics as OM
 
@@ -484,6 +491,24 @@ class Dispatcher:
                     self._run_sequential(survivors)
                 return
             except BaseException as e:
+                from cloudberry_tpu.parallel.health import recoverable
+                from cloudberry_tpu.parallel.topology import \
+                    TopologyRaceError
+
+                if recoverable(e) or isinstance(e, TopologyRaceError) \
+                        or topology_token(self.session) != topo_tok:
+                    # device loss, or a topology flip raced the stacked
+                    # launch: batched statements are READS, so re-route
+                    # them through session.sql, whose retry machinery
+                    # replans at the current epoch — the singles path
+                    # already survives the same flip, and a batch must
+                    # not drop every member where one statement would
+                    # have recovered
+                    self._bump("batch_reroutes")
+                    for sid in sids:
+                        log.finish(sid, "requeued")
+                    self._run_sequential(group)
+                    return
                 for r, sid, h in zip(group, sids, handles):
                     log.finish(sid, "error",
                                error=f"{type(e).__name__}: {e}")

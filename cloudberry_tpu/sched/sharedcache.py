@@ -187,17 +187,31 @@ def table_versions(session, names):
     return tuple(table_key(session, n) for n in names)
 
 
+def topology_token(session) -> int:
+    """The session's current topology-epoch id (parallel/topology.py) —
+    carried by EVERY shared-tier key so a program compiled under an
+    earlier epoch can never serve after a cutover, even when every
+    other identity component aliases (same nseg after a failover/recover
+    round trip, a reused config uid, an unchanged table version)."""
+    from cloudberry_tpu.parallel.topology import topology_token as _tt
+
+    return _tt(session)
+
+
 def plan_epoch(session) -> tuple:
     """The non-table part of a generic plan's validity: the process-wide
-    UDF registry version always; the catalog ddl counter only for
-    private scopes (shared scopes rely on the full structural signature —
-    ddl counters are per-catalog and would just block sharing)."""
+    UDF registry version always, plus the TOPOLOGY EPOCH TOKEN (a
+    cutover orphans every earlier epoch's programs); the catalog ddl
+    counter only for private scopes (shared scopes rely on the full
+    structural signature — ddl counters are per-catalog and would just
+    block sharing)."""
     from cloudberry_tpu.exec.udf import registry_version
 
     scope = scope_for(session)
     if scope.kind == "session":
-        return ("local", session.catalog.ddl_version, registry_version())
-    return ("store", registry_version())
+        return ("local", topology_token(session),
+                session.catalog.ddl_version, registry_version())
+    return ("store", topology_token(session), registry_version())
 
 
 def rung_scope_token(session) -> tuple:
@@ -211,8 +225,10 @@ def rung_scope_token(session) -> tuple:
     identity)."""
     scope = scope_for(session)
     if scope.kind == "store" and not session.catalog.views:
-        return ("shared", config_uid(session.config))
-    return ("cat", session_uid(session), session.catalog.ddl_version)
+        return ("shared", topology_token(session),
+                config_uid(session.config))
+    return ("cat", topology_token(session), session_uid(session),
+            session.catalog.ddl_version)
 
 
 def tier_snapshot(session) -> dict:

@@ -45,7 +45,8 @@ def _int_arg(kind: str, arg, default: int) -> int:
 def describe(session, kind: str, arg=None):
     """One metadata answer. Kinds: tables | columns | stats | views |
     matviews | sequences | info | activity | sched | tenants |
-    metrics | statements | trace | progress | flight | summary.
+    metrics | statements | trace | progress | flight | topology |
+    summary.
 
     (graftlint's ``obs-meta-verbs`` rule pins this docstring list to the
     implemented kinds BOTH ways — document new verbs here.)"""
@@ -156,6 +157,18 @@ def describe(session, kind: str, arg=None):
         # many ship (bundles embed plans + traces — they are not small)
         return {"flights": session.stmt_log.flights(
             _int_arg(kind, arg, 8))}
+    if kind == "topology":
+        # versioned cluster topology (parallel/topology.py): the
+        # serving epoch, any pending change + its rebalance progress
+        # (moved rows vs the jump-hash minimal-movement bound), flip /
+        # promotion counters, and the recent epoch history — the
+        # gp_segment_configuration + gpexpand-status role
+        topo = getattr(session, "_topology", None)
+        if topo is None:
+            return {"enabled": False}
+        out = topo.snapshot()
+        out["enabled"] = True
+        return out
     if kind == "statements":
         # pg_stat_statements analog (obs/statements.py): per-skeleton
         # calls / wall / rows / compiles / generic-hit rate / wire

@@ -391,6 +391,10 @@ class Server:
         # one circuit breaker: device-loss flapping is an ENGINE
         # condition, so read-only-degraded spans backends like the gate
         s._breaker = self.session._breaker
+        # one topology manager (parallel/topology.py): the cluster shape
+        # is engine state — a cutover on any backend's statement flips
+        # every backend at its next epoch pin
+        s._topology = self.session._topology
         # dispatcher + tenancy observability (serve/meta.py "sched" /
         # "tenants") spans backends
         s._dispatcher = getattr(self.session, "_dispatcher", None)

@@ -144,6 +144,20 @@ class Session:
             max_bytes=self.config.recovery.max_bytes,
             log=self.stmt_log)
         self._session_id = id(self) & 0xFFFF
+        # versioned topology (parallel/topology.py): every statement
+        # pins the current TopologyEpoch at dispatch; expand/shrink
+        # creates a successor epoch (online rebalance + cutover) instead
+        # of mutating the mesh in place. A server shares ONE manager
+        # across its connection backends, like the breaker and the
+        # recovery store.
+        from cloudberry_tpu.parallel.topology import TopologyManager
+
+        self._topology = TopologyManager(self)
+        # planck verifications still owed after a topology adoption
+        # (config.topology.verify_replans): the first fresh plans after
+        # a cutover run through the gate even when debug.verify_plans
+        # is off
+        self._verify_next_plans = 0
         # COPY ... LOG ERRORS row rejects, per table (the error-log /
         # gp_read_error_log analog, cdbsreh.c)
         self.copy_errors: dict[str, list] = {}
@@ -220,7 +234,8 @@ class Session:
         import time as _t
 
         from cloudberry_tpu import lifecycle
-        from cloudberry_tpu.parallel.health import run_with_retry
+        from cloudberry_tpu.parallel.health import (recoverable,
+                                                    run_with_retry)
 
         h = self.config.health
         log_id = self.stmt_log.begin(query, self._session_id)
@@ -250,8 +265,15 @@ class Session:
         recoveries = [0]
         t_first_fail = [0.0]
         trial = False
+        # the classifier's last verdict was epoch-motivated: counted in
+        # on_retry (a verdict on the FINAL attempt raises instead of
+        # retrying and must not inflate the counter)
+        epoch_retry = [False]
 
         def on_retry(e, backoff_s=0.0):
+            if epoch_retry[0]:
+                epoch_retry[0] = False
+                self.stmt_log.bump("topo_epoch_retries")
             recoveries[0] += 1
             if not t_first_fail[0]:
                 t_first_fail[0] = _t.monotonic()
@@ -270,6 +292,30 @@ class Session:
                 last_error=type(e).__name__)
             if h.probe_on_error:
                 self._recover_mesh(e)
+            # the retry replans at the CURRENT epoch — re-stamp the
+            # handle so a later unrelated failure is not misclassified
+            # as another topology race (one flip buys one re-dispatch)
+            handle.topology_epoch = self._topology.current.epoch_id
+
+        def epoch_recoverable(e):
+            """Device loss as always — PLUS any non-semantic failure of
+            a read whose pinned topology epoch was cut over mid-flight
+            (parallel/topology.py): the flip between plan and launch can
+            surface as a shape/compile error rather than device loss,
+            and re-dispatching at the new epoch IS the recovery."""
+            from cloudberry_tpu.parallel.topology import \
+                TopologyRaceError
+
+            if recoverable(e) or isinstance(e, TopologyRaceError):
+                return True
+            if isinstance(e, (lifecycle.StatementError,
+                              SerializationError)):
+                return False
+            ep = getattr(handle, "topology_epoch", None)
+            if ep is None or ep == self._topology.current.epoch_id:
+                return False
+            epoch_retry[0] = True
+            return True
 
         # per-statement compile observability: the delta of the engine-wide
         # compile counter over this statement (exact single-threaded; an
@@ -283,7 +329,16 @@ class Session:
         head = query.lstrip()[:10].split(None, 1)
         is_txn_control = bool(head) and head[0].lower() in (
             "begin", "commit", "rollback", "abort", "start", "end")
+        topo_epoch = None
         try:
+            # topology pin (parallel/topology.py): the statement runs to
+            # completion against this epoch; a concurrent cutover waits
+            # for pinned statements (bounded) before flipping, and the
+            # pin is what the drain barrier counts. Pinning also ADOPTS
+            # a newer epoch into this session first (a backend that
+            # missed a flip, or a cross-process `mgmt expand --online`).
+            topo_epoch = self._topology.pin(self)
+            handle.topology_epoch = topo_epoch.epoch_id
             with lifecycle.statement_scope(handle):
                 if not is_read and not is_txn_control:
                     # read-only-degraded admission: an open breaker
@@ -314,7 +369,8 @@ class Session:
                         retries=h.retries, backoff_s=h.backoff_s,
                         on_retry=on_retry,
                         max_backoff_s=h.backoff_max_s,
-                        budget_s=h.retry_budget_s)
+                        budget_s=h.retry_budget_s,
+                        recoverable_fn=epoch_recoverable)
         except BaseException as e:
             # BaseException too: a Ctrl-C mid-statement must not leave a
             # phantom "running" entry in the shared active registry
@@ -361,6 +417,8 @@ class Session:
             # success consumed them, and a semantic failure must not
             # leak state to whatever reuses the log id space later
             self._recovery.discard(log_id)
+            if topo_epoch is not None:
+                self._topology.unpin(topo_epoch)
         if trial:
             self._breaker.trial_succeeded()
         if recoveries[0]:
@@ -402,43 +460,54 @@ class Session:
         r = probe()
         if self.config.health.degrade and r.live:
             self.degrade_mesh(len(r.live), r.live)
+        # failover-as-shrink (parallel/topology.py): the probe result
+        # also feeds the persistence detector — the SAME survivor set
+        # observed config.topology.promote_after times promotes this
+        # per-statement degrade to a formal shrink epoch, and recovery
+        # triggers the symmetric expand back. Called OUTSIDE
+        # degrade_mesh's sync lock (lock-order discipline).
+        self._topology.note_probe(r)
 
     def degrade_mesh(self, n_devices: int, live_ids=None) -> bool:
         """Shrink the segment mesh to ``n_devices`` (over ``live_ids``
         when given) and invalidate every placement/plan cache. Derived
         placement (jump hash over shared storage) makes this a pure
         recompute — no data movement protocol, the reference's
-        gprecoverseg/rebalance role collapses into cache invalidation."""
-        with self._sync_lock:  # server handler threads share this session
-            n = max(1, min(self.config.n_segments, n_devices))
-            changed = n != self.config.n_segments
-            if live_ids is not None:
-                ids = list(live_ids)
-                if len(ids) > n:
-                    # more survivors than segments: the first n suffice,
-                    # and an unchanged prefix keeps caches valid
-                    ids = ids[:n]
-                if ids != list(range(n)):
-                    # a hole mid-list: the mesh must skip dead devices
-                    changed = changed or ids != getattr(
-                        self, "_live_device_ids", None)
-                    self._live_device_ids = ids
-                elif getattr(self, "_live_device_ids", None) is not None:
-                    changed = True
-                    self._live_device_ids = None
-            if not changed:
-                return False
-            self.config = self.config.with_overrides(n_segments=n)
-            self._shard_cache.clear()
-            self._shard_count_cache.clear()
-            with self._stmt_lock:
-                self._stmt_cache.clear()
-            with self._rung_lock:
-                self._rung_cache.clear()
-            with self._generic_lock:
-                self._generic_cache.clear()
-            self._store_scan_cache.clear()
+        gprecoverseg/rebalance role collapses into cache invalidation.
+
+        Versioned (parallel/topology.py): the degrade MINTS a 'degrade'
+        TopologyEpoch FIRST, then adopts it (config swap + cache
+        clears). Mint-before-swap matters: a statement pinning in the
+        window sees the new epoch and adopts the shrunken config — the
+        old ordering let a racing pin re-impose the previous epoch's
+        config on top of the degrade, yielding mixed-shape plans; and
+        the moved epoch token is what lets a statement that raced the
+        swap re-dispatch (epoch_recoverable) instead of surfacing a
+        shape error."""
+        cur = self._topology.current
+        n = max(1, min(cur.nseg, n_devices))
+        ids = None
+        if live_ids is not None:
+            l = list(live_ids)
+            if len(l) > n:
+                # more survivors than segments: the first n suffice,
+                # and an unchanged prefix keeps caches valid
+                l = l[:n]
+            if l != list(range(n)):
+                ids = l  # a hole mid-list: the mesh must skip dead ones
+        ep = self._topology.note_degrade(n, ids)
+        if ep is not None:
+            self._topology._adopt(self, ep)
             return True
+        # the epoch already reflects this loss (another backend minted
+        # it): THIS session may still be on the old config — adopt the
+        # current epoch so the retry replans on the survivor mesh
+        # instead of re-failing at the dead size every attempt
+        cur = self._topology.current
+        if cur.nseg == n and (cur.device_ids or None) == \
+                (tuple(ids) if ids else None):
+            return self._topology._adopt(self, cur)
+        return False
 
     @staticmethod
     def _dispatch_seams(fault_point) -> None:
@@ -507,6 +576,12 @@ class Session:
             stmt = parse_sql(query)
         t1 = _t.perf_counter()
         OM.observe_stage(self.stmt_log, "parse", t1 - t0)
+        # the config this statement PLANS under: a topology cutover
+        # swapping it before execute/cache makes the plan's baked
+        # capacities stale — the executors below refuse with the
+        # retryable TopologyRaceError instead of tracing (or caching) a
+        # mixed-shape program (parallel/topology.py)
+        cfg_plan = self.config
         with OT.span("plan"):
             result = plan_statement(stmt, self, params)
         OM.observe_stage(self.stmt_log, "plan", _t.perf_counter() - t1)
@@ -562,7 +637,7 @@ class Session:
             with self._gate, self._admitted(
                     self.config.resource.query_mem_bytes):
                 self._obs_wait(t_wait)
-                return self._run_cached_tiled(ckey, texe)
+                return self._run_cached_tiled(ckey, texe, cfg_plan)
         from cloudberry_tpu.obs import capacity as OC
 
         # capacity plane: itemized device-byte estimate (intermediates
@@ -573,7 +648,8 @@ class Session:
         t_wait = _t.perf_counter()
         with self._gate, self._admitted(est.peak_bytes) as sid:
             self._obs_wait(t_wait)
-            return self._run_with_growth(ckey, query, result.plan, sid)
+            return self._run_with_growth(ckey, query, result.plan, sid,
+                                         cfg_plan)
 
     def _obs_wait(self, t0: float) -> None:
         """Record the admission/queue wait that just ended (span +
@@ -623,7 +699,7 @@ class Session:
         return _cm()
 
     def _run_with_growth(self, ckey: str, query: str, plan,
-                         stmt_id: int = 0):
+                         stmt_id: int = 0, cfg_plan=None):
         """Execute; on a detected join-expansion overflow, grow the pair
         buffer (re-checking admission) and retry — adaptive capacity, never
         truncation (exec/executor.py:grow_expansion). Growth that blows the
@@ -635,7 +711,8 @@ class Session:
 
         for _ in range(6):
             try:
-                return self._execute_and_cache(ckey, query, plan)
+                return self._execute_and_cache(ckey, query, plan,
+                                               cfg_plan)
             except ExecError as e:
                 with self._stmt_lock:  # drop the failed runner
                     self._stmt_cache.pop(ckey, None)
@@ -659,12 +736,27 @@ class Session:
                     texe = plan_tiled(plan, self)  # …or the plan spills
                     if texe is None:
                         raise
-                    return self._run_cached_tiled(ckey, texe)
-        return self._execute_and_cache(ckey, query, plan)
+                    return self._run_cached_tiled(ckey, texe, cfg_plan)
+        return self._execute_and_cache(ckey, query, plan, cfg_plan)
 
-    def _run_cached_tiled(self, ckey: str, texe):
+    def _check_topology_race(self, cfg_plan) -> None:
+        """Refuse to execute (or cache) a plan whose epoch moved under
+        it: the baked capacities no longer match placement, and the
+        compiled program — or worse, a CACHED one serving later
+        statements — would mix shard shapes from two epochs. The
+        epoch-race retry replans at the new epoch."""
+        if cfg_plan is not None and cfg_plan is not self.config:
+            from cloudberry_tpu.parallel.topology import TopologyRaceError
+
+            self.stmt_log.bump("topo_plan_races")
+            raise TopologyRaceError(
+                "topology epoch changed between plan and execute; "
+                "the statement re-plans at the new epoch")
+
+    def _run_cached_tiled(self, ckey: str, texe, cfg_plan=None):
         from cloudberry_tpu.exec import executor as X
 
+        self._check_topology_race(cfg_plan)
         names = sorted({s.table_name
                         for s in X.scans_of(texe._whole_plan())})
         if not self._any_external(names):
@@ -673,7 +765,8 @@ class Session:
                 ckey, names, texe.run,
                 self.config.resource.query_mem_bytes,
                 obs_bytes=max(int(report.get("est_step_bytes", 0)),
-                              int(report.get("est_finalize_bytes", 0))))
+                              int(report.get("est_finalize_bytes", 0))),
+                cfg=cfg_plan)
         return self._obs_launch(texe.run)
 
     def _any_external(self, names) -> bool:
@@ -912,9 +1005,11 @@ class Session:
             return None
         return runner, cost, obs_bytes
 
-    def _execute_and_cache(self, ckey: str, query: str, plan):
+    def _execute_and_cache(self, ckey: str, query: str, plan,
+                           cfg_plan=None):
         from cloudberry_tpu.exec import executor as X
 
+        self._check_topology_race(cfg_plan)
         names = sorted({s.table_name for s in X.scans_of(plan)})
         seg = getattr(plan, "_direct_segment", None)
         runner = None
@@ -948,20 +1043,26 @@ class Session:
             from cloudberry_tpu.exec.resource import estimate_plan_memory
 
             self._cache_statement(ckey, names, runner,
-                                  estimate_plan_memory(plan).peak_bytes)
+                                  estimate_plan_memory(plan).peak_bytes,
+                                  cfg=cfg_plan)
         return self._obs_launch(runner)
 
     def _cache_statement(self, ckey: str, names, runner,
-                         cost: int = 0, obs_bytes: int | None = None) -> None:
+                         cost: int = 0, obs_bytes: int | None = None,
+                         cfg=None) -> None:
         """``cost`` is the ADMISSION reservation for cache hits;
         ``obs_bytes`` (defaults to cost) is the device-byte estimate the
         capacity plane observes — tiled runners reserve the whole
-        budget but measure their step working set."""
+        budget but measure their step working set. ``cfg`` pins the
+        entry to the config the runner's plan was BUILT under (not
+        whatever config the session holds at cache time): a topology
+        flip between plan and cache must leave an entry the identity
+        guard rejects, never one that serves a stale-epoch program."""
         from cloudberry_tpu.exec.udf import registry_version
 
         entry = (
             names, self._table_versions(names),
-            self.config,
+            cfg if cfg is not None else self.config,
             (self.catalog.ddl_version, registry_version()), runner, cost,
             cost if obs_bytes is None else int(obs_bytes))
         with self._stmt_lock:
@@ -1043,8 +1144,16 @@ class Session:
         """config.debug.verify_plans gate (plan/verify.py): verify a
         freshly planned statement and raise PlanVerifyError with
         node-path findings instead of compiling a broken plan."""
-        if plan is None or not self.config.debug.verify_plans:
+        if plan is None:
             return
+        owed = getattr(self, "_verify_next_plans", 0)
+        if not self.config.debug.verify_plans and owed <= 0:
+            return
+        if owed > 0:
+            # post-cutover replan window (config.topology.verify_replans):
+            # approximate decrement — an extra verification under a
+            # concurrent race costs wall clock, never correctness
+            self._verify_next_plans = owed - 1
         from cloudberry_tpu.plan.verify import check_plan
 
         check_plan(plan, self, context)
@@ -1146,7 +1255,9 @@ class Session:
                     buf[s, :n] = sorted_arr[starts[s]:starts[s] + n]
                 cols[cname] = buf
             st = ShardedTable(cols, counts, cap, False, version)
-        # graftlint: ignore[lock-unguarded] deliberate lock-free publish: key embeds nseg, entry is version-checked on read, and concurrent writers produce identical values (last-writer-wins is idempotent)
+        # deliberate lock-free publish: key embeds nseg, entry is
+        # version-checked on read, and concurrent writers produce
+        # identical values (last-writer-wins is idempotent)
         self._shard_cache[key] = st
         return st
 
@@ -1175,7 +1286,8 @@ class Session:
                 else _assign
             counts = np.bincount(assign, minlength=nseg).astype(np.int64)\
                 if len(assign) else np.zeros(nseg, dtype=np.int64)
-        # graftlint: ignore[lock-unguarded] deliberate lock-free publish: version rides the value and all writers derive identical counts — a race only repeats work
+        # deliberate lock-free publish: version rides the value and all
+        # writers derive identical counts — a race only repeats work
         self._shard_count_cache[key] = (version, counts)
         return counts
 

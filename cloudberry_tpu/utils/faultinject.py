@@ -71,6 +71,8 @@ INVENTORY = frozenset({
     "ckpt_save", "ckpt_resume", "tile_device_lost",
     # mesh health
     "exec_device_lost", "probe_degraded",
+    # online topology changes (parallel/topology.py)
+    "topo_rebalance_chunk", "topo_cutover", "topo_promote",
 })
 
 _registry: dict[str, _Arm] = {}

@@ -71,7 +71,13 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               # captures over the run (--slow-ms arms the threshold),
               # skew alarms from the motion telemetry, and the peak
               # per-statement device-byte estimate
-              "flight_captures,skew_events,peak_stmt_mb")
+              "flight_captures,skew_events,peak_stmt_mb,"
+              # ISSUE 13 (online topology changes): --expand-at /
+              # --shrink-at land an epoch-versioned resize mid-load —
+              # cutover wall clock, rows the background rebalancer
+              # moved (jump-hash minimal delta), and epoch flips over
+              # the run (failover promotions included)
+              "cutover_ms,moved_rows,epoch_flips")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -101,7 +107,8 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
                   mix: str = "point", chaos: float = 0.0,
                   tenants=None, server_core: str = "async",
                   clients: int = 16, aging_s: float = None,
-                  trace_sample: int = 0, slow_ms: float = None):
+                  trace_sample: int = 0, slow_ms: float = None,
+                  segments: int = 1):
     import numpy as np
 
     import cloudberry_tpu as cb
@@ -112,6 +119,7 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         "sched.tick_s": tick_s,
         "sched.max_batch": max_batch,
         "serve.threaded": server_core == "threaded",
+        "n_segments": max(1, segments),
     }
     if clients > 64:
         # warehouse-concurrency closed loop: the global dispatcher queue
@@ -297,7 +305,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
              server_core: str = "async",
              driver_threads: int = 16, aging_s: float = None,
              trace_sample: int = 0, trace_out: str = None,
-             slow_ms: float = None) -> dict:
+             slow_ms: float = None, segments: int = 1,
+             expand_at=None, shrink_at=None) -> dict:
     """One closed-loop run; returns the CSV row fields.
 
     ``cancel_mix``: fraction of requests carrying a TIGHT per-request
@@ -320,7 +329,7 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
                             mix=mix, chaos=chaos, tenants=tenants,
                             server_core=server_core, clients=clients,
                             aging_s=aging_s, trace_sample=trace_sample,
-                            slow_ms=slow_ms)
+                            slow_ms=slow_ms, segments=segments)
     # warm the compile caches OUTSIDE the measured window: the bench
     # compares steady-state dispatch, not first-compile latency
     session.sql(_point_sql(0, rows))
@@ -336,6 +345,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     rw_before = session.stmt_log.counter("recovery_wall_ms")
     fl_before = session.stmt_log.counter("flight_captures")
     sk_before = session.stmt_log.counter("skew_events")
+    ef_before = session.stmt_log.counter("epoch_flips")
+    mr_before = session.stmt_log.counter("topo_moved_rows")
 
     _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
                     "SchedDeadline")
@@ -381,6 +392,32 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     if chaos > 0:
         FI.inject_fault("tile_device_lost", "error", p=chaos, seed=1234)
         FI.inject_fault("exec_device_lost", "error", p=chaos, seed=4321)
+    # mid-load topology chaos (--expand-at/--shrink-at "T:N"): a control
+    # thread lands an epoch-versioned online resize T seconds into the
+    # measured window while the clients keep hammering — the cutover_ms
+    # / moved_rows / epoch_flips columns report what it cost
+    topo_events = []
+    for spec in ((("expand", expand_at),) if expand_at else ()) + \
+            ((("shrink", shrink_at),) if shrink_at else ()):
+        topo_events.append(spec)
+    cutover_ms = [0.0]
+    topo_errors: list[str] = []
+
+    def _topo_driver():
+        t_base = time.monotonic()
+        for _, (at_s, target) in sorted(topo_events,
+                                        key=lambda e: e[1][0]):
+            delay = t_base + at_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if time.monotonic() >= stop_at[0]:
+                return
+            try:
+                out = session._topology.online_resize(target)
+                cutover_ms[0] += out["cutover_ms"]
+            except Exception as e:  # noqa: BLE001 — surfaced after run
+                topo_errors.append(f"{type(e).__name__}: {e}")
+                return
     lat_map: dict = {}
     rejects = [0]  # backpressure refusals (mux driver) — own metric
     tenant_names = [t.name for t in tenants] if tenants else None
@@ -411,10 +448,17 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
             threads = [threading.Thread(target=worker, args=(i,))
                        for i in range(clients)]
         t_start = time.monotonic()
+        topo_thread = None
+        if topo_events:
+            topo_thread = threading.Thread(target=_topo_driver,
+                                           daemon=True)
+            topo_thread.start()
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=duration_s + 120)
+        if topo_thread is not None:
+            topo_thread.join(timeout=60)
         wall = time.monotonic() - t_start
         disp = session.stmt_log
         dsnap = getattr(session, "_dispatcher", None)
@@ -427,6 +471,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         FI.reset_fault("exec_device_lost")
     if errors:
         raise RuntimeError(f"bench clients failed: {errors[:3]}")
+    if topo_errors:
+        raise RuntimeError(f"topology chaos failed: {topo_errors}")
     if not mux:
         lat_map[None] = lats
     all_lats = sorted(x for ls in lat_map.values() for x in ls)
@@ -477,6 +523,10 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     out["skew_events"] = disp.counter("skew_events") - sk_before
     peak = reg.snapshot()["gauges"].get("stmt_device_bytes_peak", 0.0)
     out["peak_stmt_mb"] = round(peak / (1 << 20), 3)
+    # online-topology chaos columns (ISSUE 13)
+    out["cutover_ms"] = round(cutover_ms[0], 2)
+    out["moved_rows"] = disp.counter("topo_moved_rows") - mr_before
+    out["epoch_flips"] = disp.counter("epoch_flips") - ef_before
     if trace_sample and trace_out:
         from cloudberry_tpu.obs.trace import chrome_trace
 
@@ -501,6 +551,14 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
             trs.append(tr)
         out["_tenants"] = trs
     return out
+
+
+def _parse_at(spec):
+    """'T:N' → (T seconds into the run, N target segments), or None."""
+    if not spec:
+        return None
+    t, _, n = str(spec).partition(":")
+    return (float(t), int(n))
 
 
 def csv_row(r: dict) -> str:
@@ -553,6 +611,16 @@ def main(argv=None) -> list[dict]:
                          "(config.obs.slow_ms): statements slower than "
                          "this capture debug bundles, counted in the "
                          "flight_captures CSV column")
+    ap.add_argument("--segments", type=int, default=1,
+                    help="segment count the serving session starts at "
+                         "(online resizes move FROM here)")
+    ap.add_argument("--expand-at", default=None, metavar="T:N",
+                    help="land an epoch-versioned online expand to N "
+                         "segments T seconds into the measured window "
+                         "(needs N visible devices; cutover_ms / "
+                         "moved_rows / epoch_flips CSV columns)")
+    ap.add_argument("--shrink-at", default=None, metavar="T:N",
+                    help="same, shrinking to N segments")
     ap.add_argument("--csv", default=None,
                     help="append CSV rows to this file")
     args = ap.parse_args(argv)
@@ -585,7 +653,9 @@ def main(argv=None) -> list[dict]:
                      aging_s=args.aging_s,
                      trace_sample=args.trace_sample,
                      trace_out=args.trace_out,
-                     slow_ms=args.slow_ms)
+                     slow_ms=args.slow_ms, segments=args.segments,
+                     expand_at=_parse_at(args.expand_at),
+                     shrink_at=_parse_at(args.shrink_at))
         out.append(r)
         rows_out.append(r)
         rows_out.extend(r.get("_tenants", ()))
