@@ -126,13 +126,27 @@ def interconnect_context(session, qnames, nseg: int = 8) -> dict:
     from cloudberry_tpu.sql.parser import parse_sql
     from tools.tpch_queries import QUERIES
 
+    from cloudberry_tpu.parallel.mesh import host_topology
+
     clone = copy.copy(session)
     clone.config = session.config.with_overrides(n_segments=nseg)
-    out = {"n_segments": nseg, "per_query": {}}
+    # dcn/ici split model (ISSUE 14): per motion, bytes crossing host
+    # boundaries vs staying on-host under the live HostTopology (one
+    # host -> everything is ICI/local and dcn stays 0; a simulated or
+    # real multi-host grouping splits by the block's source/destination
+    # hosts the way the two-level transport would route them)
+    try:
+        topo = host_topology(nseg)
+        n_hosts = topo.n_hosts if topo.uniform_contiguous() else 1
+    except Exception:
+        n_hosts = 1
+    S = nseg // n_hosts if n_hosts > 1 else nseg
+    out = {"n_segments": nseg, "n_hosts": n_hosts, "per_query": {}}
     for qn in qnames:
         plan = plan_statement(parse_sql(QUERIES[qn]), clone, {}).plan
         rec = {"motions": 0, "launches_packed": 0, "launches_percol": 0,
-               "wire_bytes_packed": 0, "wire_bytes_percol": 0}
+               "wire_bytes_packed": 0, "wire_bytes_percol": 0,
+               "dcn_bytes": 0, "ici_bytes": 0}
         seen: set = set()
         for node in all_nodes(plan):
             # shared (PShare/CTE) subtrees appear once per reference in
@@ -143,13 +157,33 @@ def interconnect_context(session, qnames, nseg: int = 8) -> dict:
             layout = K.wire_layout(
                 {f.name: f.type.np_dtype for f in node.fields})
             rows = max(int(node.out_capacity), 1)
+            rb = layout.row_bytes()
             rec["motions"] += 1
             rec["launches_packed"] += 1
             rec["launches_percol"] += len(node.fields) + 1  # + sel buffer
-            rec["wire_bytes_packed"] += rows * layout.row_bytes()
+            rec["wire_bytes_packed"] += rows * rb
             rec["wire_bytes_percol"] += rows * (
                 sum(np.dtype(f.type.np_dtype).itemsize
                     for f in node.fields) + 1)
+            if n_hosts > 1:
+                from cloudberry_tpu.parallel.transport import (
+                    flat_wire_model, two_level_wire_model)
+
+                if node.kind == "redistribute" \
+                        and node.host_bucket_cap > 0 \
+                        and node.hier_hosts == n_hosts:
+                    # two-level: one aggregated block per host pair at
+                    # the host rung; lane staging rides ICI
+                    m = two_level_wire_model(
+                        nseg, n_hosts, node.bucket_cap,
+                        node.host_bucket_cap, rb)
+                else:
+                    # flat: every cross-host per-segment block pays DCN
+                    m = flat_wire_model(nseg, n_hosts, rows // nseg, rb)
+                rec["dcn_bytes"] += m["dcn_bytes"]
+                rec["ici_bytes"] += m["ici_bytes"]
+            else:
+                rec["ici_bytes"] += rows * rb
         out["per_query"][qn] = rec
     # live skew telemetry (ISSUE 12): what THIS process's distributed
     # executions observed per redistribute — rows-per-destination
@@ -160,6 +194,10 @@ def interconnect_context(session, qnames, nseg: int = 8) -> dict:
         "skew_events": log_.counter("skew_events"),
         "ratio_hist": log_.registry.hist("motion_skew_ratio"),
         "seg_rows_max_hist": log_.registry.hist("motion_seg_rows_max"),
+        # per-HOST skew (ISSUE 14): the shape two-level motion makes
+        # WORSE — one hot host pair's rung pads every host pair
+        "host_skew_events": log_.counter("host_skew_events"),
+        "host_ratio_hist": log_.registry.hist("motion_host_skew_ratio"),
     }
     return out
 

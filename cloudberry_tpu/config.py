@@ -42,6 +42,19 @@ class InterconnectConfig:
     # this many slices, one ppermute per (hop, slice), so hop k's rotation
     # overlaps hop k-1's placement. 1 disables (whole-block hops).
     ring_chunks: int = 1
+    # Topology-aware two-level motion (parallel/transport.py
+    # HierarchicalCollectives): collectives split into an intra-host ICI
+    # hop and ONE aggregated inter-host DCN hop, with rows re-bucketed by
+    # destination host between them (results stay bit-identical to flat).
+    # "auto" enables it on uniform multi-host meshes for motions whose
+    # blocks clear hier_min_block_bytes; "on" forces it wherever the
+    # topology allows; "off" keeps every motion flat. Single-host meshes
+    # are ALWAYS flat — the gate never fires there.
+    hierarchical: str = "auto"
+    # auto-mode per-motion floor: a redistribute whose per-destination
+    # block (bucket_cap x wire row bytes) is below this stays flat — the
+    # extra intra-host launches would cost more than the DCN bytes saved.
+    hier_min_block_bytes: int = 1 << 16
 
 
 @dataclass(frozen=True)

@@ -81,13 +81,21 @@ def compile_distributed(plan: N.PlanNode, session, param_keys=None,
     replicated stats channel — partitioned-node counts psum across
     segments, replicated nodes report segment 0's — so the instrumented
     program is this same entry point's program, not a side path's."""
-    from cloudberry_tpu.parallel.transport import make_transport
+    from cloudberry_tpu.parallel.transport import (hier_topology,
+                                                   make_transport)
 
     nseg = session.config.n_segments
-    mesh = segment_mesh(nseg,
-                        getattr(session, "_live_device_ids", None))
+    live_ids = getattr(session, "_live_device_ids", None)
+    mesh = segment_mesh(nseg, live_ids)
     ic = session.config.interconnect
-    tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
+    # topology-aware two-level motion: the host topology re-derives from
+    # the LIVE device list here, so an epoch flip (expand/shrink/
+    # failover) re-splits collectives the moment the new epoch's first
+    # program compiles — and the shared cache tier keys programs by
+    # topology epoch, so a stale split can never serve post-cutover
+    topo = hier_topology(session.config, nseg, live_ids)
+    tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks,
+                        topo=topo)
     packed = ic.packed_wire
     _, in_specs = prepare_dist_inputs(plan, session)
     if param_keys:
@@ -145,6 +153,12 @@ def record_motion_stats(plan: N.PlanNode, stats: dict,
             if node is not None:
                 node._observed_bucket = int(np.asarray(v))
             continue
+        m = re.search(r"required host bucket \(node (\d+)\)", key)
+        if m is not None:
+            node = motions.get(int(m.group(1)))
+            if node is not None:
+                node._observed_host_bucket = int(np.asarray(v))
+            continue
         m = re.search(r"seg rows \(node (\d+)\)", key)
         if m is not None:
             node = motions.get(int(m.group(1)))
@@ -185,6 +199,17 @@ def _record_skew(motions, session) -> None:
         mean = total / rows.shape[0]
         ratio = float(rows.max() / mean)
         node._skew_ratio = ratio
+        # per-HOST skew next to per-segment: a host-skewed shuffle is
+        # exactly the case the two-level exchange makes WORSE (one host
+        # pair's block rung pads every host pair), so it must alarm in
+        # the same place segment skew does
+        hrows = _host_rows(rows, session)
+        if hrows is not None:
+            node._host_rows = hrows
+            node._host_skew_ratio = float(
+                hrows.max() / (total / hrows.shape[0]))
+        else:
+            node._host_skew_ratio = None
         if log is None or not log.obs_enabled:
             continue
         reg = log.registry
@@ -194,6 +219,35 @@ def _record_skew(motions, session) -> None:
                     int(rows.max()) * _wire_row_bytes(node))
         if threshold > 0 and ratio >= threshold:
             log.bump("skew_events")
+        if node._host_skew_ratio is not None:
+            reg.observe("motion_host_skew_ratio", node._host_skew_ratio)
+            reg.observe("motion_host_rows_max", int(hrows.max()))
+            if threshold > 0 and node._host_skew_ratio >= threshold:
+                log.bump("host_skew_events")
+
+
+def _host_rows(seg_rows: np.ndarray, session) -> np.ndarray | None:
+    """Per-destination-HOST row demand from the per-segment vector —
+    None on single-host (or host-ambiguous) meshes. Uses the same
+    HostTopology derivation the motion layer splits over (including the
+    CBTPU_FORCE_HOSTS simulation), so the telemetry describes the links
+    the bytes would actually cross."""
+    from cloudberry_tpu.parallel.mesh import host_topology
+
+    try:
+        topo = host_topology(
+            seg_rows.shape[0],
+            getattr(session, "_live_device_ids", None)
+            if session is not None else None)
+    except Exception:
+        return None
+    if topo.n_hosts < 2:
+        return None
+    out = np.zeros(topo.n_hosts, dtype=np.int64)
+    for h, segs in enumerate(topo.segs_by_host):
+        out[h] = sum(int(seg_rows[s]) for s in segs
+                     if s < seg_rows.shape[0])
+    return out
 
 
 def record_jf_counters(stats: dict, log) -> None:
@@ -372,10 +426,24 @@ class DistLowerer(X.Lowerer):
             parts += [u64_words(lo), u64_words(hi)]
         parts.append(K.bloom_build(bus, bsel, bits, kk))
         digest = jnp.concatenate(parts)            # (4·nkeys + bits/32,)
-        # ONE tiny collective for the whole digest (tiled all_gather
-        # concatenates: reshape back to per-segment rows)
-        gathered = self.tx.all_gather(digest, SEG_AXIS) \
-            .reshape(self.nseg, digest.shape[0])
+        D = digest.shape[0]
+        topo = getattr(self.tx, "hier_topo", None)
+        if topo is not None and self.nseg // topo.n_hosts > 1:
+            # two-level digest: fold the HOST's digests locally (min/
+            # max/OR are order-insensitive-exact, so the fold is
+            # bit-identical to the flat reduction) and exchange ONE
+            # combined digest per host over DCN — the "one partial per
+            # host instead of one per segment" motion for digests
+            S = self.nseg // topo.n_hosts
+            local = self.tx.intra_all_gather(digest, SEG_AXIS) \
+                .reshape(S, D)
+            host_digest = _digest_fold(local, len(bus))
+            gathered = self.tx.host_ring_exchange(host_digest, SEG_AXIS)
+        else:
+            # ONE tiny collective for the whole digest (tiled all_gather
+            # concatenates: reshape back to per-segment rows)
+            gathered = self.tx.all_gather(digest, SEG_AXIS) \
+                .reshape(self.nseg, D)
 
         def seg_u64(col0):
             w = gathered[:, col0:col0 + 2].astype(jnp.uint64)
@@ -388,7 +456,7 @@ class DistLowerer(X.Lowerer):
             hit = hit & (u >= glo) & (u <= ghi)
         off = 4 * len(bus)
         bloom = gathered[0, off:]
-        for s in range(1, self.nseg):
+        for s in range(1, int(gathered.shape[0])):
             bloom = bloom | gathered[s, off:]
         hit = hit & K.bloom_test(bloom, pus, bits, kk)
         self._filter_stats(node, psel, psel & hit)
@@ -429,7 +497,52 @@ class DistLowerer(X.Lowerer):
             return self._redistribute(node, cols, sel)
         raise X.ExecError(f"motion kind {node.kind}")
 
+    def _use_hier(self, node: N.PMotion) -> bool:
+        """Two-level exchange for this redistribute? Needs the
+        hierarchical transport (topology gate passed at compile), the
+        planner's host stamps, agreement between the stamped and live
+        host grouping (an epoch flip between plan and compile replans —
+        this is the belt-and-braces), the packed wire, and u32-address-
+        able slots (the route-word contract)."""
+        topo = getattr(self.tx, "hier_topo", None)
+        return (self.packed and topo is not None
+                and node.host_bucket_cap > 0
+                and node.hier_hosts == topo.n_hosts
+                and self.nseg % topo.n_hosts == 0
+                and self.nseg * node.bucket_cap < 1 << 31)
+
+    def _host_combine(self, node: N.PMotion, cols, sel):
+        """Host-local combine of pre-aggregable motion inputs (agg
+        partials): gather the HOST's rows over ICI (packed wire), merge
+        partials by group key with the stamped order-insensitive-exact
+        merge funcs, and keep the combined rows on ONE segment per host
+        — the following exchange then ships one partial per (host,
+        group) over DCN instead of one per (segment, group). Every
+        segment of the host computes the identical combine; the lane-0
+        selection mask is what de-duplicates, so no extra collective."""
+        key_names, merges = node.combine_spec
+        layout = K.wire_layout({n: c.dtype for n, c in cols.items()})
+        buf = K.pack_wire(cols, sel, layout)
+        hb = self.tx.intra_all_gather(buf, SEG_AXIS)     # (S*cap, W)
+        hcols, hsel = K.unpack_wire(hb, layout)
+        specs = [K.AggSpec(func, name) for name, func in merges]
+        vals = {name: hcols[name] for name, _ in merges}
+        out_keys, out_aggs, out_sel, _ = K.group_aggregate(
+            {k: hcols[k] for k in key_names}, vals, specs, hsel,
+            out_capacity=hb.shape[0])
+        out = dict(out_keys)
+        out.update(out_aggs)
+        # group_aggregate widens some outputs (counts to int64); the
+        # motion's schema is the contract — restore each column's dtype
+        out = {n: v.astype(cols[n].dtype) for n, v in out.items()}
+        S = self.nseg // node.hier_hosts
+        t = jax.lax.axis_index(SEG_AXIS) % S
+        return out, out_sel & (t == 0)
+
     def _redistribute(self, node: N.PMotion, cols, sel):
+        if self._use_hier(node) and node.host_combine \
+                and node.combine_spec and cols:
+            cols, sel = self._host_combine(node, cols, sel)
         nseg, B = self.nseg, node.bucket_cap
         keys = [compile_expr(k)(cols) for k in node.hash_keys]
         h = hashing.hash_columns_jnp(keys)
@@ -471,8 +584,24 @@ class DistLowerer(X.Lowerer):
             pbuf = K.pack_wire(cols, sel, layout)
             buf = jnp.zeros((nseg * B, layout.width), dtype=jnp.uint32)
             buf = buf.at[slot].set(pbuf[order], mode="drop")
-            recv = self.tx.all_to_all(
-                buf.reshape(nseg, B, layout.width), SEG_AXIS)
+            if self._use_hier(node):
+                # two-level exchange: intra-host re-bucket by dest host,
+                # ONE aggregated DCN hop at the host rung, intra-host
+                # scatter — bit-identical recv buffer by construction
+                HB = node.host_bucket_cap
+                recv, hostdem = self.tx.hier_all_to_all(
+                    buf.reshape(nseg, B, layout.width), SEG_AXIS, HB)
+                self.checks[
+                    f"host bucket overflow: a host-pair block exceeded "
+                    f"capacity {HB} (node {id(node)}); the two-level "
+                    "retry promotes the host rung"] = (hostdem > HB).any()
+                # observed host-pair demand (replicated): the host rung
+                # ladder's one-retry promotion feed, like bucket_cap's
+                self.stats[f"required host bucket (node {id(node)})"] = \
+                    self.tx.pmax(jnp.max(hostdem), SEG_AXIS)
+            else:
+                recv = self.tx.all_to_all(
+                    buf.reshape(nseg, B, layout.width), SEG_AXIS)
             return K.unpack_wire(recv.reshape(nseg * B, layout.width),
                                  layout)
 
@@ -488,6 +617,32 @@ class DistLowerer(X.Lowerer):
         recv_sel = self.tx.all_to_all(selbuf.reshape(nseg, B),
                                       SEG_AXIS)
         return out, recv_sel.reshape(nseg * B)
+
+
+def _digest_fold(rows: "jnp.ndarray", nkeys: int) -> "jnp.ndarray":
+    """Combine (P, D) stacked runtime-filter digests into one (D,)
+    digest: per key the u64 [lo, hi] fold (min/max over the u32 word
+    pairs) and the bitwise OR of the bloom words. Order-insensitive and
+    exact — the host-local fold produces the same global digest the
+    flat per-segment reduction would."""
+    def col_u64(c0):
+        w = rows[:, c0:c0 + 2].astype(jnp.uint64)
+        return w[:, 0] | (w[:, 1] << jnp.uint64(32))
+
+    def u64_words(x):
+        return jnp.stack([(x & jnp.uint64(0xFFFFFFFF)),
+                          (x >> jnp.uint64(32))]).astype(jnp.uint32)
+
+    parts = []
+    for i in range(nkeys):
+        parts.append(u64_words(jnp.min(col_u64(4 * i))))
+        parts.append(u64_words(jnp.max(col_u64(4 * i + 2))))
+    off = 4 * nkeys
+    bloom = rows[0, off:]
+    for p in range(1, int(rows.shape[0])):
+        bloom = bloom | rows[p, off:]
+    parts.append(bloom)
+    return jnp.concatenate(parts)
 
 
 class _InstrumentedDistLowerer(DistLowerer):

@@ -443,6 +443,30 @@ def grow_expansion(plan: N.PlanNode, message: str, factor: int = 4,
             # shrink a runtime-grown buffer back below what overflowed
             nd._min_out_cap = nd.out_capacity
         return True
+    if "host bucket overflow" in message:
+        import re
+
+        m = re.search(r"\(node (\d+)\)", message)
+        nid = int(m.group(1)) if m is not None else -1
+        hits = _dedupe_nodes(
+            nd for nd in all_nodes(plan)
+            if isinstance(nd, N.PMotion) and nd.kind == "redistribute"
+            and nd.host_bucket_cap > 0 and id(nd) == nid)
+        if not hits and allow_fallback:
+            hits = _dedupe_nodes(
+                nd for nd in all_nodes(plan)
+                if isinstance(nd, N.PMotion)
+                and nd.kind == "redistribute" and nd.host_bucket_cap > 0)
+        for nd in hits:
+            # the two-level DCN block climbs the SAME pow2 ladder as the
+            # per-segment rung — straight to the observed demand's rung
+            observed = getattr(nd, "_observed_host_bucket", 0)
+            nd.host_bucket_cap = K.rung_up(
+                max(nd.host_bucket_cap * 2, observed, 64))
+            # no _min_* floor needed: nothing re-derives host_bucket_cap
+            # on a live plan (tiled _retile_dist re-derives bucket_cap
+            # only), so the promoted rung cannot be shrunk back
+        return bool(hits)
     if "redistribute overflow" in message:
         import re
 
@@ -478,6 +502,15 @@ def grow_expansion(plan: N.PlanNode, message: str, factor: int = 4,
             nd.out_capacity = nd.bucket_cap * nseg
             # tiled re-derivations must never shrink it back
             nd._min_bucket_cap = nd.bucket_cap
+            if nd.host_bucket_cap > 0:
+                # keep the two-level invariant host_bucket_cap >=
+                # bucket_cap (a pair bucket must fit its host block) and
+                # fold in the host demand this run already observed —
+                # otherwise the retry is a guaranteed host-rung overflow
+                # costing one more full recompile+execute cycle
+                nd.host_bucket_cap = K.rung_up(max(
+                    nd.host_bucket_cap, nd.bucket_cap,
+                    getattr(nd, "_observed_host_bucket", 0)))
         return bool(hits)
     return False
 

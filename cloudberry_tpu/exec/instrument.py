@@ -553,10 +553,18 @@ def _run_instrumented_dist(plan: N.PlanNode, session, query: str):
                            getattr(session, "_live_device_ids", None))
     inputs, in_specs = DX.prepare_dist_inputs(plan, session)
 
-    from cloudberry_tpu.parallel.transport import make_transport
+    from cloudberry_tpu.parallel.transport import (hier_topology,
+                                                   make_transport)
 
     ic = session.config.interconnect
-    tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
+    # instrument the program the engine actually runs: on a two-level
+    # session the real path is hierarchical, and EXPLAIN ANALYZE's
+    # counts/annotations must describe THAT program, not a flat side
+    # path (compile_distributed's same-entry-point contract)
+    topo = hier_topology(session.config, nseg,
+                         getattr(session, "_live_device_ids", None))
+    tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks,
+                        topo=topo)
     packed = ic.packed_wire
 
     class InstrDistLowerer(InstrumentingMixin, DX.DistLowerer):

@@ -784,6 +784,37 @@ def unpack_wire(buf: jnp.ndarray,
     return cols, sel
 
 
+def wire_rebucket(rows: jnp.ndarray, key: jnp.ndarray,
+                  valid: jnp.ndarray, n_buckets: int,
+                  cap: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Permutation re-bucket of PACKED wire rows — the two-level
+    motion's host-combine primitive (no unpack: rows move as opaque
+    (W,) u32 word vectors).
+
+    ``rows`` (n, W) are wire rows, ``key`` (n,) the integer bucket for
+    each row, ``valid`` which rows carry data. Valid rows compact
+    stably (by position) into their bucket's slots; all-zero fill
+    (which unpacks as invalid by the wire convention) pads the rest.
+    Returns ((n_buckets, cap, W) buffer, (n_buckets,) int32 demand) —
+    rows past ``cap`` are DROPPED FROM THE BUFFER but counted, so the
+    caller's overflow check (demand > cap) fires before any result
+    could ship; the capacity-ladder retry then promotes the rung.
+    Same slot-scatter discipline as the redistribute lowering."""
+    n = rows.shape[0]
+    k = jnp.where(valid, key, n_buckets)
+    counts = jax.ops.segment_sum(valid.astype(jnp.int32), k,
+                                 num_segments=n_buckets + 1)[:n_buckets]
+    order = jnp.argsort(k)          # stable: ties keep position order
+    sorted_k = k[order]
+    start = jnp.searchsorted(sorted_k, jnp.arange(n_buckets))
+    rank = jnp.arange(n) - start[jnp.clip(sorted_k, 0, n_buckets - 1)]
+    ok = (sorted_k < n_buckets) & (rank < cap)
+    slot = jnp.where(ok, sorted_k * cap + rank, n_buckets * cap)
+    out = jnp.zeros((n_buckets * cap, rows.shape[1]), dtype=rows.dtype)
+    out = out.at[slot].set(rows[order], mode="drop")
+    return out.reshape(n_buckets, cap, rows.shape[1]), counts
+
+
 def rung_up(n: int) -> int:
     """Round a bucket capacity up to its ladder rung (the next power of
     two, floor 8): rungs quantize motion buffer shapes so the set of

@@ -591,12 +591,19 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             return self._compiled
         shape = self.shape
         nseg = self.nseg
-        mesh = segment_mesh(nseg, getattr(self.session,
-                                          "_live_device_ids", None))
-        from cloudberry_tpu.parallel.transport import make_transport
+        live_ids = getattr(self.session, "_live_device_ids", None)
+        mesh = segment_mesh(nseg, live_ids)
+        from cloudberry_tpu.parallel.transport import (hier_topology,
+                                                       make_transport)
 
         ic = self.session.config.interconnect
-        tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
+        # the tiled program must run the SAME motion semantics as the
+        # in-memory dist path: a plan whose motions carry two-level
+        # stamps (host_combine grew the rungs) would otherwise pay the
+        # padding while shipping flat — the regression, not the win
+        topo = hier_topology(self.session.config, nseg, live_ids)
+        tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks,
+                            topo=topo)
         names = self._resident_names()
         _, res_specs = prepare_dist_inputs(None, self.session, names=names)
 
@@ -891,12 +898,17 @@ class DistSortTiledExecutable(DistTiledExecutable):
             return self._compiled
         shape = self.shape
         nseg = self.nseg
-        mesh = segment_mesh(nseg, getattr(self.session,
-                                          "_live_device_ids", None))
-        from cloudberry_tpu.parallel.transport import make_transport
+        live_ids = getattr(self.session, "_live_device_ids", None)
+        mesh = segment_mesh(nseg, live_ids)
+        from cloudberry_tpu.parallel.transport import (hier_topology,
+                                                       make_transport)
 
         ic = self.session.config.interconnect
-        tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks)
+        # same two-level selection as the in-memory dist path (see the
+        # agg-mode _compile above): stamped motions keep their semantics
+        topo = hier_topology(self.session.config, nseg, live_ids)
+        tx = make_transport(ic.backend, nseg, chunks=ic.ring_chunks,
+                            topo=topo)
         rnames = self._resident_names()
         _, res_specs = prepare_dist_inputs(None, self.session,
                                            names=rnames)

@@ -63,6 +63,12 @@ ATTR_CLASS_HINTS = {
     "session": "Session",
     "sess": "Session",
     "_sched": "TenantScheduler",
+    # two-level motion wiring (ISSUE 14): the transport and its derived
+    # topology are immutable/lock-free by design, but name them so
+    # cross-class call edges resolve when a lock-holding caller touches
+    # them (and so a future lock added there is discovered, not missed)
+    "tx": "HierarchicalCollectives",
+    "hier_topo": "HostTopology",
 }
 
 # modules (repo-relative path suffixes) whose jitted / kernel functions
@@ -151,7 +157,7 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     # rank 4 — innermost leaves (never call out while held)
     ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock",
      "MetricsRegistry._lock", "StatementStats._lock", "Trace._lock",
-     "Progress._lock"),
+     "Progress._lock", "mesh._topo_lock"),
 )
 
 

@@ -52,15 +52,42 @@ def _wire_row_bytes(node) -> int:
         return sum(np.dtype(d).itemsize for d in dtypes.values()) + 1
 
 
+def two_level_staging_bytes(node, row_bytes: int | None = None) -> int:
+    """Per-segment staging bytes the TWO-LEVEL exchange adds on top of
+    the flat wire buffer (parallel/transport.py hier_all_to_all): the
+    hop-1/hop-3 lane buffers at the proven ceil(H/S)*S*B bound (send +
+    receive each) and the H host-pair DCN blocks, every row carrying
+    the two u32 route words. Zero for unstamped (flat) motions."""
+    hh = int(getattr(node, "hier_hosts", 0) or 0)
+    hb = int(getattr(node, "host_bucket_cap", 0) or 0)
+    if hh < 2 or hb <= 0:
+        return 0
+    nseg = max(int(node.out_capacity or 0)
+               // max(int(node.bucket_cap or 1), 1), 1)
+    if nseg % hh:
+        return 0
+    from cloudberry_tpu.parallel.transport import two_level_lane_rows
+
+    S = nseg // hh
+    B = int(node.bucket_cap)
+    lane_rows = two_level_lane_rows(nseg, hh, B)
+    rb = (row_bytes if row_bytes is not None
+          else _wire_row_bytes(node)) + 8      # + dest/slot route words
+    # hop1 send + hop1 recv + hop3 send + hop3 recv, then the DCN blocks
+    return (4 * S * lane_rows + hh * hb) * rb
+
+
 def plan_device_bytes(plan, session=None) -> dict:
     """Itemized device-byte estimate for one compiled statement.
 
     Returns ``{"peak_bytes", "live_bytes", "wire_bytes", "rung_rows",
     "nodes"}``: peak is the admission estimator's
-    all-intermediates-live upper bound PLUS the wire staging buffers;
-    live is the largest single node (the floor no fusion removes);
-    rung_rows totals redistribute receive capacities (bucket_cap over
-    every destination) — the skew-governed share of the peak."""
+    all-intermediates-live upper bound PLUS the wire staging buffers
+    (including the two-level exchange's lane/host-block staging when a
+    motion is stamped hierarchical); live is the largest single node
+    (the floor no fusion removes); rung_rows totals redistribute
+    receive capacities (bucket_cap over every destination) — the
+    skew-governed share of the peak."""
     from cloudberry_tpu.exec.executor import all_nodes
     from cloudberry_tpu.exec.resource import estimate_plan_memory
     from cloudberry_tpu.plan import nodes as N
@@ -75,9 +102,11 @@ def plan_device_bytes(plan, session=None) -> dict:
             continue
         seen.add(id(node))
         rows = max(int(node.out_capacity or 0), 0)
-        wire += rows * _wire_row_bytes(node)
+        rb = _wire_row_bytes(node)
+        wire += rows * rb
         if node.kind == "redistribute":
             rung_rows += rows  # bucket_cap × nseg by construction
+            wire += two_level_staging_bytes(node, rb)
     return {
         "peak_bytes": int(est.peak_bytes + wire),
         "live_bytes": int(live),

@@ -14,16 +14,58 @@ no change anywhere else in the engine: the executor only ever names the
 ``seg`` axis. Segments stay stateless (data placement is recomputed from
 shared/deterministic storage), so there is no per-segment WAL to ship —
 a failed host re-runs statements against pinned snapshots.
+
+``HostTopology`` is the first-class host → segment layout (the promoted
+``segments_by_host`` view): the two-level Motion path
+(parallel/transport.py HierarchicalCollectives) consults it to split
+every collective into an intra-host (ICI) and an inter-host (DCN) hop.
+It is DERIVED state, never stored: ``host_topology`` recomputes it from
+the live device list (plus any survivor restriction) on demand, so an
+epoch flip — expand/shrink/failover via parallel/topology.py — re-derives
+it the moment the new epoch's first plan compiles; the shared cache tier
+already keys compiled programs by topology epoch, so a stale host layout
+can never serve a post-cutover statement. ``CBTPU_FORCE_HOSTS=N``
+partitions a single-process mesh into N simulated hosts (contiguous,
+uniform) — the CPU test/bench stand-in for a real multi-host split.
 """
 
 from __future__ import annotations
 
 import os
+import threading
+from dataclasses import dataclass
 
 import jax
 from jax.sharding import Mesh
 
 SEG_AXIS = "seg"
+
+# host-topology derivation cache (device lists are stable between epoch
+# flips; the key carries everything the derivation reads)
+# graftlint: the lock is module-level like faultinject._lock and carries
+# a witness rank (lint/config.py WITNESS_ORDER rank 4 — innermost leaf)
+_topo_lock = threading.Lock()
+_topo_cache: dict = {}
+
+
+class DeviceRestrictionError(RuntimeError):
+    """A ``device_ids`` restriction named devices the mesh cannot use.
+
+    ``kind`` distinguishes the two failure stories:
+    - ``"stale"``  — an id at or past the live device count: the id was
+      plausibly valid once (before a shrink / device loss) and the caller
+      is holding an out-of-date survivor list; re-probe and re-derive.
+    - ``"invalid"`` — a negative or duplicate id: the restriction list
+      itself is malformed, no probe will fix it.
+
+    Before this error existed, ``segment_mesh`` silently SKIPPED
+    out-of-range ids — a stale survivor list would quietly build a
+    smaller mesh and every placement assumption downstream went wrong.
+    """
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
 
 
 def init_distributed(coordinator: str | None = None,
@@ -43,20 +85,60 @@ def init_distributed(coordinator: str | None = None,
     process_id = int(process_id
                      if process_id is not None
                      else os.environ.get("CBTPU_PROC_ID", "0"))
+    # XLA:CPU only implements cross-process collectives through a
+    # pluggable backend (Gloo in jaxlib) — without this, any program
+    # whose device assignment spans processes dies at dispatch with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend". Must be set before the CPU client spins up, which is
+    # why it lives here (workers call init_distributed before any jax
+    # op). TPU pods ignore it: their DCN collectives are native.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # older/newer jax: best effort
+        pass
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
     init_distributed._done = True  # type: ignore[attr-defined]
 
 
+def _check_device_ids(device_ids, n_devices: int) -> None:
+    """The typed replacement for the old silent ``if i < len(devices)``
+    skip: holes mid-list are an error the caller must see."""
+    seen = set()
+    for i in device_ids:
+        if i < 0:
+            raise DeviceRestrictionError(
+                "invalid",
+                f"device restriction contains negative id {i} — the "
+                "restriction list is malformed, not stale")
+        if i in seen:
+            raise DeviceRestrictionError(
+                "invalid",
+                f"device restriction names id {i} twice — the "
+                "restriction list is malformed, not stale")
+        seen.add(i)
+    stale = sorted(i for i in device_ids if i >= n_devices)
+    if stale:
+        raise DeviceRestrictionError(
+            "stale",
+            f"device restriction names id(s) {stale} but only "
+            f"{n_devices} devices are visible — the ids are stale "
+            "(devices lost / cluster shrunk since the restriction was "
+            "derived); re-probe and rebuild the survivor list")
+
+
 def segment_mesh(n_segments: int, device_ids=None) -> Mesh:
     """Mesh over the first n_segments GLOBAL devices (all hosts).
     ``device_ids`` restricts to surviving devices (by index into
     jax.devices()) after a probe found losses — a real loss leaves a hole
-    mid-list, so the degraded mesh must skip it, not just shrink."""
+    mid-list, so the degraded mesh must skip it, not just shrink.
+    A restriction naming devices that no longer exist raises the typed
+    DeviceRestrictionError instead of silently building a smaller mesh."""
     devices = jax.devices()
     if device_ids is not None:
-        devices = [devices[i] for i in device_ids if i < len(devices)]
+        _check_device_ids(device_ids, len(devices))
+        devices = [devices[i] for i in device_ids]
     if len(devices) < n_segments:
         raise RuntimeError(
             f"config asks for {n_segments} segments but only "
@@ -78,15 +160,132 @@ def segment_mesh(n_segments: int, device_ids=None) -> Mesh:
     return Mesh(np.asarray(chosen), (SEG_AXIS,))
 
 
+# --------------------------------------------------------- host topology
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    """First-class host → segment layout (the promoted segments_by_host
+    view of ``mesh_topology``). Immutable and DERIVED: rebuild it via
+    ``host_topology`` whenever the device set may have changed (epoch
+    flips do — see module docstring)."""
+
+    n_segments: int
+    # host -> tuple of global segment indices it owns (ascending)
+    segs_by_host: tuple
+    # True when the grouping came from CBTPU_FORCE_HOSTS (simulated
+    # hosts on one process) rather than real process indices
+    forced: bool = False
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.segs_by_host)
+
+    @property
+    def segs_per_host(self) -> int:
+        """Segments per host when UNIFORM, else 0 (the two-level path
+        requires uniformity; a ragged cluster stays on flat motion)."""
+        sizes = {len(s) for s in self.segs_by_host}
+        return len(self.segs_by_host[0]) if len(sizes) == 1 else 0
+
+    def host_of(self, seg: int) -> int:
+        for h, segs in enumerate(self.segs_by_host):
+            if seg in segs:
+                return h
+        raise KeyError(seg)
+
+    def uniform_contiguous(self) -> bool:
+        """True when host h owns exactly segments [h*S, (h+1)*S) — the
+        layout HierarchicalCollectives' static lane algebra relies on
+        (jax.devices() orders by process index, so real clusters are
+        contiguous by construction; a degraded survivor restriction can
+        break it, and then motion stays flat)."""
+        S = self.segs_per_host
+        if S == 0:
+            return False
+        if S * self.n_hosts != self.n_segments:
+            # the hosts don't COVER n_segments (fewer visible devices
+            # than requested segments) — per-host contiguity would pass
+            # while the lane algebra's S = nseg // n_hosts disagrees
+            # with the real grouping; never let that stamp host caps
+            return False
+        for h, segs in enumerate(self.segs_by_host):
+            if tuple(segs) != tuple(range(h * S, (h + 1) * S)):
+                return False
+        return True
+
+    def as_dict(self) -> dict:
+        return {
+            "n_segments": self.n_segments,
+            "n_hosts": self.n_hosts,
+            "segs_per_host": self.segs_per_host,
+            "uniform_contiguous": self.uniform_contiguous(),
+            "forced": self.forced,
+            "segments_by_host": {h: list(s)
+                                 for h, s in enumerate(self.segs_by_host)},
+        }
+
+
+def host_topology(n_segments: int, device_ids=None) -> HostTopology:
+    """Derive the HostTopology for the FIRST n_segments live devices
+    (after the optional survivor restriction — the same selection
+    ``segment_mesh`` makes, so mesh and topology can never disagree).
+
+    ``CBTPU_FORCE_HOSTS=N`` overrides with N simulated contiguous hosts
+    (single-process CPU meshes have one real host; the env knob is how
+    tools/ic_bench.py and the tests exercise the DCN-shaped path without
+    a cluster). The derivation is cached per (nseg, restriction, force)
+    — device lists only change at epoch flips, which change the key."""
+    force = os.environ.get("CBTPU_FORCE_HOSTS")
+    key = (n_segments,
+           tuple(device_ids) if device_ids is not None else None,
+           force)
+    with _topo_lock:
+        hit = _topo_cache.get(key)
+    if hit is not None:
+        return hit
+    if force:
+        n_hosts = max(int(force), 1)
+        if n_segments % n_hosts != 0:
+            raise ValueError(
+                f"CBTPU_FORCE_HOSTS={n_hosts} does not divide "
+                f"n_segments={n_segments} (simulated hosts are uniform "
+                "by construction)")
+        S = n_segments // n_hosts
+        topo = HostTopology(
+            n_segments,
+            tuple(tuple(range(h * S, (h + 1) * S))
+                  for h in range(n_hosts)),
+            forced=True)
+    else:
+        devices = jax.devices()
+        if device_ids is not None:
+            _check_device_ids(device_ids, len(devices))
+            devices = [devices[i] for i in device_ids]
+        hosts: dict[int, list[int]] = {}
+        for i, d in enumerate(devices[:n_segments]):
+            hosts.setdefault(int(getattr(d, "process_index", 0)),
+                             []).append(i)
+        topo = HostTopology(
+            n_segments,
+            tuple(tuple(sorted(hosts[h])) for h in sorted(hosts)))
+    with _topo_lock:
+        if len(_topo_cache) >= 32:
+            _topo_cache.pop(next(iter(_topo_cache)))
+        _topo_cache[key] = topo
+    return topo
+
+
 def mesh_topology(n_segments: int) -> dict:
-    """Host → segment layout (the gp_segment_configuration view)."""
-    devices = jax.devices()[:n_segments]
-    hosts: dict[int, list[int]] = {}
-    for i, d in enumerate(devices):
-        hosts.setdefault(int(getattr(d, "process_index", 0)), []).append(i)
+    """Host → segment layout (the gp_segment_configuration view), now a
+    rendering of HostTopology. NOTE: reports REAL process grouping plus
+    ``this_host``; the forced simulation knob applies here too so the
+    observability view matches what the motion layer will do."""
+    topo = host_topology(n_segments)
     return {
         "n_segments": n_segments,
-        "n_hosts": max(len(hosts), 1),
+        "n_hosts": topo.n_hosts,
         "this_host": jax.process_index(),
-        "segments_by_host": hosts,
+        "segments_by_host": {h: list(s)
+                             for h, s in enumerate(topo.segs_by_host)},
     }

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class XlaCollectives:
@@ -139,7 +140,379 @@ class RingCollectives:
         return acc
 
 
-def make_transport(backend: str, n_segments: int, chunks: int = 1):
+class HierarchicalCollectives:
+    """Topology-aware two-level collectives (the ISSUE-14 tentpole):
+    every exchange splits into an intra-host hop over ICI and ONE
+    aggregated inter-host hop over DCN (the data-movement thesis of
+    Theseus, PAPERS.md — move bytes on the cheap links, aggregate
+    before the expensive ones).
+
+    Built entirely from ``ppermute`` compositions (the one collective
+    every backend supports identically) over a single ``seg`` axis:
+    "intra-host" permutations rotate within a host's contiguous segment
+    block, "inter-host" permutations rotate between hosts along a lane.
+    Requires a UNIFORM CONTIGUOUS HostTopology (host h owns segments
+    [h*S, (h+1)*S)) — jax.devices() orders by process index, so real
+    clusters satisfy it; ragged/degraded layouts stay on flat motion.
+
+    - ``all_gather`` (gather/broadcast motions, runtime-filter key and
+      digest gathers): host-root tree — intra-host ring gather, DCN
+      ring between the hosts' lane-0 segments only, intra-host
+      broadcast. DCN carries each host's COMBINED block once per remote
+      host instead of every segment's block to every remote segment.
+      Applied to unsigned-integer payloads (the packed wire, u64 keys,
+      u32 digests — where the zero-fill broadcast trick is exact);
+      other dtypes delegate to the flat inner transport.
+    - ``hier_all_to_all`` (hash redistribute): re-buckets rows by
+      DESTINATION HOST between the hops — packed-wire buffers
+      throughout, the re-bucket is kernels.wire_rebucket, no unpack —
+      so DCN ships one host-pair block at the ``host_cap`` rung instead
+      of nseg per-segment-pair blocks at the pair rung. Two route words
+      (destination segment, source slot) ride the wire across the hops
+      and place every received row at EXACTLY the slot the flat
+      all_to_all would have used, so the returned buffer is
+      bit-identical to ``inner.all_to_all`` — downstream programs
+      cannot tell the transports apart.
+    - ``host_ring_exchange``: per-lane inter-host ring of HOST-COMBINED
+      vectors (the runtime-filter digest fold: DCN carries one digest
+      per host, not one per segment).
+    - ``psum`` / ``pmax`` delegate flat: they carry control-plane
+      scalars (checks, stats), not data volume.
+
+    ``launches`` counts ppermute launches at trace time (ic_bench's
+    two-level launch accounting)."""
+
+    name = "hier"
+    is_hierarchical = True
+
+    def __init__(self, topo, inner=None):
+        if inner is None:
+            inner = XlaCollectives()
+        self.inner = inner
+        self.hier_topo = topo
+        self.n = topo.n_segments
+        self.H = topo.n_hosts
+        self.S = topo.n_segments // topo.n_hosts
+        if not topo.uniform_contiguous() or self.H < 2:
+            raise ValueError(
+                "HierarchicalCollectives needs a uniform contiguous "
+                f"multi-host topology; got {topo.as_dict()}")
+        self.launches = 0
+
+    # ------------------------------------------------------ primitives
+
+    def _pp(self, x, axis, perm):
+        self.launches += 1
+        return jax.lax.ppermute(x, axis, perm)
+
+    def _intra_shift(self, x, axis, by: int = 1):
+        """Rotate within each host's segment block (ICI hop)."""
+        H, S = self.H, self.S
+        perm = [(h * S + t, h * S + (t + by) % S)
+                for h in range(H) for t in range(S)]
+        return self._pp(x, axis, perm)
+
+    def _lane_shift(self, x, axis, by: int = 1):
+        """Rotate between hosts along every lane (DCN hop)."""
+        H, S = self.H, self.S
+        perm = [(h * S + t, ((h + by) % H) * S + t)
+                for h in range(H) for t in range(S)]
+        return self._pp(x, axis, perm)
+
+    def _idx(self, axis):
+        idx = jax.lax.axis_index(axis)
+        return idx // self.S, idx % self.S
+
+    # --------------------------------------------------- intra helpers
+
+    def intra_all_gather(self, x, axis):
+        """(rows, ...) -> (S*rows, ...): each segment gathers its
+        HOST's blocks in local order (ICI ring, S-1 ppermutes)."""
+        S = self.S
+        if S == 1:
+            return x
+        _, t = self._idx(axis)
+        rows = x.shape[0]
+        out = jnp.zeros((S * rows,) + x.shape[1:], dtype=x.dtype)
+        cur = x
+        for k in range(S):
+            src = (t - k) % S
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, cur, src * rows, axis=0)
+            if k + 1 < S:
+                cur = self._intra_shift(cur, axis)
+        return out
+
+    def _intra_psum(self, x, axis):
+        """Sum over each host's segments (ICI ring) — exact for the
+        unsigned payloads the tree broadcast uses."""
+        acc = x
+        cur = x
+        for _ in range(self.S - 1):
+            cur = self._intra_shift(cur, axis)
+            acc = acc + cur
+        return acc
+
+    def _intra_all_to_all(self, x, axis):
+        """(S, C, ...) per-local-destination blocks -> (S, C, ...)
+        received, within each host (the ICI all_to_all; same rotate
+        scheme as RingCollectives.all_to_all, group-local)."""
+        S = self.S
+        if S == 1:
+            return x
+        _, t = self._idx(axis)
+        out = jnp.zeros_like(x)
+        for k in range(S):
+            src = (t - k) % S
+            block = jnp.take(x, (t + k) % S, axis=0)
+            moved = block if k == 0 else self._intra_shift(block, axis,
+                                                           by=k)
+            out = out.at[src].set(moved)
+        return out
+
+    # ------------------------------------------------------- interface
+
+    def psum(self, x, axis):
+        return self.inner.psum(x, axis)
+
+    def pmax(self, x, axis):
+        return self.inner.pmax(x, axis)
+
+    def all_to_all(self, x, axis):
+        """Flat fallback (callers without host stamps / non-wire
+        payloads); the two-level exchange is ``hier_all_to_all``."""
+        return self.inner.all_to_all(x, axis)
+
+    def all_gather(self, x, axis):
+        """Host-root tree all_gather, bit-identical to the flat tiled
+        all_gather: result rows land in global segment order. Unsigned
+        payloads only (the intra-host broadcast rides an exact zero-fill
+        psum); everything else delegates flat."""
+        if self.S == 1 or not jnp.issubdtype(x.dtype,
+                                             jnp.unsignedinteger):
+            return self.inner.all_gather(x, axis)
+        h, t = self._idx(axis)
+        hb = self.intra_all_gather(x, axis)          # (S*rows, ...)
+        rows_h = hb.shape[0]
+        H, S = self.H, self.S
+        full = jnp.zeros((H * rows_h,) + hb.shape[1:], dtype=hb.dtype)
+        cur = hb
+        lane0 = [(g * S, ((g + 1) % H) * S) for g in range(H)]
+        for k in range(H):
+            src = (h - k) % H
+            full = jax.lax.dynamic_update_slice_in_dim(
+                full, cur, src * rows_h, axis=0)
+            if k + 1 < H:
+                cur = self._pp(cur, axis, lane0)     # DCN: lane 0 only
+        # intra-host broadcast of lane 0's assembled result (non-lane-0
+        # accumulations above saw zeros from the lane-0-only ring)
+        return self._intra_psum(
+            jnp.where(t == 0, full, jnp.zeros((), dtype=full.dtype)),
+            axis)
+
+    def host_ring_exchange(self, x, axis):
+        """(D,) per-segment HOST-COMBINED vector -> (H, D) host vectors
+        in host order, via an all-lane inter-host ring. The digest
+        host-combine transport: DCN carries one combined vector per
+        host per lane instead of one per segment pair."""
+        h, _ = self._idx(axis)
+        H = self.H
+        out = jnp.zeros((H,) + x.shape, dtype=x.dtype)
+        cur = x
+        for k in range(H):
+            src = (h - k) % H
+            out = out.at[src].set(cur)
+            if k + 1 < H:
+                cur = self._lane_shift(cur, axis)
+        return out
+
+    # --------------------------------------------- two-level a2a (hash)
+
+    def hier_all_to_all(self, x, axis, host_cap: int):
+        """Two-level hash redistribute over packed wire blocks.
+
+        ``x``: (nseg, B, W) uint32 per-destination-SEGMENT blocks — the
+        flat all_to_all's exact input (word 0 bit 0 = row validity).
+        Returns ``(recv, host_demand)``: recv (nseg, B, W) BIT-IDENTICAL
+        to ``inner.all_to_all(x, axis)`` (two route words carry each
+        row's destination segment and source slot through the hops, so
+        final placement reproduces the flat layout exactly), and
+        host_demand (H,) int32 — rows THIS source host addressed to each
+        destination host, the ``host_cap`` overflow/stats feed (each
+        segment reports its lane's hosts; the others read 0).
+
+        Hops: (1) ICI all_to_all routing rows to the lane that owns
+        their destination host (a STATIC permutation of wire rows —
+        destination and rank are slot-determined); between the hops the
+        lane re-buckets its host's combined rows by destination host
+        (kernels.wire_rebucket — dynamic, validity-driven, no unpack)
+        into host-pair blocks at the ``host_cap`` rung; (2) one DCN
+        exchange of the combined host-pair blocks (H-1 ppermutes, one
+        block per host pair — THE aggregated inter-host exchange); (3)
+        ICI all_to_all scattering received rows to their destination
+        segment, then slot placement. Hop-1/hop-3 capacities are the
+        PROVEN bound ceil(H/S)*S*B (every per-segment-pair bucket is
+        already capped at B by the caller's rank discipline), so only
+        the host rung needs an overflow check."""
+        from cloudberry_tpu.exec import kernels as K
+
+        n, H, S = self.n, self.H, self.S
+        B, W = int(x.shape[1]), int(x.shape[2])
+        k_hosts = -(-H // S)                     # hosts per lane (ceil)
+        h, t = self._idx(axis)
+
+        flat = x.reshape(n * B, W)
+        slot = jnp.arange(n * B, dtype=jnp.uint32)
+        destw = (slot // jnp.uint32(B)).astype(jnp.uint32)
+        idx = jax.lax.axis_index(axis).astype(jnp.uint32)
+        origw = idx * jnp.uint32(B) + slot % jnp.uint32(B)
+        rbuf = jnp.concatenate([flat, destw[:, None], origw[:, None]],
+                               axis=1)           # (n*B, W+2)
+
+        # hop 1: static lane permutation (dest host g -> lane g % S,
+        # host slot j = g // S, then dest-local s, then rank) + ICI a2a
+        C1 = two_level_lane_rows(n, H, B)
+        gidx = np.zeros((S, C1), dtype=np.int32)
+        padm = np.zeros((S, C1), dtype=bool)
+        for lane in range(S):
+            pos = 0
+            for j in range(k_hosts):
+                g = lane + j * S
+                for s in range(S):
+                    if g < H:
+                        base = (g * S + s) * B
+                        gidx[lane, pos:pos + B] = np.arange(base,
+                                                            base + B)
+                    else:
+                        padm[lane, pos:pos + B] = True
+                    pos += B
+        y = rbuf[jnp.asarray(gidx)]              # (S, C1, W+2)
+        y = jnp.where(jnp.asarray(padm)[:, :, None],
+                      jnp.zeros((), dtype=y.dtype), y)
+        z = self._intra_all_to_all(y, axis)      # peers' lane-t blocks
+        zf = z.reshape(S * C1, W + 2)
+
+        # host combine: re-bucket the host's combined rows by dest host
+        valid = (zf[:, 0] & jnp.uint32(1)).astype(jnp.bool_)
+        g_host = (zf[:, W] // jnp.uint32(S)).astype(jnp.int32)
+        j_slot = g_host // S                     # slot within this lane
+        buf2, counts_j = K.wire_rebucket(zf, j_slot, valid, k_hosts,
+                                         host_cap)
+        host_demand = jnp.zeros((H,), dtype=jnp.int32)
+        lane_hosts = t + jnp.arange(k_hosts, dtype=jnp.int32) * S
+        host_demand = host_demand.at[
+            jnp.where(lane_hosts < H, lane_hosts, H)].set(
+            counts_j, mode="drop")
+
+        # hop 2: ONE aggregated inter-host exchange (H-1 ppermutes,
+        # each moving every host's combined block for offset d)
+        out_dcn = jnp.zeros((H, host_cap, W + 2), dtype=x.dtype)
+        own = jnp.take(buf2, jnp.clip(h // S, 0, k_hosts - 1), axis=0)
+        own = jnp.where(h % S == t, own, jnp.zeros((), dtype=own.dtype))
+        out_dcn = out_dcn.at[h].set(own)
+        for d in range(1, H):
+            g = (h + d) % H
+            blk = jnp.take(buf2, jnp.clip(g // S, 0, k_hosts - 1),
+                           axis=0)
+            blk = jnp.where(g % S == t, blk,
+                            jnp.zeros((), dtype=blk.dtype))
+            perm = [(hh * S + ((hh + d) % H) % S,
+                     ((hh + d) % H) * S + hh % S) for hh in range(H)]
+            recv = self._pp(blk, axis, perm)
+            out_dcn = out_dcn.at[(h - d) % H].set(recv)
+
+        # hop 3: ICI scatter to the destination segment
+        f3 = out_dcn.reshape(H * host_cap, W + 2)
+        valid3 = (f3[:, 0] & jnp.uint32(1)).astype(jnp.bool_)
+        s_local = (f3[:, W] % jnp.uint32(S)).astype(jnp.int32)
+        C3 = two_level_lane_rows(n, H, B)        # proven bound, no check
+        buf3, _ = K.wire_rebucket(f3, s_local, valid3, S, C3)
+        recv3 = self._intra_all_to_all(buf3, axis)
+        ff = recv3.reshape(S * C3, W + 2)
+
+        # final placement: the flat layout's exact slot (src*B + rank)
+        validf = (ff[:, 0] & jnp.uint32(1)).astype(jnp.bool_)
+        origf = ff[:, W + 1].astype(jnp.int32)
+        slotf = jnp.where(validf, origf, n * B)
+        out = jnp.zeros((n * B, W), dtype=x.dtype)
+        out = out.at[slotf].set(ff[:, :W], mode="drop")
+        return out.reshape(n, B, W), host_demand
+
+
+def two_level_lane_rows(nseg: int, n_hosts: int,
+                        bucket_cap: int) -> int:
+    """Rows one hop-1/hop-3 lane buffer holds: the PROVEN bound
+    ceil(H/S)·S·B (every per-segment-pair bucket is capped at B by the
+    caller's rank discipline). The ONE place the lane algebra lives —
+    the transport sizes its staging with it, obs/capacity itemizes it,
+    and the benches' byte models derive from it, so the hop structure
+    cannot drift between the implementation and its accounting."""
+    S = nseg // n_hosts
+    return -(-n_hosts // S) * S * bucket_cap
+
+
+def two_level_wire_model(nseg: int, n_hosts: int, bucket_cap: int,
+                         host_bucket_cap: int, row_bytes: int) -> dict:
+    """Analytic per-redistribute byte split for the TWO-LEVEL exchange:
+    DCN carries one aggregated block per ordered host pair at the host
+    rung; the hop-1/hop-3 lane staging (send + receive each) rides ICI.
+    Every row carries the two u32 route words on both hops."""
+    S = nseg // n_hosts
+    rb2 = row_bytes + 8                  # + dest/slot route words
+    lane = two_level_lane_rows(nseg, n_hosts, bucket_cap)
+    return {
+        "dcn_bytes": n_hosts * (n_hosts - 1) * host_bucket_cap * rb2,
+        "ici_bytes": 2 * nseg * (S - 1) * lane * rb2,
+    }
+
+
+def flat_wire_model(nseg: int, n_hosts: int, bucket_cap: int,
+                    row_bytes: int) -> dict:
+    """FLAT all_to_all byte split under the same host grouping: every
+    cross-host (source segment → destination segment) block crosses DCN
+    padded to the pair rung; same-host blocks ride ICI."""
+    S = nseg // n_hosts
+    return {
+        "dcn_bytes": nseg * (nseg - S) * bucket_cap * row_bytes,
+        "ici_bytes": nseg * (S - 1) * bucket_cap * row_bytes,
+    }
+
+
+def hier_topology(cfg, n_segments: int, device_ids=None):
+    """The two-level selection gate: the HostTopology motion should
+    split over, or None for flat. Flat when the feature is off, the
+    transport is not the packed xla path, the cluster is one host, or
+    the layout is not uniform-contiguous (degraded survivor meshes).
+    ``auto`` vs ``on`` differ only in the per-motion size gate the
+    DISTRIBUTOR applies when stamping host rungs
+    (interconnect.hier_min_block_bytes) — topology legality is
+    identical. Epoch-aware by construction: host_topology re-derives
+    from the live device list every call, and compiled two-level
+    programs are keyed by topology epoch in the shared cache tier."""
+    ic = cfg.interconnect
+    mode = getattr(ic, "hierarchical", "off")
+    if mode not in ("auto", "on"):
+        return None
+    if ic.backend != "xla" or not ic.packed_wire:
+        return None
+    from cloudberry_tpu.parallel.mesh import host_topology
+
+    try:
+        topo = host_topology(n_segments, device_ids)
+    except Exception:
+        return None     # stale/odd restriction: mesh build will report
+    if topo.n_hosts < 2 or n_segments % topo.n_hosts != 0 \
+            or not topo.uniform_contiguous():
+        return None
+    return topo
+
+
+def make_transport(backend: str, n_segments: int, chunks: int = 1,
+                   topo=None):
+    """``topo`` (a HostTopology from hier_topology) selects the
+    two-level transport; None keeps the flat vtable choice."""
+    if topo is not None:
+        return HierarchicalCollectives(topo)
     if backend == "xla":
         return XlaCollectives()
     if backend == "ring":

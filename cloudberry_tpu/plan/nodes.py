@@ -368,6 +368,23 @@ class PMotion(PlanNode):
     # compact selected rows to this capacity BEFORE the collective (top-N
     # pushdown: gather k·nseg rows instead of whole shards); 0 = off
     pre_compact: int = 0
+    # two-level motion stamps (ISSUE 14; redistribute only, stamped when
+    # the session's topology gate selects the hierarchical transport):
+    # host_bucket_cap is the per-(source host -> destination host) block
+    # capacity of the aggregated DCN exchange (a power-of-two rung on
+    # the same ladder as bucket_cap; overflow promotes and retries), and
+    # hier_hosts pins the host count the caps were derived for — a
+    # program compiled at a different host grouping must not reuse them.
+    host_bucket_cap: int = 0
+    hier_hosts: int = 0
+    # host-local combine (pre-aggregable motions): between the two hops,
+    # each host merges its segments' agg PARTIALS so DCN carries one
+    # partial per (host, group) instead of one per (segment, group).
+    # combine_spec = (group key names, ((agg out name, merge func), ...))
+    # — stamped ONLY when every merge func is order-insensitive-exact
+    # (count/int-sum/min/max), so results stay bit-identical to flat.
+    host_combine: bool = False
+    combine_spec: Optional[tuple] = None
 
     def children(self):
         return [self.child]

@@ -378,7 +378,8 @@ class _Walker:
             hk = tuple(self.esig(k, False)[0] for k in node.hash_keys)
             return (t, node.kind, node.out_capacity, node.bucket_cap,
                     node.pre_compact, hk, self._fieldsig(node),
-                    self.nsig(node.child))
+                    node.host_bucket_cap, node.hier_hosts,
+                    node.host_combine, self.nsig(node.child))
         raise UnsupportedPlan(f"node {t}")
 
 

@@ -1096,7 +1096,8 @@ class Session:
         for n in X.all_nodes(plan):
             if isinstance(n, N.PMotion):
                 sig.append((n.kind, n.bucket_cap, n.out_capacity,
-                            n.pre_compact))
+                            n.pre_compact, n.host_bucket_cap,
+                            n.hier_hosts, n.host_combine))
             elif isinstance(n, N.PJoin):
                 sig.append(("join", n.out_capacity))
         return tuple(sig)

@@ -62,8 +62,25 @@ def main():
     for q in QUERIES:
         df = session.sql(q).to_pandas()
         results.append({c: df[c].tolist() for c in df.columns})
+    # the same statements over the TWO-LEVEL motion path (hierarchical
+    # redistribute/gather/broadcast + the host-combined agg merge) on
+    # the REAL 2-process cluster — collectives genuinely cross the
+    # process boundary here, and results must be bit-identical to flat
+    hier = cb.Session(get_config().with_overrides(**{
+        "n_segments": 8,
+        "interconnect.hierarchical": "on",
+    }))
+    load(hier)
+    hier_results = []
+    for q, flat_res in zip(QUERIES, results):
+        df = hier.sql(q).to_pandas()
+        got = {c: df[c].tolist() for c in df.columns}
+        assert got == flat_res, \
+            f"hierarchical differs from flat for {q!r}"
+        hier_results.append(got)
     print("RESULT " + json.dumps(
-        {"host": topo["this_host"], "results": results}), flush=True)
+        {"host": topo["this_host"], "results": results,
+         "hier_results": hier_results}), flush=True)
 
 
 if __name__ == "__main__":

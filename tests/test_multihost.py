@@ -79,6 +79,12 @@ def test_two_host_cluster_matches_single_host(session):
     # both hosts observed identical results (the gathered top slice is
     # replicated across segments, hence across hosts)
     assert outs[0]["results"] == outs[1]["results"]
+    # the TWO-LEVEL motion path ran the same statements on the real
+    # 2-process cluster (hierarchical redistribute / gather / broadcast
+    # + host-combined agg merge) — the worker already asserted
+    # hier == flat per query; pin cross-host agreement here too
+    assert outs[0]["hier_results"] == outs[0]["results"]
+    assert outs[0]["hier_results"] == outs[1]["hier_results"]
 
     # oracle: the same statements on this process's single-host 8-seg mesh
     import cloudberry_tpu as cb
