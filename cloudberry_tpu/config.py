@@ -142,6 +142,36 @@ class PlannerConfig:
 
 
 @dataclass(frozen=True)
+class ScanPipelineConfig:
+    """Asynchronous tiled-scan pipeline (exec/scanpipe.py) — the input-
+    pipeline discipline of a training loop applied to the out-of-core
+    scan path: a background reader stages the NEXT micro-partitions
+    (read + decode + pad) into a bounded prefetch queue while the device
+    computes the current tile, with the host→device transfer of tile
+    k+1 double-buffered behind the dispatch of tile k. Results are
+    bit-identical pipeline on/off (same tiles, same order — tests pin
+    it); the knobs only move decode/pad/transfer off the critical
+    path. Queue memory is charged into the statement's capacity
+    estimate (obs/capacity.py record_tiled: prefetch_tiles × tile
+    working set rides est_pipeline_bytes)."""
+
+    enabled: bool = True
+    # Tiles staged ahead of the consumer (the bounded queue depth). The
+    # queue holds HOST numpy buffers; 1 still overlaps read/decode of
+    # tile k+1 with compute of tile k.
+    prefetch_tiles: int = 2
+    # Reader-pool threads for column-parallel micro-partition decode
+    # (zstd/zlib/dvarint release the GIL; each thread keeps its own
+    # decompression context). <=1 decodes serially in the reader.
+    decode_workers: int = 2
+    # Double-buffered jax.device_put: the pipeline stages the next
+    # host tile onto the device while the current tile's step program
+    # is still dispatched (single-node tiled path; the distributed
+    # path feeds shard_map directly and stages host-side only).
+    device_buffer: bool = True
+
+
+@dataclass(frozen=True)
 class ResourceConfig:
     """Memory governance analog (vmem_tracker.c:94, workfile_mgr.c)."""
 
@@ -489,6 +519,8 @@ class Config:
     planner: PlannerConfig = field(default_factory=PlannerConfig)
     join_filter: JoinFilterConfig = field(default_factory=JoinFilterConfig)
     resource: ResourceConfig = field(default_factory=ResourceConfig)
+    scan_pipeline: ScanPipelineConfig = field(
+        default_factory=ScanPipelineConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     health: HealthConfig = field(default_factory=HealthConfig)

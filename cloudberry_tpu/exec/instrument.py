@@ -460,6 +460,24 @@ def _tiled_lines(report: dict) -> list[str]:
         lines.append(
             f"  tile step: mean {th['mean'] * 1000:.2f} ms  "
             f"p95 {th['p95'] * 1000:.2f} ms  over {th['count']} tiles")
+    pl = report.get("pipeline")
+    if pl:
+        if pl.get("enabled"):
+            # stall attribution (exec/scanpipe.py): feed = the host work
+            # the pipeline moved off the critical path, stall = what the
+            # device still waited for, decode/read split the feed side
+            bits = [f"prefetch depth {pl.get('depth', '?')}",
+                    f"feed {pl.get('feed_s', 0) * 1000:.1f} ms",
+                    f"stall {pl.get('stall_s', 0) * 1000:.1f} ms"]
+            if "overlap_frac" in pl:
+                bits.append(f"overlap {pl['overlap_frac'] * 100:.0f}%")
+            if pl.get("decode_s"):
+                bits.append(f"decode {pl['decode_s'] * 1000:.1f} ms")
+            if pl.get("read_s"):
+                bits.append(f"read {pl['read_s'] * 1000:.1f} ms")
+            lines.append("  scan pipeline: " + "  ".join(bits))
+        else:
+            lines.append("  scan pipeline: off")
     ck = {k: report[k] for k in ("checkpoints", "resumed_from_tile",
                                  "tiles_replayed") if k in report}
     if ck:

@@ -39,6 +39,7 @@ import numpy as np
 from cloudberry_tpu.columnar.batch import ColumnBatch
 from cloudberry_tpu.exec import executor as X
 from cloudberry_tpu.exec import kernels as K
+from cloudberry_tpu.exec import scanpipe as SP
 from cloudberry_tpu.exec.resource import estimate_plan_memory
 from cloudberry_tpu.plan import expr as ex
 from cloudberry_tpu.plan import nodes as N
@@ -769,6 +770,12 @@ class TiledExecutable(AdaptiveTiledMixin):
             "tile_rows": self.tile_rows,
             "acc_capacity": shape.g_cap,
             "est_step_bytes": est + merge_bytes,
+            # scan-pipeline staging charge (exec/scanpipe.py): the
+            # bounded prefetch queue pins prefetch_tiles × one tile's
+            # host working set — obs/capacity.record_tiled adds it to
+            # the statement's observed peak
+            "est_pipeline_bytes": SP.queue_charge_bytes(
+                shape.stream, self.tile_rows, self.session.config),
             "budget_bytes": self.budget,
         }
 
@@ -910,19 +917,28 @@ class TiledExecutable(AdaptiveTiledMixin):
         n_local = 0
         timer = _TileTimer(self.session)
         tracker = _progress_tracker(self, n_base, skip)
-        for tile, tile_n in _tile_feed(self.shape.stream, self.session,
-                                       self.tile_rows, skip_rows=skip):
-            fault_point("tile_step")
-            fault_point("tile_device_lost")
-            with timer.step(n_base + n_local):
-                acc, checks = step_fn(resident, prelude, tile,
-                                      jnp.asarray(tile_n,
-                                                  dtype=jnp.int32), acc)
-                _raise_tile_checks(checks, n_base + n_local)
-            n_local += 1
-            tracker.step(n_local)
-            if ctx is not None:
-                ctx.tick(n_local, lambda: R.acc_payload(acc))
+        feed = _tile_feed(self.shape.stream, self.session,
+                          self.tile_rows, skip_rows=skip)
+        try:
+            for tile, tile_n in feed:
+                fault_point("tile_step")
+                fault_point("tile_device_lost")
+                with timer.step(n_base + n_local):
+                    acc, checks = step_fn(resident, prelude, tile,
+                                          jnp.asarray(tile_n,
+                                                      dtype=jnp.int32),
+                                          acc)
+                    _raise_tile_checks(checks, n_base + n_local)
+                n_local += 1
+                tracker.step(n_local)
+                if ctx is not None:
+                    ctx.tick(n_local, lambda: R.acc_payload(acc))
+        finally:
+            # deterministic teardown on EVERY exit (cancel, overflow
+            # retry, device loss): the reader joins and staged tiles
+            # release — no orphan thread, no pinned prefetch buffers
+            SP.close_feed(feed)
+        SP.stamp_report(self.report, feed)
         n_tiles = n_base + n_local
         timer.stamp(self.report)
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
@@ -1046,6 +1062,8 @@ class SortTiledExecutable(TiledExecutable):
             "tile_rows": self.tile_rows,
             "acc_capacity": 0,
             "est_step_bytes": est + _merge_bytes(shape),
+            "est_pipeline_bytes": SP.queue_charge_bytes(
+                shape.stream, self.tile_rows, self.session.config),
             "budget_bytes": self.budget,
         }
 
@@ -1105,24 +1123,30 @@ class SortTiledExecutable(TiledExecutable):
         n_local = 0
         timer = _TileTimer(self.session)
         tracker = _progress_tracker(self, n_base, skip)
-        for tile, tile_n in _tile_feed(shape.stream, self.session,
-                                       self.tile_rows, skip_rows=skip):
-            fault_point("tile_step")
-            fault_point("tile_device_lost")
-            with timer.step(n_base + n_local):
-                (pcols, psel, keys), checks = step_fn(
-                    resident, prelude, tile,
-                    jnp.asarray(tile_n, dtype=jnp.int32))
-                _raise_tile_checks(checks, n_base + n_local)
-            n_local += 1
-            tracker.step(n_local)
-            mask = np.asarray(psel)
-            for nm in names:
-                runs[nm].append(np.asarray(pcols[nm])[mask])
-            for i, k in enumerate(keys):
-                key_runs[i].append(np.asarray(k)[mask])
-            if ctx is not None:
-                ctx.tick(n_local, lambda: R.runs_payload(runs, key_runs))
+        feed = _tile_feed(shape.stream, self.session,
+                          self.tile_rows, skip_rows=skip)
+        try:
+            for tile, tile_n in feed:
+                fault_point("tile_step")
+                fault_point("tile_device_lost")
+                with timer.step(n_base + n_local):
+                    (pcols, psel, keys), checks = step_fn(
+                        resident, prelude, tile,
+                        jnp.asarray(tile_n, dtype=jnp.int32))
+                    _raise_tile_checks(checks, n_base + n_local)
+                n_local += 1
+                tracker.step(n_local)
+                mask = np.asarray(psel)
+                for nm in names:
+                    runs[nm].append(np.asarray(pcols[nm])[mask])
+                for i, k in enumerate(keys):
+                    key_runs[i].append(np.asarray(k)[mask])
+                if ctx is not None:
+                    ctx.tick(n_local,
+                             lambda: R.runs_payload(runs, key_runs))
+        finally:
+            SP.close_feed(feed)
+        SP.stamp_report(self.report, feed)
         timer.stamp(self.report)
 
         fault_point("tiled_finalize")
@@ -1309,15 +1333,29 @@ def _empty_tile(scan: N.PScan, tile_rows: int) -> dict:
 
 def _tile_feed(scan: N.PScan, session, tile_rows: int,
                skip_rows: int = 0):
-    """Yield (tile dict of padded arrays, n_valid). Cold tables stream
-    micro-partition files (host staging: the device never holds more than
-    one tile); warm tables slice their RAM arrays. ``skip_rows`` drops
-    the already-consumed prefix — the mid-statement resume entry point
-    (exec/recovery.py): single-node consumption is always a prefix of
-    the deterministic stream order."""
+    """The single-node tile feed: (tile dict of padded arrays, n_valid)
+    items, wrapped in the asynchronous scan pipeline when
+    ``config.scan_pipeline`` enables it (exec/scanpipe.py — prefetch +
+    column-parallel decode + double-buffered device staging; tile order
+    and content are the synchronous feed's, bit-identical on/off).
+    Cold tables stream micro-partition files (host staging: the device
+    never holds more than one tile); warm tables slice their RAM
+    arrays. ``skip_rows`` drops the already-consumed prefix — the
+    mid-statement resume entry point (exec/recovery.py): single-node
+    consumption is always a prefix of the deterministic stream order.
+    Callers must close the feed (scanpipe.close_feed) on every exit."""
+    stats = SP.ScanStats()
     if hasattr(scan, "_store_parts"):
-        yield from _store_tiles(scan, session, tile_rows, skip_rows)
-        return
+        gen = _store_tiles(scan, session, tile_rows, skip_rows, stats)
+    else:
+        gen = _ram_tiles(scan, session, tile_rows, skip_rows)
+    return SP.maybe_pipeline(gen, session.config, device_stage=True,
+                             stats=stats)
+
+
+def _ram_tiles(scan: N.PScan, session, tile_rows: int,
+               skip_rows: int = 0):
+    """Warm-table tile producer: slices of the resident RAM arrays."""
     t = session.catalog.table(scan.table_name)
     t.ensure_loaded()
     cols = {phys: np.asarray(t.data[phys]) for phys in scan.column_map}
@@ -1333,50 +1371,153 @@ def _tile_feed(scan: N.PScan, session, tile_rows: int,
         yield _pad_tile(cols, off, n, tile_rows), n
 
 
+class _PendBuf:
+    """Offset-cursor ring over decoded partition chunks. ``take(n)``
+    copies ONLY the emitted rows — each row at most once, never the
+    whole pending tail the old code re-concatenated per emitted tile
+    (O(n²) over a partition). A tile covering a chunk EXACTLY hands
+    the chunk array over zero-copy; partial-chunk tiles copy rather
+    than emit a view, because a view's base is the whole decoded
+    partition column and the prefetch queue would pin partitions, not
+    tiles (the out-of-core bound is one partition + bounded staging).
+    ``skip(n)`` advances the cursor without touching a byte (the
+    resume prefix). All columns share one chunk-length spine, so the
+    cursor is maintained once."""
+
+    def __init__(self, stats=None):
+        self._names: Optional[list[str]] = None
+        self._chunks: dict[str, list] = {}
+        self._lens: list[int] = []
+        self._off = 0           # consumed rows of the FIRST chunk
+        self.rows = 0           # rows pending past the cursor
+        self._stats = stats
+
+    def append(self, cols: dict) -> None:
+        n = len(next(iter(cols.values()))) if cols else 0
+        if self._names is None:
+            self._names = list(cols)
+            self._chunks = {nm: [] for nm in self._names}
+        if n == 0:
+            return
+        for nm in self._names:
+            self._chunks[nm].append(cols[nm])
+        self._lens.append(n)
+        self.rows += n
+
+    def _plan(self, n: int):
+        """Slice plan [(chunk_idx, lo, hi)] covering the next n rows,
+        plus the advanced cursor (chunks_to_drop, new_offset)."""
+        plan = []
+        i, off, need = 0, self._off, n
+        while need > 0:
+            length = self._lens[i]
+            t = min(length - off, need)
+            plan.append((i, off, off + t))
+            need -= t
+            off += t
+            if off == length:
+                i += 1
+                off = 0
+        return plan, i, off
+
+    def _advance(self, drop: int, off: int, n: int) -> None:
+        for _ in range(drop):
+            self._lens.pop(0)
+            for nm in self._names:
+                self._chunks[nm].pop(0)
+        self._off = off
+        self.rows -= n
+
+    def skip(self, n: int) -> None:
+        _, drop, off = self._plan(n)
+        self._advance(drop, off, n)
+
+    def take(self, n: int) -> dict:
+        plan, drop, off = self._plan(n)
+        whole = (len(plan) == 1 and plan[0][1] == 0
+                 and plan[0][2] == self._lens[plan[0][0]])
+        out = {}
+        for nm in self._names:
+            chunks = self._chunks[nm]
+            if whole:
+                out[nm] = chunks[plan[0][0]]
+            else:
+                parts = [chunks[i][lo:hi] for i, lo, hi in plan]
+                out[nm] = parts[0].copy() if len(parts) == 1 \
+                    else np.concatenate(parts)
+        if self._stats is not None:
+            if whole:
+                self._stats.view_rows += n
+            else:
+                self._stats.copy_rows += n
+        self._advance(drop, off, n)
+        return out
+
+
 def _store_tiles(scan: N.PScan, session, tile_rows: int,
-                 skip_rows: int = 0):
+                 skip_rows: int = 0, stats=None):
     """Stream a pruned cold scan part-by-part, re-chunked to tile_rows:
-    the out-of-core path — peak host memory is one partition + one tile."""
+    the out-of-core path — peak host memory is one partition + the
+    pipeline's bounded staging. A resume's ``skip_rows`` drops whole
+    already-consumed partitions WITHOUT reading or decoding them (the
+    replay cost of a checkpointed restart is bounded by one partition
+    plus ≤ K tiles, never the consumed prefix)."""
+    import time as _t
+
     store = session.catalog.store
     needed = _phys_cols(scan)
-    pend: dict[str, list[np.ndarray]] = {}
-    pend_rows = 0
+    stats = stats if stats is not None else SP.ScanStats()
+    pool = SP.decode_pool(session.config)
+    log = getattr(session, "stmt_log", None)
+    obs = log is not None and getattr(log, "obs_enabled", False)
+    buf = _PendBuf(stats)
     skip_left = max(int(skip_rows), 0)
 
-    def drain(final: bool):
-        nonlocal pend, pend_rows, skip_left
-        # drop the resume prefix first (rows a prior attempt consumed)
-        while skip_left > 0 and pend_rows > 0:
-            take = min(skip_left, pend_rows)
-            for name, chunks in pend.items():
-                cat = chunks[0] if len(chunks) == 1 \
-                    else np.concatenate(chunks)
-                pend[name] = [cat[take:]]
-            pend_rows -= take
-            skip_left -= take
-        while pend_rows >= tile_rows or (final and pend_rows > 0):
-            take = min(tile_rows, pend_rows)
-            tile = {}
-            for name, chunks in pend.items():
-                cat = chunks[0] if len(chunks) == 1 \
-                    else np.concatenate(chunks)
-                tile[name] = cat[:take]
-                pend[name] = [cat[take:]]
-            pend_rows -= take
-            yield _pad_tile(tile, 0, take, tile_rows), take
+    parts = list(scan._store_parts)
+    start = 0
+    for part in parts:
+        eff = int(part["num_rows"]) - len(part.get("deleted") or ())
+        if skip_left < eff:
+            break
+        skip_left -= eff
+        start += 1
+        stats.parts_skipped += 1
 
-    for part in scan._store_parts:
+    def drain(final: bool):
+        nonlocal skip_left
+        if skip_left > 0 and buf.rows > 0:
+            t = min(skip_left, buf.rows)
+            buf.skip(t)  # sub-partition resume remainder: cursor only
+            skip_left -= t
+        while buf.rows >= tile_rows or (final and buf.rows > 0):
+            take = min(tile_rows, buf.rows)
+            yield _pad_tile(buf.take(take), 0, take, tile_rows), take
+
+    for part in parts[start:]:
+        fault_point("scan_decode")
+        dts: list = []  # per-column decode seconds (list.append: atomic)
+        t0 = _t.perf_counter()
         cols, validity = store.read_partitions(
-            scan.table_name, [part], needed)
+            scan.table_name, [part], needed, pool=pool,
+            on_decode=dts.append)
+        stats.read_s += _t.perf_counter() - t0
+        stats.parts_read += 1
+        stats.decode_s += sum(dts)
+        if obs:
+            for dt in dts:
+                log.registry.observe("decode_seconds", dt)
         n = len(next(iter(cols.values()))) if cols else 0
+        chunk = {}
         for phys in scan.column_map:
-            pend.setdefault(phys, []).append(np.asarray(cols[phys]))
+            chunk[phys] = np.asarray(cols[phys])
         for phys in scan.mask_map:
             vm = validity.get(phys)
-            pend.setdefault(f"$nn:{phys}", []).append(
+            chunk[f"$nn:{phys}"] = (
                 np.asarray(vm, dtype=np.bool_) if vm is not None
                 else np.ones(n, dtype=np.bool_))
-        pend_rows += n
+        stats.bytes_decoded += sum(int(a.nbytes)
+                                   for a in chunk.values())
+        buf.append(chunk)
         yield from drain(final=False)
     yield from drain(final=True)
 

@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 from cloudberry_tpu.columnar.batch import ColumnBatch
 from cloudberry_tpu.exec import executor as X
 from cloudberry_tpu.exec import kernels as K
+from cloudberry_tpu.exec import scanpipe as SP
 from cloudberry_tpu.exec.dist_executor import (DistLowerer, _local_row,
                                                _shard_map,
                                                prepare_dist_inputs)
@@ -566,6 +567,13 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             "acc_capacity": shape.g_cap,
             "est_step_bytes": est + _merge_bytes(shape),
             "est_finalize_bytes": _finalize_bytes(shape, self.nseg),
+            # scan-pipeline staging charge (exec/scanpipe.py): the
+            # bounded prefetch queue pins prefetch_tiles × one
+            # (nseg, tile_rows) host tile — obs/capacity.record_tiled
+            # adds it to the statement's observed peak
+            "est_pipeline_bytes": SP.queue_charge_bytes(
+                shape.stream, self.tile_rows, self.session.config,
+                nseg=self.nseg),
             "budget_bytes": self.budget,
         }
 
@@ -767,17 +775,26 @@ class DistTiledExecutable(AdaptiveTiledMixin):
 
         timer = _TileTimer(self.session)
         tracker = _dist_progress_tracker(self, feed, n_base)
-        for tile, tile_ns in feed:
-            fault_point("tile_step_dist")
-            fault_point("tile_device_lost")
-            with timer.step(n_base + n_local):
-                acc, checks = step_fn(resident, prelude, tile, tile_ns,
-                                      acc)
-                _raise_tile_checks(checks, n_base + n_local)
-            n_local += 1
-            tracker.step(n_local)
-            if ctx is not None:
-                ctx.tick(n_local, lambda: R.acc_payload(acc))
+        # prefetch pipeline over the per-segment feed (exec/scanpipe.py:
+        # host staging only — shard_map owns device placement); the
+        # tracker/checkpoint math reads the UNWRAPPED feed above, and
+        # progress counts consumed tiles, never staged ones
+        stream = SP.maybe_pipeline(iter(feed), self.session.config)
+        try:
+            for tile, tile_ns in stream:
+                fault_point("tile_step_dist")
+                fault_point("tile_device_lost")
+                with timer.step(n_base + n_local):
+                    acc, checks = step_fn(resident, prelude, tile,
+                                          tile_ns, acc)
+                    _raise_tile_checks(checks, n_base + n_local)
+                n_local += 1
+                tracker.step(n_local)
+                if ctx is not None:
+                    ctx.tick(n_local, lambda: R.acc_payload(acc))
+        finally:
+            SP.close_feed(stream)
+        SP.stamp_report(self.report, stream)
         timer.stamp(self.report)
         n_tiles = n_base + n_local
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
@@ -984,24 +1001,32 @@ class DistSortTiledExecutable(DistTiledExecutable):
 
         timer = _TileTimer(self.session)
         tracker = _dist_progress_tracker(self, feed, n_base)
-        for tile, tile_ns in feed:
-            fault_point("tile_step_dist")
-            fault_point("tile_device_lost")
-            with timer.step(n_base + n_local):
-                (pcols, psel, keys), checks = step_fn(resident, prelude,
-                                                      tile, tile_ns)
-                _raise_tile_checks(checks, n_base + n_local)
-            n_local += 1
-            tracker.step(n_local)
-            selnp = np.asarray(psel)
-            for s in range(self.nseg):
-                m = selnp[s]
-                for nm in names:
-                    runs[nm].append(np.asarray(pcols[nm][s])[m])
-                for i, k in enumerate(keys):
-                    key_runs[i].append(np.asarray(k[s])[m])
-            if ctx is not None:
-                ctx.tick(n_local, lambda: R.runs_payload(runs, key_runs))
+        # same pipeline wrap as the agg-mode loop: staging off the
+        # critical path, consumed-tile accounting unchanged
+        stream = SP.maybe_pipeline(iter(feed), self.session.config)
+        try:
+            for tile, tile_ns in stream:
+                fault_point("tile_step_dist")
+                fault_point("tile_device_lost")
+                with timer.step(n_base + n_local):
+                    (pcols, psel, keys), checks = step_fn(
+                        resident, prelude, tile, tile_ns)
+                    _raise_tile_checks(checks, n_base + n_local)
+                n_local += 1
+                tracker.step(n_local)
+                selnp = np.asarray(psel)
+                for s in range(self.nseg):
+                    m = selnp[s]
+                    for nm in names:
+                        runs[nm].append(np.asarray(pcols[nm][s])[m])
+                    for i, k in enumerate(keys):
+                        key_runs[i].append(np.asarray(k[s])[m])
+                if ctx is not None:
+                    ctx.tick(n_local,
+                             lambda: R.runs_payload(runs, key_runs))
+        finally:
+            SP.close_feed(stream)
+        SP.stamp_report(self.report, stream)
         timer.stamp(self.report)
         from cloudberry_tpu.exec.tiled import merge_sorted_runs
 

@@ -31,7 +31,7 @@ CONCURRENT_CLASSES = frozenset({
     "RecoveryStore", "CircuitBreaker", "CancelToken", "Watchdog",
     "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
     "MetricsRegistry", "StatementStats", "Trace", "Progress",
-    "TopologyManager",
+    "TopologyManager", "ScanPipeline",
 })
 
 # attribute-name → class-name hints for cross-class lock edges: when a
@@ -94,6 +94,7 @@ SEAM_LOOP_MODULES = (
     "exec/tiled.py",
     "exec/tiled_dist.py",
     "exec/recovery.py",
+    "exec/scanpipe.py",
 )
 
 # calls that count as a cancellation seam inside a loop body
@@ -157,7 +158,8 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     # rank 4 — innermost leaves (never call out while held)
     ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock",
      "MetricsRegistry._lock", "StatementStats._lock", "Trace._lock",
-     "Progress._lock", "mesh._topo_lock"),
+     "Progress._lock", "mesh._topo_lock", "ScanPipeline._cond",
+     "scanpipe._pool_lock"),
 )
 
 

@@ -153,12 +153,17 @@ def record_statement(log, plan, session, est=None) -> None:
 def record_tiled(log, report: dict) -> None:
     """Tiled (out-of-core) statements: the carried working set — tile
     step intermediates plus the accumulator — IS the device peak; the
-    report already itemizes it (exec/tiled.py _refresh_report)."""
+    report already itemizes it (exec/tiled.py _refresh_report). The
+    scan pipeline's bounded prefetch queue (exec/scanpipe.py) pins
+    prefetch_tiles × one tile's host working set on top — charged here
+    (``est_pipeline_bytes``) so the staging memory is visible in the
+    same histograms as the device estimate."""
     if log is None or not getattr(log, "obs_enabled", False):
         return
     peak = int(report.get("est_step_bytes", 0))
     fin = int(report.get("est_finalize_bytes", 0))
-    observe_stmt_bytes(log, max(peak, fin))
+    pipe = int(report.get("est_pipeline_bytes", 0))
+    observe_stmt_bytes(log, max(peak, fin) + pipe)
 
 
 # --------------------------------------------------------- memory gauges

@@ -527,10 +527,13 @@ class TableStore:
 
     def read_partitions(self, table: str, parts: list[dict],
                         columns: list[str] | None = None,
-                        version: Optional[int] = None
-                        ) -> tuple[dict, dict]:
+                        version: Optional[int] = None,
+                        pool=None, on_decode=None) -> tuple[dict, dict]:
         """Read (selected columns of) the given partitions; "$nn:" validity
-        columns split out. Returns (columns dict, validity dict)."""
+        columns split out. Returns (columns dict, validity dict).
+        ``pool``/``on_decode`` ride through to the column decode
+        (micropartition.read_columns) — the scan pipeline's
+        column-parallel decode and its ``decode_seconds`` feed."""
         from cloudberry_tpu.utils.faultinject import fault_point
 
         fault_point("store_read_partition")
@@ -543,7 +546,8 @@ class TableStore:
         chunks: list[dict[str, np.ndarray]] = []
         for part in parts:
             cols = mp.read_columns(os.path.join(tdir, part["file"]),
-                                   want, cipher=self.cipher)
+                                   want, cipher=self.cipher,
+                                   pool=pool, on_decode=on_decode)
             if part["deleted"]:
                 keep = np.ones(part["num_rows"], dtype=bool)
                 keep[np.asarray(part["deleted"], dtype=np.int64)] = False

@@ -69,6 +69,9 @@ INVENTORY = frozenset({
     # tiled execution + recovery
     "tile_step", "tile_step_dist", "tiled_finalize",
     "ckpt_save", "ckpt_resume", "tile_device_lost",
+    # asynchronous scan pipeline (exec/scanpipe.py): the prefetch
+    # reader's per-tile seam and the per-partition decode seam
+    "scan_prefetch", "scan_decode",
     # mesh health
     "exec_device_lost", "probe_degraded",
     # online topology changes (parallel/topology.py)

@@ -1,0 +1,485 @@
+"""Asynchronous scan pipeline (exec/scanpipe.py) — prefetch + parallel
+decode + device double-buffering over the tiled executors.
+
+The contract under test: pipeline on/off is BIT-IDENTICAL across every
+tiled mode (agg/topn/sort/window, single-node and dist8) because the
+pipeline only moves host work off the critical path; cancellation mid-
+prefetch leaves no orphan reader thread; checkpoint resume with a warm
+queue replays ≤ K tiles and never re-decodes consumed partitions; the
+bounded queue respects its depth under a tiny-tile stress; and the
+``scan_prefetch``/``scan_decode`` fault seams fire and recover.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu import lifecycle
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.exec import scanpipe as SP
+from cloudberry_tpu.utils import faultinject as FI
+
+AGG_Q = ("SELECT g, sum(v) AS sv, count(*) AS c "
+         "FROM fact JOIN dim ON fact.k = dim.k GROUP BY g ORDER BY g")
+TOPN_Q = ("SELECT fact.k AS k, v, g FROM fact JOIN dim ON fact.k = dim.k "
+          "WHERE v < 90 ORDER BY v, fact.k, g LIMIT 25")
+SORT_Q = ("SELECT g, v FROM fact JOIN dim ON fact.k = dim.k "
+          "WHERE v < 50 ORDER BY g, v DESC, fact.k")
+WIN_Q = ("SELECT g, v, rank() over (partition by g order by v desc) AS r,"
+         " sum(v) over (partition by g) AS sv "
+         "FROM fact JOIN dim ON fact.k = dim.k")
+
+
+def _load(s, n_fact=120_000, n_dim=500, n_groups=9):
+    rng = np.random.default_rng(3)
+    s.sql("CREATE TABLE dim (k BIGINT, g BIGINT) DISTRIBUTED BY (k)")
+    s.sql("CREATE TABLE fact (k BIGINT, v BIGINT) DISTRIBUTED BY (k)")
+    s.catalog.table("dim").set_data(
+        {"k": np.arange(n_dim), "g": np.arange(n_dim) % n_groups})
+    s.catalog.table("fact").set_data(
+        {"k": rng.integers(0, n_dim, n_fact),
+         "v": rng.integers(0, 100, n_fact)})
+
+
+def _mk(budget=None, pipeline=None, nseg=1, **extra):
+    ov = {"n_segments": nseg}
+    if budget is not None:
+        ov["resource.query_mem_bytes"] = budget
+    if pipeline is not None:
+        ov["scan_pipeline.enabled"] = pipeline
+    ov.update(extra)
+    return cb.Session(get_config().with_overrides(**ov))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FI.reset_fault()
+    yield
+    FI.reset_fault()
+
+
+def _no_orphan_readers(timeout=5.0) -> bool:
+    """True once no cbtpu-scan-reader thread is alive (join-with-timeout
+    discipline: the pipeline must tear its reader down, not leak it)."""
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if not any(t.name.startswith("cbtpu-scan-reader")
+                   and t.is_alive() for t in threading.enumerate()):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ------------------------------------------------- on/off bit-identity
+
+
+@pytest.fixture(scope="module")
+def expected():
+    s = _mk()
+    _load(s)
+    return {q: s.sql(q).to_pandas() for q in (AGG_Q, TOPN_Q, SORT_Q,
+                                              WIN_Q)}
+
+
+@pytest.mark.parametrize("q,mode", [(AGG_Q, None), (TOPN_Q, "topn"),
+                                    (SORT_Q, "sort"), (WIN_Q, "window")])
+def test_pipeline_on_off_bit_identical_single(expected, q, mode):
+    got = {}
+    for pipe in (True, False):
+        s = _mk(budget=3 << 20, pipeline=pipe)
+        _load(s)
+        got[pipe] = s.sql(q).to_pandas()
+        rep = s.last_tiled_report
+        assert rep["tiled"] and rep["n_tiles"] > 1
+        if mode is not None:
+            assert rep["mode"] == mode
+        assert rep["pipeline"]["enabled"] is pipe
+    assert got[True].equals(got[False])
+    if mode != "window":  # window row order is sort-compared elsewhere
+        assert expected[q].equals(got[True])
+    assert _no_orphan_readers()
+
+
+# per-mode dist8 shapes: the (nseg, tile_rows) tile covers 8× the
+# single-node rows, so agg/topn/sort stream multiple tiles at 1 MiB;
+# the window path additionally needs every partition to fit one spill
+# chunk, so it runs finer-grained groups (300) over more rows at the
+# budget whose chunk capacity holds them
+_DIST8 = [(AGG_Q, None, 1 << 20, 120_000, 9),
+          (TOPN_Q, "topn", 1 << 20, 120_000, 9),
+          (SORT_Q, "sort", 1 << 20, 120_000, 9),
+          (WIN_Q, "window", 4 << 20, 240_000, 300)]
+
+
+@pytest.mark.parametrize("q,mode,budget,n_fact,n_groups", _DIST8)
+def test_pipeline_on_off_bit_identical_dist8(q, mode, budget, n_fact,
+                                             n_groups):
+    got = {}
+    for pipe in (True, False):
+        s = _mk(budget=budget, pipeline=pipe, nseg=8)
+        _load(s, n_fact=n_fact, n_groups=n_groups)
+        got[pipe] = s.sql(q).to_pandas()
+        rep = s.last_tiled_report
+        assert rep["tiled"] and rep["n_tiles"] > 1
+        if mode is not None:
+            assert rep["mode"] == mode
+        assert rep["pipeline"]["enabled"] is pipe
+    assert got[True].equals(got[False])
+    assert _no_orphan_readers()
+
+
+def test_cold_store_pipeline_bit_identical(tmp_path):
+    """The out-of-core path proper: micro-partition files stream
+    through the prefetch pipeline with column-parallel decode; on/off
+    bit-identical, decode accounting stamped on the report, and the
+    ``decode_seconds`` histogram feeds the registry."""
+    root = str(tmp_path / "store")
+    s0 = _mk(**{"storage.root": root,
+                "storage.rows_per_partition": 20_000})
+    _load(s0)
+    exp = s0.sql(AGG_Q).to_pandas()
+
+    got = {}
+    for pipe in (True, False):
+        s = _mk(budget=3 << 20, pipeline=pipe, **{"storage.root": root})
+        assert s.catalog.table("fact").cold
+        got[pipe] = s.sql(AGG_Q).to_pandas()
+        rep = s.last_tiled_report
+        assert rep["pipeline"]["enabled"] is pipe
+        assert rep["pipeline"]["parts_read"] > 1
+        assert rep["pipeline"]["decode_s"] >= 0.0
+        if pipe:
+            assert rep["pipeline"]["tiles_prefetched"] == rep["n_tiles"]
+            # depth respected: the high-water mark never exceeds the
+            # configured queue bound
+            assert rep["pipeline"]["max_depth"] \
+                <= s.config.scan_pipeline.prefetch_tiles
+        h = s.stmt_log.registry.hist("decode_seconds")
+        assert h is not None and h["count"] > 0
+    assert got[True].equals(got[False]) and exp.equals(got[True])
+    assert _no_orphan_readers()
+
+
+# -------------------------------------------------------- cancellation
+
+
+def test_cancel_mid_prefetch_no_orphan_reader():
+    """Cancel lands while the reader is prefetching ahead (the consumer
+    is slowed by a tile_step sleep, so the queue is warm): the
+    statement dies with StatementCancelled, the reader thread joins,
+    and a rerun on the same session is bit-identical."""
+    expect_s = _mk(budget=3 << 20)
+    _load(expect_s)
+    expect = expect_s.sql(AGG_Q).to_pandas()
+
+    s = _mk(budget=3 << 20)
+    _load(s)
+    FI.inject_fault("tile_step", "sleep", sleep_s=0.05)
+    errs = []
+
+    def bg():
+        try:
+            s.sql(AGG_Q)
+        except BaseException as e:  # noqa: BLE001 — assertion target
+            errs.append(e)
+
+    th = threading.Thread(target=bg)
+    th.start()
+    act = None
+    for _ in range(500):
+        act = s.stmt_log.activity()
+        if act:
+            break
+        time.sleep(0.01)
+    assert act, "statement never appeared in the activity view"
+    time.sleep(0.25)  # let the reader stage tiles ahead
+    assert s.stmt_log.cancel(act[0]["id"])
+    th.join(timeout=60)
+    assert errs and isinstance(errs[0], lifecycle.StatementCancelled)
+    assert _no_orphan_readers()
+
+    FI.reset_fault()
+    got = s.sql(AGG_Q).to_pandas()
+    assert s.last_tiled_report is not None
+    assert expect.equals(got)
+
+
+# -------------------------------------------------- checkpoint/resume
+
+
+def test_resume_warm_queue_replays_bounded(tmp_path):
+    """Device loss mid-stream with a warm prefetch queue: the resume
+    replays ≤ K tiles (staged-but-unconsumed tiles never count as
+    progress) and — on the cold path — skips already-consumed
+    partitions without re-decoding them."""
+    root = str(tmp_path / "store")
+    s0 = _mk(**{"storage.root": root,
+                "storage.rows_per_partition": 20_000})
+    _load(s0)
+    exp = s0.sql(AGG_Q).to_pandas()
+
+    K = 2
+    # 1 MiB → 8 tiles of 16384 over the 120k-row fact: the kill at the
+    # 6th tile lands well past the second checkpoint AND past whole
+    # 20k-row partitions (the skip fast path has something to skip)
+    s = _mk(budget=1 << 20, **{"storage.root": root,
+                               "recovery.checkpoint_every": K,
+                               "health.retries": 2,
+                               "health.backoff_s": 0.01})
+    # kill late enough that whole partitions are behind the checkpoint
+    FI.inject_fault("tile_device_lost", "error", start_hit=6, end_hit=6)
+    got = s.sql(AGG_Q).to_pandas()
+    assert exp.equals(got)
+    rep = s.last_tiled_report
+    assert rep["tiles_replayed"] <= K
+    assert rep["resumed_from_tile"] >= 1
+    # the resumed attempt's feed skipped consumed partitions outright
+    assert rep["pipeline"]["parts_skipped"] >= 1
+    assert _no_orphan_readers()
+
+
+# ------------------------------------------------------- queue behavior
+
+
+def test_queue_bound_respected_tiny_tiles():
+    """1-row-tile stress directly on the pipeline: 500 tiles through a
+    depth-3 queue with a slow consumer — every tile arrives in order
+    and the buffer high-water mark never exceeds the bound."""
+    def gen():
+        for i in range(500):
+            yield ({"x": np.array([i], dtype=np.int64)}, 1)
+
+    p = SP.ScanPipeline(gen(), depth=3)
+    seen = []
+    try:
+        for i, (tile, n) in enumerate(p):
+            assert n == 1
+            seen.append(int(tile["x"][0]))
+            if i % 50 == 0:
+                time.sleep(0.01)  # let the reader race ahead
+    finally:
+        p.close()
+    assert seen == list(range(500))
+    assert p.max_depth <= 3
+    assert p.stats()["tiles_prefetched"] == 500
+    assert _no_orphan_readers()
+
+
+def test_abandoned_pipeline_close_joins_reader():
+    """close() mid-stream (the adaptive-retry restart shape): the
+    reader joins promptly and staged buffers release."""
+    def gen():
+        for i in range(10_000):
+            yield ({"x": np.zeros(1024, dtype=np.int64)}, 1024)
+
+    p = SP.ScanPipeline(gen(), depth=2)
+    next(iter(p))
+    p.close()
+    assert _no_orphan_readers()
+
+
+def test_pendbuf_linear_copies():
+    """The O(n²) drain fix, pinned by allocation accounting:
+    chunk-exact tiles hand the decoded chunk over zero-copy; every
+    other tile copies its rows EXACTLY once — never the whole pending
+    tail per tile, and never a sub-chunk view (whose base would pin
+    the whole partition in the prefetch queue)."""
+    from cloudberry_tpu.exec.tiled import _PendBuf
+
+    # chunk-exact: chunk size == tile size — all zero-copy handovers
+    st = SP.ScanStats()
+    buf = _PendBuf(st)
+    src = [np.arange(c * 250, (c + 1) * 250) for c in range(16)]
+    for c in src:
+        buf.append({"a": c})
+    outs = []
+    while buf.rows >= 250:
+        outs.append(buf.take(250)["a"])
+    assert st.copy_rows == 0 and st.view_rows == 4_000
+    for got, chunk in zip(outs, src):
+        assert got is chunk  # the chunk array itself, not a copy
+
+    # sub-chunk tiles: 64 chunks × 1000 rows, tiles of 250 — every
+    # row copied exactly once, and no emitted array aliases a chunk
+    # (no partition pinning)
+    st1 = SP.ScanStats()
+    buf1 = _PendBuf(st1)
+    for _ in range(64):
+        buf1.append({"a": np.arange(1000), "b": np.ones(1000)})
+    out_rows = 0
+    while buf1.rows >= 250:
+        t = buf1.take(250)
+        assert t["a"].base is None  # owned copy, not a view
+        out_rows += len(t["a"])
+    assert out_rows == 64_000
+    assert st1.copy_rows == 64_000 and st1.view_rows == 0
+
+    # misaligned: tiles of 300 cross chunk boundaries — copies stay
+    # LINEAR in the data (each row copied at most once), and the
+    # emitted stream is exactly the concatenated input
+    st2 = SP.ScanStats()
+    buf2 = _PendBuf(st2)
+    for c in range(16):
+        buf2.append({"a": np.arange(c * 1000, (c + 1) * 1000)})
+    got = []
+    while buf2.rows > 0:
+        take = min(300, buf2.rows)
+        got.append(buf2.take(take)["a"])
+    assert np.array_equal(np.concatenate(got), np.arange(16_000))
+    assert st2.copy_rows + st2.view_rows == 16_000
+    assert st2.copy_rows <= 16_000  # linear, never the n² tail recopy
+
+
+def test_pendbuf_skip_is_cursor_only():
+    st = SP.ScanStats()
+    from cloudberry_tpu.exec.tiled import _PendBuf
+
+    buf = _PendBuf(st)
+    for c in range(8):
+        buf.append({"a": np.arange(c * 100, (c + 1) * 100)})
+    buf.skip(350)  # crosses 3.5 chunks: no take, no copy
+    assert st.copy_rows == 0 and st.view_rows == 0
+    assert buf.rows == 450
+    assert np.array_equal(buf.take(50)["a"], np.arange(350, 400))
+
+
+# ----------------------------------------------------------- fault arms
+
+
+def test_scan_prefetch_seam_fires_and_recovers():
+    s = _mk(budget=3 << 20)
+    _load(s)
+    exp = s.sql(AGG_Q).to_pandas()
+    FI.inject_fault("scan_prefetch", "error", start_hit=2, end_hit=2)
+    with pytest.raises(FI.InjectedFault):
+        s.sql(AGG_Q).to_pandas()
+    assert FI.list_faults()["armed"]["scan_prefetch"]["fired"] == 1
+    assert _no_orphan_readers()
+    FI.reset_fault()
+    assert exp.equals(s.sql(AGG_Q).to_pandas())
+
+
+def test_scan_decode_seam_fires_and_recovers(tmp_path):
+    root = str(tmp_path / "store")
+    s0 = _mk(**{"storage.root": root,
+                "storage.rows_per_partition": 20_000})
+    _load(s0)
+    exp = s0.sql(AGG_Q).to_pandas()
+    s = _mk(budget=3 << 20, **{"storage.root": root})
+    FI.inject_fault("scan_decode", "error", start_hit=2, end_hit=2)
+    with pytest.raises(FI.InjectedFault):
+        s.sql(AGG_Q).to_pandas()
+    assert FI.list_faults()["armed"]["scan_decode"]["fired"] == 1
+    assert _no_orphan_readers()
+    FI.reset_fault()
+    assert exp.equals(s.sql(AGG_Q).to_pandas())
+
+
+# --------------------------------------------------- accounting / tools
+
+
+def test_queue_charge_rides_report_and_capacity():
+    s = _mk(budget=3 << 20)
+    _load(s)
+    s.sql(AGG_Q)
+    rep = s.last_tiled_report
+    assert rep["est_pipeline_bytes"] > 0
+    cfg = s.config.scan_pipeline
+    # the charge is the documented model: prefetch_tiles × tile bytes
+    assert rep["est_pipeline_bytes"] % cfg.prefetch_tiles == 0
+    assert rep["est_pipeline_bytes"] // cfg.prefetch_tiles \
+        >= rep["tile_rows"]  # ≥ 1 byte per row per staged tile
+    s_off = _mk(budget=3 << 20, pipeline=False)
+    _load(s_off)
+    s_off.sql(AGG_Q)
+    assert s_off.last_tiled_report["est_pipeline_bytes"] == 0
+    # capacity plane: the tiled statement's observed bytes include the
+    # staging charge (histogram count grew; exact value is the model's)
+    h = s.stmt_log.registry.hist("stmt_device_bytes")
+    assert h is not None and h["count"] >= 1
+
+
+def test_explain_analyze_tiled_trailer_shows_pipeline():
+    s = _mk(budget=3 << 20)
+    _load(s)
+    text = s.explain_analyze(AGG_Q)
+    assert "scan pipeline:" in text
+    assert "stall" in text
+
+
+def test_stream_loader_self_consistent(tmp_path):
+    """tools/tpchgen.py stream_load_tpch: key-range chunks append
+    straight into micro-partitions without a whole-table DataFrame.
+    The contract is self-consistency, not byte-equality with the
+    in-RAM generator: row counts match the SF model, lineitems join
+    their orders, and the engine's cold aggregate equals pandas over
+    the SAME loaded data."""
+    import pandas as pd
+
+    from tools.tpchgen import stream_load_tpch
+
+    s = _mk(**{"storage.root": str(tmp_path / "st")})
+    counts = stream_load_tpch(s, sf=0.01, seed=7,
+                              tables=["orders", "lineitem"],
+                              chunk_rows=5_000)
+    assert counts["orders"] == 15_000
+    assert counts["lineitem"] >= counts["orders"]  # 1..7 lines/order
+
+    li = s.sql("select l_orderkey, l_quantity, l_returnflag, "
+               "l_linestatus from lineitem").to_pandas()
+    ok = s.sql("select o_orderkey from orders").to_pandas()
+    assert len(li) == counts["lineitem"]
+    # FK closure: every lineitem belongs to a generated order
+    assert set(li["l_orderkey"]).issubset(set(ok["o_orderkey"]))
+
+    got = s.sql("select l_returnflag, l_linestatus, "
+                "sum(l_quantity) as sq, count(*) as c from lineitem "
+                "group by l_returnflag, l_linestatus "
+                "order by l_returnflag, l_linestatus").to_pandas()
+    exp = (li.groupby(["l_returnflag", "l_linestatus"], as_index=False)
+           .agg(sq=("l_quantity", "sum"), c=("l_quantity", "size"))
+           .sort_values(["l_returnflag", "l_linestatus"])
+           .reset_index(drop=True))
+    assert list(got["c"]) == list(exp["c"])
+    assert np.allclose(np.asarray(got["sq"], dtype=np.float64),
+                       np.asarray(exp["sq"], dtype=np.float64))
+
+
+def test_serve_bench_coldscan_smoke():
+    """serve_bench --mix coldscan CPU smoke: long cold tiled scans
+    (store-backed li through the scan pipeline) compete with point
+    lookups on one server; both classes complete and the CSV row is
+    well-formed — the multi-tenant starvation-case workload."""
+    import tools.serve_bench as SB
+
+    r = SB.run_mode("direct", "coldscan", clients=2, duration_s=1.5,
+                    rows=60_000, tick_s=0.002, max_batch=8)
+    assert r["requests"] > 0
+    assert r["mix"] == "coldscan"
+    row = SB.csv_row(r)
+    assert len(row.split(",")) == len(SB.CSV_HEADER.split(","))
+    assert _no_orphan_readers()
+
+
+def test_scan_bench_smoke(tmp_path):
+    """tools/scan_bench.py CPU smoke: the A/B harness runs end-to-end
+    at a toy scale and emits well-formed CSV rows + a speedup line."""
+    import tools.scan_bench as sb
+
+    rows = sb.run_ab(sf=0.01, root=str(tmp_path / "st"), reps=1,
+                     budget=1 << 20)
+    assert {"on", "off"} <= {r["mode"] for r in rows}
+    on = next(r for r in rows if r["mode"] == "on")
+    off = next(r for r in rows if r["mode"] == "off")
+    assert on["rows"] == off["rows"] > 0
+    assert on["checksum"] == off["checksum"]  # bit-identical A/B
+    assert on["n_tiles"] > 1
+    csv = sb.to_csv(rows)
+    assert csv.splitlines()[0].startswith("sf,mode,")
+    point = sb.ladder_point(0.01, root=str(tmp_path / "st2"),
+                            budget=1 << 20)
+    assert point["rows_per_s_chip"] > 0
+    assert 0.0 <= point["overlap_frac"] <= 1.0

@@ -34,6 +34,12 @@ Mixes:
 - ``q6``    — a parameterized TPC-H-Q6-shaped aggregate over a synthetic
   lineitem slice with rotating predicate literals.
 - ``mixed`` — 80% point / 20% q6.
+- ``coldscan`` — 1-in-8 requests run a long COLD tiled aggregate (the
+  catalog is store-backed and the budget shrunk, so ``li`` streams
+  micro-partition files through the scan pipeline, exec/scanpipe.py)
+  while the rest stay point lookups: the multi-tenant starvation case —
+  long out-of-core statements competing with latency-sensitive points.
+  Pair with --tenants to read the fairness columns under it.
 
 Runs on CPU (JAX_PLATFORMS=cpu) for CI smoke; on real hardware the launch
 amortization grows with dispatch overhead. Usage:
@@ -50,6 +56,7 @@ import os
 import selectors
 import socket
 import sys
+import tempfile
 import threading
 import time
 
@@ -137,6 +144,15 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         # the chaos workload streams tiles: shrink the budget so the li
         # aggregate runs through the tiled (checkpointable) path
         over["resource.query_mem_bytes"] = 1 << 20
+    if mix == "coldscan":
+        # long COLD tiled scans competing with point lookups: back the
+        # catalog with a store and shrink the budget so li streams
+        # micro-partition files through the scan pipeline; a FRESH
+        # session binds below (set_data leaves tables warm in the
+        # loading session). pts stays small enough to dispatch direct.
+        over["storage.root"] = tempfile.mkdtemp(
+            prefix="cbtpu_servebench_cold_")
+        over["resource.query_mem_bytes"] = 2 << 20
     if chaos > 0:
         # probabilistic device loss compounds per tile: give recovery
         # more re-dispatches than the default flap allowance
@@ -152,24 +168,32 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         over["obs.slow_ms"] = float(slow_ms)
     cfg = Config().with_overrides(**over)
     s = cb.Session(cfg)
+    # coldscan sizing: pts small enough to stay under the shrunken
+    # budget (point lookups must dispatch direct), li big enough that
+    # the cold aggregate streams several tiles per statement
+    n_pts = min(rows, _COLD_PTS_ROWS) if mix == "coldscan" else rows
     s.sql("create table pts (k bigint, v bigint, w double) "
           "distributed by (k)")
     t = s.catalog.table("pts")
     t.set_data({
-        "k": np.arange(rows, dtype=np.int64),
-        "v": (np.arange(rows, dtype=np.int64) * 7) % 1000,
-        "w": np.arange(rows, dtype=np.float64) * 0.5,
+        "k": np.arange(n_pts, dtype=np.int64),
+        "v": (np.arange(n_pts, dtype=np.int64) * 7) % 1000,
+        "w": np.arange(n_pts, dtype=np.float64) * 0.5,
     }, {})
     s.sql("create table li (qty decimal(2), price decimal(2), "
           "disc decimal(2), sd date)")
     rng = np.random.default_rng(11)
-    m = max(rows // 2, 1024)
+    m = max(rows * 2, 120_000) if mix == "coldscan" \
+        else max(rows // 2, 1024)
     s.catalog.table("li").set_data({
         "qty": rng.integers(1, 5000, m).astype(np.int64),
         "price": rng.integers(100, 10000, m).astype(np.int64),
         "disc": rng.integers(0, 11, m).astype(np.int64),
         "sd": rng.integers(8000, 12000, m).astype(np.int32),
     }, {})
+    if mix == "coldscan":
+        s = cb.Session(cfg)  # fresh bind: li/pts come up cold
+        s._servebench_root = cfg.storage.root
     return s
 
 
@@ -192,6 +216,11 @@ def _spill_sql(i: int) -> str:
             f"where qty < {4000 + (i % 50)}.0")
 
 
+# coldscan keeps pts small so point lookups dispatch direct under the
+# shrunken tiled budget; _mix_sql caps the key range to match
+_COLD_PTS_ROWS = 10_000
+
+
 def _mix_sql(mix: str, i: int, rows: int) -> str:
     if mix == "point":
         return _point_sql(i, rows)
@@ -199,6 +228,13 @@ def _mix_sql(mix: str, i: int, rows: int) -> str:
         return _q6_sql(i)
     if mix == "spill":
         return _spill_sql(i)
+    if mix == "coldscan":
+        # 1-in-8 long cold tiled scans (same statement shape as spill,
+        # but li is store-backed: every run re-streams and re-decodes
+        # its micro-partitions through the scan pipeline) against a
+        # majority of latency-sensitive point lookups
+        return (_spill_sql(i) if i % 8 == 7
+                else _point_sql(i, min(rows, _COLD_PTS_ROWS)))
     return _q6_sql(i) if i % 5 == 4 else _point_sql(i, rows)
 
 
@@ -334,7 +370,7 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     # compares steady-state dispatch, not first-compile latency
     session.sql(_point_sql(0, rows))
     session.sql(_q6_sql(0))
-    if mix == "spill":
+    if mix in ("spill", "coldscan"):
         session.sql(_spill_sql(0))
     c_before = session.stmt_log.counter("compiles")
     d_before = session.stmt_log.counter("dispatches")
@@ -469,6 +505,11 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     if chaos > 0:
         FI.reset_fault("tile_device_lost")
         FI.reset_fault("exec_device_lost")
+    root = getattr(session, "_servebench_root", None)
+    if root:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
     if errors:
         raise RuntimeError(f"bench clients failed: {errors[:3]}")
     if topo_errors:
@@ -570,7 +611,8 @@ def main(argv=None) -> list[dict]:
     ap.add_argument("--mode", default="both",
                     choices=["both", "direct", "batched"])
     ap.add_argument("--mix", default="point",
-                    choices=["point", "q6", "mixed", "spill"])
+                    choices=["point", "q6", "mixed", "spill",
+                             "coldscan"])
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--duration", type=float, default=5.0)
     ap.add_argument("--rows", type=int, default=200_000)
