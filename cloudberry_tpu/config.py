@@ -172,6 +172,29 @@ class ScanPipelineConfig:
 
 
 @dataclass(frozen=True)
+class BufferPoolConfig:
+    """HBM-resident micro-partition buffer pool (exec/bufferpool.py) —
+    the shared-buffer-pool analog with device residency: decoded, packed
+    columnar partition chunks stay on-chip across statements, so a
+    repeat scan of a hot table starts from HBM instead of paying
+    read + decode + transfer again. Keys carry the store version, the
+    topology epoch, and the config epoch (the shared-cache-tier token
+    discipline, sched/sharedcache.py), so results are bit-identical
+    pool on/off by construction and stale entries can never serve."""
+
+    enabled: bool = True
+    # Engine-wide resident budget in bytes (per cache scope — sessions
+    # over the same store root share one pool). Admission refuses
+    # oversize chunks and never evicts a hotter entry for a colder one
+    # (the RecoveryStore byte-budget discipline). 0 disables.
+    max_bytes: int = 256 << 20
+    # Admission threshold: a partition is admitted once it has been
+    # scanned this many times (observed per-partition frequency — the
+    # obs-plane signal); 1 admits on first touch.
+    admit_min_scans: int = 2
+
+
+@dataclass(frozen=True)
 class ResourceConfig:
     """Memory governance analog (vmem_tracker.c:94, workfile_mgr.c)."""
 
@@ -521,6 +544,7 @@ class Config:
     resource: ResourceConfig = field(default_factory=ResourceConfig)
     scan_pipeline: ScanPipelineConfig = field(
         default_factory=ScanPipelineConfig)
+    bufferpool: BufferPoolConfig = field(default_factory=BufferPoolConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     health: HealthConfig = field(default_factory=HealthConfig)

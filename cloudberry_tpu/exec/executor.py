@@ -216,12 +216,16 @@ _STORE_SCAN_CACHE_MAX = 16
 def _load_store_scan(scan: N.PScan, session) -> dict:
     """Read a pruned scan's columns from micro-partitions (column
     projection: ONLY column_map + mask_map physical columns are read),
-    cached per (table, version, partitions, columns)."""
+    cached per (table, version, partitions, columns). Cache traffic is
+    visible on the metrics plane (``store_scan_cache_*`` counters —
+    meta "metrics"), and a cache miss consults the HBM buffer pool
+    per partition before touching the store (exec/bufferpool.py)."""
     store = session.catalog.store
     key = (scan.table_name, store.effective_version(scan.table_name),
            tuple(p["file"] for p in scan._store_parts),
            tuple(sorted(scan.column_map)), tuple(sorted(scan.mask_map)))
     cache = session._store_scan_cache
+    log = getattr(session, "stmt_log", None)
     # LRU, not FIFO: pop-and-reinsert moves a hit to the dict's end so a
     # hot table's scan survives a burst of one-off queries; eviction
     # takes the true least-recently-used head. Hits now MUTATE the dict,
@@ -233,17 +237,73 @@ def _load_store_scan(scan: N.PScan, session) -> dict:
         hit = cache.pop(key, None)
         if hit is not None:
             cache[key] = hit
-            return hit
-    cols, validity = store.read_partitions(
-        scan.table_name, scan._store_parts,
-        sorted(set(scan.column_map) | set(scan.mask_map)))
-    hit = {c: jnp.asarray(v) for c, v in cols.items()}
-    for c, v in validity.items():
-        hit[f"$nn:{c}"] = jnp.asarray(np.asarray(v, dtype=np.bool_))
+    if hit is not None:
+        if log is not None:
+            log.bump("store_scan_cache_hits")
+        return hit
+    if log is not None:
+        log.bump("store_scan_cache_misses")
+    hit = _read_scan_columns(scan, session, log)
+    evicted = 0
     with lock:
         while len(cache) >= _STORE_SCAN_CACHE_MAX:
             cache.pop(next(iter(cache)))
+            evicted += 1
         cache[key] = hit
+    if evicted and log is not None:
+        log.bump("store_scan_cache_evictions", evicted)
+    return hit
+
+
+def _read_scan_columns(scan: N.PScan, session, log) -> dict:
+    """Assemble one pruned scan's input dict. With the buffer pool on,
+    partitions are looked up (and admitted) individually and the chunks
+    concatenated in part order — read_partitions does exactly that
+    internally, so the assembly is bit-identical to one batched read;
+    resident partitions skip the host read/decode entirely."""
+    from cloudberry_tpu.exec import bufferpool as BUF
+
+    store = session.catalog.store
+    needed = sorted(set(scan.column_map) | set(scan.mask_map))
+    parts = list(scan._store_parts)
+    bpool = BUF.pool_for(session)
+    if bpool is None or not parts:
+        cols, validity = store.read_partitions(scan.table_name, parts,
+                                               needed)
+        if log is not None and parts:
+            log.bump("host_decodes", len(parts))
+        hit = {c: jnp.asarray(v) for c, v in cols.items()}
+        for c, v in validity.items():
+            hit[f"$nn:{c}"] = jnp.asarray(np.asarray(v, dtype=np.bool_))
+        return hit
+    cols_key = tuple(needed)
+    col_chunks: dict[str, list] = {}
+    val_chunks: dict[str, list] = {}
+    for part in parts:
+        pk = BUF.partition_key(session, scan.table_name, part, cols_key)
+        ent = bpool.lookup(pk, log)
+        if ent is None:
+            cols, validity = store.read_partitions(
+                scan.table_name, [part], needed)
+            if log is not None:
+                log.bump("host_decodes")
+            ent = {"cols": {c: np.asarray(v) for c, v in cols.items()},
+                   "validity": {c: np.asarray(v, dtype=np.bool_)
+                                for c, v in validity.items()}}
+            bpool.offer(pk, ent, table=scan.table_name, log=log)
+        for c, v in ent["cols"].items():
+            col_chunks.setdefault(c, []).append(v)
+        for c, v in ent["validity"].items():
+            val_chunks.setdefault(c, []).append(v)
+    hit = {c: (jnp.asarray(vs[0]) if len(vs) == 1
+               else jnp.concatenate([jnp.asarray(v) for v in vs]))
+           for c, vs in col_chunks.items()}
+    for c, vs in val_chunks.items():
+        # chunks are bool by construction (pool entries and fresh
+        # decodes both store np.bool_), so no re-cast is needed
+        hit[f"$nn:{c}"] = (jnp.asarray(vs[0]) if len(vs) == 1
+                           else jnp.concatenate(
+                               [jnp.asarray(v) for v in vs]))
     return hit
 
 

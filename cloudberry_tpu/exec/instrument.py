@@ -721,6 +721,7 @@ def _pipeline_once(plan, session, query):
             raise
         from cloudberry_tpu.obs import capacity as OC
 
+        texe.refresh_bufpool_charge()
         OC.record_tiled(session.stmt_log, texe.report)
         t0 = time.monotonic()
         with session._gate, session._admitted(

@@ -66,13 +66,15 @@ class ScanStats:
     would race the still-running writer)."""
 
     __slots__ = ("decode_s", "read_s", "parts_read", "parts_skipped",
-                 "bytes_decoded", "copy_rows", "view_rows")
+                 "parts_resident", "bytes_decoded", "copy_rows",
+                 "view_rows")
 
     def __init__(self):
         self.decode_s = 0.0      # pure column-decode seconds
         self.read_s = 0.0        # partition read wall (IO + decode)
         self.parts_read = 0
         self.parts_skipped = 0   # resume fast-path: skipped whole files
+        self.parts_resident = 0  # served from the HBM buffer pool
         self.bytes_decoded = 0
         self.copy_rows = 0       # rows copied on emit (each at most once)
         self.view_rows = 0       # chunk-exact zero-copy emits
@@ -83,6 +85,7 @@ class ScanStats:
             "read_s": round(self.read_s, 6),
             "parts_read": self.parts_read,
             "parts_skipped": self.parts_skipped,
+            "parts_resident": self.parts_resident,
             "bytes_decoded": self.bytes_decoded,
         }
 

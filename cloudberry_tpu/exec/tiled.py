@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cloudberry_tpu.columnar.batch import ColumnBatch
+from cloudberry_tpu.exec import bufferpool as BUF
 from cloudberry_tpu.exec import executor as X
 from cloudberry_tpu.exec import kernels as K
 from cloudberry_tpu.exec import scanpipe as SP
@@ -656,6 +657,21 @@ class AdaptiveTiledMixin:
 
     _what = "tiled execution"
 
+    def refresh_bufpool_charge(self) -> None:
+        """Re-stamp the report's ``est_bufpool_bytes``. The report is
+        built once per compile but the pool's residency for the
+        streamed table moves between statements (admits during a prior
+        run, evictions, topology sweeps) — dispatch-time capacity
+        recording and report publication both re-read it."""
+        bpool = BUF.pool_for(self.session)
+        self.report["est_bufpool_bytes"] = (
+            bpool.table_bytes(self.shape.stream.table_name)
+            if bpool is not None else 0)
+
+    def _publish_report(self) -> None:
+        self.refresh_bufpool_charge()
+        self.session.last_tiled_report = dict(self.report)
+
     def _run_adaptive(self) -> ColumnBatch:
         from cloudberry_tpu.lifecycle import check_cancel
 
@@ -776,6 +792,11 @@ class TiledExecutable(AdaptiveTiledMixin):
             # the statement's observed peak
             "est_pipeline_bytes": SP.queue_charge_bytes(
                 shape.stream, self.tile_rows, self.session.config),
+            # HBM buffer-pool residency attributable to the streamed
+            # table (exec/bufferpool.py) — charged into the capacity
+            # plane next to the pipeline's staging bytes
+            "est_bufpool_bytes": _bufpool_charge(
+                self.session, shape.stream.table_name),
             "budget_bytes": self.budget,
         }
 
@@ -957,7 +978,7 @@ class TiledExecutable(AdaptiveTiledMixin):
         self.report["n_tiles"] = n_tiles
         if ctx is not None:
             ctx.stamp_report(self.report)
-        self.session.last_tiled_report = dict(self.report)
+        self._publish_report()
         return X.make_batch(self.shape.root, cols, sel)
 
 
@@ -1064,6 +1085,8 @@ class SortTiledExecutable(TiledExecutable):
             "est_step_bytes": est + _merge_bytes(shape),
             "est_pipeline_bytes": SP.queue_charge_bytes(
                 shape.stream, self.tile_rows, self.session.config),
+            "est_bufpool_bytes": _bufpool_charge(
+                self.session, shape.stream.table_name),
             "budget_bytes": self.budget,
         }
 
@@ -1166,7 +1189,7 @@ class SortTiledExecutable(TiledExecutable):
         self.report["n_tiles"] = n_tiles
         if ctx is not None:
             ctx.stamp_report(self.report)
-        self.session.last_tiled_report = dict(self.report)
+        self._publish_report()
         out_node = shape.post[0] if shape.post else shape.sortnode
         return X.make_batch(out_node, cols,
                             np.ones((n_out,), dtype=bool))
@@ -1223,7 +1246,7 @@ class WindowTiledExecutable(SortTiledExecutable):
         self.report["n_chunks"] = n_chunks
         if ctx is not None:
             ctx.stamp_report(self.report)
-        self.session.last_tiled_report = dict(self.report)
+        self._publish_report()
         return X.make_batch(shape.root, final,
                             np.ones((n_out,), dtype=bool))
 
@@ -1454,6 +1477,30 @@ class _PendBuf:
         return out
 
 
+def _bufpool_charge(session, table: str) -> int:
+    """The buffer pool's resident bytes for one table — the tiled
+    report's ``est_bufpool_bytes`` capacity-plane charge."""
+    bpool = BUF.pool_for(session)
+    return bpool.table_bytes(table) if bpool is not None else 0
+
+
+def _pool_chunk(scan: N.PScan, ent: dict) -> dict:
+    """Assemble one feed chunk from a buffer-pool entry (the canonical
+    ``{"cols", "validity"}`` read_partitions split) — the exact dict
+    the cold path builds, so pooled and decoded chunks are
+    interchangeable bit-for-bit."""
+    cols, validity = ent["cols"], ent["validity"]
+    n = len(next(iter(cols.values()))) if cols else 0
+    chunk = {}
+    for phys in scan.column_map:
+        chunk[phys] = cols[phys]
+    for phys in scan.mask_map:
+        vm = validity.get(phys)
+        chunk[f"$nn:{phys}"] = (vm if vm is not None
+                                else np.ones(n, dtype=np.bool_))
+    return chunk
+
+
 def _store_tiles(scan: N.PScan, session, tile_rows: int,
                  skip_rows: int = 0, stats=None):
     """Stream a pruned cold scan part-by-part, re-chunked to tile_rows:
@@ -1461,13 +1508,18 @@ def _store_tiles(scan: N.PScan, session, tile_rows: int,
     pipeline's bounded staging. A resume's ``skip_rows`` drops whole
     already-consumed partitions WITHOUT reading or decoding them (the
     replay cost of a checkpointed restart is bounded by one partition
-    plus ≤ K tiles, never the consumed prefix)."""
+    plus ≤ K tiles, never the consumed prefix). Partitions resident in
+    the HBM buffer pool (exec/bufferpool.py) are served from the device
+    copy — no read, no decode, no host→device transfer; only misses go
+    to the store (and hot misses are admitted for next time)."""
     import time as _t
 
     store = session.catalog.store
     needed = _phys_cols(scan)
     stats = stats if stats is not None else SP.ScanStats()
     pool = SP.decode_pool(session.config)
+    bpool = BUF.pool_for(session)
+    cols_key = tuple(needed)
     log = getattr(session, "stmt_log", None)
     obs = log is not None and getattr(log, "obs_enabled", False)
     buf = _PendBuf(stats)
@@ -1494,6 +1546,19 @@ def _store_tiles(scan: N.PScan, session, tile_rows: int,
             yield _pad_tile(buf.take(take), 0, take, tile_rows), take
 
     for part in parts[start:]:
+        key = None
+        if bpool is not None:
+            key = BUF.partition_key(session, scan.table_name, part,
+                                    cols_key)
+            ent = bpool.lookup(key, log)
+            if ent is not None:
+                # HBM hit: the decoded chunk is already on-device —
+                # the host path (read/decode/transfer) is skipped
+                # entirely, like the resume parts_skipped fast path
+                stats.parts_resident += 1
+                buf.append(_pool_chunk(scan, ent))
+                yield from drain(final=False)
+                continue
         fault_point("scan_decode")
         dts: list = []  # per-column decode seconds (list.append: atomic)
         t0 = _t.perf_counter()
@@ -1503,20 +1568,19 @@ def _store_tiles(scan: N.PScan, session, tile_rows: int,
         stats.read_s += _t.perf_counter() - t0
         stats.parts_read += 1
         stats.decode_s += sum(dts)
+        if log is not None:
+            log.bump("host_decodes")
         if obs:
             for dt in dts:
                 log.registry.observe("decode_seconds", dt)
-        n = len(next(iter(cols.values()))) if cols else 0
-        chunk = {}
-        for phys in scan.column_map:
-            chunk[phys] = np.asarray(cols[phys])
-        for phys in scan.mask_map:
-            vm = validity.get(phys)
-            chunk[f"$nn:{phys}"] = (
-                np.asarray(vm, dtype=np.bool_) if vm is not None
-                else np.ones(n, dtype=np.bool_))
+        ent = {"cols": {c: np.asarray(v) for c, v in cols.items()},
+               "validity": {c: np.asarray(v, dtype=np.bool_)
+                            for c, v in validity.items()}}
+        chunk = _pool_chunk(scan, ent)
         stats.bytes_decoded += sum(int(a.nbytes)
                                    for a in chunk.values())
+        if bpool is not None:
+            bpool.offer(key, ent, table=scan.table_name, log=log)
         buf.append(chunk)
         yield from drain(final=False)
     yield from drain(final=True)
@@ -1525,6 +1589,13 @@ def _store_tiles(scan: N.PScan, session, tile_rows: int,
 def _pad_tile(cols: dict, off: int, n: int, tile_rows: int) -> dict:
     out = {}
     for name, arr in cols.items():
+        if not isinstance(arr, np.ndarray) and off == 0 \
+                and n == tile_rows and len(arr) == tile_rows:
+            # device-resident (buffer-pool) column covering the tile
+            # exactly: hand it through — routing it via numpy would
+            # round-trip HBM→host→HBM
+            out[name] = arr
+            continue
         sl = arr[off:off + n]
         if n < tile_rows:
             sl = np.concatenate(

@@ -42,6 +42,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from cloudberry_tpu.columnar.batch import ColumnBatch
+from cloudberry_tpu.exec import bufferpool as BUF
 from cloudberry_tpu.exec import executor as X
 from cloudberry_tpu.exec import kernels as K
 from cloudberry_tpu.exec import scanpipe as SP
@@ -574,6 +575,11 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             "est_pipeline_bytes": SP.queue_charge_bytes(
                 shape.stream, self.tile_rows, self.session.config,
                 nseg=self.nseg),
+            # buffer-pool residency for the streamed table's packed
+            # feed tiles (exec/bufferpool.py; host-side here —
+            # shard_map owns device placement on the distributed path)
+            "est_bufpool_bytes": _bufpool_charge_dist(
+                self.session, shape.stream.table_name),
             "budget_bytes": self.budget,
         }
 
@@ -815,7 +821,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         self.report["n_tiles"] = n_tiles
         if ctx is not None:
             ctx.stamp_report(self.report)
-        self.session.last_tiled_report = dict(self.report)
+        self._publish_report()
         host_cols = {k: _local_row(v) for k, v in cols.items()}
         return X.make_batch(self.shape.root, host_cols, _local_row(sel))
 
@@ -1046,7 +1052,7 @@ class DistSortTiledExecutable(DistTiledExecutable):
         self.report["n_tiles"] = n_tiles
         if ctx is not None:
             ctx.stamp_report(self.report)
-        self.session.last_tiled_report = dict(self.report)
+        self._publish_report()
         out_node = shape.post_above[0] if shape.post_above \
             else shape.sortnode
         return X.make_batch(out_node, cols,
@@ -1105,7 +1111,7 @@ class DistWindowTiledExecutable(DistSortTiledExecutable):
         self.report["n_chunks"] = n_chunks
         if ctx is not None:
             ctx.stamp_report(self.report)
-        self.session.last_tiled_report = dict(self.report)
+        self._publish_report()
         return X.make_batch(shape.root, final,
                             np.ones((n_out,), dtype=bool))
 
@@ -1147,24 +1153,53 @@ def _dist_progress_tracker(exe, feed, n_base: int):
                        base_rows=base_rows, rows_total=total)
 
 
+def _bufpool_charge_dist(session, table: str) -> int:
+    bpool = BUF.pool_for(session)
+    return bpool.table_bytes(table) if bpool is not None else 0
+
+
 def _dist_tile_feed(scan: N.PScan, session, tile_rows: int):
     """Yield (tile dict of (nseg, tile_rows) arrays, per-segment valid
     counts). All segments step in lock-step; a segment whose shard ran dry
     contributes masked rows — the SPMD analog of a QE sending EOS while
-    its peers still stream."""
+    its peers still stream. Packed feed tiles resident in the buffer
+    pool (exec/bufferpool.py, keyed by tile offset + the shared-tier
+    content/epoch tokens) skip the slice-pad-copy work; the pool holds
+    HOST arrays on this path — shard_map owns device placement, exactly
+    like the pipeline's host-only staging."""
     st = session.sharded_table(scan.table_name)
     nseg, shard_cap = len(st.counts), st.capacity
-    cols: dict[str, np.ndarray] = {}
-    for phys in scan.column_map:
-        cols[phys] = np.asarray(st.columns[phys])
-    for phys in scan.mask_map:
-        vm = st.columns.get(f"$nn:{phys}")
-        cols[f"$nn:{phys}"] = (np.asarray(vm) if vm is not None
-                               else np.ones((nseg, shard_cap),
-                                            dtype=np.bool_))
+    bpool = BUF.pool_for(session)
+    cols_key = (tuple(sorted(scan.column_map)),
+                tuple(sorted(scan.mask_map)))
+    log = getattr(session, "stmt_log", None)
+    counts = np.asarray(st.counts)
+    cols: Optional[dict] = None  # built lazily: an all-hit feed never
     max_rows = int(st.counts.max()) if len(st.counts) else 0
     for off in range(0, max(max_rows, 0), tile_rows):
         n = min(tile_rows, max_rows - off)
+        tile_ns = np.clip(counts - off, 0, tile_rows)
+        key = None
+        if bpool is not None:
+            try:
+                key = BUF.dist_tile_key(session, scan.table_name,
+                                        cols_key, nseg, tile_rows, off)
+            except KeyError:  # table dropped mid-plan: fall through
+                key = None
+        if key is not None:
+            ent = bpool.lookup(key, log)
+            if ent is not None:
+                yield ent["tile"], tile_ns
+                continue
+        if cols is None:
+            cols = {}
+            for phys in scan.column_map:
+                cols[phys] = np.asarray(st.columns[phys])
+            for phys in scan.mask_map:
+                vm = st.columns.get(f"$nn:{phys}")
+                cols[f"$nn:{phys}"] = (
+                    np.asarray(vm) if vm is not None
+                    else np.ones((nseg, shard_cap), dtype=np.bool_))
         tile = {}
         for name, arr in cols.items():
             sl = arr[:, off:off + n]
@@ -1173,5 +1208,7 @@ def _dist_tile_feed(scan: N.PScan, session, tile_rows: int):
                     [sl, np.zeros((nseg, tile_rows - n), dtype=arr.dtype)],
                     axis=1)
             tile[name] = np.ascontiguousarray(sl)
-        tile_ns = np.clip(np.asarray(st.counts) - off, 0, tile_rows)
+        if key is not None:
+            bpool.offer(key, {"tile": tile}, table=scan.table_name,
+                        log=log, device=False)
         yield tile, tile_ns

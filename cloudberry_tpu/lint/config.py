@@ -31,7 +31,7 @@ CONCURRENT_CLASSES = frozenset({
     "RecoveryStore", "CircuitBreaker", "CancelToken", "Watchdog",
     "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
     "MetricsRegistry", "StatementStats", "Trace", "Progress",
-    "TopologyManager", "ScanPipeline",
+    "TopologyManager", "ScanPipeline", "BufferPool",
 })
 
 # attribute-name → class-name hints for cross-class lock edges: when a
@@ -69,6 +69,11 @@ ATTR_CLASS_HINTS = {
     # them (and so a future lock added there is discovered, not missed)
     "tx": "HierarchicalCollectives",
     "hier_topo": "HostTopology",
+    # HBM buffer pool (exec/bufferpool.py) — the scan-path consumers
+    # and the topology-cutover sweep reach it through these names
+    "bpool": "BufferPool",
+    "bufpool": "BufferPool",
+    "_bufpool": "BufferPool",
 }
 
 # modules (repo-relative path suffixes) whose jitted / kernel functions
@@ -159,7 +164,8 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock",
      "MetricsRegistry._lock", "StatementStats._lock", "Trace._lock",
      "Progress._lock", "mesh._topo_lock", "ScanPipeline._cond",
-     "scanpipe._pool_lock"),
+     "scanpipe._pool_lock", "BufferPool._lock",
+     "bufferpool._create_lock"),
 )
 
 

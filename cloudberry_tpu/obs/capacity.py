@@ -163,7 +163,12 @@ def record_tiled(log, report: dict) -> None:
     peak = int(report.get("est_step_bytes", 0))
     fin = int(report.get("est_finalize_bytes", 0))
     pipe = int(report.get("est_pipeline_bytes", 0))
-    observe_stmt_bytes(log, max(peak, fin) + pipe)
+    # HBM buffer-pool residency for the streamed table
+    # (exec/bufferpool.py, report stamp est_bufpool_bytes): charged
+    # next to the pipeline's staging bytes — resident chunks occupy
+    # device memory alongside the statement's working set
+    bufp = int(report.get("est_bufpool_bytes", 0))
+    observe_stmt_bytes(log, max(peak, fin) + pipe + bufp)
 
 
 # --------------------------------------------------------- memory gauges
@@ -231,6 +236,17 @@ def refresh_gauges(session) -> dict:
     if scan_cache is not None:
         vals["mem_store_scan_bytes"] = nbytes_of(
             list(scan_cache.values()))
+        vals["mem_store_scan_entries"] = len(scan_cache)
+    # HBM buffer pool (exec/bufferpool.py): resident device bytes and
+    # entry count for this session's cache scope — the residency side
+    # of the bufpool_* counters
+    if scope is not None:
+        pool = getattr(scope, "bufferpool", None)
+        if pool is not None:
+            psnap = pool.snapshot()
+            vals["mem_bufpool_bytes"] = psnap["bytes"]
+            vals["mem_bufpool_entries"] = psnap["entries"]
+            vals["mem_bufpool_max_bytes"] = psnap["max_bytes"]
     # versioned topology (parallel/topology.py): the serving epoch id,
     # the in-flight rebalance fraction (1.0 when no change is pending),
     # and bytes moved by the current/most-recent rebalance — the

@@ -311,6 +311,16 @@ class TopologyManager:
             session._shard_cache.clear()
             session._shard_count_cache.clear()
             session._store_scan_cache.clear()
+            # HBM buffer pool: stale-epoch keys could never serve (the
+            # epoch token is in every key), but the resident bytes are
+            # placement-era garbage — free them with the rest of the
+            # placement-derived caches (legal order: rank-1 sync lock
+            # held, the pool lock is a rank-4 leaf)
+            bufpool = getattr(
+                getattr(session, "_cache_scope", None),
+                "bufferpool", None)
+            if bufpool is not None:
+                bufpool.clear()
             with session._stmt_lock:
                 session._stmt_cache.clear()
             with session._rung_lock:

@@ -65,6 +65,11 @@ class CacheScope:
         # join indexes (exec/joinindex.py)
         self.joinindex: dict = {}
         self.joinindex_lock = threading.Lock()
+        # HBM-resident scan buffer pool (exec/bufferpool.py), created
+        # lazily by bufferpool.pool_for — it owns its own leaf lock and
+        # byte budget; anchored here so sessions over one store root
+        # share residency the way they share compiled programs
+        self.bufferpool = None
 
     def clear(self) -> None:
         with self.generic_lock:
@@ -73,14 +78,21 @@ class CacheScope:
             self.rung.clear()
         with self.joinindex_lock:
             self.joinindex.clear()
+        pool = self.bufferpool
+        if pool is not None:
+            pool.clear()
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "kind": self.kind,
             "generic_skeletons": len(self.generic),
             "rung_entries": len(self.rung),
             "join_index_entries": len(self.joinindex),
         }
+        pool = self.bufferpool
+        if pool is not None:
+            out["bufferpool"] = pool.snapshot()
+        return out
 
 
 _tier_lock = threading.Lock()

@@ -630,6 +630,9 @@ class Session:
                 raise
             from cloudberry_tpu.obs import capacity as OC
 
+            # a cached executable's report predates the pool's current
+            # residency — re-stamp before charging the capacity plane
+            texe.refresh_bufpool_charge()
             OC.record_tiled(self.stmt_log, texe.report)
             self.stmt_log.bump("dispatches")
             self._dispatch_seams(fault_point)

@@ -72,6 +72,10 @@ INVENTORY = frozenset({
     # asynchronous scan pipeline (exec/scanpipe.py): the prefetch
     # reader's per-tile seam and the per-partition decode seam
     "scan_prefetch", "scan_decode",
+    # HBM buffer pool (exec/bufferpool.py): admission and eviction
+    # seams — 'error' provokes mid-offer failures, 'skip' suppresses
+    # admission / forces refusal-over-eviction
+    "bufpool_admit", "bufpool_evict",
     # mesh health
     "exec_device_lost", "probe_degraded",
     # online topology changes (parallel/topology.py)

@@ -40,6 +40,17 @@ Mixes:
   while the rest stay point lookups: the multi-tenant starvation case —
   long out-of-core statements competing with latency-sensitive points.
   Pair with --tenants to read the fairness columns under it.
+- ``hotcold`` — the HBM buffer-pool serving workload (ISSUE 16): a
+  store-backed HOT table scanned by the SAME tiled aggregate on most
+  requests (from the third scan the pool serves its tiles from device
+  memory at zero host reads/decodes) against a same-shape COLD table
+  scanned with rotating literals under a pool budget sized to hold only
+  the hot set (the cold set is refused over evicting hotter, then
+  churns). The bufpool_hit_rate / host_decodes CSV columns report the
+  run's counter deltas, and an after-window probe times one pool-warm
+  hot scan vs one cold scan on the same container size — printed as a
+  rows/s comparison with the hot probe's host-decode count (zero when
+  the claim holds).
 
 Runs on CPU (JAX_PLATFORMS=cpu) for CI smoke; on real hardware the launch
 amortization grows with dispatch overhead. Usage:
@@ -84,7 +95,13 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               # cutover wall clock, rows the background rebalancer
               # moved (jump-hash minimal delta), and epoch flips over
               # the run (failover promotions included)
-              "cutover_ms,moved_rows,epoch_flips")
+              "cutover_ms,moved_rows,epoch_flips,"
+              # ISSUE 16 (HBM buffer pool): pool hit rate over the
+              # run's store scans (bufpool_hits / lookups) and host
+              # decode count — under --mix hotcold the hot set's
+              # repeats are served from device memory, so decodes
+              # track the COLD set only
+              "bufpool_hit_rate,host_decodes")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -153,6 +170,17 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         over["storage.root"] = tempfile.mkdtemp(
             prefix="cbtpu_servebench_cold_")
         over["resource.query_mem_bytes"] = 2 << 20
+    if mix == "hotcold":
+        # the buffer-pool serving workload: li (hot) and lc (cold) are
+        # the same store-backed shape; the pool budget holds the hot
+        # statement's two scanned columns (~2MB at 120k rows) with a
+        # little slack but NOT both tables, so the hot set goes
+        # device-resident while the cold set is refused over evicting
+        # hotter entries and churns in the remainder
+        over["storage.root"] = tempfile.mkdtemp(
+            prefix="cbtpu_servebench_hot_")
+        over["resource.query_mem_bytes"] = 2 << 20
+        over["bufferpool.max_bytes"] = 3 << 20
     if chaos > 0:
         # probabilistic device loss compounds per tile: give recovery
         # more re-dispatches than the default flap allowance
@@ -171,7 +199,8 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
     # coldscan sizing: pts small enough to stay under the shrunken
     # budget (point lookups must dispatch direct), li big enough that
     # the cold aggregate streams several tiles per statement
-    n_pts = min(rows, _COLD_PTS_ROWS) if mix == "coldscan" else rows
+    n_pts = min(rows, _COLD_PTS_ROWS) \
+        if mix in ("coldscan", "hotcold") else rows
     s.sql("create table pts (k bigint, v bigint, w double) "
           "distributed by (k)")
     t = s.catalog.table("pts")
@@ -183,7 +212,7 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
     s.sql("create table li (qty decimal(2), price decimal(2), "
           "disc decimal(2), sd date)")
     rng = np.random.default_rng(11)
-    m = max(rows * 2, 120_000) if mix == "coldscan" \
+    m = max(rows * 2, 120_000) if mix in ("coldscan", "hotcold") \
         else max(rows // 2, 1024)
     s.catalog.table("li").set_data({
         "qty": rng.integers(1, 5000, m).astype(np.int64),
@@ -191,9 +220,22 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
         "disc": rng.integers(0, 11, m).astype(np.int64),
         "sd": rng.integers(8000, 12000, m).astype(np.int32),
     }, {})
-    if mix == "coldscan":
-        s = cb.Session(cfg)  # fresh bind: li/pts come up cold
+    if mix == "hotcold":
+        # the COLD container: identical schema and row count as li so
+        # the after-window rows/s probe compares pool-served vs
+        # host-decoded scans of the SAME shape
+        s.sql("create table lc (qty decimal(2), price decimal(2), "
+              "disc decimal(2), sd date)")
+        s.catalog.table("lc").set_data({
+            "qty": rng.integers(1, 5000, m).astype(np.int64),
+            "price": rng.integers(100, 10000, m).astype(np.int64),
+            "disc": rng.integers(0, 11, m).astype(np.int64),
+            "sd": rng.integers(8000, 12000, m).astype(np.int32),
+        }, {})
+    if mix in ("coldscan", "hotcold"):
+        s = cb.Session(cfg)  # fresh bind: tables come up cold
         s._servebench_root = cfg.storage.root
+        s._servebench_rows = m
     return s
 
 
@@ -216,6 +258,22 @@ def _spill_sql(i: int) -> str:
             f"where qty < {4000 + (i % 50)}.0")
 
 
+def _hot_sql() -> str:
+    # IDENTICAL every time: the same statement re-scans the same tiles,
+    # so from the third scan the buffer pool serves it from device
+    # memory (admit_min_scans=2; the warmup scan counts as the first)
+    return "select sum(price) as sp, count(*) as c from li " \
+           "where qty < 4000.0"
+
+
+def _cold_sql(i: int) -> str:
+    # same shape/size container as the hot statement but a rotating
+    # literal over lc — whose tiles never fit the hotcold pool budget
+    # next to li's, so every scan pays host read+decode
+    return ("select sum(price) as sp, count(*) as c from lc "
+            f"where qty < {4000 + (i % 50)}.0")
+
+
 # coldscan keeps pts small so point lookups dispatch direct under the
 # shrunken tiled budget; _mix_sql caps the key range to match
 _COLD_PTS_ROWS = 10_000
@@ -235,6 +293,12 @@ def _mix_sql(mix: str, i: int, rows: int) -> str:
         # majority of latency-sensitive point lookups
         return (_spill_sql(i) if i % 8 == 7
                 else _point_sql(i, min(rows, _COLD_PTS_ROWS)))
+    if mix == "hotcold":
+        # 6-in-8 hot (identical, pool-served once admitted) against
+        # 2-in-8 cold rotating scans: the 3:1 scan-frequency gap is
+        # what keeps the hot set winning the refusal-over-evicting-
+        # hotter comparison
+        return _cold_sql(i) if i % 8 in (3, 7) else _hot_sql()
     return _q6_sql(i) if i % 5 == 4 else _point_sql(i, rows)
 
 
@@ -334,6 +398,29 @@ def _stage_shares(registry) -> tuple[dict, int]:
     return shares, spans
 
 
+def _hotcold_probe(session) -> dict:
+    """After the measured window closes: time ONE pool-warm hot scan
+    against ONE cold scan of the same-size container, each with its
+    host_decodes counter delta — the bench's direct pin that the hot
+    set is served with ZERO host reads/decodes (a counter fact, not a
+    clock fact) and at measurably higher rows/s than the cold path.
+    Rides as non-CSV extras (underscore keys) + a stderr summary."""
+    log = session.stmt_log
+    m = getattr(session, "_servebench_rows", 0)
+    # settle scan: guarantees the hot set is past admission (scan 3+)
+    # even if a very short window only reached it once
+    session.sql(_hot_sql())
+    out = {}
+    for name, sql in (("hot", _hot_sql()), ("cold", _cold_sql(2))):
+        d0 = log.counter("host_decodes")
+        t0 = time.monotonic()
+        session.sql(sql)
+        wall = time.monotonic() - t0
+        out[f"_{name}_rows_per_s"] = int(m / wall) if wall > 0 else 0
+        out[f"_{name}_host_decodes"] = log.counter("host_decodes") - d0
+    return out
+
+
 def run_mode(mode: str, mix: str, clients: int, duration_s: float,
              rows: int, tick_s: float, max_batch: int,
              cancel_mix: float = 0.0, deadline_s: float = 0.005,
@@ -372,6 +459,12 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     session.sql(_q6_sql(0))
     if mix in ("spill", "coldscan"):
         session.sql(_spill_sql(0))
+    if mix == "hotcold":
+        # compiles both scan shapes outside the window; the hot warmup
+        # is also the pool's FIRST observed scan (frequency 1), so the
+        # measured window opens exactly one scan short of admission
+        session.sql(_hot_sql())
+        session.sql(_cold_sql(0))
     c_before = session.stmt_log.counter("compiles")
     d_before = session.stmt_log.counter("dispatches")
     x_before = (session.stmt_log.counter("cancel_requests")
@@ -383,6 +476,9 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     sk_before = session.stmt_log.counter("skew_events")
     ef_before = session.stmt_log.counter("epoch_flips")
     mr_before = session.stmt_log.counter("topo_moved_rows")
+    bh_before = session.stmt_log.counter("bufpool_hits")
+    bm_before = session.stmt_log.counter("bufpool_misses")
+    hd_before = session.stmt_log.counter("host_decodes")
 
     _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
                     "SchedDeadline")
@@ -505,14 +601,20 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     if chaos > 0:
         FI.reset_fault("tile_device_lost")
         FI.reset_fault("exec_device_lost")
+    # the store root must outlive the counter reads AND the hotcold
+    # probe below (which re-scans the store after the window closes)
     root = getattr(session, "_servebench_root", None)
-    if root:
-        import shutil
 
-        shutil.rmtree(root, ignore_errors=True)
+    def _cleanup():
+        if root:
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
     if errors:
+        _cleanup()
         raise RuntimeError(f"bench clients failed: {errors[:3]}")
     if topo_errors:
+        _cleanup()
         raise RuntimeError(f"topology chaos failed: {topo_errors}")
     if not mux:
         lat_map[None] = lats
@@ -568,6 +670,17 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     out["cutover_ms"] = round(cutover_ms[0], 2)
     out["moved_rows"] = disp.counter("topo_moved_rows") - mr_before
     out["epoch_flips"] = disp.counter("epoch_flips") - ef_before
+    # HBM buffer-pool columns (ISSUE 16): hit rate over the run's pool
+    # lookups and the host decode count — under --mix hotcold the hot
+    # set's repeats stop decoding once admitted, so host_decodes
+    # tracks the cold set (plus the hot set's single admission pass)
+    bh = disp.counter("bufpool_hits") - bh_before
+    bm = disp.counter("bufpool_misses") - bm_before
+    out["bufpool_hit_rate"] = round(bh / (bh + bm), 4) if bh + bm else 0.0
+    out["host_decodes"] = disp.counter("host_decodes") - hd_before
+    if mix == "hotcold":
+        out.update(_hotcold_probe(session))
+    _cleanup()
     if trace_sample and trace_out:
         from cloudberry_tpu.obs.trace import chrome_trace
 
@@ -612,7 +725,7 @@ def main(argv=None) -> list[dict]:
                     choices=["both", "direct", "batched"])
     ap.add_argument("--mix", default="point",
                     choices=["point", "q6", "mixed", "spill",
-                             "coldscan"])
+                             "coldscan", "hotcold"])
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--duration", type=float, default=5.0)
     ap.add_argument("--rows", type=int, default=200_000)
@@ -703,6 +816,13 @@ def main(argv=None) -> list[dict]:
         rows_out.extend(r.get("_tenants", ()))
         for rr in [r] + list(r.get("_tenants", ())):
             print(csv_row(rr), flush=True)
+        if args.mix == "hotcold":
+            print(f"# hotcold[{mode}]: hot {r['_hot_rows_per_s']} rows/s"
+                  f" ({r['_hot_host_decodes']} host decodes) vs cold "
+                  f"{r['_cold_rows_per_s']} rows/s "
+                  f"({r['_cold_host_decodes']} host decodes); "
+                  f"run hit rate {r['bufpool_hit_rate']}",
+                  file=sys.stderr)
     if args.csv:
         new = not os.path.exists(args.csv)
         with open(args.csv, "a") as fh:
