@@ -348,6 +348,17 @@ def bufferpool_context() -> dict:
     except Exception as e:  # the bench must never die on its metadata
         rec["error"] = f"{type(e).__name__}: {e}"
     try:
+        hot_path = os.path.join(REPO, "SCAN_SF10_HOT.json")
+        if os.path.exists(hot_path):
+            # committed SF10 hot_point artifact (scan_bench --hot-json):
+            # a MEASURED second-pass pool record, replayed verbatim
+            with open(hot_path) as f:
+                p = json.load(f)
+            p["provenance"] = (
+                f"REPLAY of {p.get('measured_utc', 'unknown date')} "
+                "committed hot_point measurement (SCAN_SF10_HOT.json)")
+            rec["sf10"] = p
+            return rec
         sf10_path = os.path.join(REPO, "SCAN_SF10.json")
         if os.path.exists(sf10_path):
             with open(sf10_path) as f:
@@ -429,6 +440,79 @@ def recovery_context(session) -> dict:
             "tiles_replayed", "tile_resume_declined",
             "recovery_wall_ms")},
     }
+
+
+def adaptive_context(session=None) -> dict:
+    """The feedback-driven re-optimization record (ISSUE 17) next to
+    the robustness one: the bench session's learned-sketch store and
+    adaptation counters, plus a SELF-CONTAINED first-vs-second A/B on a
+    mis-stated-skew workload — the first execution learns (and, tiled,
+    adapts mid-statement); the second plans against the folded sketch.
+    Runs on whatever backend this process has (engine vs itself), so it
+    rides live and replay rounds identically."""
+    import numpy as np
+
+    import cloudberry_tpu as cb
+    from cloudberry_tpu.config import get_config
+
+    rec: dict = {}
+    if session is not None:
+        from cloudberry_tpu.plan import feedback as FB
+
+        store = FB.store_for(session)
+        if store is not None:
+            rec["store"] = store.snapshot()
+        lg = session.stmt_log
+        rec["counters"] = {k: lg.counter(k) for k in (
+            "feedback_folds", "feedback_seeded", "feedback_gen_bumps",
+            "rung_downgrades", "rung_upgrades", "adaptive_replans",
+            "tile_replans")}
+    try:
+        s = cb.Session(get_config().with_overrides(**{
+            "n_segments": 8, "planner.broadcast_threshold": 0,
+            "resource.query_mem_bytes": 2 << 20}))
+        rng = np.random.default_rng(7)
+        s.sql("create table adim (d bigint, g bigint) "
+              "distributed by (g)")
+        s.sql("create table afact (k bigint, d bigint, v bigint) "
+              "distributed by (k)")
+        n_dim, n_fact = 400, 200_000
+        s.catalog.table("adim").set_data(
+            {"d": np.arange(n_dim), "g": np.arange(n_dim) % 7})
+        # mis-stated skew: the planner's stats see a uniform d, the
+        # data sends 80% of probe rows to one dim key's segment
+        d = rng.integers(0, n_dim, n_fact)
+        d[rng.random(n_fact) < 0.8] = 3
+        s.catalog.table("afact").set_data(
+            {"k": np.arange(n_fact) % 997, "d": d,
+             "v": rng.integers(0, 100, n_fact)})
+        q = ("select g, sum(v) as sv, count(*) as c from afact "
+             "join adim on afact.d = adim.d group by g order by g")
+        lg = s.stmt_log
+        keys = ("compiles", "tile_replans", "adaptive_replans",
+                "feedback_seeded", "rung_downgrades", "rung_upgrades")
+
+        def snap():
+            return {k: lg.counter(k) for k in keys}
+
+        b0 = snap()
+        r1 = s.sql(q).to_pandas()
+        b1 = snap()
+        r2 = s.sql(q).to_pandas()
+        b2 = snap()
+        rec["ab"] = {
+            "bit_identical": bool(r1.equals(r2)),
+            "first": {k: b1[k] - b0[k] for k in keys},
+            "second": {k: b2[k] - b1[k] for k in keys},
+        }
+        from cloudberry_tpu.plan import feedback as FB
+
+        store = FB.store_for(s)
+        if store is not None:
+            rec["ab_store"] = store.snapshot()
+    except Exception as e:  # the bench must never die on its metadata
+        rec["ab_error"] = f"{type(e).__name__}: {e}"
+    return rec
 
 
 def obs_context(session=None) -> dict:
@@ -651,6 +735,7 @@ def replay_last_good(reason: str) -> None:
             "lint": lint_context(),
             "planverify": planverify_context(),
             "obs": obs_context(),
+            "adaptive": adaptive_context(),
             "scan_ladder": scan_ladder_context(),
             "bufferpool": bufferpool_context(),
         })
@@ -665,6 +750,7 @@ def replay_last_good(reason: str) -> None:
             "lint": lint_context(),
             "planverify": planverify_context(),
             "obs": obs_context(),
+            "adaptive": adaptive_context(),
             "scan_ladder": scan_ladder_context(),
             "bufferpool": bufferpool_context(),
         })
@@ -857,6 +943,12 @@ def measure() -> None:
     except Exception as e:
         log(f"obs context failed: {type(e).__name__}: {e}")
         obs = None
+    try:
+        # adaptation view: learned-sketch store + first-vs-second A/B
+        adaptive = adaptive_context(session)
+    except Exception as e:
+        log(f"adaptive context failed: {type(e).__name__}: {e}")
+        adaptive = None
     per_q = ", ".join(
         f"{q}={s:.2f}x/{rows_s[q]/1e6:.0f}Mrows_s_chip"
         f"/{roofline['per_query'].get(q, {}).get('hbm_frac', 0):.3f}HBM"
@@ -877,6 +969,7 @@ def measure() -> None:
         "lint": lint_context(),
         "planverify": planverify_context(),
         "obs": obs,
+        "adaptive": adaptive,
         "scan_ladder": scan_ladder_context(),
         "bufferpool": bufferpool_context(),
         "scan_bytes": scan_bytes,

@@ -394,6 +394,45 @@ class RecoveryConfig:
 
 
 @dataclass(frozen=True)
+class FeedbackConfig:
+    """Feedback-driven re-optimization (plan/feedback.py).
+
+    After every statement the motion stats the executors already psum
+    (per-destination demand vectors, runtime-filter survivor counts)
+    fold into per-(table, key-set) sketches keyed by the shared cache
+    tier's content-stable tokens — DML version bumps, topology epoch
+    flips, and relevant config swaps invalidate by construction. The
+    planner consumes them three ways: the memo re-ranks join order /
+    motion choice when an observed skew alarm contradicts the histogram,
+    the distributor seeds capacity rungs at the observed demand rung
+    (exact skew bounds stay the authoritative ceiling; overflow still
+    promotes up the ladder), and long tiled statements replan
+    MID-STATEMENT through the PR-6 checkpoint store when per-tile motion
+    stats cross the skew alarm."""
+
+    enabled: bool = True
+    # Multiplier over observed per-destination demand when seeding a
+    # rung (rung_up gives pow2 headroom on top); >1 absorbs tile-order
+    # and bloom-false-positive jitter between executions.
+    headroom: float = 1.25
+    # Persist sketches alongside ANALYZE stats (store-backed sessions
+    # only) so fresh sessions inherit them.
+    persist: bool = True
+    # Mid-statement adaptive replan for tiled statements. Needs
+    # health.retries > 0 (the replan rides the statement retry loop).
+    adaptive: bool = True
+    # Per-tile cumulative skew ratio (max/mean destination rows) that
+    # triggers the mid-statement replan; 0 = inherit obs.skew_ratio.
+    replan_skew_ratio: float = 0.0
+    # Tiles observed before the skew alarm may fire (one hot tile is
+    # noise; a sustained hot destination is a plan problem).
+    min_tiles: int = 2
+    # Mid-statement replans allowed per statement (the retry loop must
+    # terminate even if the replanned statement stays skewed).
+    max_replans: int = 1
+
+
+@dataclass(frozen=True)
 class HealthConfig:
     """Failure detection / recovery knobs (the FTS analog, fts.c:118).
 
@@ -549,6 +588,7 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
     recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
+    feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)

@@ -123,6 +123,20 @@ def compile_distributed(plan: N.PlanNode, session, param_keys=None,
                               _out_specs_like(plan)))
 
 
+def stat_node_ids(plan: N.PlanNode) -> tuple:
+    """Ordered ids of the plan's stats-bearing nodes (redistributes,
+    then runtime filters, document order). The rung-program cache stores
+    the TRACED plan's tuple so that a signature-equal plan reusing the
+    compiled program can alias its own nodes onto the stats keys — the
+    telemetry keys embed trace-time node ids, and without the alias a
+    cache hit would silently drop the feedback loop's observations."""
+    red = tuple(id(n) for n in X.all_nodes(plan)
+                if isinstance(n, N.PMotion) and n.kind == "redistribute")
+    rf = tuple(id(n) for n in X.all_nodes(plan)
+               if isinstance(n, N.PRuntimeFilter))
+    return (red, rf)
+
+
 def record_motion_stats(plan: N.PlanNode, stats: dict,
                         session=None) -> None:
     """Pin each redistribute's observed global bucket demand onto its
@@ -146,6 +160,18 @@ def record_motion_stats(plan: N.PlanNode, stats: dict,
                if isinstance(n, N.PMotion) and n.kind == "redistribute"}
     filters = {id(n): n for n in X.all_nodes(plan)
                if isinstance(n, N.PRuntimeFilter)}
+    # program reused from an equivalent traced plan (_rung_executable):
+    # admit the TRACED ids as aliases for this plan's same-ordered nodes.
+    # A live id is never overwritten — if a traced id happens to collide
+    # with a current node's id, the kind filter + first-writer-wins keeps
+    # the pre-existing aliasing guarantee.
+    alias = getattr(plan, "_stat_id_alias", None)
+    if alias:
+        for old, new in alias.items():
+            if new in motions and old not in motions:
+                motions[old] = motions[new]
+            elif new in filters and old not in filters:
+                filters[old] = filters[new]
     for key, v in stats.items():
         m = re.search(r"required bucket \(node (\d+)\)", key)
         if m is not None:
@@ -281,6 +307,9 @@ def execute_distributed(plan: N.PlanNode, session,
     record_motion_stats(plan, stats, session=session)
     X.raise_checks(checks)
     record_jf_counters(stats, getattr(session, "stmt_log", None))
+    from cloudberry_tpu.plan.feedback import fold_plan
+
+    fold_plan(session, plan)
     # every segment computed the (gathered) final result; read the first
     # shard THIS HOST can address (on a multi-host mesh, segment 0 may
     # live on another process — any local copy is identical post-gather)

@@ -100,6 +100,9 @@ def declare(session, name: str, query_ast) -> dict:
                 X.raise_checks(checks)
                 record_jf_counters(stats,
                                    getattr(session, "stmt_log", None))
+                from cloudberry_tpu.plan.feedback import fold_plan
+
+                fold_plan(session, stripped)
                 sel_np = np.asarray(sel)
                 for s in range(nseg):
                     shard_cols = {k: np.asarray(v)[s]

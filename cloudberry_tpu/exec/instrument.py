@@ -752,6 +752,9 @@ def _pipeline_once(plan, session, query):
             DX.record_motion_stats(plan, stats, session=session)
             X.raise_checks(checks)
             DX.record_jf_counters(stats, session.stmt_log)
+            from cloudberry_tpu.plan.feedback import fold_plan
+
+            fold_plan(session, plan)
             counts_host = DX.instrument_counts(plan, stats)
             host_cols = {k: DX._local_row(v) for k, v in cols.items()}
             host_sel = DX._local_row(sel)

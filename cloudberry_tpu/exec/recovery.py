@@ -63,6 +63,30 @@ import numpy as np
 from cloudberry_tpu.utils.faultinject import fault_point
 
 
+class TileReplan(Exception):
+    """Mid-statement adaptive replan request (NOT a failure).
+
+    Raised by the tiled-dist skew sentinel (exec/tiled.py SkewSentinel)
+    after it has (a) folded the cumulative per-destination motion rows
+    into the feedback store as a partial sketch and (b) durably
+    checkpointed the carried state via RecoveryCtx.force_snapshot. The
+    session's statement retry treats it like a topology race: evict the
+    cached statement, re-plan — the memo now sees the fresh sketch —
+    and the new executable resumes from the checkpoint (plan_signature
+    deliberately excludes nseg/tile size/motion choices, so a
+    differently-shaped plan still accepts it).
+
+    Deliberately NOT an executor ExecError subclass: the adaptive
+    grow/halve loop (exec/tiled.py _run_adaptive) absorbs ExecError to
+    retry at a new capacity, and an adaptation request must propagate
+    past it to the session."""
+
+    def __init__(self, msg: str, tiles_done: int = 0, ratio: float = 0.0):
+        super().__init__(msg)
+        self.tiles_done = tiles_done
+        self.ratio = ratio
+
+
 # The declared re-placement rule per checkpointed mode — HOW a
 # snapshot's carried state re-places onto a changed (degraded) mesh.
 # Keys must equal exec/tiled.py CHECKPOINT_MODES (the plan verifier's
@@ -636,6 +660,26 @@ class RecoveryCtx:
             # the run finish (a later device loss just replays more)
             self._ckpt_broken = True
             self.log.bump("tile_ckpt_failed")
+
+    def force_snapshot(self, tiles_local: int, payload_fn) -> bool:
+        """Snapshot NOW, ignoring the K-tile cadence — the mid-statement
+        adaptive replan (exec/tiled_dist.py) checkpoints the carried
+        state at the alarm tile so the replanned executable resumes from
+        exactly here instead of re-streaming. True when the checkpoint
+        was durably saved; an adaptation must not proceed on a failed
+        save (the replanned run would replay consumed tiles)."""
+        if self.sid is None or not self.cfg.enabled or self._ckpt_broken:
+            return False
+        total = self.tiles_base + tiles_local
+        if self._last_snapshot == total:
+            return True      # the cadence tick already saved this tile
+        try:
+            self._snapshot(total, tiles_local, payload_fn())
+            return True
+        except Exception:  # noqa: BLE001 — same degrade rule as tick()
+            self._ckpt_broken = True
+            self.log.bump("tile_ckpt_failed")
+            return False
 
     def _snapshot(self, tiles_total: int, tiles_local: int,
                   payload: dict) -> None:

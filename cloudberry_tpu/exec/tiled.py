@@ -644,6 +644,145 @@ def _progress_tracker(exe, n_base: int, skip: int):
                        rows_total=total)
 
 
+class SkewSentinel:
+    """Mid-statement adaptive-replan watcher for tiled-dist runs.
+
+    The distributed step program already psums every redistribute's
+    per-destination row counts for the capacity-forensics channel; the
+    sentinel accumulates those vectors host-side across tiles and, when
+    the CUMULATIVE distribution crosses the skew alarm
+    (``config.feedback.replan_skew_ratio``, 0 = inherit
+    ``config.obs.skew_ratio``), asks the session to re-plan the rest of
+    the statement: it folds the observed counts into the feedback store
+    as a partial sketch, force-checkpoints the carried state
+    (exec/recovery.py), and raises TileReplan. Correctness never
+    depends on it — an adaptation that cannot checkpoint simply
+    disarms and the run finishes on the static plan.
+
+    Guard rails, in check order: feature off / no recovery scope / too
+    few tiles seen (``min_tiles``) / statement replan budget spent
+    (``max_replans``) / no motion alarmed / ``tile_replan`` fault seam
+    armed / checkpoint save failed."""
+
+    def __init__(self, exe, motions, ctx):
+        cfg = getattr(exe.session.config, "feedback", None)
+        self.exe = exe
+        self.session = exe.session
+        self.motions = motions
+        self.ctx = ctx
+        self.min_tiles = cfg.min_tiles if cfg is not None else 2
+        self.max_replans = cfg.max_replans if cfg is not None else 0
+        self.threshold = float(
+            (cfg.replan_skew_ratio or exe.session.config.obs.skew_ratio)
+            if cfg is not None else 0.0)
+        # collect: accumulate telemetry for the end-of-run fold (the
+        # learning half works even with adaptation off); armed: the
+        # mid-statement replan trigger itself
+        self.collect = bool(cfg is not None and cfg.enabled and motions)
+        self.armed = bool(
+            self.collect and cfg.adaptive and ctx is not None
+            and self.threshold > 0.0)
+        self.cum = [np.zeros(exe.nseg, dtype=np.int64) for _ in motions]
+        self.demand = [0] * len(motions)
+
+    def observe(self, stats) -> None:
+        """Fold one tile's per-motion (required-bucket scalar, psum'd
+        per-destination row vector) pairs, traversal order matching
+        ``self.motions``."""
+        if not self.collect:
+            return
+        for i, (bucket, rows) in enumerate(stats):
+            self.demand[i] = max(self.demand[i], int(np.asarray(bucket)))
+            self.cum[i] += np.asarray(rows, dtype=np.int64)
+
+    def _pin(self) -> bool:
+        """Stamp the cumulative observations onto the partial plan's
+        motions the way record_motion_stats does for the one-shot path;
+        True when anything flowed."""
+        any_rows = False
+        for m, c, d in zip(self.motions, self.cum, self.demand):
+            if int(c.sum()) > 0:
+                m._seg_rows = c.copy()
+                any_rows = True
+            if d > 0:
+                # per-TILE demand, not cumulative: the rung a re-seeded
+                # tiled run needs is the largest single-tile bucket
+                m._observed_bucket = max(
+                    d, getattr(m, "_observed_bucket", 0) or 0)
+        return any_rows
+
+    def fold_final(self) -> None:
+        """End-of-run fold: the one-shot dist path folds in
+        execute_distributed, the tiled stream folds here."""
+        if not self.collect:
+            return
+        from cloudberry_tpu.plan import feedback as FB
+
+        if self._pin():
+            FB.fold_plan(self.session, self.exe.shape.partial_plan)
+
+    def _worst(self):
+        worst = None
+        for m, c in zip(self.motions, self.cum):
+            total = int(c.sum())
+            if total <= 0:
+                continue
+            ratio = float(c.max()) * len(c) / total
+            if ratio >= self.threshold and (worst is None
+                                            or ratio > worst[1]):
+                worst = (m, ratio)
+        return worst
+
+    def maybe_replan(self, tiles_local: int, payload_fn) -> None:
+        """Raise TileReplan when the cumulative distribution alarms and
+        the adaptation can resume safely; no-op otherwise."""
+        from cloudberry_tpu.exec import recovery as R
+        from cloudberry_tpu.lifecycle import current_handle
+        from cloudberry_tpu.obs import trace as OT
+
+        if not self.armed or tiles_local < self.min_tiles:
+            return
+        session = self.session
+        # the replan budget rides the STATEMENT handle (session.sql
+        # re-dispatches under the same one), and only handles the
+        # session marked adaptation-safe (reads) may restart
+        handle = current_handle()
+        if handle is None or not getattr(handle, "adaptive_ok", False):
+            return
+        if getattr(handle, "tile_replans", 0) >= self.max_replans:
+            return
+        worst = self._worst()
+        if worst is None:
+            return
+        if fault_point("tile_replan"):
+            self.armed = False      # seam: suppress the adaptation
+            return
+        # Publish what we actually saw BEFORE deciding to restart: pin
+        # the cumulative counts on the partial plan's motions and fold a
+        # partial sketch — the re-planned statement prices against it.
+        from cloudberry_tpu.plan import feedback as FB
+
+        self._pin()
+        FB.fold_plan(session, self.exe.shape.partial_plan, partial=True)
+        # The replanned run must resume from HERE, not re-stream: a
+        # failed save disarms the sentinel and the static plan finishes.
+        if not self.ctx.force_snapshot(tiles_local, payload_fn):
+            self.armed = False
+            return
+        handle.tile_replans = getattr(handle, "tile_replans", 0) + 1
+        log = getattr(session, "stmt_log", None)
+        if log is not None:
+            log.bump("tile_replans")
+        import time as _t
+        OT.mark("tile-replan", _t.perf_counter(),
+                tile=tiles_local, ratio=round(worst[1], 3))
+        raise R.TileReplan(
+            f"[tile {tiles_local}] cumulative redistribute skew "
+            f"{worst[1]:.2f}x crossed the adaptive replan alarm "
+            f"{self.threshold:.2f}x; carried state checkpointed",
+            tiles_done=tiles_local, ratio=worst[1])
+
+
 class AdaptiveTiledMixin:
     """Shared adaptive-retry discipline for tiled executables (single-node
     and distributed): classify a detected overflow, grow the guilty buffer

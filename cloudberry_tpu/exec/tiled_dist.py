@@ -528,6 +528,21 @@ def _reduce_checks(checks: dict) -> dict:
             for k, v in checks.items()}
 
 
+def _motion_stats(low, motions, nseg: int):
+    """Per-motion (required-bucket scalar, per-destination row vector)
+    pairs off the lowerer's replicated stats channel
+    (dist_executor.DistLowerer.motion psums/pmaxes them) — zeros when a
+    motion lowered without the bucketed path. The skew sentinel
+    (exec/tiled.py SkewSentinel) accumulates these host-side across
+    tiles; the end-of-run fold publishes them to the feedback store."""
+    return tuple(
+        (low.stats.get(f"required bucket (node {id(m)})",
+                       jnp.zeros((), jnp.int32)),
+         low.stats.get(f"seg rows (node {id(m)})",
+                       jnp.zeros((nseg,), jnp.int32)))
+        for m in motions)
+
+
 class DistTiledExecutable(AdaptiveTiledMixin):
     """Compiled distributed tiled statement: prelude (once) → step (per
     tile, lock-step across segments) → finalize. ``report`` records the
@@ -648,12 +663,23 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         self._compiled = (prelude_fn, step_fn, finalize_fn)
         return self._compiled
 
+    def _stat_motions(self):
+        """The step program's redistribute motions, in deterministic
+        traversal order — the skew sentinel (exec/tiled.py) watches
+        their psum'd per-destination row counts, and the end-of-run
+        fold publishes the cumulative observations to the feedback
+        store (plan/feedback.py)."""
+        return tuple(n for n in X.all_nodes(self.shape.partial_plan)
+                     if isinstance(n, N.PMotion)
+                     and n.kind == "redistribute")
+
     def _make_step(self, mesh, tx, res_specs):
         shape = self.shape
         nseg = self.nseg
         group_names = list(shape.group_names)
         specs = shape.merge_specs
         pallas, plat = self._use_pallas, jax.default_backend()
+        stat_motions = self._stat_motions()
 
         def step_seg(resident, prelude, tile, tile_n, acc):
             tables = dict(resident)
@@ -667,6 +693,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                                    packed=self._packed)
             pcols, psel = low.lower(shape.partial_plan)
             checks = dict(low.checks)
+            srows = _motion_stats(low, stat_motions, nseg)
             acc_cols, acc_sel = _strip_seg(tuple(acc))
             g_cap = shape.g_cap
             if group_names:
@@ -684,14 +711,14 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                        f"{g_cap}; raise the aggregation capacity"] = \
                     n_groups > g_cap
                 return _add_seg(({**ok, **oa}, osel)), \
-                    _reduce_checks(checks)
+                    _reduce_checks(checks), srows
             agg_vals = {s.out_name: jnp.concatenate(
                 [acc_cols[s.out_name], pcols[s.out_name]])
                 for s in specs}
             sel = jnp.concatenate([acc_sel, psel])
             out = K.global_aggregate(agg_vals, specs, sel)
             return _add_seg((out, jnp.ones((1,), dtype=jnp.bool_))), \
-                _reduce_checks(checks)
+                _reduce_checks(checks), srows
 
         return self._jit_step(step_seg, mesh, res_specs)
 
@@ -701,8 +728,11 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         # donate the accumulator so the step updates in place on device;
         # CPU XLA can't always honor donation — skip the warning noise
         donate = () if jax.default_backend() == "cpu" else (4,)
+        # third output: per-motion (required-bucket, per-destination
+        # rows) telemetry pairs — psum/pmax replicated, so P() like the
+        # checks; the skew sentinel consumes them host-side
         return jax.jit(_shard_map(step_seg, mesh, step_in,
-                                  (P(SEG_AXIS), P())),
+                                  (P(SEG_AXIS), P(), P())),
                        donate_argnums=donate)
 
     def _refinalize(self) -> None:
@@ -777,10 +807,11 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                                self.tile_rows)
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
-        from cloudberry_tpu.exec.tiled import _TileTimer
+        from cloudberry_tpu.exec.tiled import SkewSentinel, _TileTimer
 
         timer = _TileTimer(self.session)
         tracker = _dist_progress_tracker(self, feed, n_base)
+        sentinel = SkewSentinel(self, self._stat_motions(), ctx)
         # prefetch pipeline over the per-segment feed (exec/scanpipe.py:
         # host staging only — shard_map owns device placement); the
         # tracker/checkpoint math reads the UNWRAPPED feed above, and
@@ -791,23 +822,29 @@ class DistTiledExecutable(AdaptiveTiledMixin):
                 fault_point("tile_step_dist")
                 fault_point("tile_device_lost")
                 with timer.step(n_base + n_local):
-                    acc, checks = step_fn(resident, prelude, tile,
-                                          tile_ns, acc)
+                    acc, checks, srows = step_fn(resident, prelude, tile,
+                                                 tile_ns, acc)
                     _raise_tile_checks(checks, n_base + n_local)
                 n_local += 1
+                sentinel.observe(srows)
                 tracker.step(n_local)
                 if ctx is not None:
                     ctx.tick(n_local, lambda: R.acc_payload(acc))
+                # AFTER the cadence tick: an alarm at a tick tile reuses
+                # that snapshot instead of saving twice
+                sentinel.maybe_replan(n_local,
+                                      lambda: R.acc_payload(acc))
         finally:
             SP.close_feed(stream)
         SP.stamp_report(self.report, stream)
         timer.stamp(self.report)
+        sentinel.fold_final()
         n_tiles = n_base + n_local
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
             tile, _ = _empty_dist_tile(self.shape.stream, self.tile_rows,
                                        self.nseg)
             zeros = np.zeros((self.nseg,), dtype=np.int64)
-            acc, checks = step_fn(resident, prelude, tile, zeros, acc)
+            acc, checks, _ = step_fn(resident, prelude, tile, zeros, acc)
             _raise_tile_checks(checks, 0)
             n_tiles = 1
 
@@ -870,6 +907,7 @@ class DistTopNTiledExecutable(DistTiledExecutable):
         msort = N.PSort(mleaf, list(shape.sortnode.keys))
         msort.fields = list(mleaf.fields)
         names = [f.name for f in shape.partial_plan.fields]
+        stat_motions = self._stat_motions()
 
         def step_seg(resident, prelude, tile, tile_n, acc):
             tables = dict(resident)
@@ -883,6 +921,7 @@ class DistTopNTiledExecutable(DistTiledExecutable):
                                    packed=self._packed)
             pcols, psel = low.lower(shape.partial_plan)
             checks = dict(low.checks)
+            srows = _motion_stats(low, stat_motions, nseg)
             acc_cols, acc_sel = _strip_seg(tuple(acc))
             ccols = {n: jnp.concatenate([acc_cols[n], pcols[n]])
                      for n in names}
@@ -893,7 +932,7 @@ class DistTopNTiledExecutable(DistTiledExecutable):
             scols, ssel = low2.lower(msort)
             checks.update(low2.checks)
             return _add_seg(({n: scols[n][:m] for n in names},
-                             ssel[:m])), _reduce_checks(checks)
+                             ssel[:m])), _reduce_checks(checks), srows
 
         return self._jit_step(step_seg, mesh, res_specs)
 

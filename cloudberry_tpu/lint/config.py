@@ -31,7 +31,7 @@ CONCURRENT_CLASSES = frozenset({
     "RecoveryStore", "CircuitBreaker", "CancelToken", "Watchdog",
     "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
     "MetricsRegistry", "StatementStats", "Trace", "Progress",
-    "TopologyManager", "ScanPipeline", "BufferPool",
+    "TopologyManager", "ScanPipeline", "BufferPool", "FeedbackStore",
 })
 
 # attribute-name → class-name hints for cross-class lock edges: when a
@@ -74,6 +74,10 @@ ATTR_CLASS_HINTS = {
     "bpool": "BufferPool",
     "bufpool": "BufferPool",
     "_bufpool": "BufferPool",
+    # learned-stats store (plan/feedback.py) — planner consumers reach
+    # it through these names while cache-tier locks may be held
+    "feedback": "FeedbackStore",
+    "_feedback_store": "FeedbackStore",
 }
 
 # modules (repo-relative path suffixes) whose jitted / kernel functions
@@ -160,12 +164,19 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     # generic_lock → StatementLog._lock; plan-local rung growth nests
     # under the session rung lock)
     ("StatementLog._lock", "GenericPlan._rung_lock"),
-    # rank 4 — innermost leaves (never call out while held)
+    # rank 4 — innermost leaves (never call out while held). The
+    # feedback-store locks live HERE, not with the rank-2 cache-tier
+    # locks: planning paths reach sketch lookups while holding
+    # CacheScope locks (generic-plan builds plan under generic_lock),
+    # so FeedbackStore._lock must nest inside them; _io_lock serializes
+    # the _FEEDBACK.json write and is never nested with _lock (the
+    # snapshot is taken, released, THEN written).
     ("CancelToken._lock", "faultinject._lock", "sharedcache._tier_lock",
      "MetricsRegistry._lock", "StatementStats._lock", "Trace._lock",
      "Progress._lock", "mesh._topo_lock", "ScanPipeline._cond",
      "scanpipe._pool_lock", "BufferPool._lock",
-     "bufferpool._create_lock"),
+     "bufferpool._create_lock", "FeedbackStore._lock",
+     "FeedbackStore._io_lock", "feedback._create_lock"),
 )
 
 

@@ -55,8 +55,26 @@ def _estimate(node: N.PlanNode, catalog) -> float:
             nd = _expr_ndv(node.child, e, catalog)
             prod *= nd if nd is not None else max(child ** 0.5, 1.0)
             if prod >= child:
-                return child
-        return min(prod, child)
+                prod = child
+                break
+        est = min(prod, child)
+        # feedback (plan/feedback.py): a prior merge motion over these
+        # group keys COUNTED the shipped partials, bracketing the true
+        # distinct-group count (every group ships >= 1 and <= nseg
+        # partial rows) — clamp the static product into the observed
+        # bracket. Refines both failure modes: an over-estimate shrinks
+        # the merge rung (fewer padded wire bytes), an under-estimate
+        # grows g_cap before the overflow-retry would have.
+        fb = getattr(catalog, "_feedback", None)
+        if fb is not None:
+            bounds = fb.group_ndv(node)
+            if bounds is not None:
+                lo, hi = bounds
+                clamped = min(max(est, float(lo)), float(hi), child)
+                if clamped != est:
+                    node._feedback_ndv = (lo, hi)
+                    est = clamped
+        return est
     if isinstance(node, N.PJoin):
         return _estimate_join(node, catalog)
     return 1.0
@@ -132,8 +150,8 @@ def _col_source(plan: N.PlanNode, name: str):
             if out == name:
                 return (plan.table_name, phys)
         return None
-    if isinstance(plan, (N.PFilter, N.PSort, N.PLimit, N.PMotion,
-                         N.PWindow, N.PShare)):
+    if isinstance(plan, (N.PFilter, N.PRuntimeFilter, N.PSort, N.PLimit,
+                         N.PMotion, N.PWindow, N.PShare)):
         return _col_source(plan.children()[0], name)
     if isinstance(plan, N.PProject):
         for out, e in plan.exprs:

@@ -369,9 +369,47 @@ class Distributor:
                 min(est_rows, cap) / self.nseg * factor)), 64)
             m.bucket_cap = min(m.bucket_cap, est_bucket)
         m.bucket_cap = rung_up(m.bucket_cap)
+        # feedback-driven seed (plan/feedback.py): when a prior execution
+        # OBSERVED this (table, key-set) shuffle under the same validity
+        # tokens, the observed per-destination demand replaces the static
+        # estimate — a learned rung, not a guess. Both directions pay:
+        # seeding BELOW the static rung cuts padded wire bytes
+        # (rung_downgrades), seeding ABOVE it skips the grow-and-retry
+        # recompile the static seed would have hit (rung_upgrades). The
+        # ladder discipline is untouched — the exact path above never gets
+        # here, and an overflow against a stale-generalized sketch still
+        # promotes and retries. planck re-derives the justified bound
+        # from the live sketch (verify.py motion-rung-feedback-forged).
+        self._feedback_seed(m, child, keys)
         m.out_capacity = m.bucket_cap * self.nseg
         self._stamp_hier(m, child, keys)
         return m, m.out_capacity
+
+    def _feedback_seed(self, m: N.PMotion, child: N.PlanNode,
+                       keys) -> None:
+        from cloudberry_tpu.plan import feedback as FB
+
+        store = FB.store_for(self.session)
+        if store is None:
+            return
+        src = FB.resolve_sources(child, keys)
+        if src is None:
+            return
+        sk = store.lookup(self.session, "redist", src)
+        if sk is None or sk.demand_max <= 0:
+            return
+        headroom = self.cfg.feedback.headroom
+        seeded = rung_up(max(int(sk.demand_max * headroom), 8))
+        if seeded == m.bucket_cap:
+            return
+        log = getattr(self.session, "stmt_log", None)
+        if log is not None:
+            log.bump("feedback_seeded")
+            log.bump("rung_downgrades" if seeded < m.bucket_cap
+                     else "rung_upgrades")
+        m._feedback_seed = {"demand": sk.demand_max, "static": m.bucket_cap,
+                            "rung": seeded, "src": src}
+        m.bucket_cap = seeded
 
     # ------------------------------------------------- two-level stamping
 
@@ -853,6 +891,16 @@ def digest_filter_frac(node: N.PJoin, catalog, cfg, nseg: int) -> float:
                                  len(node.build_keys), cfg, nseg)
     if not ok:
         return 1.0
+    # feedback (plan/feedback.py): a prior execution COUNTED this
+    # filter's survivors — price the shuffle at the observed fraction
+    # instead of the bloom model's. Learned, so stamp provenance for
+    # EXPLAIN / the flight recorder.
+    fb = getattr(catalog, "_feedback", None)
+    if fb is not None:
+        obs = fb.jf_frac(node)
+        if obs is not None:
+            node._jf_frac_src = "feedback"
+            return max(obs, 1e-6)
     return max(est / est_p, 1e-6)
 
 

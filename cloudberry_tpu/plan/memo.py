@@ -184,7 +184,19 @@ def _hot_frac(plan: N.PlanNode, keys, catalog) -> float:
             best = max(best, run)
         frac = min(frac, (best - 1) / (len(hist) - 1))
         seen = True
-    return frac if seen else 0.0
+    out = frac if seen else 0.0
+    # feedback (plan/feedback.py): when a prior execution of this
+    # (table, key-set) shuffle ALARMED on observed skew, the measured
+    # hottest-destination fraction overrides an optimistic histogram —
+    # this is what re-ranks join order / motion choice on the second
+    # execution of a mis-estimated hot-key probe. Sub-alarm
+    # observations leave the histogram estimate in charge.
+    fb = getattr(catalog, "_feedback", None)
+    if fb is not None:
+        obs = fb.hot_frac(plan, keys)
+        if obs is not None and obs > out:
+            return obs
+    return out
 
 
 def _redist_cost(est: float, width: int, frac: float, nseg: int) -> float:
@@ -699,6 +711,7 @@ def annotate_distribution(plan: N.PlanNode, session) -> None:
     from cloudberry_tpu.exec.executor import all_nodes
     from cloudberry_tpu.plan.distribute import digest_filter_frac
 
+    fb = getattr(catalog, "_feedback", None)
     for nd in all_nodes(plan):
         if isinstance(nd, N.PJoin) and not hasattr(nd, "_jf_frac"):
             try:
@@ -706,6 +719,16 @@ def annotate_distribution(plan: N.PlanNode, session) -> None:
                                                  session.config, nseg)
             except Exception:
                 nd._jf_frac = 1.0
+        if isinstance(nd, N.PJoin) and fb is not None \
+                and not hasattr(nd, "_feedback_skew"):
+            # provenance for EXPLAIN/flight recorder: this join's probe
+            # shuffle has an ALARMED skew sketch, so the exploration
+            # below re-ranks with the observed hot fraction
+            try:
+                if fb.hot_frac(nd.probe, nd.probe_keys) is not None:
+                    nd._feedback_skew = True
+            except Exception:
+                pass
 
     def region(root: N.PlanNode, agg: Optional[N.PAgg]) -> None:
         alts = explore(root, catalog, nseg, thr, gst)

@@ -153,6 +153,19 @@ def rung_below_exact(plan, session):
     return None
 
 
+def feedback_rung_forged(plan, session):
+    """Stamp a redistribute as feedback-seeded and drop its rung below
+    anything a live sketch justifies: a poisoned/forged learned seed
+    must be a guaranteed overflow finding, not a trusted stamp."""
+    for m in _motions(plan, "redistribute"):
+        m._feedback_seed = {"demand": 1, "static": m.bucket_cap,
+                            "rung": 8, "src": ()}
+        m.bucket_cap = 8
+        m.out_capacity = m.bucket_cap * session.config.n_segments
+        return plan, "forged feedback seed, bucket_cap dropped to 8"
+    return None
+
+
 def gather_capacity_shrink(plan, session):
     """Undersize a gather's receive buffer below rows x nseg."""
     for m in _motions(plan, "gather"):
@@ -500,6 +513,10 @@ MUTATIONS: dict[str, tuple[str, Callable, frozenset]] = {
     "rung-below-exact": (
         _Q_LEFT_EXPAND, rung_below_exact,
         frozenset({"motion-rung-below-exact"})),
+    "feedback-rung-forged": (
+        _Q_REDIST_JOIN, feedback_rung_forged,
+        frozenset({"motion-rung-feedback-forged",
+                   "motion-rung-below-exact"})),
     "gather-capacity-shrink": (
         _Q_SCAN, gather_capacity_shrink, frozenset({"motion-capacity"})),
     "sharding-stamp-lie": (

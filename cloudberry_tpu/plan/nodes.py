@@ -45,6 +45,27 @@ class PlanField:
         return self.null_mask
 
 
+def _feedback_suffix(node) -> str:
+    """`` feedback: ...`` plan-text tags for estimates learned from live
+    telemetry (plan/feedback.py) — absent on purely static plans, so
+    golden corpora planned in sketch-free sessions are unchanged."""
+    tags = []
+    seed = getattr(node, "_feedback_seed", None)
+    if seed is not None:
+        tags.append(f"rung {seed['rung']} "
+                    f"(demand {seed['demand']}, static {seed['static']})")
+    ndv = getattr(node, "_feedback_ndv", None)
+    if ndv is not None:
+        tags.append(f"ndv {ndv[0]}..{ndv[1]}")
+    if getattr(node, "_jf_frac_src", None) == "feedback":
+        tags.append("jf-frac observed")
+    if getattr(node, "_feedback_skew", False):
+        tags.append("skew alarmed")
+    if not tags:
+        return ""
+    return "  feedback: " + ", ".join(tags)
+
+
 @dataclass
 class PlanNode:
     fields: list[PlanField] = dc_field(default_factory=list, init=False)
@@ -89,7 +110,12 @@ class PlanNode:
                      # (plan/memo.py annotate_distribution); pinned in
                      # plan text so golden tests catch regressions
                      + (" memo: abstained"
-                        if getattr(self, "_memo_abstained", False) else ""))
+                        if getattr(self, "_memo_abstained", False) else "")
+                     # learned-vs-guessed provenance (plan/feedback.py):
+                     # estimates taken from live-telemetry sketches are
+                     # marked so EXPLAIN and the flight recorder show
+                     # which numbers the planner LEARNED
+                     + _feedback_suffix(self))
         for c in self.children():
             lines.append(c.explain(indent + 3))
         return "\n".join(lines)

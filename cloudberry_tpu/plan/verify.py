@@ -895,6 +895,8 @@ def _r_motion(v: Verifier, node: N.PMotion, kids, path) -> Props:
                    f"redistribute bucket_cap {node.bucket_cap} < exact "
                    f"skew bound rung {rung_up(max(exact, 8))} with no "
                    "runtime filter below to justify the undercut")
+    if getattr(node, "_feedback_seed", None) is not None:
+        _check_feedback_seed(v, node, path)
     if node.host_bucket_cap or node.hier_hosts or node.host_combine \
             or node.combine_spec is not None:
         _check_two_level(v, node, path)
@@ -903,6 +905,41 @@ def _r_motion(v: Verifier, node: N.PMotion, kids, path) -> Props:
     d = Sharding.hashed(*names) if names and \
         len(names) == len(node.hash_keys) else Sharding.strewn()
     return Props(None if v.local else d, max(node.out_capacity, 1))
+
+
+def _check_feedback_seed(v: Verifier, node: N.PMotion, path: str) -> None:
+    """Feedback-seeded rungs (plan/feedback.py, distribute._feedback_seed)
+    re-derive their justified bound from the LIVE sketch — the stamp's
+    own numbers are never trusted. The sketch's sources are re-resolved
+    from the motion's actual child and keys, the sketch must still exist
+    under current validity tokens, and the rung must cover the observed
+    demand (scaled by the session's headroom when it shrinks the seed,
+    never when it would inflate the bound away). A stamp with no live
+    sketch behind it is forged — exactly what a feedback-poisoning bug
+    or a replayed stale plan would look like."""
+    from cloudberry_tpu.exec.kernels import rung_up
+    from cloudberry_tpu.plan import feedback as FB
+
+    seed = node._feedback_seed
+    store = FB.store_for(v.session)
+    src = FB.resolve_sources(node.child, node.hash_keys)
+    sk = store.lookup(v.session, "redist", src) \
+        if store is not None and src is not None else None
+    if sk is None or sk.demand_max <= 0:
+        v.fail("motion-rung-feedback-forged", path,
+               f"feedback-seeded rung {node.bucket_cap} with no live "
+               f"sketch for sources {src!r} — the stamp claims demand "
+               f"{seed.get('demand')!r} nothing currently observed "
+               "justifies")
+        return
+    headroom = min(float(v.session.config.feedback.headroom), 1.0)
+    bound = rung_up(max(int(sk.demand_max * headroom), 8))
+    if node.bucket_cap < bound:
+        v.fail("motion-rung-feedback-forged", path,
+               f"feedback-seeded bucket_cap {node.bucket_cap} < rung "
+               f"{bound} justified by the observed demand "
+               f"{sk.demand_max} — an undercut rung is a guaranteed "
+               "overflow the sketch existed to prevent")
 
 
 def _check_two_level(v: Verifier, node: N.PMotion, path: str) -> None:

@@ -624,6 +624,9 @@ class GenericPlan:
                     b._observed_bucket = ob
             X.raise_checks(checks)
             DX.record_jf_counters(stats, session.stmt_log)
+            from cloudberry_tpu.plan.feedback import fold_plan
+
+            fold_plan(session, self.plan)
             host_cols = {k: DX._local_row(v) for k, v in cols.items()}
             return X.make_batch(self.plan, host_cols, DX._local_row(sel))
         inputs = self.bind_inputs(session, planB, keyedB, bindings)

@@ -70,6 +70,10 @@ class CacheScope:
         # byte budget; anchored here so sessions over one store root
         # share residency the way they share compiled programs
         self.bufferpool = None
+        # learned-stats store (plan/feedback.py), created lazily by
+        # feedback.store_for — same anchoring rationale: sketches learned
+        # by one session serve every session over the same store root
+        self.feedback = None
 
     def clear(self) -> None:
         with self.generic_lock:
@@ -92,6 +96,9 @@ class CacheScope:
         pool = self.bufferpool
         if pool is not None:
             out["bufferpool"] = pool.snapshot()
+        fb = self.feedback
+        if fb is not None:
+            out["feedback"] = fb.snapshot()
         return out
 
 

@@ -153,6 +153,16 @@ class Session:
         from cloudberry_tpu.parallel.topology import TopologyManager
 
         self._topology = TopologyManager(self)
+        # feedback-driven re-optimization (plan/feedback.py): learned
+        # per-(table, key-set) sketches folded from live motion stats.
+        # The store is scope-anchored (shared across sessions of a store
+        # root); the VIEW is stamped on the catalog so cost/memo code
+        # that only sees the catalog can consult sketches.
+        from cloudberry_tpu.plan import feedback as FB
+
+        fb_store = FB.store_for(self)
+        if fb_store is not None:
+            self.catalog._feedback = FB.FeedbackView(fb_store, self)
         # planck verifications still owed after a topology adoption
         # (config.topology.verify_replans): the first fresh plans after
         # a cutover run through the gate even when debug.verify_plans
@@ -303,9 +313,12 @@ class Session:
             (parallel/topology.py): the flip between plan and launch can
             surface as a shape/compile error rather than device loss,
             and re-dispatching at the new epoch IS the recovery."""
+            from cloudberry_tpu.exec.recovery import TileReplan
             from cloudberry_tpu.parallel.topology import \
                 TopologyRaceError
 
+            if isinstance(e, TileReplan):
+                return False  # the adaptive-replan loop in sql() owns it
             if recoverable(e) or isinstance(e, TopologyRaceError):
                 return True
             if isinstance(e, (lifecycle.StatementError,
@@ -348,29 +361,72 @@ class Session:
                     # a session must always be able to ROLLBACK out of
                     # an open transaction on a degraded engine
                     trial = self._breaker.check_write()
-                if h.retries <= 0 or not is_read:
-                    # DML/DDL/COPY are NOT retried: a device failure
-                    # striking after the host-side mutation would re-apply
-                    # the statement on retry (re-execution is only safe
-                    # when re-running cannot change state — the
-                    # reference's FTS likewise lets in-flight write
-                    # transactions abort rather than replay them)
-                    out = self._sql_once(query, **params)
-                else:
-                    def attempt():
-                        # a retried attempt is live again: the activity
-                        # row leaves 'recovering' when execution resumes
-                        if recoveries[0]:
-                            self.stmt_log.set_state(log_id, "running")
-                        return self._sql_once(query, **params)
+                # mid-statement adaptive replan (exec/tiled.py
+                # SkewSentinel): reads only — a write's tiled subplan
+                # must never restart after host-side mutation. The
+                # sentinel checks this flag (and its own per-handle
+                # replan budget) before raising TileReplan.
+                handle.adaptive_ok = is_read
+                from cloudberry_tpu.exec.recovery import TileReplan
+                adaptations = 0
+                while True:
+                    try:
+                        if h.retries <= 0 or not is_read:
+                            # DML/DDL/COPY are NOT retried: a device
+                            # failure striking after the host-side
+                            # mutation would re-apply the statement on
+                            # retry (re-execution is only safe when
+                            # re-running cannot change state — the
+                            # reference's FTS likewise lets in-flight
+                            # write transactions abort rather than
+                            # replay them)
+                            out = self._sql_once(query, **params)
+                        else:
+                            def attempt():
+                                # a retried attempt is live again: the
+                                # activity row leaves 'recovering' when
+                                # execution resumes
+                                if recoveries[0]:
+                                    self.stmt_log.set_state(
+                                        log_id, "running")
+                                return self._sql_once(query, **params)
 
-                    out = run_with_retry(
-                        attempt,
-                        retries=h.retries, backoff_s=h.backoff_s,
-                        on_retry=on_retry,
-                        max_backoff_s=h.backoff_max_s,
-                        budget_s=h.retry_budget_s,
-                        recoverable_fn=epoch_recoverable)
+                            out = run_with_retry(
+                                attempt,
+                                retries=h.retries,
+                                backoff_s=h.backoff_s,
+                                on_retry=on_retry,
+                                max_backoff_s=h.backoff_max_s,
+                                budget_s=h.retry_budget_s,
+                                recoverable_fn=epoch_recoverable)
+                        break
+                    except TileReplan as e:
+                        # NOT a failure (no probe, no backoff, no
+                        # breaker signal): the sentinel already folded
+                        # the observed sketch and force-checkpointed the
+                        # carried state. Evict the cached statement so
+                        # the immediate re-dispatch re-plans against the
+                        # fresh sketch, owe the plan verifier a pass on
+                        # whatever the re-plan produces, and re-run
+                        # under the SAME statement handle — the
+                        # replanned executable resumes from the
+                        # checkpoint (plan_signature excludes motion
+                        # choices by design).
+                        adaptations += 1
+                        if adaptations > self.config.feedback\
+                                .max_replans + 1:
+                            raise  # belt over the sentinel's budget
+                        with self._stmt_lock:
+                            self._stmt_cache.pop(
+                                self._stmt_cache_key(query, params),
+                                None)
+                        self._verify_next_plans = max(
+                            getattr(self, "_verify_next_plans", 0), 1)
+                        self.stmt_log.bump("adaptive_replans")
+                        self.stmt_log.set_state(log_id, "replanning")
+                        self.stmt_log.annotate(
+                            log_id, adaptive_skew=round(e.ratio, 2),
+                            replan_at_tile=e.tiles_done)
         except BaseException as e:
             # BaseException too: a Ctrl-C mid-statement must not leave a
             # phantom "running" entry in the shared active registry
@@ -986,17 +1042,25 @@ class Session:
         if entry is None:
             return None
         from cloudberry_tpu.exec.udf import registry_version
+        from cloudberry_tpu.plan.feedback import feedback_gen
 
-        names, versions, cfg, ddlv, runner, cost, obs_bytes = entry
+        names, versions, cfg, ddlv, runner, cost, obs_bytes, fbgen = \
+            entry
         # ddlv pairs the catalog DDL version with the UDF registry
         # version: re-registering a function must drop plans that baked
         # its OLD results in at bind time. The config IDENTITY check is
         # the config-epoch guard: any with_overrides/degrade_mesh swap
         # (n_segments, pallas, packed wire, ...) replaces the frozen tree
         # wholesale, so `is` catches every knob a program may have baked.
+        # fbgen is the feedback-store generation the plan was built
+        # against: a MATERIAL sketch fold (plan/feedback.py — new
+        # observation or >10% drift, never a steady-state re-fold) bumps
+        # it, so learned stats reach even statements the cache would
+        # otherwise pin to their first plan forever.
         stale = (cfg is not self.config
                  or ddlv != (self.catalog.ddl_version,
-                             registry_version()))
+                             registry_version())
+                 or fbgen != feedback_gen(self))
         if not stale:
             try:
                 stale = self._table_versions(names) != versions
@@ -1062,12 +1126,15 @@ class Session:
         flip between plan and cache must leave an entry the identity
         guard rejects, never one that serves a stale-epoch program."""
         from cloudberry_tpu.exec.udf import registry_version
+        from cloudberry_tpu.plan.feedback import feedback_gen
 
         entry = (
             names, self._table_versions(names),
             cfg if cfg is not None else self.config,
-            (self.catalog.ddl_version, registry_version()), runner, cost,
-            cost if obs_bytes is None else int(obs_bytes))
+            (self.catalog.ddl_version, registry_version()),
+            runner, cost,
+            cost if obs_bytes is None else int(obs_bytes),
+            feedback_gen(self))
         with self._stmt_lock:
             self._stmt_cache.pop(ckey, None)  # re-insert at the tail
             while len(self._stmt_cache) >= self._STMT_CACHE_MAX:
@@ -1132,16 +1199,30 @@ class Session:
         key = (query, self.config.n_segments,
                sharedcache.rung_scope_token(self),
                registry_version(), versions, self._motion_rung_sig(plan))
+        from cloudberry_tpu.exec.dist_executor import stat_node_ids
+
         with self._rung_lock:
-            fn = self._rung_cache.pop(key, None)
-            if fn is not None:
-                self._rung_cache[key] = fn  # LRU touch
-                return fn
+            ent = self._rung_cache.pop(key, None)
+            if ent is not None:
+                self._rung_cache[key] = ent  # LRU touch
+        if ent is not None:
+            fn, traced = ent
+            cur = stat_node_ids(plan)
+            if traced != cur \
+                    and tuple(map(len, traced)) == tuple(map(len, cur)):
+                # the program's telemetry keys embed the TRACED plan's
+                # node ids — alias this signature-equal plan's nodes to
+                # them so motion stats (and the feedback fold behind
+                # them) survive the cache hit
+                plan._stat_id_alias = {
+                    o: n for ts, cs in zip(traced, cur)
+                    for o, n in zip(ts, cs)}
+            return fn
         fn = compile_distributed(plan, self)
         with self._rung_lock:
             while len(self._rung_cache) >= self._RUNG_CACHE_MAX:
                 self._rung_cache.pop(next(iter(self._rung_cache)))
-            self._rung_cache[key] = fn
+            self._rung_cache[key] = (fn, stat_node_ids(plan))
         return fn
 
     def _verify_plan(self, plan, context: str) -> None:

@@ -1,0 +1,331 @@
+"""Feedback-driven re-optimization (plan/feedback.py) — ISSUE 17.
+
+The contract under test: motion telemetry from one execution folds into
+per-(table, key-set) sketches that (1) persist across sessions on
+store-backed scopes, (2) invalidate by construction on DML / config /
+topology token movement, (3) seed capacity rungs so the SECOND execution
+of a mis-estimated statement beats the first by at least one capacity
+rung — fewer recompiles on under-estimates, less padded wire on
+over-estimates — and (4) replan a tiled statement MID-STREAM through
+the checkpoint store when per-tile skew crosses the alarm, with results
+bit-identical to the in-memory run and every adapted plan verified.
+"""
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.plan import feedback as FB
+from cloudberry_tpu.utils import faultinject as FI
+
+JOIN_GROUP_Q = ("SELECT g, sum(v) AS sv, count(*) AS c "
+                "FROM fact JOIN dim ON fact.d = dim.d "
+                "GROUP BY g ORDER BY g")
+
+# selective probe filter: ~2% of rows survive, while the planner's
+# static selectivity guess prices the redistribute at a far higher rung
+FILTERED_Q = ("SELECT g, sum(v) AS sv, count(*) AS c "
+              "FROM fact JOIN dim ON fact.d = dim.d "
+              "WHERE fact.v < 2 GROUP BY g ORDER BY g")
+
+AGG_Q = "SELECT g, sum(v) AS sv, count(*) AS c FROM fact GROUP BY g ORDER BY g"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FI.reset_fault()
+    yield
+    FI.reset_fault()
+
+
+def _mk(budget=None, **extra):
+    ov = {"n_segments": 8,
+          # keep the small dim out of broadcast so the probe redistributes
+          "planner.broadcast_threshold": 0}
+    if budget is not None:
+        ov["resource.query_mem_bytes"] = budget
+    ov.update(extra)
+    return cb.Session(get_config().with_overrides(**ov))
+
+
+def _load(session, n_fact=120_000, n_dim=500, seed=3,
+          hot_key=None, hot_frac=0.0):
+    """fact JOIN dim on d, dim distributed on g != d so the probe side
+    redistributes. hot_key/hot_frac mis-state the d distribution."""
+    rng = np.random.default_rng(seed)
+    session.sql("CREATE TABLE dim (d BIGINT, g BIGINT) DISTRIBUTED BY (g)")
+    session.sql("CREATE TABLE fact (k BIGINT, d BIGINT, v BIGINT) "
+                "DISTRIBUTED BY (k)")
+    session.catalog.table("dim").set_data(
+        {"d": np.arange(n_dim), "g": np.arange(n_dim) % 9})
+    d = rng.integers(0, n_dim, n_fact)
+    if hot_key is not None:
+        d[rng.random(n_fact) < hot_frac] = hot_key
+    session.catalog.table("fact").set_data(
+        {"k": np.arange(n_fact) % 997, "d": d,
+         "v": rng.integers(0, 100, n_fact)})
+
+
+def _plan(session, q):
+    from cloudberry_tpu.plan.planner import plan_statement
+    from cloudberry_tpu.sql.parser import parse_sql
+
+    return plan_statement(parse_sql(q), session, {},
+                          explain_only=True).plan
+
+
+def _redists(plan):
+    """src -> PMotion for every learnable redistribute in the plan."""
+    from cloudberry_tpu.exec.executor import all_nodes
+    from cloudberry_tpu.plan import nodes as N
+
+    out = {}
+    for n in all_nodes(plan):
+        if isinstance(n, N.PMotion) and n.kind == "redistribute":
+            src = FB.resolve_sources(n.child, n.hash_keys)
+            if src is not None:
+                out[src] = n
+    return out
+
+
+# -------------------------------------------------------- fold + lookup
+
+
+def test_fold_from_execution_populates_store():
+    s = _mk()
+    _load(s, n_fact=60_000)
+    s.sql(JOIN_GROUP_Q)
+    store = FB.store_for(s)
+    snap = store.snapshot()
+    assert snap["sketches"] >= 1 and snap["folds"] >= 1
+    assert s.stmt_log.counter("feedback_folds") >= 1
+    # the probe-side shuffle's sketch is live and carries real telemetry
+    srcs = _redists(_plan(s, JOIN_GROUP_Q))
+    assert srcs, "join plan lost its learnable redistribute"
+    sk = next(filter(None, (store.lookup(s, "redist", src)
+                            for src in srcs)), None)
+    assert sk is not None
+    assert sk.demand_max > 0 and sk.rows_total > 0
+    assert sk.statements >= 1
+
+
+def test_steady_state_folds_do_not_churn_gen():
+    """Re-executions that reproduce their stats must not bump the store
+    generation — cached statements stay warm (no recompile churn)."""
+    s = _mk()
+    _load(s, n_fact=60_000)
+    s.sql(JOIN_GROUP_Q)                       # learn (material: new sketch)
+    s.sql(JOIN_GROUP_Q)                       # replan under the sketch
+    store = FB.store_for(s)
+    gen2, folds2 = store.gen, store.folds
+    compiles2 = s.stmt_log.counter("compiles")
+    s.sql(JOIN_GROUP_Q)                       # steady state
+    assert store.folds > folds2               # still learning...
+    assert store.gen == gen2                  # ...without churning the cache
+    assert s.stmt_log.counter("compiles") == compiles2
+
+
+# ------------------------------------------------ persistence + tokens
+
+
+def test_sketch_persistence_round_trip(tmp_path):
+    root = str(tmp_path / "store")
+    w = cb.Session(get_config().with_overrides(**{
+        "n_segments": 8, "storage.root": root}))
+    rng = np.random.default_rng(5)
+    w.sql("CREATE TABLE fact (k BIGINT, g BIGINT, v BIGINT) "
+          "DISTRIBUTED BY (k)")
+    w.catalog.table("fact").set_data({
+        "k": np.arange(60_000, dtype=np.int64) % 997,
+        "g": rng.integers(0, 9, 60_000).astype(np.int64),
+        "v": rng.integers(0, 100, 60_000).astype(np.int64)})
+    w.sql(AGG_Q)                      # two-stage agg: merge redistribute on g
+    assert (tmp_path / "store" / "_FEEDBACK.json").exists()
+
+    # a FRESH store over the same root re-loads the sketch, and its
+    # validity tokens still match a fresh session's view of the tables
+    s2 = cb.Session(get_config().with_overrides(**{
+        "n_segments": 8, "storage.root": root}))
+    st = FB.FeedbackStore(str(tmp_path / "store" / "_FEEDBACK.json"))
+    srcs = _redists(_plan(s2, AGG_Q))
+    assert srcs
+    sk = next(filter(None, (st.lookup(s2, "redist", src)
+                            for src in srcs)), None)
+    assert sk is not None and sk.demand_max > 0
+
+    # config swaps that change what the observation MEANS invalidate:
+    # same root, different capacity factor -> every lookup misses
+    s3 = cb.Session(get_config().with_overrides(**{
+        "n_segments": 8, "storage.root": root,
+        "interconnect.capacity_factor": 9.5}))
+    st2 = FB.FeedbackStore(str(tmp_path / "store" / "_FEEDBACK.json"))
+    assert all(st2.lookup(s3, "redist", src) is None for src in srcs)
+
+
+def test_invalidation_on_dml_and_topology(monkeypatch):
+    s = _mk()
+    _load(s, n_fact=60_000)
+    s.sql(JOIN_GROUP_Q)
+    store = FB.store_for(s)
+    srcs = list(_redists(_plan(s, JOIN_GROUP_Q)))
+    live = [src for src in srcs
+            if store.lookup(s, "redist", src) is not None]
+    assert live
+
+    # topology epoch flip: every sketch folded under the old epoch drops
+    from cloudberry_tpu.sched import sharedcache as SC
+    real = SC.topology_token
+    monkeypatch.setattr(SC, "topology_token", lambda sess: ("epoch", -1))
+    assert all(store.lookup(s, "redist", src) is None for src in srcs)
+    monkeypatch.setattr(SC, "topology_token", real)
+
+    # sketches re-learn (the rung-program cache hit must not drop the
+    # telemetry), then a DML version bump invalidates — scoped to the
+    # written table: dim's sketches survive a write to fact
+    s.sql(JOIN_GROUP_Q)
+    assert any(store.lookup(s, "redist", src) is not None for src in live)
+    t = s.catalog.table("fact")
+    t.set_data({c: t.to_pandas()[c].to_numpy() for c in ("k", "d", "v")})
+    fact_srcs = [src for src in live
+                 if any(tab == "fact" for tab, _ in src)]
+    dim_srcs = [src for src in live
+                if all(tab == "dim" for tab, _ in src)]
+    assert fact_srcs and dim_srcs
+    assert all(store.lookup(s, "redist", src) is None for src in fact_srcs)
+    assert any(store.lookup(s, "redist", src) is not None
+               for src in dim_srcs)
+
+
+def test_planck_mutation_class_registered():
+    """The mutation fuzzer carries a forged-feedback-rung class; the
+    planverify suite executes it — pin the registration here."""
+    from cloudberry_tpu.plan.mutate import MUTATIONS
+
+    _, _, expected = MUTATIONS["feedback-rung-forged"]
+    assert "motion-rung-feedback-forged" in expected
+
+
+# ------------------------------------------- acceptance: second execution
+
+
+def test_second_execution_downgrades_rung_and_wire():
+    """Over-stated demand (selective filter the static estimate misses):
+    run 2 plans the probe redistribute at least one capacity rung BELOW
+    run 1's, with strictly less padded wire — and every feedback-seeded
+    plan passes the planck verifier (debug.verify_plans on)."""
+    from cloudberry_tpu.obs import capacity as CAP
+
+    s = _mk(**{"debug.verify_plans": True})
+    _load(s)
+    p1 = _plan(s, FILTERED_Q)
+    b1 = CAP.plan_device_bytes(p1, s)
+    got1 = s.sql(FILTERED_Q).to_pandas()
+
+    p2 = _plan(s, FILTERED_Q)
+    b2 = CAP.plan_device_bytes(p2, s)
+    assert s.stmt_log.counter("feedback_seeded") >= 1
+    assert s.stmt_log.counter("rung_downgrades") >= 1
+    assert b2["wire_bytes"] < b1["wire_bytes"]
+
+    # the seeded motion sits >= one pow2 rung under its static rung
+    r1, r2 = _redists(p1), _redists(p2)
+    seeded = {src: m for src, m in r2.items()
+              if getattr(m, "_feedback_seed", None) is not None}
+    assert seeded
+    assert any(2 * m.bucket_cap <= r1[src].bucket_cap
+               for src, m in seeded.items() if src in r1)
+    assert "feedback:" in s.explain(FILTERED_Q)
+
+    got2 = s.sql(FILTERED_Q).to_pandas()
+    assert got1.equals(got2)
+
+
+def test_second_execution_upgrade_saves_recompiles():
+    """Under-stated skew (a projection hides the base scan from the
+    exact bucket sizer and a hot key blows through the fair-share
+    estimate — the PR-8 promotion workload): run 1 pays the overflow
+    grow-and-retry recompile; run 2 seeds the rung at observed demand
+    and compiles strictly fewer programs."""
+    s = _mk(**{"planner.runtime_filter_threshold": 0})
+    s.sql("CREATE TABLE j1 (a BIGINT, key BIGINT) DISTRIBUTED BY (a)")
+    s.sql("CREATE TABLE j2 (b BIGINT, key BIGINT, w BIGINT) "
+          "DISTRIBUTED BY (b)")
+    n = 2000
+    s.catalog.table("j1").set_data({
+        "a": np.arange(n, dtype=np.int64),
+        "key": np.where(np.arange(n) < 1500, 0, np.arange(n))})
+    s.catalog.table("j2").set_data({
+        "b": np.arange(n, dtype=np.int64),
+        "key": np.arange(n, dtype=np.int64),
+        "w": np.arange(n, dtype=np.int64)})
+    q = ("SELECT sum(j2.w) AS sw FROM (SELECT key AS kk FROM j1) x "
+         "JOIN j2 ON kk = j2.key")
+
+    c0 = s.stmt_log.counter("compiles")
+    got1 = s.sql(q).to_pandas()
+    c1 = s.stmt_log.counter("compiles")
+    assert s.growth_events >= 1, "run 1 should have overflowed the rung"
+    assert c1 - c0 >= 2, "run 1 should have paid an overflow recompile"
+
+    grown = s.growth_events
+    got2 = s.sql(q).to_pandas()
+    c2 = s.stmt_log.counter("compiles")
+    assert got1.equals(got2)
+    assert c2 - c1 < c1 - c0            # fewer recompiles than run 1
+    assert s.growth_events == grown     # and no overflow at all
+    assert s.stmt_log.counter("rung_upgrades") >= 1
+
+
+# --------------------------------------- acceptance: mid-statement replan
+
+
+@pytest.fixture(scope="module")
+def adaptive_expected():
+    s = _mk()
+    _load(s, n_fact=400_000, hot_key=7, hot_frac=0.85)
+    return s.sql(JOIN_GROUP_Q).to_pandas()
+
+
+def test_midstatement_adaptive_replan(adaptive_expected):
+    """A tiled-dist statement whose cumulative redistribute skew crosses
+    the alarm checkpoints, replans through the memo with the partial
+    sketch, and resumes — bit-identical to the in-memory run, with the
+    adapted plan planck-verified (debug.verify_plans on)."""
+    s = _mk(budget=2 << 20, **{"debug.verify_plans": True})
+    _load(s, n_fact=400_000, hot_key=7, hot_frac=0.85)
+    got = s.sql(JOIN_GROUP_Q).to_pandas()
+    assert adaptive_expected.equals(got)
+
+    assert s.stmt_log.counter("tile_replans") == 1
+    assert s.stmt_log.counter("adaptive_replans") == 1
+    assert s.stmt_log.counter("tile_checkpoints") >= 1
+    assert s.stmt_log.counter("tile_resumes") >= 1
+    assert s.stmt_log.counter("feedback_folds") >= 2   # partial + final
+    rep = s.last_tiled_report
+    assert rep["tiled"] and rep["distributed"] and rep["n_tiles"] > 1
+
+
+def test_fault_skip_suppresses_adaptation(adaptive_expected):
+    """Chaos arm: a skipped tile_replan fault point disarms adaptation
+    for the statement — the static plan finishes, results unchanged."""
+    FI.inject_fault("tile_replan", action="skip")
+    s = _mk(budget=2 << 20)
+    _load(s, n_fact=400_000, hot_key=7, hot_frac=0.85)
+    got = s.sql(JOIN_GROUP_Q).to_pandas()
+    assert adaptive_expected.equals(got)
+    assert s.stmt_log.counter("tile_replans") == 0
+    assert s.stmt_log.counter("adaptive_replans") == 0
+
+
+# ---------------------------------------------------------- bench surface
+
+
+def test_bench_surfaces_adaptive_counters():
+    import bench
+    from tools import serve_bench as SB
+
+    header = SB.CSV_HEADER.split(",")
+    assert "adaptive_replans" in header and "rung_downgrades" in header
+    assert callable(bench.adaptive_context)
+    assert "feedback_fold" in FI.INVENTORY and "tile_replan" in FI.INVENTORY

@@ -291,9 +291,11 @@ def hot_point(sf: float, root: str | None = None,
     read+decode) with the pool pass on the SAME container: rows/s
     each, the pool pass's hit rate and host-decode count (the ZERO
     claim, pinned by counters rather than clocks), and bit identity
-    between the passes. ``pool_bytes`` defaults far above any live SF
-    here — this record measures hit-rate behavior, not budget
-    pressure (tests/test_bufferpool.py owns the eviction story)."""
+    between the passes. ``pool_bytes`` must exceed the SF's decoded
+    working set (the 1 GiB default covers SF1, NOT SF10 — pass
+    ``--pool-bytes`` there) — this record measures hit-rate behavior,
+    not budget pressure (tests/test_bufferpool.py owns the eviction
+    story)."""
     own = root is None
     root = root or tempfile.mkdtemp(prefix="cbtpu_scanhot_")
     try:
@@ -360,11 +362,17 @@ def main(argv=None) -> int:
                     help="emit ONE hot_point record (second-pass HBM "
                          "buffer-pool hit rate) to this file — how an "
                          "SF10 pool point gets committed on hardware")
+    ap.add_argument("--pool-bytes", type=int, default=1 << 30,
+                    help="bufferpool.max_bytes for --hot-json; must "
+                         "exceed the SF's decoded working set or the "
+                         "record measures eviction, not hit rate "
+                         "(SF10 needs ~8 GiB)")
     args = ap.parse_args(argv)
 
     if args.hot_json:
         rec = hot_point(args.sf, root=args.root, budget=args.budget,
-                        seed=args.seed, chunk_rows=args.chunk_rows)
+                        seed=args.seed, chunk_rows=args.chunk_rows,
+                        pool_bytes=args.pool_bytes)
         rec["measured_utc"] = time.strftime("%Y-%m-%d", time.gmtime())
         with open(args.hot_json, "w") as f:
             json.dump(rec, f, indent=1)

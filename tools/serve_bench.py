@@ -101,7 +101,12 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               # decode count — under --mix hotcold the hot set's
               # repeats are served from device memory, so decodes
               # track the COLD set only
-              "bufpool_hit_rate,host_decodes")
+              "bufpool_hit_rate,host_decodes,"
+              # ISSUE 17 (feedback-driven re-optimization):
+              # mid-statement adaptive replans taken over the window
+              # and capacity rungs the learned sketches priced down
+              # from the static estimate on repeat statements
+              "adaptive_replans,rung_downgrades")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -479,6 +484,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     bh_before = session.stmt_log.counter("bufpool_hits")
     bm_before = session.stmt_log.counter("bufpool_misses")
     hd_before = session.stmt_log.counter("host_decodes")
+    ar_before = session.stmt_log.counter("adaptive_replans")
+    rd_before = session.stmt_log.counter("rung_downgrades")
 
     _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
                     "SchedDeadline")
@@ -678,6 +685,14 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     bm = disp.counter("bufpool_misses") - bm_before
     out["bufpool_hit_rate"] = round(bh / (bh + bm), 4) if bh + bm else 0.0
     out["host_decodes"] = disp.counter("host_decodes") - hd_before
+    # feedback-driven re-optimization columns (ISSUE 17): mid-statement
+    # adaptive replans taken over the window, and capacity rungs the
+    # learned sketches priced DOWN from the static estimate (the wire /
+    # padding saving the feedback loop bought on repeat statements)
+    out["adaptive_replans"] = (disp.counter("adaptive_replans")
+                               - ar_before)
+    out["rung_downgrades"] = (disp.counter("rung_downgrades")
+                              - rd_before)
     if mix == "hotcold":
         out.update(_hotcold_probe(session))
     _cleanup()
