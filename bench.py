@@ -378,6 +378,44 @@ def bufferpool_context() -> dict:
     return rec
 
 
+def writepath_context() -> dict:
+    """The streaming-ingest + compaction record (ISSUE 18): one short
+    serve_bench ``--mix readwrite`` closed loop (3 reads : 1 wire append
+    per client) with the background compaction service folding the delta
+    debt live, next to its ``--no-compact`` A/B baseline (same loop and
+    append share, debt left unfolded). ``read_qps_held`` is the
+    acceptance ratio — reads under compaction vs reads with the debt
+    accumulating — and ``delta_parts_max`` vs the baseline's shows the
+    bounded-delta invariant doing its job."""
+    rec: dict = {}
+    try:
+        from tools import serve_bench
+
+        on = serve_bench.run_mode("direct", "readwrite", clients=4,
+                                  duration_s=1.5, rows=20_000,
+                                  tick_s=0.002, max_batch=8)
+        off = serve_bench.run_mode("direct", "readwrite", clients=4,
+                                   duration_s=1.5, rows=20_000,
+                                   tick_s=0.002, max_batch=8,
+                                   compact_off=True)
+        rec = {
+            "qps": on["qps"],
+            "read_qps": on["_read_qps"],
+            "ingest_qps": on["ingest_qps"],
+            "flush_ms_p95": on["flush_ms_p95"],
+            "compact_chunks": on["compact_chunks"],
+            "delta_parts_max": on["delta_parts_max"],
+            "nocompact_read_qps": off["_read_qps"],
+            "nocompact_delta_parts_max": off["delta_parts_max"],
+            "read_qps_held": round(
+                on["_read_qps"] / max(off["_read_qps"], 1e-9), 4),
+            "provenance": "live",
+        }
+    except Exception as e:  # the bench must never die on its metadata
+        rec["error"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
 def lint_context() -> dict:
     """The static-analysis record next to the perf ones: graftlint's
     verdict on the CURRENT tree (rule counts, suppression count, files)
@@ -738,6 +776,7 @@ def replay_last_good(reason: str) -> None:
             "adaptive": adaptive_context(),
             "scan_ladder": scan_ladder_context(),
             "bufferpool": bufferpool_context(),
+            "writepath": writepath_context(),
         })
     except Exception:
         emit({
@@ -753,6 +792,7 @@ def replay_last_good(reason: str) -> None:
             "adaptive": adaptive_context(),
             "scan_ladder": scan_ladder_context(),
             "bufferpool": bufferpool_context(),
+            "writepath": writepath_context(),
         })
 
 
@@ -972,6 +1012,7 @@ def measure() -> None:
         "adaptive": adaptive,
         "scan_ladder": scan_ladder_context(),
         "bufferpool": bufferpool_context(),
+        "writepath": writepath_context(),
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })

@@ -567,6 +567,51 @@ class DebugConfig:
 
 
 @dataclass(frozen=True)
+class IngestConfig:
+    """Streaming ingest plane (storage/ingest.py): per-(table, tenant)
+    buffers batching wire appends into micro-partition-sized commits —
+    the AO-table small-write absorber. Durability is acknowledged only
+    when the covering flush commits through the one SQL write path."""
+
+    enabled: bool = True
+    # Pending rows that trip an immediate (size-threshold) flush.
+    flush_rows: int = 512
+    # Oldest-pending-row age (milliseconds) that trips an age flush —
+    # the commit-latency bound a trickle writer sees.
+    flush_ms: float = 25.0
+    # Per-buffer pending-row cap; past it append refuses with the
+    # retryable IngestQueueFull (write backpressure, not data loss).
+    max_buffered_rows: int = 8192
+
+
+@dataclass(frozen=True)
+class CompactConfig:
+    """Background compaction service (storage/compact.py): the VACUUM
+    analog for store-backed tables — merges delta partitions (including
+    the rebalancer's destination-tagged ones), applies delete vectors,
+    re-sorts toward the table's partition column, and re-packs toward
+    rows_per_partition. OFF by default: a plain session/server pays
+    nothing; the ingest-heavy deployment opts in."""
+
+    enabled: bool = False
+    # Seconds the worker sleeps between scans when nothing is due
+    # (commits wake it immediately via IngestService.on_commit).
+    interval_s: float = 2.0
+    # Sleep between chunks — the background throttle (foreground reads
+    # outrank the rewrite; the acceptance bench pins the QPS hold).
+    throttle_s: float = 0.0
+    # Source partitions merged per chunk (one OCC commit per chunk).
+    chunk_partitions: int = 8
+    # The bounded-delta invariant: a table whose delta-partition count
+    # (dirty parts + mergeable small tails) exceeds this is compacted
+    # back toward 0 (hysteresis: once triggered, drive to clean).
+    max_delta_parts: int = 8
+    # A clean partition counts as a mergeable small tail below
+    # target_fill * storage.rows_per_partition live rows.
+    target_fill: float = 0.5
+
+
+@dataclass(frozen=True)
 class Config:
     n_segments: int = 1
     # Per-statement wall-clock limit in seconds (the statement_timeout
@@ -593,6 +638,8 @@ class Config:
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     topology: TopologyConfig = field(default_factory=TopologyConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
+    compact: CompactConfig = field(default_factory=CompactConfig)
     debug: DebugConfig = field(default_factory=DebugConfig)
 
     def with_overrides(self, **kv: Any) -> "Config":

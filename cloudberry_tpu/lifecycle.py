@@ -92,6 +92,15 @@ class ServerBusy(StatementError):
     retryable = True
 
 
+class IngestQueueFull(StatementError):
+    """The streaming ingest buffer for a (table, tenant) is at its
+    ``config.ingest.max_buffered_rows`` cap — pure write backpressure,
+    the SchedQueueFull analog for the append plane: back off and retry
+    once a flush drains the buffer."""
+
+    retryable = True
+
+
 # errors raised OUTSIDE this module that belong to the retryable side:
 # the dispatcher's backpressure/deadline pair (sched/dispatcher.py) and
 # the per-tenant admission refusal (exec/resource.py TenantQueueFull)
@@ -99,7 +108,8 @@ class ServerBusy(StatementError):
 _RETRYABLE_NAMES = frozenset({
     "StatementTimeout", "ServerDraining", "BreakerOpen",
     "SchedQueueFull", "SchedDeadline",
-    "TenantQueueFull", "ServerBusy",
+    "TenantQueueFull", "ServerBusy", "IngestQueueFull",
+    "CompactionError",
 })
 
 

@@ -32,6 +32,7 @@ CONCURRENT_CLASSES = frozenset({
     "AdmissionGate", "VmemTracker", "QueueManager", "_Conn", "_IOLoop",
     "MetricsRegistry", "StatementStats", "Trace", "Progress",
     "TopologyManager", "ScanPipeline", "BufferPool", "FeedbackStore",
+    "IngestService", "CompactionService",
 })
 
 # attribute-name → class-name hints for cross-class lock edges: when a
@@ -78,6 +79,12 @@ ATTR_CLASS_HINTS = {
     # it through these names while cache-tier locks may be held
     "feedback": "FeedbackStore",
     "_feedback_store": "FeedbackStore",
+    # write plane (ISSUE 18): the server and capacity gauges reach the
+    # ingest buffers / compactor through these names
+    "ingest": "IngestService",
+    "_ingest": "IngestService",
+    "compactor": "CompactionService",
+    "_compactor": "CompactionService",
 }
 
 # modules (repo-relative path suffixes) whose jitted / kernel functions
@@ -104,6 +111,8 @@ SEAM_LOOP_MODULES = (
     "exec/tiled_dist.py",
     "exec/recovery.py",
     "exec/scanpipe.py",
+    "storage/ingest.py",
+    "storage/compact.py",
 )
 
 # calls that count as a cancellation seam inside a loop body
@@ -153,12 +162,18 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
     # lock: pin/cutover capture state under it, release, then adopt)
     ("Dispatcher._cond", "Session._sync_lock", "TopologyManager._lock"),
     # rank 2 — tenancy / breaker / cache-tier locks (Dispatcher._cond
-    # and Session._sync_lock callers nest into these)
+    # and Session._sync_lock callers nest into these). The write-plane
+    # conditions live here too: both are NEVER held across a flush /
+    # SQL / the store lock (batches are taken under the condition,
+    # executed outside it), never nested with each other (the
+    # on_commit → wake call runs outside both), and only counter bumps
+    # (rank-4 MetricsRegistry) happen while held.
     ("TenantScheduler._lock", "CircuitBreaker._lock",
      "CacheScope.generic_lock", "CacheScope.rung_lock",
      "CacheScope.joinindex_lock", "RecoveryStore._lock",
      "AdmissionGate._lock", "VmemTracker._cond", "QueueManager._cond",
-     "Session._stmt_lock"),
+     "Session._stmt_lock", "IngestService._cond",
+     "CompactionService._cond"),
     # rank 3 — accounting taken while cache locks are held (the
     # compile-counter bump inside a generic-plan build holds
     # generic_lock → StatementLog._lock; plan-local rung growth nests

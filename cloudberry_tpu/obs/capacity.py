@@ -261,6 +261,16 @@ def refresh_gauges(session) -> dict:
             reb["fraction"] if reb else 1.0)
         vals["topo_moved_bytes"] = float(
             log.counter("topo_moved_bytes"))
+    # write plane (storage/ingest.py + storage/compact.py): host bytes
+    # parked in ingest buffers awaiting group commit, and the worst
+    # per-table delta-partition count from the compactor's last pass —
+    # the bounded-invariant needle
+    ing = getattr(session, "_ingest", None)
+    if ing is not None:
+        vals["mem_ingest_buffer_bytes"] = ing.buffered_bytes()
+    comp = getattr(session, "_compactor", None)
+    if comp is not None:
+        vals["compact_delta_parts_max"] = comp.delta_parts_gauge()
     for name, v in vals.items():
         log.registry.gauge(name, v)
     return vals

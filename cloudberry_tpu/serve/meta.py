@@ -46,7 +46,7 @@ def describe(session, kind: str, arg=None):
     """One metadata answer. Kinds: tables | columns | stats | views |
     matviews | sequences | info | activity | sched | tenants |
     metrics | statements | trace | progress | flight | topology |
-    summary.
+    ingest | compaction | summary.
 
     (graftlint's ``obs-meta-verbs`` rule pins this docstring list to the
     implemented kinds BOTH ways — document new verbs here.)"""
@@ -169,6 +169,24 @@ def describe(session, kind: str, arg=None):
         out = topo.snapshot()
         out["enabled"] = True
         return out
+    if kind == "ingest":
+        # streaming ingest plane (storage/ingest.py): buffer occupancy
+        # per (table, tenant), flush thresholds, drain state, and the
+        # append/flush/backpressure counter story — the write-plane
+        # half of the AO-table dashboard
+        ing = getattr(session, "_ingest", None)
+        if ing is None:
+            return {"enabled": False}
+        return ing.snapshot()
+    if kind == "compaction":
+        # background compaction (storage/compact.py): per-table
+        # delta-partition census against the bounded invariant, worker
+        # state, and the chunk/conflict/journal counters — the VACUUM
+        # progress role
+        comp = getattr(session, "_compactor", None)
+        if comp is None:
+            return {"enabled": False}
+        return comp.snapshot()
     if kind == "statements":
         # pg_stat_statements analog (obs/statements.py): per-skeleton
         # calls / wall / rows / compiles / generic-hit rate / wire

@@ -225,6 +225,28 @@ class Server:
             self.dispatcher = Dispatcher(self.session,
                                          exec_scope=self._locked,
                                          tenancy=self.tenancy)
+        # streaming ingest plane (storage/ingest.py): ONE service on the
+        # SERVER session in both sharing modes — group commit must span
+        # connections (per-connection backends see the flushed commits
+        # through the store's epoch sync like any other writer's)
+        self.ingest = None
+        if self._config.ingest.enabled:
+            from cloudberry_tpu.storage.ingest import IngestService
+
+            self.ingest = IngestService(self.session,
+                                        exec_scope=self._locked)
+            self.session._ingest = self.ingest
+        # background compaction (storage/compact.py): opt-in (a read-
+        # mostly server pays nothing) and store-backed only; committed
+        # ingest flushes poke it so write bursts fold promptly
+        self.compactor = None
+        if self._config.compact.enabled and self.session.store is not None:
+            from cloudberry_tpu.storage.compact import CompactionService
+
+            self.compactor = CompactionService(self.session)
+            self.session._compactor = self.compactor
+            if self.ingest is not None:
+                self.ingest.on_commit = self.compactor.wake
 
     # -------------------------------------------------- lifecycle plumbing
 
@@ -403,6 +425,11 @@ class Server:
         # ENGINE's held checkpoints, not each backend's (statement ids
         # come from the shared stmt_log, so keys never collide)
         s._recovery = self.session._recovery
+        # write-plane services live on the server session (group commit
+        # and the compaction census span backends); meta "ingest" /
+        # "compaction" answered by any backend must see them
+        s._ingest = getattr(self.session, "_ingest", None)
+        s._compactor = getattr(self.session, "_compactor", None)
         # memory-gauge anchor (obs/capacity.refresh_gauges): session-
         # private holders (stmt/store-scan caches) report the SERVING
         # session's, not whichever backend answered meta "metrics" —
@@ -431,6 +458,8 @@ class Server:
             self.cron.start()
         if self.dispatcher is not None:
             self.dispatcher.start()
+        if self.compactor is not None and not self.read_only:
+            self.compactor.start()
         self.watchdog.start()
         return self
 
@@ -473,6 +502,13 @@ class Server:
         self.cron.stop()
         if self.dispatcher is not None:
             self.dispatcher.stop()
+        if self.ingest is not None:
+            # drain flush-on-stop: buffered rows whose appenders are
+            # still blocked commit now (their acks turn true), and the
+            # append verb has been refusing since _draining flipped
+            self.ingest.stop()
+        if self.compactor is not None:
+            self.compactor.stop()
         self.watchdog.stop()
         self._transport.stop()
 
@@ -575,6 +611,34 @@ class Server:
             out["rows"] = [[_json_safe(v) for v in row]
                            for row in out["rows"]]
             return {"ok": True, **out}
+        if "append" in req:
+            # streaming ingest verb: rows buffer server-side and the
+            # response is written only when the covering flush COMMITS
+            # (durability-at-ack, same contract as a successful INSERT).
+            # Works on both transports — the handler blocks for at most
+            # the flush latency, which is the point of group commit.
+            a = req["append"]
+            if not isinstance(a, dict) or "table" not in a \
+                    or "rows" not in a:
+                return {"ok": False, "retryable": False,
+                        "error": "append needs "
+                                 "{table, rows[, columns]}"}
+            if self.read_only:
+                return {"ok": False, "etype": "ReadOnlyError",
+                        "retryable": False,
+                        "error": "read-only standby: route appends to "
+                                 "the primary server"}
+            if self.ingest is None:
+                return {"ok": False, "etype": "IngestDisabled",
+                        "retryable": False,
+                        "error": "streaming ingest is disabled "
+                                 "(config.ingest.enabled)"}
+            dl = req.get("deadline_s")
+            n = self.ingest.append(
+                a["table"], a["rows"], columns=a.get("columns"),
+                tenant=req.get("tenant"),
+                deadline_s=float(dl) if dl is not None else None)
+            return {"ok": True, "status": f"APPEND {n}", "rows": n}
         sql = req.get("sql")
         if not isinstance(sql, str):
             return {"ok": False, "retryable": False,

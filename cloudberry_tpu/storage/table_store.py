@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 import uuid
 from dataclasses import dataclass
 from typing import Optional
@@ -73,6 +74,12 @@ class TableStore:
         # repeatable reads even while OTHER sessions commit (the
         # distributed-snapshot discipline, cdbdistributedsnapshot.c)
         self.pinned: dict[str, int] = {}
+        # intra-process writer exclusion (see lock()): the O_EXCL file
+        # only arbitrates between PROCESSES; threads sharing this store
+        # object (the ingest flusher, the compaction worker, statement
+        # threads) serialize here first
+        self._tlock = threading.Lock()
+        self._lock_owner: Optional[int] = None
 
     # ------------------------------------------------- session transactions
 
@@ -286,44 +293,60 @@ class TableStore:
     # ---------------------------------------------- inter-process write lock
 
     def lock(self, timeout_s: float = 30.0):
-        """Store-wide mutual exclusion across PROCESSES (O_EXCL lock file):
-        held around version-check-then-commit so two committers can never
-        both pass the OCC check and overwrite each other. Re-entrant within
-        one store object."""
+        """Store-wide mutual exclusion: _tlock serializes the THREADS
+        sharing this store object (ingest flusher, compaction worker,
+        statement threads), the O_EXCL lock file serializes PROCESSES.
+        Held around version-check-then-commit so two committers can never
+        both pass the OCC check and overwrite each other. Re-entrant
+        within one thread — a boolean "am I inside?" flag is NOT enough
+        here: it is readable by sibling threads, and a sibling that
+        treated the holder's flag as its own re-entrancy would walk
+        straight into the critical section and tear the v{N}.json both
+        would then write."""
         import contextlib
         import time as _time
 
         @contextlib.contextmanager
         def _locked():
-            if getattr(self, "_lock_held", False):
+            me = threading.get_ident()
+            if self._lock_owner == me:
                 yield
                 return
             from cloudberry_tpu.utils.faultinject import fault_point
 
             fault_point("store_lock_acquire")
-            path = os.path.join(self.root, "_LOCK")
-            deadline = _time.monotonic() + timeout_s
-            while True:
-                try:
-                    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                    os.write(fd, str(os.getpid()).encode())
-                    os.close(fd)
-                    break
-                except FileExistsError:
-                    if _time.monotonic() > deadline:
-                        raise RuntimeError(
-                            f"store lock timeout after {timeout_s}s — if no "
-                            f"writer is alive, remove stale {path}")
-                    _time.sleep(0.01)
-            self._lock_held = True
+            if not self._tlock.acquire(timeout=timeout_s):
+                raise RuntimeError(
+                    f"store lock timeout after {timeout_s}s — another "
+                    "thread of this process is holding the store lock")
             try:
-                yield
-            finally:
-                self._lock_held = False
+                path = os.path.join(self.root, "_LOCK")
+                deadline = _time.monotonic() + timeout_s
+                while True:
+                    try:
+                        fd = os.open(path,
+                                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                        os.write(fd, str(os.getpid()).encode())
+                        os.close(fd)
+                        break
+                    except FileExistsError:
+                        if _time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"store lock timeout after {timeout_s}s — "
+                                "if no writer is alive, remove stale "
+                                f"{path}")
+                        _time.sleep(0.01)
+                self._lock_owner = me
                 try:
-                    os.unlink(path)
-                except FileNotFoundError:
-                    pass
+                    yield
+                finally:
+                    self._lock_owner = None
+                    try:
+                        os.unlink(path)
+                    except FileNotFoundError:
+                        pass
+            finally:
+                self._tlock.release()
 
         return _locked()
 
@@ -458,19 +481,30 @@ class TableStore:
 
     def delete_rows(self, table: str, pred) -> int:
         """Mark rows deleted (visimap-style) where pred(columns)->bool mask;
-        pred receives decoded per-partition columns. Returns new version."""
-        man = self.read_manifest(table)
-        schema = Schema(tuple(mp._field_from_json(j) for j in man["schema"]))
-        tdir = os.path.join(self.root, table)
-        for part in man["partitions"]:
-            cols = mp.read_columns(os.path.join(tdir, part["file"]),
-                                    cipher=self.cipher)
-            mask = np.asarray(pred(cols))
-            if mask.any():
-                dead = set(part["deleted"]) | set(np.nonzero(mask)[0].tolist())
-                part["deleted"] = sorted(dead)
-        del schema
-        return self._commit(table, man)
+        pred receives decoded per-partition columns. Returns new version.
+
+        OCC like every other manifest writer: the per-partition masks are
+        computed outside the lock (file IO), and the commit only lands if
+        the manifest version is still the one that was read — a concurrent
+        append/compaction commit forces a re-read and re-apply, so neither
+        side's partitions are silently dropped (last-writer-wins on the
+        whole manifest was a lost-update bug under the write plane)."""
+        for _ in range(50):
+            man = self.read_manifest(table)
+            tdir = os.path.join(self.root, table)
+            for part in man["partitions"]:
+                cols = mp.read_columns(os.path.join(tdir, part["file"]),
+                                       cipher=self.cipher)
+                mask = np.asarray(pred(cols))
+                if mask.any():
+                    dead = set(part["deleted"]) \
+                        | set(np.nonzero(mask)[0].tolist())
+                    part["deleted"] = sorted(dead)
+            with self.lock():
+                if self.current_version(table) == man["version"]:
+                    return self._commit(table, man)
+        raise RuntimeError(
+            f"delete_rows({table!r}) kept losing the manifest OCC race")
 
     # --------------------------------------------------------------- reads
 

@@ -85,6 +85,13 @@ INVENTORY = frozenset({
     "exec_device_lost", "probe_degraded",
     # online topology changes (parallel/topology.py)
     "topo_rebalance_chunk", "topo_cutover", "topo_promote",
+    # write path (storage/ingest.py, storage/compact.py): 'error' on
+    # ingest_flush is device-loss-mid-flush — the WHOLE batch fails
+    # before any statement commits (no partial durability); 'hang' on
+    # compact_chunk wedges the worker cooperatively (cancel-mid-chunk);
+    # 'error' on compact_commit dies inside the locked commit window
+    # AFTER the new files exist — the crash-restart journal-resume case
+    "ingest_flush", "compact_chunk", "compact_commit",
 })
 
 _registry: dict[str, _Arm] = {}

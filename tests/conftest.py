@@ -133,6 +133,17 @@ _SLOW_TIER = (
     "test_hier_motion.py::test_tiled_dist_hier_parity",
     "test_hier_motion.py::test_host_rung_overflow_promotes_and_retries",
     "test_capacity_forensics.py::test_progress_monotone_degraded_8_to_7",
+    # round 18 (write-path suite joins tier-1): the two consumers of the
+    # module-scoped adaptive_expected fixture move together (the fixture
+    # build alone is ~39s; moving only one test would just shift it to
+    # the other) — the feedback plane keeps its tier-1 coverage via the
+    # fold/persistence/invalidation tests plus the rung-downgrade and
+    # bench-counter paths; the expand-cutover checkpoint-resume test
+    # keeps its cheaper cutover siblings (stale-nseg, epoch-pin,
+    # under-load cutover) in tier 1.
+    "test_feedback.py::test_midstatement_adaptive_replan",
+    "test_feedback.py::test_fault_skip_suppresses_adaptation",
+    "test_topology.py::test_checkpointed_statement_resumes_across_expand_cutover",
 )
 
 

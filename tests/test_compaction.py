@@ -1,0 +1,460 @@
+"""Background compaction service (storage/compact.py) — ISSUE 18.
+
+Pinned here:
+
+- the fold itself: delta partitions merge, delete vectors apply (a
+  rewritten partition carries none), rows re-pack toward
+  storage.rows_per_partition, and a declared range partition column
+  re-sorts merged rows toward scan order;
+- correctness: a compacted TPC-H store answers queries identically to
+  its un-compacted self — fresh readers, buffer pool on AND off, at 1
+  and 8 segments (the full query matrix runs in the slow tier, the
+  writer-session subset in tier 1);
+- the PR-13 fold: post-rebalance seg/seg_nseg-tagged delta partitions
+  converge to a clean manifest with tags preserved (merges never cross
+  destination groups) and results bit-identical;
+- chaos: cancel-mid-chunk aborts cooperatively at the chunk seam with a
+  consistent manifest; a crash inside the commit window leaves orphans
+  the restart journal deletes, then compaction converges; a seeded
+  fault soak with concurrent appends still lands the bounded
+  delta-partition invariant;
+- the version-bump contract: a compaction commit moves the table
+  version, so pooled/cached state invalidates by construction (same
+  answers through an enabled buffer pool before and after);
+- observability: meta "compaction", compact_* counters, the COMPACT
+  statement in the StatementLog, and the capacity gauge.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu import lifecycle
+from cloudberry_tpu.config import get_config
+from cloudberry_tpu.storage.compact import (
+    CompactionService, delta_parts)
+from cloudberry_tpu.storage.ingest import IngestService
+from cloudberry_tpu.utils import faultinject as FI
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FI.reset_fault()
+    yield
+    FI.reset_fault()
+
+
+RPP = 512
+
+
+def _store_session(tmp_path, nseg=1, n=4000, **ov):
+    over = {"n_segments": nseg, "storage.root": str(tmp_path),
+            "storage.rows_per_partition": RPP,
+            "ingest.flush_rows": 32, "ingest.flush_ms": 10.0}
+    over.update(ov)
+    s = cb.Session(get_config().with_overrides(**over))
+    s.sql("create table t (k bigint, v bigint) distributed by (k)")
+    t = s.catalog.table("t")
+    t.set_data({"k": np.arange(n, dtype=np.int64),
+                "v": (np.arange(n, dtype=np.int64) * 3) % 97}, {})
+    s._sync_store()
+    return s
+
+
+def _fragment(s, lo=100_000, batches=12, rows=16):
+    """Small appends (tiny tail partitions) + a visimap delete pass
+    (dirty partitions) — the debt compaction exists to fold."""
+    ing = IngestService(s)
+    for b in range(batches):
+        ing.append("t", [[lo + b * rows + j, 5] for j in range(rows)])
+    ing.stop()
+    s.store.delete_rows("t", lambda c: c["k"] % 11 == 3)
+    s._sync_store()
+
+
+_Q = "select count(*) as c, sum(v) as sv, min(k) as mn, max(k) as mx from t"
+
+
+def _census(s, name="t"):
+    return delta_parts(s.store.read_manifest(name), RPP, 0.5)
+
+
+# -------------------------------------------------------------- the fold
+
+
+def test_merge_applies_deletes_and_repacks(tmp_path):
+    s = _store_session(tmp_path)
+    _fragment(s)
+    before = s.sql(_Q).to_pandas()
+    rows_before = s.sql("select k, v from t order by k").to_pandas()
+    man0 = s.store.read_manifest("t")
+    assert _census(s) > 0
+    assert any(p["deleted"] for p in man0["partitions"])
+
+    comp = CompactionService(s)
+    out = comp.run_once(force=True)
+    assert out["chunks"] >= 1 and out["parts_merged"] >= 2
+
+    man = s.store.read_manifest("t")
+    assert _census(s) == 0, "compaction must drive the census to zero"
+    assert not any(p["deleted"] for p in man["partitions"]), \
+        "a rewritten partition carries no delete vector"
+    live = sum(p["num_rows"] for p in man["partitions"])
+    # re-packed: at most one under-filled tail remains
+    assert len(man["partitions"]) <= live // RPP + 1
+    assert before.equals(s.sql(_Q).to_pandas())
+    assert rows_before.equals(
+        s.sql("select k, v from t order by k").to_pandas())
+    # a FRESH session over the compacted store reads the same relation
+    s2 = cb.Session(get_config().with_overrides(
+        **{"storage.root": str(tmp_path)}))
+    assert rows_before.equals(
+        s2.sql("select k, v from t order by k").to_pandas())
+
+
+def test_resort_toward_declared_scan_order(tmp_path):
+    """With a range partition column declared, merged partitions come
+    out sorted by it — min/max stats tighten back to prunable."""
+    s = cb.Session(get_config().with_overrides(
+        **{"storage.root": str(tmp_path),
+           "storage.rows_per_partition": RPP}))
+    s.sql("create table t (k bigint, v bigint) "
+          "partition by range (k) (start 0 end 4000 every 1000)")
+    t = s.catalog.table("t")
+    rng = np.random.default_rng(7)
+    t.set_data({"k": rng.permutation(1000).astype(np.int64),
+                "v": np.arange(1000, dtype=np.int64)}, {})
+    s._sync_store()
+    # shuffled small appends: each tail is internally unsorted
+    ing = IngestService(s)
+    for b in range(6):
+        ks = rng.permutation(40) + 2000 + b * 100
+        ing.append("t", [[int(k), 1] for k in ks])
+    ing.stop()
+    before = {p["file"] for p in
+              s.store.read_manifest("t")["partitions"]}
+    CompactionService(s).run_once(force=True)
+    man = s.store.read_manifest("t")
+    from cloudberry_tpu.storage import micropartition as mp
+    import os
+    written = [p for p in man["partitions"] if p["file"] not in before]
+    assert written, "compaction must have rewritten the small tails"
+    for p in written:
+        cols = mp.read_columns(
+            os.path.join(s.store.root, "t", p["file"]), ["k"])
+        k = np.asarray(cols["k"])
+        assert np.all(k[:-1] <= k[1:]), \
+            f"partition {p['file']} not in scan order after compaction"
+    # the fold lost nothing: relation is the base + every appended key
+    got = s.sql("select count(*) c, sum(k) sk from t").to_pandas()
+    exp_k = int(np.arange(1000).sum()
+                + sum((np.arange(40) + 2000 + b * 100).sum()
+                      for b in range(6)))
+    assert int(got["c"][0]) == 1240 and int(got["sk"][0]) == exp_k
+
+
+def test_compaction_is_a_logged_statement(tmp_path):
+    s = _store_session(tmp_path)
+    _fragment(s, batches=6)
+    comp = CompactionService(s)
+    comp.run_once(force=True)
+    recent = s.stmt_log.recent(20)
+    compacts = [r for r in recent if r["sql"].startswith("COMPACT ")]
+    assert compacts and compacts[0]["status"] == "ok"
+    assert s.stmt_log.counter("compact_chunks") >= 1
+    snap = comp.snapshot()
+    assert snap["enabled"] and snap["chunks"] >= 1
+    assert any(row["table"] == "t" and row["delta_parts"] == 0
+               for row in snap["tables"])
+    # capacity gauge rides the last pass's census
+    from cloudberry_tpu.obs import capacity
+    s._compactor = comp
+    vals = capacity.refresh_gauges(s)
+    assert vals["compact_delta_parts_max"] == 0
+
+
+# ----------------------------------------------- PR-13 rebalance folding
+
+
+def test_post_rebalance_delta_partitions_converge(tmp_path):
+    """The satellite regression: an online expand leaves seg-tagged
+    delta partitions plus movement delete-vectors; compaction folds
+    BOTH to a clean manifest — tags preserved (merges never cross
+    destination groups), relation unchanged, fresh session identical."""
+    s = _store_session(tmp_path, nseg=4, n=5000)
+    rows_before = s.sql("select k, v from t order by k").to_pandas()
+    s._topology.online_resize(6)
+    man0 = s.store.read_manifest("t")
+    tagged0 = [p for p in man0["partitions"] if p.get("seg_nseg") == 6]
+    assert tagged0, "rebalance must leave destination-tagged deltas"
+    assert any(p["deleted"] for p in man0["partitions"])
+    assert _census(s) > 0
+
+    CompactionService(s).run_once(force=True)
+    man = s.store.read_manifest("t")
+    assert _census(s) == 0
+    assert not any(p["deleted"] for p in man["partitions"])
+    tagged = [p for p in man["partitions"] if p.get("seg_nseg") == 6]
+    # destination purity survives the fold: moved rows stay in tagged
+    # partitions, exactly as many live rows as before
+    assert sum(p["num_rows"] for p in tagged) \
+        == sum(p["num_rows"] - len(p["deleted"]) for p in tagged0)
+    for p in tagged:
+        assert 0 <= p["seg"] < 6
+    assert rows_before.equals(
+        s.sql("select k, v from t order by k").to_pandas())
+    s2 = cb.Session(get_config().with_overrides(
+        **{"n_segments": 6, "storage.root": str(tmp_path)}))
+    assert rows_before.equals(
+        s2.sql("select k, v from t order by k").to_pandas())
+
+
+# ----------------------------------------------------------------- chaos
+
+
+def test_cancel_mid_chunk(tmp_path):
+    """The pg_cancel_backend story holds for background work: a hang at
+    the chunk seam is cancellable via the StatementLog, the pass aborts
+    with a CONSISTENT manifest, and the next pass converges."""
+    s = _store_session(tmp_path)
+    _fragment(s)
+    before = s.sql(_Q).to_pandas()
+    comp = CompactionService(s)
+    FI.inject_fault("compact_chunk", "hang")
+
+    def canceller():
+        for _ in range(200):
+            act = [r for r in s.stmt_log.activity()
+                   if r["sql"].startswith("COMPACT ")]
+            if act:
+                assert s.stmt_log.cancel(act[0]["id"])
+                return
+            time.sleep(0.01)
+
+    bg = threading.Thread(target=canceller)
+    bg.start()
+    with pytest.raises(lifecycle.StatementCancelled):
+        comp.run_once(force=True)
+    bg.join()
+    FI.reset_fault()
+    assert before.equals(s.sql(_Q).to_pandas())
+    comp.run_once(force=True)
+    assert _census(s) == 0
+    assert before.equals(s.sql(_Q).to_pandas())
+
+
+def test_crash_restart_journal_resume(tmp_path):
+    """An 'error' inside the locked commit window dies AFTER the
+    replacement files exist: the journal's pending record survives, a
+    fresh service's restore() deletes exactly the never-committed
+    orphans, and the next pass converges with nothing lost."""
+    import os
+
+    s = _store_session(tmp_path)
+    _fragment(s)
+    before = s.sql(_Q).to_pandas()
+    comp = CompactionService(s)
+    FI.inject_fault("compact_commit", "error", start_hit=1, end_hit=1)
+    with pytest.raises(FI.InjectedFault):
+        comp.run_once(force=True)
+    FI.reset_fault()
+    rec = comp._read_journal(s.store)
+    assert rec["pending"] and rec["pending"]["table"] == "t"
+    orphans = [f for f in rec["pending"]["files"]
+               if os.path.exists(os.path.join(str(tmp_path), "t", f))]
+    assert orphans, "the crash left replacement files on disk"
+    man = s.store.read_manifest("t")
+    committed = {p["file"] for p in man["partitions"]}
+    assert not (set(orphans) & committed)
+
+    # crash-restart analog: a FRESH service restores from the journal
+    comp2 = CompactionService(s)
+    assert comp2._read_journal(s.store)["pending"] is None
+    for f in orphans:
+        assert not os.path.exists(os.path.join(str(tmp_path), "t", f))
+    assert s.stmt_log.counter("compact_journal_restores") == 1
+    assert before.equals(s.sql(_Q).to_pandas())
+    comp2.run_once(force=True)
+    assert _census(s) == 0
+    assert before.equals(s.sql(_Q).to_pandas())
+
+
+def test_fault_soak_holds_bounded_invariant(tmp_path):
+    """Seeded chunk faults + concurrent appends, then quiesce: the
+    bounded delta-partition invariant still lands and no row is lost —
+    the worker survives every injected error."""
+    s = _store_session(
+        tmp_path, **{"compact.interval_s": 0.05,
+                     "compact.max_delta_parts": 4})
+    comp = CompactionService(s)
+    comp.start()
+    FI.inject_fault("compact_chunk", "error", p=0.3, seed=1234)
+    ing = IngestService(s)
+    for b in range(20):
+        ing.append("t", [[200_000 + b * 8 + j, 2] for j in range(8)])
+        if b == 10:
+            s.store.delete_rows("t", lambda c: c["k"] % 13 == 5)
+    ing.stop()
+    time.sleep(0.3)
+    FI.reset_fault()
+    comp.wake()
+    time.sleep(0.3)
+    comp.stop()
+    final = comp.run_once()  # census-only unless debt remains
+    assert final["delta_parts_max"] <= comp.max_delta_parts
+    s._sync_store()
+    df = s.sql(_Q).to_pandas()
+    keep = np.arange(4000)[np.arange(4000) % 13 != 5]
+    app = np.arange(200_000, 200_160)
+    # the delete pass ran after batch 10: only the first 11 batches'
+    # rows (keys < 200_088) were durable — and deletable — then
+    app_live = app[~((app % 13 == 5) & (app < 200_088))]
+    assert int(df["c"][0]) == len(keep) + len(app_live)
+    assert int(df["sv"][0]) == int(((keep * 3) % 97).sum()) \
+        + 2 * len(app_live)
+
+
+def test_worker_defers_while_breaker_open(tmp_path):
+    s = _store_session(
+        tmp_path, **{"compact.interval_s": 0.05,
+                     "compact.max_delta_parts": 0})
+    _fragment(s, batches=4)
+    debt = _census(s)
+    assert debt > 0
+
+    class _Breaker:
+        state = "open"
+
+    s._breaker = _Breaker()
+    comp = CompactionService(s)
+    comp.start()
+    comp.wake()
+    time.sleep(0.2)
+    assert _census(s) == debt, "an open breaker must defer compaction"
+    s._breaker.state = "closed"
+    comp.wake()
+    for _ in range(100):
+        if _census(s) == 0:
+            break
+        time.sleep(0.02)
+    comp.stop()
+    assert _census(s) == 0
+
+
+# ------------------------------------------- version-bump invalidation
+
+
+def test_version_bump_invalidates_pooled_state(tmp_path):
+    """Compaction rewrites files under the SAME table name; correctness
+    of every cache keyed by store version (buffer pool, shared plans,
+    sketches) rides on the commit bumping that version."""
+    s = _store_session(tmp_path, **{"bufferpool.enabled": True})
+    _fragment(s)
+    before = s.sql(_Q).to_pandas()  # pool now holds pre-compaction tiles
+    v0 = s.store.current_version("t")
+    CompactionService(s).run_once(force=True)
+    assert s.store.current_version("t") > v0
+    # same session: _sync_store sees the moved version, re-registers
+    assert before.equals(s.sql(_Q).to_pandas())
+    assert s.catalog.table("t")._store_version > v0
+
+
+# ------------------------------------------------------- TPC-H identity
+
+
+def _pyv(v):
+    import pandas as pd
+    if isinstance(v, pd.Timestamp):
+        return str(v.date())
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v
+
+
+@pytest.fixture(scope="module")
+def tpch_store(tmp_path_factory):
+    """A store-backed TPC-H sf=0.01 set, fragmented (duplicate tail
+    appends through the ingest plane + a visimap delete pass on
+    lineitem/orders), with pre-compaction answers captured, THEN
+    compacted to census zero. Readers in the tests open fresh sessions
+    over the compacted root."""
+    from tools.tpch_queries import QUERIES
+    from tools.tpchgen import load_tpch
+
+    root = str(tmp_path_factory.mktemp("tpch_store"))
+    s = cb.Session(get_config().with_overrides(**{
+        "storage.root": root, "storage.rows_per_partition": 2048,
+        "ingest.flush_rows": 64, "ingest.flush_ms": 10.0}))
+    load_tpch(s, sf=0.01, seed=7)
+    li = s.catalog.table("lineitem").to_pandas()
+    ing = IngestService(s)
+    for b in range(4):
+        ing.append("lineitem",
+                   [[_pyv(v) for v in li.iloc[-(b * 50 + j) - 1]]
+                    for j in range(50)])
+    ing.stop()
+    s.store.delete_rows("lineitem", lambda c: c["l_orderkey"] % 37 == 0)
+    s.store.delete_rows("orders", lambda c: c["o_orderkey"] % 37 == 0)
+    s._sync_store()
+    frag_census = delta_parts(
+        s.store.read_manifest("lineitem"), 2048, 0.5)
+    assert frag_census > 0
+    tables = {}
+    for n, t in s.catalog.tables.items():
+        t.ensure_loaded()  # lineitem/orders re-registered cold above
+        tables[n] = t.to_pandas()
+    subset = ("q1", "q3", "q6")
+    baseline = {q: s.sql(QUERIES[q]).to_pandas() for q in subset}
+    out = CompactionService(s).run_once(force=True)
+    assert out["chunks"] >= 1
+    for name in ("lineitem", "orders"):
+        assert delta_parts(s.store.read_manifest(name), 2048, 0.5) == 0
+    return root, tables, baseline
+
+
+@pytest.mark.parametrize("nseg,pool", [(1, True), (1, False)],
+                         ids=["pool", "nopool"])
+def test_tpch_compacted_identical_subset(tpch_store, nseg, pool):
+    """Tier-1 cut of the acceptance matrix: fresh readers over the
+    compacted store answer the captured pre-compaction results."""
+    from tools.tpch_queries import QUERIES
+    from tests.test_tpch import assert_frames_match
+
+    root, _, baseline = tpch_store
+    s = cb.Session(get_config().with_overrides(
+        **{"n_segments": nseg, "storage.root": root,
+           "bufferpool.enabled": pool}))
+    for q, exp in baseline.items():
+        assert_frames_match(s.sql(QUERIES[q]).to_pandas(), exp, q)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("nseg,pool", [(1, True), (1, False),
+                                       (8, True), (8, False)],
+                         ids=["1seg-pool", "1seg-nopool",
+                              "8seg-pool", "8seg-nopool"])
+def test_tpch_compacted_full_matrix(tpch_store, nseg, pool):
+    """The full acceptance matrix: EVERY TPC-H query over the compacted
+    store, against the pandas oracle on the fragmented data (test_tpch
+    pins un-compacted == oracle, so this pins compacted == un-compacted
+    transitively), at 1 and 8 segments, pool on and off."""
+    from tools.tpch_oracle import ORACLES
+    from tools.tpch_queries import QUERIES
+    from tests.test_tpch import assert_frames_match
+
+    root, tables, _ = tpch_store
+    s = cb.Session(get_config().with_overrides(
+        **{"n_segments": nseg, "storage.root": root,
+           "bufferpool.enabled": pool}))
+    for qname in sorted(QUERIES):
+        if qname not in ORACLES:
+            continue
+        got = s.sql(QUERIES[qname]).to_pandas()
+        assert_frames_match(got, ORACLES[qname](tables), qname)

@@ -51,6 +51,15 @@ Mixes:
   hot scan vs one cold scan on the same container size — printed as a
   rows/s comparison with the hot probe's host-decode count (zero when
   the claim holds).
+- ``readwrite`` — the write-plane workload (ISSUE 18): 1-in-4 requests
+  are wire-level APPENDs into a store-backed table through the
+  streaming ingest plane (group-committed INSERT flushes) while the
+  rest stay point lookups, with the background compaction service
+  enabled and folding the append debt DURING the measured window. The
+  ingest_qps / flush_ms_p95 / compact_chunks / delta_parts_max CSV
+  columns report the write plane's side of the run; the read QPS
+  column is the bench's pin that foreground serving holds up while
+  compaction runs.
 
 Runs on CPU (JAX_PLATFORMS=cpu) for CI smoke; on real hardware the launch
 amortization grows with dispatch overhead. Usage:
@@ -106,7 +115,13 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               # mid-statement adaptive replans taken over the window
               # and capacity rungs the learned sketches priced down
               # from the static estimate on repeat statements
-              "adaptive_replans,rung_downgrades")
+              "adaptive_replans,rung_downgrades,"
+              # ISSUE 18 (write plane): appends/s accepted by the
+              # streaming ingest buffers over the window, the p95 group
+              # flush commit latency, compaction chunks folded DURING
+              # the run, and the post-run bounded-invariant census
+              # (worst per-table delta-partition count)
+              "ingest_qps,flush_ms_p95,compact_chunks,delta_parts_max")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -137,7 +152,7 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
                   tenants=None, server_core: str = "async",
                   clients: int = 16, aging_s: float = None,
                   trace_sample: int = 0, slow_ms: float = None,
-                  segments: int = 1):
+                  segments: int = 1, compact_off: bool = False):
     import numpy as np
 
     import cloudberry_tpu as cb
@@ -186,6 +201,23 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
             prefix="cbtpu_servebench_hot_")
         over["resource.query_mem_bytes"] = 2 << 20
         over["bufferpool.max_bytes"] = 3 << 20
+    if mix == "readwrite":
+        # the write-plane workload: every table store-backed, the ingest
+        # buffers tuned so a closed loop's appends group-commit visibly,
+        # and the compaction service folding the debt DURING the window
+        # (tight interval, low invariant threshold, small partitions so
+        # small flushed tails actually accumulate census)
+        over["storage.root"] = tempfile.mkdtemp(
+            prefix="cbtpu_servebench_rw_")
+        over["storage.rows_per_partition"] = 4096
+        over["ingest.flush_rows"] = 128
+        over["ingest.flush_ms"] = 5.0
+        # --no-compact is the A/B baseline for the acceptance claim
+        # ("read QPS holds while compaction runs"): same closed loop,
+        # same append share, debt just accumulates unfolded
+        over["compact.enabled"] = not compact_off
+        over["compact.interval_s"] = 0.25
+        over["compact.max_delta_parts"] = 8
     if chaos > 0:
         # probabilistic device loss compounds per tile: give recovery
         # more re-dispatches than the default flap allowance
@@ -237,6 +269,14 @@ def build_session(mode: str, rows: int, tick_s: float, max_batch: int,
             "disc": rng.integers(0, 11, m).astype(np.int64),
             "sd": rng.integers(8000, 12000, m).astype(np.int32),
         }, {})
+    if mix == "readwrite":
+        # the append target: store-backed with a committed base, so
+        # compaction has a manifest to fold the flushed tails into
+        s.sql("create table ing (k bigint, v bigint) distributed by (k)")
+        s.catalog.table("ing").set_data({
+            "k": np.arange(4096, dtype=np.int64),
+            "v": np.zeros(4096, dtype=np.int64)}, {})
+        s._servebench_root = cfg.storage.root
     if mix in ("coldscan", "hotcold"):
         s = cb.Session(cfg)  # fresh bind: tables come up cold
         s._servebench_root = cfg.storage.root
@@ -284,7 +324,20 @@ def _cold_sql(i: int) -> str:
 _COLD_PTS_ROWS = 10_000
 
 
+def _is_append(mix: str, i: int) -> bool:
+    # readwrite: every 4th request is a wire-level APPEND — the drivers
+    # branch on this BEFORE asking _mix_sql for a statement
+    return mix == "readwrite" and i % 4 == 3
+
+
+def _append_req(i: int) -> dict:
+    return {"append": {"table": "ing",
+                       "rows": [[1_000_000 + i, i % 97]]}}
+
+
 def _mix_sql(mix: str, i: int, rows: int) -> str:
+    if mix == "readwrite":
+        return _point_sql(i, rows)
     if mix == "point":
         return _point_sql(i, rows)
     if mix == "q6":
@@ -307,12 +360,13 @@ def _mix_sql(mix: str, i: int, rows: int) -> str:
     return _q6_sql(i) if i % 5 == 4 else _point_sql(i, rows)
 
 
-_BACKPRESSURE_ETYPES = ("TenantQueueFull", "SchedQueueFull", "ServerBusy")
+_BACKPRESSURE_ETYPES = ("TenantQueueFull", "SchedQueueFull", "ServerBusy",
+                        "IngestQueueFull")
 
 
 def _mux_driver(wid: int, n_conns: int, first_idx: int, host, port,
                 mix: str, rows: int, tenant_names, stop_at, lat_map,
-                lat_lock, rejects, errors):
+                lat_lock, rejects, errors, reads):
     """One driver thread simulating ``n_conns`` independent closed-loop
     clients: a selector loop sends each connection's next request the
     moment its previous response lands, so per-tenant throughput under
@@ -322,6 +376,7 @@ def _mux_driver(wid: int, n_conns: int, first_idx: int, host, port,
     conns = []
     local: dict = {}
     rej_local = 0
+    reads_local = 0
     try:
         for j in range(n_conns):
             idx = first_idx + j
@@ -338,7 +393,9 @@ def _mux_driver(wid: int, n_conns: int, first_idx: int, host, port,
             local.setdefault(tenant, [])
 
         def send_next(rec):
-            req = {"sql": _mix_sql(mix, rec["i"], rows)}
+            rec["ap"] = _is_append(mix, rec["i"])
+            req = _append_req(rec["i"]) if rec["ap"] \
+                else {"sql": _mix_sql(mix, rec["i"], rows)}
             if rec["tenant"]:
                 req["tenant"] = rec["tenant"]
             rec["i"] += 1
@@ -358,6 +415,8 @@ def _mux_driver(wid: int, n_conns: int, first_idx: int, host, port,
                 dt = time.monotonic() - rec["t0"]
                 if resp.get("ok"):
                     local[rec["tenant"]].append(dt)
+                    if not rec.get("ap"):
+                        reads_local += 1
                 elif resp.get("etype") in _BACKPRESSURE_ETYPES:
                     # retryable refusal: counted as BACKPRESSURE (its
                     # own metric — NOT a deadline miss), loop retries
@@ -377,6 +436,7 @@ def _mux_driver(wid: int, n_conns: int, first_idx: int, host, port,
         sel.close()
     with lat_lock:
         rejects[0] += rej_local
+        reads[0] += reads_local
         for tenant, lats in local.items():
             lat_map.setdefault(tenant, []).extend(lats)
 
@@ -434,7 +494,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
              driver_threads: int = 16, aging_s: float = None,
              trace_sample: int = 0, trace_out: str = None,
              slow_ms: float = None, segments: int = 1,
-             expand_at=None, shrink_at=None) -> dict:
+             expand_at=None, shrink_at=None,
+             compact_off: bool = False) -> dict:
     """One closed-loop run; returns the CSV row fields.
 
     ``cancel_mix``: fraction of requests carrying a TIGHT per-request
@@ -457,7 +518,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
                             mix=mix, chaos=chaos, tenants=tenants,
                             server_core=server_core, clients=clients,
                             aging_s=aging_s, trace_sample=trace_sample,
-                            slow_ms=slow_ms, segments=segments)
+                            slow_ms=slow_ms, segments=segments,
+                            compact_off=compact_off)
     # warm the compile caches OUTSIDE the measured window: the bench
     # compares steady-state dispatch, not first-compile latency
     session.sql(_point_sql(0, rows))
@@ -486,6 +548,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     hd_before = session.stmt_log.counter("host_decodes")
     ar_before = session.stmt_log.counter("adaptive_replans")
     rd_before = session.stmt_log.counter("rung_downgrades")
+    ia_before = session.stmt_log.counter("ingest_appends")
+    cc_before = session.stmt_log.counter("compact_chunks")
 
     _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
                     "SchedDeadline")
@@ -502,31 +566,40 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     def worker(wid: int):
         lat_local = []
         miss_local = 0
+        reads_local = 0
         try:
             with Client(srv.host, srv.port) as c:
                 i = wid * 100_003
                 while time.monotonic() < stop_at[0]:
-                    sql = _mix_sql(mix, i, rows)
+                    ap = _is_append(mix, i)
+                    sql = None if ap else _mix_sql(mix, i, rows)
                     dl = deadline_s if stride and i % stride == 0 else None
-                    i += 1
                     t0 = time.monotonic()
                     try:
-                        c.sql(sql, deadline_s=dl)
+                        if ap:
+                            c.append("ing", _append_req(i)["append"]["rows"])
+                        else:
+                            c.sql(sql, deadline_s=dl)
+                            reads_local += 1
                     except ServerError as e:
                         # a deadlined request missing its deadline is the
                         # workload working, not a bench failure
                         if dl is not None and e.etype in _MISS_ETYPES:
                             miss_local += 1
+                        elif e.etype in _BACKPRESSURE_ETYPES:
+                            pass  # retryable refusal; the loop retries
                         elif chaos and e.etype in _CHAOS_ETYPES:
                             pass
                         else:
                             raise
+                    i += 1
                     lat_local.append(time.monotonic() - t0)
         except Exception as e:  # pragma: no cover - surfaced in result
             errors.append(f"{type(e).__name__}: {e}")
         with lat_lock:
             lats.extend(lat_local)
             misses[0] += miss_local
+            reads[0] += reads_local
 
     if chaos > 0:
         FI.inject_fault("tile_device_lost", "error", p=chaos, seed=1234)
@@ -559,6 +632,7 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
                 return
     lat_map: dict = {}
     rejects = [0]  # backpressure refusals (mux driver) — own metric
+    reads = [0]    # successful READ requests (the readwrite split)
     tenant_names = [t.name for t in tenants] if tenants else None
     # driver choice: one OS thread per client stays exact for small runs
     # (and the cancel-mix workload needs per-request deadlines); past
@@ -581,7 +655,7 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
                     target=_mux_driver,
                     args=(i, n, first, srv.host, srv.port, mix, rows,
                           tenant_names, stop_at, lat_map, lat_lock,
-                          rejects, errors)))
+                          rejects, errors, reads)))
                 first += n
         else:
             threads = [threading.Thread(target=worker, args=(i,))
@@ -693,6 +767,28 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
                                - ar_before)
     out["rung_downgrades"] = (disp.counter("rung_downgrades")
                               - rd_before)
+    # write-plane columns (ISSUE 18): appends/s the ingest buffers
+    # accepted, p95 group-flush commit latency, compaction chunks
+    # folded during the window, and a LIVE end-of-run census of the
+    # bounded invariant (worst per-table delta-partition count, read
+    # from the manifests rather than the compactor's cached gauge)
+    out["ingest_qps"] = round(
+        (disp.counter("ingest_appends") - ia_before) / max(wall, 1e-9), 1)
+    fh = reg.hist("ingest_flush_seconds") or {}
+    out["flush_ms_p95"] = round(fh.get("p95", 0.0) * 1000, 3)
+    out["compact_chunks"] = disp.counter("compact_chunks") - cc_before
+    dmax = 0
+    if session.store is not None and mix == "readwrite":
+        from cloudberry_tpu.storage.compact import delta_parts
+
+        rpp = getattr(session.store, "rows_per_partition", 1 << 20)
+        tf = session.config.compact.target_fill
+        for name in session.store.table_names():
+            man = session.store.read_manifest(name)
+            if man["schema"] is not None:
+                dmax = max(dmax, delta_parts(man, rpp, tf))
+    out["delta_parts_max"] = dmax
+    out["_read_qps"] = round(reads[0] / max(wall, 1e-9), 1)
     if mix == "hotcold":
         out.update(_hotcold_probe(session))
     _cleanup()
@@ -740,7 +836,7 @@ def main(argv=None) -> list[dict]:
                     choices=["both", "direct", "batched"])
     ap.add_argument("--mix", default="point",
                     choices=["point", "q6", "mixed", "spill",
-                             "coldscan", "hotcold"])
+                             "coldscan", "hotcold", "readwrite"])
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--duration", type=float, default=5.0)
     ap.add_argument("--rows", type=int, default=200_000)
@@ -791,6 +887,10 @@ def main(argv=None) -> list[dict]:
                          "moved_rows / epoch_flips CSV columns)")
     ap.add_argument("--shrink-at", default=None, metavar="T:N",
                     help="same, shrinking to N segments")
+    ap.add_argument("--no-compact", action="store_true",
+                    help="readwrite baseline: same append share with "
+                         "the compaction service off (the A/B for the "
+                         "read-QPS-holds-under-compaction claim)")
     ap.add_argument("--csv", default=None,
                     help="append CSV rows to this file")
     args = ap.parse_args(argv)
@@ -825,7 +925,8 @@ def main(argv=None) -> list[dict]:
                      trace_out=args.trace_out,
                      slow_ms=args.slow_ms, segments=args.segments,
                      expand_at=_parse_at(args.expand_at),
-                     shrink_at=_parse_at(args.shrink_at))
+                     shrink_at=_parse_at(args.shrink_at),
+                     compact_off=args.no_compact)
         out.append(r)
         rows_out.append(r)
         rows_out.extend(r.get("_tenants", ()))
