@@ -416,6 +416,76 @@ def writepath_context() -> dict:
     return rec
 
 
+def durability_context() -> dict:
+    """The crash-only storage record (ISSUE 19) next to the perf ones:
+    which durability seams the process-kill torture matrix covers (the
+    crash matrix itself is tests/test_crash_torture.py — minutes of
+    subprocess wall, not bench work), an fsck verdict over a scratch
+    store written through the real append path, and the checksum
+    verification overhead A/B on the partition decode path (the
+    acceptance bound is <3% on scans). Runs identically on live and
+    replay rounds: CPU-only, storage-layer work."""
+    rec: dict = {}
+    try:
+        import shutil
+        import tempfile
+
+        import numpy as np
+
+        from cloudberry_tpu import types as T
+        from cloudberry_tpu.storage.fsck import fsck
+        from cloudberry_tpu.storage.table_store import TableStore
+        from cloudberry_tpu.types import Schema
+        from cloudberry_tpu.utils.faultinject import INVENTORY
+        from tools.crash_torture import MATRIX_SEAMS
+
+        seams = [s for s, _ in MATRIX_SEAMS]
+        rec["seams_covered"] = len(seams)
+        rec["seams_in_inventory"] = sum(
+            1 for s in seams if s in INVENTORY)
+        d = tempfile.mkdtemp(prefix="bench-durability-")
+        try:
+            store = TableStore(os.path.join(d, "store"))
+            n = 1_500_000
+            rng = np.random.default_rng(19)
+            store.append(
+                "t", {"k": np.arange(n, dtype=np.int64),
+                      "v": rng.integers(0, 1 << 30, n, dtype=np.int64)},
+                Schema.of(k=T.INT64, v=T.INT64),
+                rows_per_partition=1 << 18)
+            rep = fsck(store.root, deep=True)
+            rec["fsck_clean"] = rep["clean"]
+            rec["fsck_problems"] = len(rep["problems"])
+            parts = store.read_manifest("t")["partitions"]
+            reps = 3
+
+            def _scan_wall(verify: bool) -> float:
+                store.verify_checksums = verify
+                store.read_partitions("t", parts)  # warm page cache
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    store.read_partitions("t", parts)
+                return time.perf_counter() - t0
+
+            # interleave + best-of-three per mode: the loops are ~100ms
+            # and allocator/thermal drift across a run-then-run A/B
+            # reads as fake overhead otherwise
+            offs, ons = [], []
+            for _ in range(3):
+                offs.append(_scan_wall(False))
+                ons.append(_scan_wall(True))
+            off, on = min(offs), min(ons)
+            rec["scan_verify_off_s"] = round(off / reps, 4)
+            rec["scan_verify_on_s"] = round(on / reps, 4)
+            rec["checksum_overhead_pct"] = round(
+                (on - off) / max(off, 1e-9) * 100.0, 2)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    except Exception as e:  # the bench must never die on its metadata
+        rec["error"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
 def lint_context() -> dict:
     """The static-analysis record next to the perf ones: graftlint's
     verdict on the CURRENT tree (rule counts, suppression count, files)
@@ -777,6 +847,7 @@ def replay_last_good(reason: str) -> None:
             "scan_ladder": scan_ladder_context(),
             "bufferpool": bufferpool_context(),
             "writepath": writepath_context(),
+            "durability": durability_context(),
         })
     except Exception:
         emit({
@@ -793,6 +864,7 @@ def replay_last_good(reason: str) -> None:
             "scan_ladder": scan_ladder_context(),
             "bufferpool": bufferpool_context(),
             "writepath": writepath_context(),
+            "durability": durability_context(),
         })
 
 
@@ -1013,6 +1085,7 @@ def measure() -> None:
         "scan_ladder": scan_ladder_context(),
         "bufferpool": bufferpool_context(),
         "writepath": writepath_context(),
+        "durability": durability_context(),
         "scan_bytes": scan_bytes,
         "tpu_wall_s": {q: round(t, 6) for q, t in tpu_wall.items()},
     })

@@ -363,6 +363,12 @@ class StorageConfig:
     # manifests encrypt at rest (Fernet: AES-CBC + HMAC). Feed this from
     # a secret manager; None = plaintext storage.
     encryption_key: str | None = None
+    # Verify column-blob content checksums at decode (pg_checksums
+    # analog): a mismatch raises StorageCorruptionError instead of
+    # decoding garbage into an answer. crc32 over the compressed blob —
+    # cheap next to decompression; `mgmt fsck --deep` uses the same
+    # checksums offline. Off only for benchmarking the overhead.
+    verify_checksums: bool = True
 
 
 @dataclass(frozen=True)

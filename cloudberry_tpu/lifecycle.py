@@ -101,6 +101,28 @@ class IngestQueueFull(StatementError):
     retryable = True
 
 
+class StorageIOError(StatementError):
+    """A storage write/read failed at the OS layer (ENOSPC, EIO, a torn
+    or short write the shim surfaced) — about the ENVIRONMENT the
+    statement ran in, not the statement: the commit protocol left the
+    previous snapshot intact, so an idempotent retry may succeed once
+    the device/space condition clears. Counted in ``storage_io_errors``
+    (storage/iofault.py) and breaker-visible like every retryable
+    refusal."""
+
+    retryable = True
+
+
+class StorageCorruptionError(StatementError):
+    """Stored bytes failed their content checksum (or a container parsed
+    as garbage) — semantic and sticky: retrying re-reads the same bad
+    bytes. The read path raises this INSTEAD of returning a wrong
+    answer; ``mgmt fsck`` finds the same file offline. The pg_checksums
+    verdict class."""
+
+    retryable = False
+
+
 # errors raised OUTSIDE this module that belong to the retryable side:
 # the dispatcher's backpressure/deadline pair (sched/dispatcher.py) and
 # the per-tenant admission refusal (exec/resource.py TenantQueueFull)
@@ -109,7 +131,7 @@ _RETRYABLE_NAMES = frozenset({
     "StatementTimeout", "ServerDraining", "BreakerOpen",
     "SchedQueueFull", "SchedDeadline",
     "TenantQueueFull", "ServerBusy", "IngestQueueFull",
-    "CompactionError",
+    "CompactionError", "StorageIOError",
 })
 
 

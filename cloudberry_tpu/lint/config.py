@@ -192,6 +192,12 @@ WITNESS_ORDER: tuple[tuple[str, ...], ...] = (
      "scanpipe._pool_lock", "BufferPool._lock",
      "bufferpool._create_lock", "FeedbackStore._lock",
      "FeedbackStore._io_lock", "feedback._create_lock"),
+    # rank 5 — the storage IO shim's counter lock (storage/iofault.py):
+    # every durable write can bump storage_io_errors, and writers reach
+    # it while holding rank-4 locks (FeedbackStore._io_lock wraps the
+    # _FEEDBACK.json atomic replace), so it nests inside EVERYTHING and
+    # never calls out
+    ("iofault._lock",),
 )
 
 

@@ -231,7 +231,7 @@ def cmd_check(args) -> int:
                     print(f"MISMATCH {name}/{part['file']}: manifest rows "
                           f"{part['num_rows']} != footer {footer['num_rows']}")
                     problems += 1
-                cols = mp.read_columns(path, cipher=ts.cipher)
+                cols = mp.read_columns(path, cipher=ts.cipher, verify=True)
                 for cname, values in man["dicts"].items():
                     if cname in cols and len(cols[cname]) \
                             and cols[cname].max() >= len(values):
@@ -246,15 +246,62 @@ def cmd_check(args) -> int:
     return 0 if problems == 0 else 1
 
 
+def cmd_fsck(args) -> int:
+    """Store integrity scan + orphan GC (pg_checksums / fsck analog):
+    manifest closure, store-JSON parse, optional deep checksum sweep,
+    and collection of crash residue (orphan partitions, stale tmp
+    files) past the grace window."""
+    from cloudberry_tpu.storage.fsck import fsck
+    from cloudberry_tpu.utils.tde import make_cipher
+
+    report = fsck(args.store, cipher=make_cipher(_enc_key()),
+                  deep=args.deep, grace_s=args.grace_s, gc=args.gc)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for name, t in sorted(report["tables"].items()):
+            print(f"table {name}: v{t['version']}, {t['partitions']} "
+                  f"partitions, {t['rows']} live rows"
+                  + (f", {t['checked']} deep-checked" if args.deep else ""))
+        for p in report["problems"]:
+            print(f"PROBLEM {p}")
+        for o in report["orphans"]:
+            print(f"orphan {o['path']} (age {o['age_s']}s"
+                  f"{', collectable' if o['collectable'] else ''})")
+        for c in report["collected"]:
+            print(f"collected {c}")
+        print(f"fsck {'clean' if report['clean'] else 'NOT CLEAN'}: "
+              f"{len(report['problems'])} problem(s), "
+              f"{len(report['orphans'])} orphan(s), "
+              f"{len(report['collected'])} collected")
+    return 0 if report["clean"] else 1
+
+
 def cmd_serve(args) -> int:
     """Run the socket serving layer (the postmaster/tcop analog): one
     process owns the session; clients connect over TCP."""
     from cloudberry_tpu.serve import Server
+    from cloudberry_tpu.utils import faultinject
 
-    srv = Server(config=cluster_config(args.store),
+    # crash-torture arming: the harness launches this very entry point
+    # with CBTPU_INJECT set, so the faults land inside the REAL server
+    # process it is about to kill (never armed in normal operation)
+    n_armed = faultinject.arm_from_env()
+    cfg = cluster_config(args.store)
+    for kv in getattr(args, "set", None) or []:
+        key, _, val = kv.partition("=")
+        try:
+            val = json.loads(val)
+        except ValueError:
+            pass  # bare strings stay strings
+        cfg = cfg.with_overrides(**{key: val})
+    srv = Server(config=cfg,
                  host=args.host, port=args.port,
                  read_only=getattr(args, "standby", False),
                  auth_token=getattr(args, "auth_token", None))
+    if n_armed:
+        print(f"fault injection armed: {n_armed} seam(s) from "
+              "CBTPU_INJECT", flush=True)
     role = "standby (read-only)" if srv.read_only else "primary"
     print(f"serving on {srv.host}:{srv.port} (store {args.store}, "
           f"{srv.session.config.n_segments} segments, {role})", flush=True)
@@ -369,6 +416,20 @@ def main(argv=None) -> int:
     pc = sub.add_parser("check", help="storage consistency (gpcheckcat)")
     pc.set_defaults(fn=cmd_check)
 
+    pk = sub.add_parser("fsck", help="store integrity + orphan GC "
+                                     "(pg_checksums analog)")
+    pk.add_argument("--deep", action="store_true",
+                    help="re-read every column blob and verify its "
+                         "footer content checksum")
+    pk.add_argument("--gc", action="store_true",
+                    help="collect orphans past the grace window")
+    pk.add_argument("--grace-s", type=float, default=300.0,
+                    help="age before crash residue becomes collectable "
+                         "(protects in-flight commits; default 300)")
+    pk.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    pk.set_defaults(fn=cmd_fsck)
+
     pq = sub.add_parser("sql", help="run a statement")
     pq.add_argument("query")
     pq.add_argument("--save", action="store_true",
@@ -386,6 +447,10 @@ def main(argv=None) -> int:
     pv.add_argument("--auth-token", default=None,
                     help="require {\"auth\": token} before requests "
                          "(failed logins lock the address out)")
+    pv.add_argument("--set", action="append", metavar="KEY=VALUE",
+                    help="config override (repeatable), e.g. "
+                         "--set compact.enabled=true — values parse as "
+                         "JSON, falling back to bare strings")
     pv.set_defaults(fn=cmd_serve)
 
     pf = sub.add_parser("fdist",

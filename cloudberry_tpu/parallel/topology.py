@@ -953,7 +953,8 @@ def _move_partition_delta(store, name: str, man: dict, part: dict,
 
     tdir = os.path.join(store.root, name)
     path = os.path.join(tdir, part["file"])
-    cols = mp.read_columns(path, cipher=store.cipher)
+    cols = mp.read_columns(path, cipher=store.cipher,
+                           verify=getattr(store, "verify_checksums", True))
     n_file = part["num_rows"]
     live = np.ones(n_file, dtype=bool)
     if part["deleted"]:
@@ -1032,4 +1033,7 @@ def _read_topology(store) -> Optional[dict]:
 
 
 def _write_topology(store, rec: dict) -> None:
+    # its own seam on top of io_atomic_json: the torture matrix kills at
+    # the topology record specifically (mid-expand/cutover crash)
+    fault_point("io_topology_write")
     store._atomic_json(os.path.join(store.root, "_TOPOLOGY.json"), rec)

@@ -222,11 +222,16 @@ class FeedbackStore:
     # --------------------------------------------------------- persistence
 
     def persist(self) -> None:
-        """Write-through to ``_FEEDBACK.json`` (atomic replace). Sketch
-        loss is never a correctness problem — the loop just re-learns —
-        so any IO failure is swallowed."""
+        """Write-through to ``_FEEDBACK.json`` (atomic replace via the
+        iofault primitives — fsynced temp + rename, so a crash never
+        leaves torn JSON). Sketch loss is never a correctness problem —
+        the loop just re-learns — so IO failures are swallowed here,
+        but they are COUNTED (storage_io_errors), not silent."""
         if self.path is None:
             return
+        from cloudberry_tpu.lifecycle import StorageIOError
+        from cloudberry_tpu.storage import iofault
+
         with self._lock:
             ents = [{"key": [k[0], [list(p) for p in k[1]], k[2]],
                      "tokens": [list(map(list, t[0])), t[1], list(t[2])],
@@ -235,12 +240,10 @@ class FeedbackStore:
             body = {"version": 1, "gen": self.gen, "entries": ents}
         try:
             with self._io_lock:
-                tmp = self.path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(body, f)
-                os.replace(tmp, self.path)
-        except OSError:
-            pass
+                fault_point("io_feedback_write")
+                iofault.atomic_json(self.path, body)
+        except StorageIOError:
+            pass  # counted by the shim; the learner re-folds
 
     def _load(self) -> None:
         try:

@@ -468,6 +468,11 @@ class Server:
             self.cron.start()  # foreground entry point runs jobs too
         if self.dispatcher is not None:
             self.dispatcher.start()
+        if self.compactor is not None and not self.read_only:
+            # parity with start(): the CLI serve path must run the
+            # background compactor too, or `--set compact.enabled=true`
+            # silently does nothing (caught by the crash-torture matrix)
+            self.compactor.start()
         self.watchdog.start()
         self._transport.serve_forever()
 

@@ -60,6 +60,8 @@ class Session:
             self.store.rows_per_partition = \
                 self.config.storage.rows_per_partition
             self.store.quota_bytes = self.config.storage.quota_bytes
+            self.store.verify_checksums = \
+                self.config.storage.verify_checksums
             if self.config.storage.encryption_key:
                 from cloudberry_tpu.utils.tde import make_cipher
 

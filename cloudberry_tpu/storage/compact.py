@@ -54,6 +54,7 @@ import uuid
 import numpy as np
 
 from cloudberry_tpu import lifecycle
+from cloudberry_tpu.storage import iofault
 from cloudberry_tpu.utils.faultinject import fault_point
 
 _JOURNAL = "_COMPACTION.json"
@@ -128,7 +129,8 @@ def _read_live(store, name: str, part: dict) -> dict:
     from cloudberry_tpu.storage import micropartition as mp
 
     path = os.path.join(store.root, name, part["file"])
-    cols = mp.read_columns(path, cipher=store.cipher)
+    cols = mp.read_columns(path, cipher=store.cipher,
+                           verify=getattr(store, "verify_checksums", True))
     if part["deleted"]:
         keep = np.ones(part["num_rows"], dtype=bool)
         keep[np.asarray(part["deleted"], dtype=np.int64)] = False
@@ -432,8 +434,12 @@ class CompactionService:
                     for e in new_entries:
                         try:
                             os.unlink(os.path.join(tdir, e["file"]))
-                        except OSError:
-                            pass
+                        except FileNotFoundError:
+                            pass  # already gone — nothing was lost
+                        except OSError as exc:
+                            # an undeletable orphan is an IO fault worth
+                            # counting; fsck's GC sweep retries it later
+                            iofault.note_io_error(e["file"], exc)
                     self._journal_pending(store, None, None)
                     return False, 0
                 man["partitions"] = [p for p in man["partitions"]
@@ -466,6 +472,9 @@ class CompactionService:
         rec = self._read_journal(store)
         rec["pending"] = ({"table": table, "files": list(files)}
                           if table is not None else None)
+        # the journal's own durability seam: a crash here must leave
+        # either the old or the new pending record, never torn JSON
+        fault_point("io_journal_write")
         store._atomic_json(self._journal_path(store), rec)
 
     def _journal_progress(self, store, **deltas) -> None:
@@ -499,8 +508,10 @@ class CompactionService:
             if f not in committed:
                 try:
                     os.unlink(os.path.join(store.root, name, f))
-                except OSError:
-                    pass
+                except FileNotFoundError:
+                    pass  # already gone — nothing to clean
+                except OSError as exc:
+                    iofault.note_io_error(f, exc)
         self._journal_pending(store, None, None)
         log = getattr(self.session, "stmt_log", None)
         if log is not None:

@@ -58,6 +58,8 @@ def create(session, name: str) -> None:
 
 
 def upload(session, table: str, rel: str, data: bytes) -> str:
+    from cloudberry_tpu.storage import iofault
+
     root = _root(session, table)
     if not os.path.isdir(root):
         raise DirTableError(f"unknown directory table {table!r}")
@@ -65,28 +67,44 @@ def upload(session, table: str, rel: str, data: bytes) -> str:
     path = os.path.join(root, rel)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     cipher = session.store.cipher
-    with open(path, "wb") as f:
-        f.write(cipher.encrypt(data) if cipher is not None else data)
+    # durable like any other store write: an upload the catalog row will
+    # advertise must survive a crash (and IO faults surface typed)
+    iofault.durable_write(
+        path, cipher.encrypt(data) if cipher is not None else data)
     return rel
 
 
 def read(session, table: str, rel: str) -> bytes:
+    from cloudberry_tpu.lifecycle import StorageIOError
+    from cloudberry_tpu.storage import iofault
+
     path = os.path.join(_root(session, table), _safe(table, rel))
     try:
         with open(path, "rb") as f:
             raw = f.read()
-    except OSError:
+    except FileNotFoundError:
         raise DirTableError(f"no file {rel!r} in directory table {table!r}")
+    except OSError as e:
+        # an EIO is NOT "no such file" — surface it as the retryable
+        # storage fault it is, and count it
+        iofault.note_io_error(path, e)
+        raise StorageIOError(f"{path}: {e}") from e
     cipher = session.store.cipher
     return cipher.decrypt(raw) if cipher is not None else raw
 
 
 def remove(session, table: str, rel: str) -> None:
+    from cloudberry_tpu.lifecycle import StorageIOError
+    from cloudberry_tpu.storage import iofault
+
     path = os.path.join(_root(session, table), _safe(table, rel))
     try:
         os.remove(path)
-    except OSError:
+    except FileNotFoundError:
         raise DirTableError(f"no file {rel!r} in directory table {table!r}")
+    except OSError as e:
+        iofault.note_io_error(path, e)
+        raise StorageIOError(f"{path}: {e}") from e
 
 
 def refresh(session, t) -> None:

@@ -31,6 +31,7 @@ from typing import Optional
 import numpy as np
 
 from cloudberry_tpu.columnar.dictionary import StringDictionary
+from cloudberry_tpu.storage import iofault
 from cloudberry_tpu.storage import micropartition as mp
 from cloudberry_tpu.types import DType, Schema
 
@@ -64,6 +65,12 @@ class TableStore:
         # TDE (utils/tde.py): set via storage.encryption_key; encrypts
         # micro-partition files and manifests at rest
         self.cipher = None
+        # content-checksum verification at decode (pg_checksums analog):
+        # column blobs carry a crc in the footer; a mismatch raises
+        # StorageCorruptionError instead of decoding garbage. Config:
+        # storage.verify_checksums (default on — crc32 is cheap next to
+        # decompression).
+        self.verify_checksums = True
         # disk quota (diskquota extension analog): enforced at write time
         # against real on-disk usage; 0 = unlimited. Like the reference's,
         # enforcement is a hard stop once usage REACHES the quota — the
@@ -244,6 +251,8 @@ class TableStore:
             return self._commit_locked(table, manifest)
 
     def _commit_locked(self, table: str, manifest: dict) -> int:
+        from cloudberry_tpu.utils.faultinject import fault_point
+
         mdir = self._mdir(table)
         os.makedirs(mdir, exist_ok=True)
         v = self.current_version(table) + 1
@@ -252,15 +261,13 @@ class TableStore:
         raw = json.dumps(manifest).encode()
         if self.cipher is not None:
             raw = b"CBMPENC1" + self.cipher.encrypt(raw)
-        with open(path, "wb") as f:
-            f.write(raw)
-            f.flush()
-            os.fsync(f.fileno())
+        # the manifest body write — a crash here leaves a torn/orphan
+        # v{N}.json that CURRENT never points at (fsck collects it)
+        fault_point("io_manifest_write")
+        iofault.durable_write(path, raw)
         # atomic CURRENT swap — the commit point; the fault point simulates
         # a crash in the window after the manifest is written but before the
         # commit becomes visible (chaos tests verify the old snapshot wins)
-        from cloudberry_tpu.utils.faultinject import fault_point
-
         if fault_point("storage_commit_before_current"):
             return v
         fd, tmp = tempfile.mkstemp(dir=mdir)
@@ -269,6 +276,10 @@ class TableStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(mdir, "CURRENT"))
+        iofault.fsync_dir(mdir)  # the rename must survive power loss too
+        # the committed-but-unacknowledged window: a crash here loses the
+        # ack, not the data — restart-verify must FIND these rows durable
+        fault_point("storage_commit_after_current")
         self._bump_epoch()
         return v
 
@@ -291,6 +302,25 @@ class TableStore:
         os.replace(tmp, os.path.join(self.root, "_EPOCH"))
 
     # ---------------------------------------------- inter-process write lock
+
+    @staticmethod
+    def _lock_is_stale(path: str) -> bool:
+        """True when _LOCK names a pid that is no longer alive — the
+        signature of a process killed while holding the store lock."""
+        try:
+            with open(path) as f:
+                pid = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return False  # unreadable/mid-write: let the retry loop spin
+        if pid <= 0 or pid == os.getpid():
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True
+        except PermissionError:
+            return False  # alive, owned by someone else
+        return False
 
     def lock(self, timeout_s: float = 30.0):
         """Store-wide mutual exclusion: _tlock serializes the THREADS
@@ -330,6 +360,16 @@ class TableStore:
                         os.close(fd)
                         break
                     except FileExistsError:
+                        # crash-only discipline: a lock file whose owner
+                        # pid is dead is leftover state from a killed
+                        # process, not a live writer — break it (the
+                        # O_EXCL retry arbitrates racing breakers)
+                        if self._lock_is_stale(path):
+                            try:
+                                os.unlink(path)
+                            except FileNotFoundError:
+                                pass
+                            continue
                         if _time.monotonic() > deadline:
                             raise RuntimeError(
                                 f"store lock timeout after {timeout_s}s — "
@@ -457,8 +497,10 @@ class TableStore:
             for f in files:
                 try:
                     total += os.path.getsize(os.path.join(dirpath, f))
-                except OSError:
-                    pass
+                except FileNotFoundError:
+                    pass  # raced a concurrent unlink — benign
+                except OSError as e:
+                    iofault.note_io_error(os.path.join(dirpath, f), e)
         self._usage_cache = (now, total)
         return total
 
@@ -494,7 +536,8 @@ class TableStore:
             tdir = os.path.join(self.root, table)
             for part in man["partitions"]:
                 cols = mp.read_columns(os.path.join(tdir, part["file"]),
-                                       cipher=self.cipher)
+                                       cipher=self.cipher,
+                                       verify=self.verify_checksums)
                 mask = np.asarray(pred(cols))
                 if mask.any():
                     dead = set(part["deleted"]) \
@@ -581,7 +624,8 @@ class TableStore:
         for part in parts:
             cols = mp.read_columns(os.path.join(tdir, part["file"]),
                                    want, cipher=self.cipher,
-                                   pool=pool, on_decode=on_decode)
+                                   pool=pool, on_decode=on_decode,
+                                   verify=self.verify_checksums)
             if part["deleted"]:
                 keep = np.ones(part["num_rows"], dtype=bool)
                 keep[np.asarray(part["deleted"], dtype=np.int64)] = False
@@ -637,14 +681,13 @@ class TableStore:
     # every session on the root draws from the same number line.
 
     def _atomic_json(self, path: str, obj) -> None:
-        """Durable atomic JSON replace (shared by sequences/matview defs —
-        same discipline as the manifest CURRENT swap)."""
-        fd, tmp = tempfile.mkstemp(dir=self.root)
-        with os.fdopen(fd, "w") as f:
-            json.dump(obj, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        """Durable atomic JSON replace (shared by sequences/matview defs,
+        the topology record, and the compaction journal — same
+        discipline as the manifest CURRENT swap)."""
+        from cloudberry_tpu.utils.faultinject import fault_point
+
+        fault_point("io_atomic_json")
+        iofault.atomic_json(path, obj, dirpath=self.root)
 
     def _seq_path(self) -> str:
         return os.path.join(self.root, "_SEQUENCES.json")

@@ -17,6 +17,7 @@ a soak can state exactly which seams fired.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -29,9 +30,19 @@ class InjectedFault(RuntimeError):
     pass
 
 
+# IO-fault actions (consumed by storage/iofault.py): when one of these
+# fires at a seam, fault_point() records it thread-locally and returns;
+# the NEXT iofault write primitive on that thread implements the fault
+# (torn prefix, short write, dropped fsync, ENOSPC, EIO). Arm them only
+# on io_* seams — a seam with no following iofault write would leave
+# the pending action to the thread's next unrelated write.
+IO_ACTIONS = frozenset({"torn", "short", "fsync_drop", "enospc", "eio"})
+
+
 @dataclass
 class _Arm:
-    action: str           # 'error' | 'sleep' | 'skip' | 'hang'
+    action: str           # 'error' | 'sleep' | 'skip' | 'hang' |
+    #                       'crash' | one of IO_ACTIONS
     sleep_s: float = 0.0
     start_hit: int = 1    # trigger from the Nth hit...
     end_hit: int = 1 << 30  # ...through this hit
@@ -92,11 +103,30 @@ INVENTORY = frozenset({
     # 'error' on compact_commit dies inside the locked commit window
     # AFTER the new files exist — the crash-restart journal-resume case
     "ingest_flush", "compact_chunk", "compact_commit",
+    # faulty-IO seams (storage/iofault.py, ISSUE 19): each guards ONE
+    # durable write primitive — arm an IO_ACTIONS action to corrupt that
+    # write (torn/short/fsync_drop/enospc/eio), or 'crash' to hard-kill
+    # the process there (the torture-harness matrix).
+    # io_partition_write: micro-partition file body
+    # io_manifest_write:  v{N}.json snapshot manifest
+    # storage_commit_after_current: just AFTER the CURRENT swap — the
+    #   committed-but-unacknowledged window
+    # io_atomic_json:     every _atomic_json (sequences, matviews,
+    #   _TOPOLOGY.json, the compaction journal)
+    # io_journal_write:   the compaction journal specifically
+    # io_topology_write:  the topology record specifically
+    # io_feedback_write:  the learned-stats _FEEDBACK.json write
+    "io_partition_write", "io_manifest_write",
+    "storage_commit_after_current", "io_atomic_json",
+    "io_journal_write", "io_topology_write", "io_feedback_write",
 })
 
 _registry: dict[str, _Arm] = {}
 _seen: set[str] = set()
 _lock = threading.Lock()
+# the fired-but-unconsumed IO action (per thread): set by fault_point
+# when an IO_ACTIONS arm fires, popped by the next iofault write
+_tls = threading.local()
 
 
 def inject_fault(name: str, action: str = "error", sleep_s: float = 0.0,
@@ -153,6 +183,14 @@ def fault_point(name: str) -> bool:
         wake = arm.wake
     if action == "error":
         raise InjectedFault(f"fault injected at {name!r}")
+    if action == "crash":
+        # the process-kill arm (ISSUE 19): no atexit, no flush, no
+        # cleanup — the closest in-process analog of SIGKILL, so the
+        # torture harness can die at ANY seam and restart-verify
+        os._exit(137)
+    if action in IO_ACTIONS:
+        _tls.io_action = (name, action)
+        return False
     if action == "sleep":
         time.sleep(sleep_s)
         return False
@@ -167,6 +205,41 @@ def fault_point(name: str) -> bool:
             if time.monotonic() >= end:
                 break
     return False
+
+
+def take_io_action() -> Optional[tuple[str, str]]:
+    """Pop this thread's pending (seam, io_action) pair, if any — the
+    iofault write primitives call this at entry, so the IO fault lands
+    on exactly the write the preceding fault_point() guarded."""
+    pending = getattr(_tls, "io_action", None)
+    _tls.io_action = None
+    return pending
+
+
+def arm_from_env(spec: Optional[str] = None) -> int:
+    """Arm seams from a ``CBTPU_INJECT`` spec — how the crash-torture
+    harness injects into a REAL server subprocess it is about to kill:
+    semicolon-separated ``name=action[@start_hit[-end_hit]]`` entries,
+    e.g. ``"io_manifest_write=crash@3"`` (crash on the 3rd hit) or
+    ``"io_partition_write=torn"``. Returns the number of seams armed.
+    Called once at server start (mgmt/cli.py serve)."""
+    spec = spec if spec is not None else os.environ.get("CBTPU_INJECT", "")
+    n = 0
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry or "=" not in entry:
+            continue
+        name, _, act = entry.partition("=")
+        start, end = 1, 1 << 30
+        if "@" in act:
+            act, _, window = act.partition("@")
+            lo, _, hi = window.partition("-")
+            start = int(lo) if lo else 1
+            end = int(hi) if hi else 1 << 30
+        inject_fault(name.strip(), act.strip(), start_hit=start,
+                     end_hit=end)
+        n += 1
+    return n
 
 
 def known_fault_points() -> set[str]:

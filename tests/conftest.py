@@ -144,13 +144,78 @@ _SLOW_TIER = (
     "test_feedback.py::test_midstatement_adaptive_replan",
     "test_feedback.py::test_fault_skip_suppresses_adaptation",
     "test_topology.py::test_checkpointed_statement_resumes_across_expand_cutover",
+    # round 19 (crash-torture + iofault suites join tier-1): more
+    # dist8/heavy variants whose cheaper sibling stays — seven more
+    # TPC-H dist8 queries keep their test_tpch_query single-seg
+    # siblings (q2/q8 precedent), DS distributed/round5 dist8 cases
+    # keep their single-seg runs, digest-parity q3-dist8 now rides the
+    # slow full sweep like q5/q10 already do (the whole single-seg
+    # digest subset minus q5 stays tier-1), packed-parity q3-seg8
+    # keeps q3-seg1, and the dist global agg keeps its single-node
+    # twin (test_spill.py::test_tiled_global_agg).
+    "test_join_filter.py::test_tpch_digest_parity_dist8[q3]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q43]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q94]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q97]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q16]",
+    "test_tpcds_round5.py::test_tpcds_round5[dist8-q56]",
+    "test_distributed.py::test_tpch_distributed[q9]",
+    "test_distributed.py::test_tpch_distributed[q15]",
+    "test_distributed.py::test_tpch_distributed[q10]",
+    "test_distributed.py::test_tpch_distributed[q18]",
+    "test_distributed.py::test_tpch_distributed[q17]",
+    "test_distributed.py::test_tpch_distributed[q22]",
+    "test_distributed.py::test_tpch_distributed[q11]",
+    "test_tpcds.py::test_tpcds_distributed[q36]",
+    "test_tpcds.py::test_tpcds_distributed[q20]",
+    "test_tpcds.py::test_tpcds_distributed[q42]",
+    "test_tpcds.py::test_tpcds_distributed[q27]",
+    "test_tpcds.py::test_tpcds_distributed[q55]",
+    "test_tpcds.py::test_tpcds_distributed[q12]",
+    "test_packed_motion.py::test_tpch_packed_parity_pinned[q3-seg8]",
+    "test_spill_dist.py::test_dist_tiled_global_agg",
 )
 
 
+# Environment skips, PINNED (ISSUE 19 triage): tests whose only failure
+# mode is a dependency this image does not ship skip with the reason
+# spelled out instead of failing — tier-1 signal must be clean so a real
+# regression (e.g. in the crash matrix) is never lost in known noise.
+# The pin is the explicit node-id list: only THESE tests may skip for
+# the named module, and they run normally wherever the module exists.
+_ENV_SKIPS = (
+    ("cryptography", (
+        "test_tde.py::test_roundtrip_under_encryption",
+        "test_tde.py::test_no_plaintext_on_disk",
+        "test_tde.py::test_wrong_or_missing_key_refused",
+        "test_dirtable.py::test_directory_table_tde",
+    )),
+)
+
+
+def _module_missing(name: str) -> bool:
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec(name) is None
+    except (ImportError, ValueError):
+        return True
+
+
 def pytest_collection_modifyitems(config, items):
+    env_skips = {}
+    for mod, nodeids in _ENV_SKIPS:
+        if _module_missing(mod):
+            mark = pytest.mark.skip(
+                reason=f"needs the {mod!r} package (not in this image)")
+            for nid in nodeids:
+                env_skips[nid] = mark
     for item in items:
         if item.nodeid.endswith(_SLOW_TIER):
             item.add_marker(pytest.mark.slow)
+        for nid, mark in env_skips.items():
+            if item.nodeid.endswith(nid):
+                item.add_marker(mark)
 
 
 @pytest.fixture

@@ -1,0 +1,67 @@
+"""Process-kill torture matrix (tools/crash_torture.py) — ISSUE 19.
+
+The real-process half of the crash-only story: a server subprocess is
+killed at an armed durability seam (``os._exit(137)`` mid-write),
+restarted clean, and the acked/unacked ledger is verified over the
+wire, then offline via fsck. The full matrix (every seam) is slow-tier;
+tier-1 keeps one end-to-end smoke so the harness itself — subprocess
+launch, CBTPU_INJECT arming, banner sync, restart, verify, fsck —
+cannot rot between full-suite runs.
+"""
+
+import pytest
+
+from tools import crash_torture as ct
+
+
+def _assert_clean(rec):
+    assert rec["problems"] == [], (
+        f"{rec['seam']}@{rec['hit']}: {rec['problems']}")
+    assert rec["fired"], f"{rec['seam']} never fired"
+    assert rec["exit_code"] == 137
+    assert rec["acked_lost"] == 0
+    assert rec["fsck_clean"] is True
+    assert rec["recovery_ms"] is not None
+
+
+def test_single_seam_smoke():
+    """Tier-1 smoke: kill INSIDE the manifest commit (after the new
+    v{N}.json is written, before CURRENT swings) — the classic torn-
+    commit window. Zero acked loss, fsck clean, orphans collected."""
+    rec = ct.run_seam("storage_commit_before_current", hit=14)
+    _assert_clean(rec)
+    assert rec["acked_inserts"] > 0  # the kill came after real acks
+
+
+@pytest.mark.slow  # ~11 server lifecycles: minutes of wall clock
+@pytest.mark.parametrize("seam,hit", ct.MATRIX_SEAMS,
+                         ids=[s for s, _ in ct.MATRIX_SEAMS])
+def test_matrix_seam(seam, hit):
+    """The full crash matrix, one seam per test so a regression names
+    its seam. Acceptance (ISSUE 19): >= 10 seams, zero acked loss,
+    zero torn manifests/journals, bit-identical read set, fsck clean."""
+    _assert_clean(ct.run_seam(seam, hit=hit))
+
+
+def test_serve_bench_kill_at_row():
+    """serve_bench --kill-at emits the crash pass as a CSV row: the
+    recovery_ms column carries restart-to-first-answer and acked_lost
+    is 0 — crash recovery rides the same dashboards as QPS."""
+    import tools.serve_bench as SB
+
+    rows = SB.main(["--kill-at", "io_manifest_write"])
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["mode"] == "killat" and row["mix"] == "io_manifest_write"
+    assert row["acked_lost"] == 0
+    assert row["recovery_ms"] > 0
+    assert row["_torture"]["problems"] == []
+    # the row is full-width: every CSV column renders
+    assert len(SB.csv_row(row).split(",")) == \
+        len(SB.CSV_HEADER.split(","))
+
+
+def test_matrix_covers_ten_seams():
+    """The acceptance floor is pinned here, not in prose: the matrix
+    must keep >= 10 distinct durability seams."""
+    assert len({s for s, _ in ct.MATRIX_SEAMS}) >= 10

@@ -1,0 +1,273 @@
+"""Faulty-IO shim, checksums, fsck/GC, stale-lock recovery (ISSUE 19).
+
+The in-process half of the crash-only story: every fault the torture
+harness provokes by killing a real server has a deterministic unit test
+here — torn/short/dropped-fsync/ENOSPC/EIO writes through the iofault
+shim, content-checksum detection of flipped bits, fsck's corruption/
+orphan split, and the stale-lock break a killed writer leaves behind.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import cloudberry_tpu as cb
+from cloudberry_tpu import lifecycle
+from cloudberry_tpu.config import Config
+from cloudberry_tpu.storage import iofault
+from cloudberry_tpu.storage import micropartition as mp
+from cloudberry_tpu.storage.fsck import fsck
+from cloudberry_tpu.storage.table_store import TableStore
+from cloudberry_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset_fault()
+    iofault.reset_counters()
+    yield
+    faultinject.reset_fault()
+    iofault.reset_counters()
+
+
+def _sess(tmp_path, **over):
+    cfg = Config().with_overrides(
+        **{"storage.root": str(tmp_path / "store"), **over})
+    return cb.Session(cfg)
+
+
+def _insert(s, n0=0, n=4):
+    s.sql("create table t (k bigint, v bigint) distributed by (k)")
+    vals = ", ".join(f"({k}, {k * 7})" for k in range(n0, n0 + n))
+    s.sql(f"insert into t values {vals}")
+
+
+def _rows(s):
+    df = s.sql("select k, v from t order by k").to_pandas()
+    return list(zip(df["k"].tolist(), df["v"].tolist()))
+
+
+# ------------------------------------------------------------- the shim
+
+
+def test_torn_manifest_write_keeps_old_snapshot(tmp_path):
+    s = _sess(tmp_path)
+    _insert(s)
+    before = _rows(s)
+    faultinject.inject_fault("io_manifest_write", "torn")
+    with pytest.raises(lifecycle.StorageIOError):
+        s.sql("insert into t values (100, 700)")
+    faultinject.reset_fault()
+    assert iofault.io_error_count() == 1
+    # the torn v{N}.json is unreachable: reads still serve the old
+    # snapshot, in this session and a fresh one
+    assert _rows(s) == before
+    s2 = _sess(tmp_path)
+    assert _rows(s2) == before
+    # and fsck calls the residue an orphan, never corruption
+    rep = fsck(str(tmp_path / "store"), deep=True)
+    assert rep["clean"], rep["problems"]
+
+
+@pytest.mark.parametrize("action", ["enospc", "eio", "short"])
+def test_io_failures_surface_typed_and_counted(tmp_path, action):
+    s = _sess(tmp_path)
+    _insert(s)
+    before = _rows(s)
+    faultinject.inject_fault("io_partition_write", action)
+    with pytest.raises(lifecycle.StorageIOError) as ei:
+        s.sql("insert into t values (100, 700)")
+    assert lifecycle.is_retryable(ei.value) is True
+    assert iofault.io_error_count() == 1
+    faultinject.reset_fault()
+    assert _rows(s) == before
+    # the retry goes through clean — transient means transient
+    s.sql("insert into t values (100, 700)")
+    assert (100, 700) in _rows(s)
+
+
+def test_dropped_fsync_lost_at_crash_is_caught_by_fsck(tmp_path):
+    """The latent bug this shim closed: a partition that only reached
+    the page cache when the manifest committed. fsync_drop + simulated
+    power loss reproduces it; fsck --deep names the missing file."""
+    s = _sess(tmp_path)
+    _insert(s)
+    faultinject.inject_fault("io_partition_write", "fsync_drop")
+    s.sql("insert into t values (100, 700)")  # acked!
+    faultinject.reset_fault()
+    assert iofault.unsynced_paths()
+    lost = iofault.simulated_crash()
+    assert len(lost) == 1
+    rep = fsck(str(tmp_path / "store"), deep=True)
+    assert not rep["clean"]
+    assert any("missing" in p for p in rep["problems"])
+
+
+def test_crash_action_exits_hard(tmp_path, monkeypatch):
+    codes = []
+    monkeypatch.setattr(os, "_exit", lambda c: codes.append(c))
+    faultinject.inject_fault("io_manifest_write", "crash")
+    s = _sess(tmp_path)
+    _insert(s)
+    assert codes and codes[0] == 137
+
+
+def test_atomic_json_failure_leaves_target_intact(tmp_path):
+    path = str(tmp_path / "obj.json")
+    iofault.atomic_json(path, {"v": 1})
+    faultinject.inject_fault("io_atomic_json", "torn")
+    faultinject.fault_point("io_atomic_json")  # stash like a caller
+    with pytest.raises(lifecycle.StorageIOError):
+        iofault.atomic_json(path, {"v": 2})
+    with open(path) as f:
+        assert json.load(f) == {"v": 1}
+    # no tmp droppings either — the failed replace cleans up
+    assert [f for f in os.listdir(tmp_path) if f.startswith("tmp")] == []
+
+
+def test_arm_from_env_parses_windows():
+    n = faultinject.arm_from_env(
+        "io_manifest_write=crash@3; io_partition_write=torn ;bad")
+    assert n == 2
+    armed = faultinject.list_faults()["armed"]
+    assert armed["io_manifest_write"]["action"] == "crash"
+    assert armed["io_manifest_write"]["start_hit"] == 3
+    assert armed["io_partition_write"]["action"] == "torn"
+
+
+# ----------------------------------------------------------- checksums
+
+
+def test_bit_flip_raises_corruption_not_wrong_answer(tmp_path):
+    s = _sess(tmp_path)
+    _insert(s, n=8)
+    part = next(f for f in os.listdir(tmp_path / "store" / "t")
+                if f.endswith(".cbmp"))
+    path = str(tmp_path / "store" / "t" / part)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(mp.MAGIC) + 3] ^= 0x40  # flip one bit inside a column blob
+    open(path, "wb").write(bytes(raw))
+    s2 = _sess(tmp_path)
+    with pytest.raises(lifecycle.StorageCorruptionError) as ei:
+        # select BOTH columns: the flipped byte is in the first column
+        # blob, and only decoded columns are verified
+        s2.sql("select k, v from t").to_pandas()
+    assert lifecycle.is_retryable(ei.value) is False
+    # fsck --deep reaches the same verdict offline
+    rep = fsck(str(tmp_path / "store"), deep=True)
+    assert not rep["clean"]
+    assert any("checksum" in p for p in rep["problems"])
+
+
+def test_verify_off_is_a_config_choice(tmp_path):
+    s = _sess(tmp_path, **{"storage.verify_checksums": False})
+    assert s.store.verify_checksums is False
+    s2 = _sess(tmp_path)
+    assert s2.store.verify_checksums is True
+
+
+def test_footer_checksums_survive_compaction(tmp_path):
+    s = _sess(tmp_path)
+    _insert(s)
+    s.sql("insert into t values (50, 350)")
+    from cloudberry_tpu.storage.compact import CompactionService
+
+    CompactionService(s).run_once()
+    for f in os.listdir(tmp_path / "store" / "t"):
+        if not f.endswith(".cbmp"):
+            continue
+        footer = mp.read_footer(str(tmp_path / "store" / "t" / f))
+        assert all("cksum" in c for c in footer["columns"])
+        assert mp.verify_file(str(tmp_path / "store" / "t" / f)) == []
+
+
+# ------------------------------------------------------------- fsck/GC
+
+
+def test_fsck_orphans_grace_and_gc(tmp_path):
+    s = _sess(tmp_path)
+    _insert(s)
+    root = str(tmp_path / "store")
+    orphan = os.path.join(root, "t", "part-deadbeef.cbmp")
+    open(orphan, "wb").write(b"not a partition")
+    # young orphan: reported, protected by grace
+    rep = fsck(root, grace_s=3600.0, gc=True)
+    assert rep["clean"]
+    assert [o["path"] for o in rep["orphans"]] == ["t/part-deadbeef.cbmp"]
+    assert not rep["orphans"][0]["collectable"]
+    assert os.path.exists(orphan)
+    # past grace: collected
+    rep = fsck(root, grace_s=0.0, gc=True)
+    assert rep["collected"] == ["t/part-deadbeef.cbmp"]
+    assert not os.path.exists(orphan)
+    assert fsck(root)["orphans"] == []
+
+
+def test_fsck_protects_journal_pending_files(tmp_path):
+    s = _sess(tmp_path)
+    _insert(s)
+    root = str(tmp_path / "store")
+    pend = os.path.join(root, "t", "part-pending.cbmp")
+    open(pend, "wb").write(b"replacement-in-flight")
+    with open(os.path.join(root, "_COMPACTION.json"), "w") as f:
+        json.dump({"counters": {}, "pending":
+                   {"table": "t", "files": ["part-pending.cbmp"]}}, f)
+    rep = fsck(root, grace_s=0.0, gc=True)
+    assert os.path.exists(pend)  # the journal owns it, GC must not
+    assert all(o["path"] != "t/part-pending.cbmp"
+               for o in rep["orphans"])
+
+
+def test_fsck_flags_delete_vector_out_of_range(tmp_path):
+    s = _sess(tmp_path)
+    _insert(s)
+    store = TableStore(str(tmp_path / "store"))
+    man = store.read_manifest("t")
+    man["partitions"][0]["deleted"] = [10_000]
+    with store.lock():
+        store._commit("t", man)
+    rep = fsck(str(tmp_path / "store"))
+    assert not rep["clean"]
+    assert any("out of range" in p for p in rep["problems"])
+
+
+# ------------------------------------------------------ stale lock break
+
+
+def test_stale_lock_from_dead_pid_is_broken(tmp_path):
+    store = TableStore(str(tmp_path / "store"))
+    lockfile = os.path.join(store.root, "_LOCK")
+    # a pid that cannot be alive: fork-range max is far below this
+    with open(lockfile, "w") as f:
+        f.write("999999999")
+    with store.lock(timeout_s=2.0):
+        assert not os.path.exists(lockfile) or \
+            open(lockfile).read() == str(os.getpid())
+    assert not os.path.exists(lockfile)
+
+
+def test_live_lock_is_respected(tmp_path):
+    store = TableStore(str(tmp_path / "store"))
+    lockfile = os.path.join(store.root, "_LOCK")
+    with open(lockfile, "w") as f:
+        f.write("1")  # pid 1 is always alive (and not ours)
+    with pytest.raises(RuntimeError, match="lock timeout"):
+        with store.lock(timeout_s=0.3):
+            pass
+    os.unlink(lockfile)
+
+
+# ------------------------------------------------- durable write basics
+
+
+def test_durable_write_and_checksum_helpers(tmp_path):
+    p = str(tmp_path / "f.bin")
+    iofault.durable_write(p, b"hello")
+    assert open(p, "rb").read() == b"hello"
+    h = iofault.content_hash(b"hello")
+    assert h.startswith("crc32:")
+    assert iofault.hash_matches(h, b"hello")
+    assert not iofault.hash_matches(h, b"hellp")
+    assert iofault.hash_matches("xxh3:feed", b"anything")  # unknown algo

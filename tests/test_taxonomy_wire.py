@@ -36,6 +36,11 @@ WIRE_ERRORS = [
     (SchedQueueFull, True),
     (SchedDeadline, True),
     (TenantQueueFull, True),
+    # storage taxonomy (ISSUE 19): an OS-layer write failure is
+    # transient (the previous snapshot is intact — retry); bytes that
+    # fail their content checksum are not coming back on a retry
+    (lifecycle.StorageIOError, True),
+    (lifecycle.StorageCorruptionError, False),
     (ValueError, False),          # ordinary semantic failure
 ]
 

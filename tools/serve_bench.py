@@ -121,7 +121,13 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               # flush commit latency, compaction chunks folded DURING
               # the run, and the post-run bounded-invariant census
               # (worst per-table delta-partition count)
-              "ingest_qps,flush_ms_p95,compact_chunks,delta_parts_max")
+              "ingest_qps,flush_ms_p95,compact_chunks,delta_parts_max,"
+              # ISSUE 19 (crash-only storage): --kill-at SEAM runs one
+              # process-kill torture pass (tools/crash_torture.py) —
+              # recovery_ms carries restart-to-first-answer wall clock
+              # and acked_lost MUST be 0 (acked writes survive the
+              # kill). Normal bench rows report acked_lost=0.
+              "acked_lost")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -724,6 +730,7 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
         "tenant_p99_ms": round(_pct(all_lats, 0.99), 3),
         "tenant_queue_depth": dstats.get("max_depth", 0),
         "fairness_index": round(fidx, 4),
+        "acked_lost": 0,  # the --kill-at column; a live run loses nothing
         # non-CSV extras for programmatic callers
         "_backpressure": rejects[0],
     }
@@ -818,6 +825,41 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     return out
 
 
+def run_killat(seam: str, hit: int | None = None) -> dict:
+    """--kill-at: one process-kill torture pass as a bench row. The
+    heavy lifting (server subprocess, CBTPU_INJECT arming, restart,
+    wire verify, fsck) is tools/crash_torture.py's run_seam; this
+    wrapper shapes the verdict into the serving CSV so crash recovery
+    rides the same dashboards as QPS. acked_lost != 0 or any problem
+    is a FAILURE, surfaced both in the row and on stderr."""
+    from tools.crash_torture import MATRIX_SEAMS, run_seam
+
+    known = dict(MATRIX_SEAMS)
+    if hit is None:
+        hit = known.get(seam, 6)
+    rec = run_seam(seam, hit=hit)
+    row = {k: 0 for k in CSV_HEADER.split(",")}
+    row.update({
+        "mode": "killat", "mix": seam, "clients": 1,
+        "duration_s": 0.0, "requests": rec["acked_inserts"],
+        "qps": 0.0, "p50_ms": 0.0, "p99_ms": 0.0,
+        "avg_occupancy": 0.0, "fairness_index": 1.0, "tenant": "all",
+        "recovery_count": 1 if rec["fired"] else 0,
+        "recovery_ms": rec["recovery_ms"] or 0.0,
+        "acked_lost": rec["acked_lost"],
+        # non-CSV extras for programmatic callers / tests
+        "_torture": rec,
+    })
+    for p in rec["problems"]:
+        print(f"# kill-at {seam}@{hit}: {p}", file=sys.stderr)
+    if not rec["problems"]:
+        print(f"# kill-at {seam}@{hit}: clean — exit=137, "
+              f"acked={rec['acked_inserts']}, acked_lost=0, "
+              f"recovery={rec['recovery_ms']}ms, fsck clean",
+              file=sys.stderr)
+    return row
+
+
 def _parse_at(spec):
     """'T:N' → (T seconds into the run, N target segments), or None."""
     if not spec:
@@ -887,6 +929,17 @@ def main(argv=None) -> list[dict]:
                          "moved_rows / epoch_flips CSV columns)")
     ap.add_argument("--shrink-at", default=None, metavar="T:N",
                     help="same, shrinking to N segments")
+    ap.add_argument("--kill-at", default=None, metavar="SEAM",
+                    help="crash-recovery bench: launch a real server "
+                         "subprocess, kill it (os._exit) at this armed "
+                         "durability seam mid-workload, restart, and "
+                         "verify — emits one CSV row whose recovery_ms "
+                         "is restart-to-first-answer and whose "
+                         "acked_lost MUST be 0 (see "
+                         "tools/crash_torture.py MATRIX_SEAMS)")
+    ap.add_argument("--kill-hit", type=int, default=None,
+                    help="fire --kill-at on the Nth seam hit "
+                         "(default: the torture matrix's)")
     ap.add_argument("--no-compact", action="store_true",
                     help="readwrite baseline: same append share with "
                          "the compaction service off (the A/B for the "
@@ -907,6 +960,17 @@ def main(argv=None) -> list[dict]:
                 _res.setrlimit(_res.RLIMIT_NOFILE, (want, hard))
         except (ImportError, ValueError, OSError):
             pass
+    if args.kill_at:
+        r = run_killat(args.kill_at, args.kill_hit)
+        print(CSV_HEADER)
+        print(csv_row(r), flush=True)
+        if args.csv:
+            new = not os.path.exists(args.csv)
+            with open(args.csv, "a") as fh:
+                if new:
+                    fh.write(CSV_HEADER + "\n")
+                fh.write(csv_row(r) + "\n")
+        return [r]
     tenants = parse_tenantspec(args.tenants, args.clients) \
         if args.tenants else None
     modes = ["direct", "batched"] if args.mode == "both" else [args.mode]
