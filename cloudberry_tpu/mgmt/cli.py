@@ -268,6 +268,9 @@ def cmd_fsck(args) -> int:
         for o in report["orphans"]:
             print(f"orphan {o['path']} (age {o['age_s']}s"
                   f"{', collectable' if o['collectable'] else ''})")
+        for name in report["census_skipped"]:
+            print(f"census skipped for {name}: manifest chain unreadable "
+                  "or problems found — orphan GC disabled for this table")
         for c in report["collected"]:
             print(f"collected {c}")
         print(f"fsck {'clean' if report['clean'] else 'NOT CLEAN'}: "

@@ -20,6 +20,15 @@ contract the write path promises (ISSUE 19):
   files and anything younger than ``grace_s`` are protected (an
   in-flight commit looks orphaned until CURRENT lands).
 
+The census is fail-safe: a table whose manifest chain could not be
+FULLY read (CURRENT torn, an old committed version unreadable, the
+_manifests dir unlistable) or that recorded any problem is excluded
+from the orphan census entirely (``report["census_skipped"]``) — an
+incomplete referenced-set would classify live data files as orphans,
+and ``--gc`` would then destroy exactly the table fsck was run to
+diagnose. Likewise a torn compaction journal suppresses the census for
+every table, because journal-pending protection is unknowable.
+
 ``gc=True`` unlinks collectable orphans. The verdict is ``clean`` iff
 no corruption problems were found.
 """
@@ -40,14 +49,18 @@ _STORE_JSON = ("_SEQUENCES.json", "_MATVIEWS.json", "_TOPOLOGY.json",
 _KEEP = {"cluster.json", "_EPOCH", "_LOCK"} | set(_STORE_JSON)
 
 
-def _journal_protected(root: str) -> set[str]:
+def _journal_protected(root: str) -> Optional[set[str]]:
     """table-relative paths the compaction journal's pending record still
-    owns — their commit may be about to happen on restart."""
+    owns — their commit may be about to happen on restart. ``None`` when
+    the journal EXISTS but cannot be read: protection is then unknowable
+    and the orphan census must not run at all."""
     try:
         with open(os.path.join(root, "_COMPACTION.json")) as f:
             rec = json.load(f)
-    except (OSError, ValueError):
+    except FileNotFoundError:
         return set()
+    except (OSError, ValueError):
+        return None
     pend = rec.get("pending") or {}
     table = pend.get("table")
     if not table:
@@ -56,10 +69,13 @@ def _journal_protected(root: str) -> set[str]:
 
 
 def _check_table(store, root: str, name: str, deep: bool,
-                 report: dict) -> set[str]:
+                 report: dict) -> Optional[set[str]]:
     """Verify one table; returns the set of referenced partition files
     (across ALL manifest versions — older snapshots pin their files
-    until their manifests are pruned)."""
+    until their manifests are pruned), or ``None`` when the manifest
+    chain could not be fully read — the referenced-set is then
+    incomplete and MUST NOT drive the orphan census (every live file
+    would look orphaned and --gc would unlink the table's data)."""
     problems = report["problems"]
     tdir = os.path.join(root, name)
     mdir = os.path.join(tdir, "_manifests")
@@ -68,7 +84,7 @@ def _check_table(store, root: str, name: str, deep: bool,
         man = store.read_manifest(name)
     except Exception as e:  # noqa: BLE001 — any parse failure is the finding
         problems.append(f"{name}: CURRENT manifest unreadable: {e}")
-        return referenced
+        return None
     entry = {"version": man.get("version", 0),
              "partitions": len(man.get("partitions", ())),
              "rows": 0, "checked": 0}
@@ -101,20 +117,30 @@ def _check_table(store, root: str, name: str, deep: bool,
                 problems.append(f"{name}/{fname}: {p}")
             entry["checked"] += 1
     # older manifest versions pin their files too (versioned reads)
+    chain_complete = True
     try:
         for mf in os.listdir(mdir):
             if mf.startswith("v") and mf.endswith(".json"):
                 try:
-                    old = store.read_manifest(
-                        name, int(mf[1:-5]))
-                except Exception:  # noqa: BLE001 — uncommitted orphan
+                    v = int(mf[1:-5])
+                    old = store.read_manifest(name, v)
+                except Exception:  # noqa: BLE001
+                    # AHEAD of CURRENT: expected crash residue (possibly
+                    # torn, never committed). At or BEHIND: a committed
+                    # snapshot whose pins we cannot enumerate — the
+                    # referenced-set is incomplete, census unsafe.
+                    if mf[1:-5].isdigit() and int(mf[1:-5]) <= entry["version"]:
+                        problems.append(
+                            f"{name}/_manifests/{mf}: committed manifest "
+                            "unreadable")
+                        chain_complete = False
                     continue
                 referenced.update(p["file"]
                                   for p in old.get("partitions", ()))
     except OSError:
-        pass
+        chain_complete = False  # cannot enumerate versions at all
     report["tables"][name] = entry
-    return referenced
+    return referenced if chain_complete else None
 
 
 def fsck(root: str, cipher=None, deep: bool = False,
@@ -130,14 +156,25 @@ def fsck(root: str, cipher=None, deep: bool = False,
     store.verify_checksums = True
     now = time.time() if now is None else now
     report: dict = {"root": root, "tables": {}, "problems": [],
-                    "orphans": [], "collected": []}
+                    "orphans": [], "collected": [], "census_skipped": []}
     protected = _journal_protected(root)
 
     for name in sorted(os.listdir(root)):
         tdir = os.path.join(root, name)
         if not os.path.isdir(os.path.join(tdir, "_manifests")):
             continue
+        n_problems = len(report["problems"])
         referenced = _check_table(store, root, name, deep, report)
+        # fail-safe census: only a table whose manifest chain was FULLY
+        # read and that reported zero problems may have its unreferenced
+        # files classified as orphans — anything else and "orphan" may
+        # mean "live file we failed to account for", which --gc would
+        # then destroy. A torn compaction journal (protected is None)
+        # suppresses the census store-wide for the same reason.
+        if (referenced is None or protected is None
+                or len(report["problems"]) > n_problems):
+            report["census_skipped"].append(name)
+            continue
         # orphan census: partition files no manifest version references
         for fname in sorted(os.listdir(tdir)):
             rel = os.path.join(name, fname)

@@ -79,15 +79,28 @@ def reset_counters() -> None:
 # as "crc32:<hex>" so the algorithm can evolve without ambiguity.
 
 
+KNOWN_HASH_ALGOS = frozenset({"crc32"})
+
+
 def content_hash(data: bytes) -> str:
     return f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
 
 
-def hash_matches(stored: str, data: bytes) -> bool:
+def hash_verdict(stored: str, data: bytes) -> str:
+    """``"ok"`` | ``"mismatch"`` | ``"unknown"``. An unrecognized
+    algorithm prefix is indistinguishable from a corrupted label (the
+    ``crc32:`` tag itself can take the bit flip), so it gets its own
+    verdict: the hot read path stays lenient for forward compat, but
+    the offline pass (verify_file) reports it instead of treating the
+    blob as verified."""
     algo, _, _hex = stored.partition(":")
-    if algo != "crc32":
-        return True  # unknown algorithm: unverifiable, not corrupt
-    return content_hash(data) == stored
+    if algo not in KNOWN_HASH_ALGOS:
+        return "unknown"
+    return "ok" if content_hash(data) == stored else "mismatch"
+
+
+def hash_matches(stored: str, data: bytes) -> bool:
+    return hash_verdict(stored, data) != "mismatch"
 
 
 # --------------------------------------------------------------- writes
@@ -178,6 +191,7 @@ def atomic_json(path: str, obj, dirpath: Optional[str] = None) -> None:
     except OSError as e:
         note_io_error(path, e)
         raise StorageIOError(f"{path}: {e}") from e
+    existed = os.path.exists(path)
     try:
         durable_write(tmp, data)
         os.replace(tmp, path)
@@ -188,6 +202,11 @@ def atomic_json(path: str, obj, dirpath: Optional[str] = None) -> None:
         _unlink_quiet(tmp)
         note_io_error(path, e)
         raise StorageIOError(f"{path}: {e}") from e
+    # an fsync-dropped temp write was just renamed onto the target: the
+    # bytes at risk now live at the DESTINATION path (replace moves the
+    # unsynced inode), so the crash simulation must lose it there — with
+    # the pre-replace existence deciding truncate-vs-unlink
+    _migrate_unsynced(tmp, path, existed)
     fsync_dir(d)
 
 
@@ -196,6 +215,14 @@ def _unlink_quiet(path: str) -> None:
         os.unlink(path)
     except OSError:
         pass
+
+
+def _migrate_unsynced(old: str, new: str, new_existed: bool) -> None:
+    """os.replace moved an unsynced write from ``old`` to ``new``."""
+    with _lock:
+        if old in _unsynced:
+            del _unsynced[old]
+            _unsynced.setdefault(new, new_existed)
 
 
 # -------------------------------------------------- simulated power loss

@@ -366,10 +366,20 @@ def verify_file(path: str, cipher=None) -> list[str]:
                     problems.append(
                         f"{path}: column {enc['name']!r} truncated "
                         f"({len(blob)} of {enc['length']} bytes)")
-                elif not iofault.hash_matches(enc["cksum"], blob):
+                    continue
+                verdict = iofault.hash_verdict(enc["cksum"], blob)
+                if verdict == "mismatch":
                     problems.append(
                         f"{path}: column {enc['name']!r} failed its "
                         f"content checksum ({enc['cksum']})")
+                elif verdict == "unknown":
+                    # a corrupted algorithm label must not read as clean
+                    # offline; the hot path alone stays lenient for
+                    # forward-compat footers
+                    problems.append(
+                        f"{path}: column {enc['name']!r} carries an "
+                        f"unknown checksum algorithm ({enc['cksum']!r}) "
+                        "— cannot verify")
         finally:
             if head != MAGIC_ENC:
                 fh.close()

@@ -20,6 +20,7 @@ Layout:
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import tempfile
@@ -303,36 +304,28 @@ class TableStore:
 
     # ---------------------------------------------- inter-process write lock
 
-    @staticmethod
-    def _lock_is_stale(path: str) -> bool:
-        """True when _LOCK names a pid that is no longer alive — the
-        signature of a process killed while holding the store lock."""
-        try:
-            with open(path) as f:
-                pid = int(f.read().strip() or "0")
-        except (OSError, ValueError):
-            return False  # unreadable/mid-write: let the retry loop spin
-        if pid <= 0 or pid == os.getpid():
-            return False
-        try:
-            os.kill(pid, 0)
-        except ProcessLookupError:
-            return True
-        except PermissionError:
-            return False  # alive, owned by someone else
-        return False
-
     def lock(self, timeout_s: float = 30.0):
         """Store-wide mutual exclusion: _tlock serializes the THREADS
         sharing this store object (ingest flusher, compaction worker,
-        statement threads), the O_EXCL lock file serializes PROCESSES.
-        Held around version-check-then-commit so two committers can never
-        both pass the OCC check and overwrite each other. Re-entrant
-        within one thread — a boolean "am I inside?" flag is NOT enough
-        here: it is readable by sibling threads, and a sibling that
-        treated the holder's flag as its own re-entrancy would walk
-        straight into the critical section and tear the v{N}.json both
-        would then write."""
+        statement threads), an flock(2) on the persistent _LOCK file
+        serializes PROCESSES. Held around version-check-then-commit so
+        two committers can never both pass the OCC check and overwrite
+        each other. Re-entrant within one thread — a boolean "am I
+        inside?" flag is NOT enough here: it is readable by sibling
+        threads, and a sibling that treated the holder's flag as its own
+        re-entrancy would walk straight into the critical section and
+        tear the v{N}.json both would then write.
+
+        flock, not a pid-stamped O_EXCL file: the kernel drops the lock
+        the instant the holder dies (crash-only — a SIGKILLed writer
+        needs no stale-lock breaking), and breaking by unlink had an
+        unfixable TOCTOU — between "pid in _LOCK is dead" and the
+        unlink, a racer can break the same stale file and acquire a
+        fresh one, which the unlink then destroys, letting two processes
+        into the commit critical section. The _LOCK file itself is
+        permanent (unlink-on-release re-opens the same race: a lock
+        taken on a just-unlinked inode excludes nobody); its content is
+        the holder's pid, for diagnostics only."""
         import contextlib
         import time as _time
 
@@ -351,40 +344,35 @@ class TableStore:
                     "thread of this process is holding the store lock")
             try:
                 path = os.path.join(self.root, "_LOCK")
-                deadline = _time.monotonic() + timeout_s
-                while True:
-                    try:
-                        fd = os.open(path,
-                                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                        os.write(fd, str(os.getpid()).encode())
-                        os.close(fd)
-                        break
-                    except FileExistsError:
-                        # crash-only discipline: a lock file whose owner
-                        # pid is dead is leftover state from a killed
-                        # process, not a live writer — break it (the
-                        # O_EXCL retry arbitrates racing breakers)
-                        if self._lock_is_stale(path):
-                            try:
-                                os.unlink(path)
-                            except FileNotFoundError:
-                                pass
-                            continue
-                        if _time.monotonic() > deadline:
-                            raise RuntimeError(
-                                f"store lock timeout after {timeout_s}s — "
-                                "if no writer is alive, remove stale "
-                                f"{path}")
-                        _time.sleep(0.01)
-                self._lock_owner = me
+                fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
                 try:
-                    yield
-                finally:
-                    self._lock_owner = None
+                    deadline = _time.monotonic() + timeout_s
+                    while True:
+                        try:
+                            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                            break
+                        except OSError:
+                            if _time.monotonic() > deadline:
+                                raise RuntimeError(
+                                    f"store lock timeout after {timeout_s}s "
+                                    f"— another process holds {path}")
+                            _time.sleep(0.01)
                     try:
-                        os.unlink(path)
-                    except FileNotFoundError:
-                        pass
+                        os.ftruncate(fd, 0)
+                        os.write(fd, str(os.getpid()).encode())
+                    except OSError:
+                        pass  # diagnostics only — the flock IS the lock
+                    self._lock_owner = me
+                    try:
+                        yield
+                    finally:
+                        self._lock_owner = None
+                        try:
+                            os.ftruncate(fd, 0)
+                        except OSError:
+                            pass
+                finally:
+                    os.close(fd)  # releases the flock
             finally:
                 self._tlock.release()
 

@@ -9,6 +9,8 @@ orphan split, and the stale-lock break a killed writer leaves behind.
 
 import json
 import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -114,6 +116,32 @@ def test_crash_action_exits_hard(tmp_path, monkeypatch):
     assert codes and codes[0] == 137
 
 
+def test_atomic_json_fsync_drop_loses_destination_at_crash(tmp_path):
+    """The dropped fsync must follow the os.replace rename: the bytes at
+    risk live at the DESTINATION once the temp file is renamed onto it,
+    so simulated power loss tears the target — not a vanished temp name
+    (which would make the fault a silent no-op on every atomic-JSON
+    seam: CURRENT discipline, sequences, journal, feedback)."""
+    path = str(tmp_path / "obj.json")
+    iofault.atomic_json(path, {"v": 1})
+    faultinject.inject_fault("io_atomic_json", "fsync_drop")
+    faultinject.fault_point("io_atomic_json")  # stash like a caller
+    iofault.atomic_json(path, {"v": 2})
+    faultinject.reset_fault()
+    assert iofault.unsynced_paths() == [path]
+    assert iofault.simulated_crash() == [path]
+    # rewrite of an existing file: the buffered bytes are gone
+    assert open(path, "rb").read() == b""
+    # a FIRST write (no prior file) vanishes entirely instead
+    fresh = str(tmp_path / "fresh.json")
+    faultinject.inject_fault("io_atomic_json", "fsync_drop")
+    faultinject.fault_point("io_atomic_json")
+    iofault.atomic_json(fresh, {"v": 1})
+    faultinject.reset_fault()
+    assert iofault.simulated_crash() == [fresh]
+    assert not os.path.exists(fresh)
+
+
 def test_atomic_json_failure_leaves_target_intact(tmp_path):
     path = str(tmp_path / "obj.json")
     iofault.atomic_json(path, {"v": 1})
@@ -159,6 +187,28 @@ def test_bit_flip_raises_corruption_not_wrong_answer(tmp_path):
     rep = fsck(str(tmp_path / "store"), deep=True)
     assert not rep["clean"]
     assert any("checksum" in p for p in rep["problems"])
+
+
+def test_unknown_cksum_algo_flagged_offline_lenient_online(tmp_path):
+    """A bit flip can hit the 'crc32:' label itself. Offline, fsck must
+    report it — 'unverifiable' reading as 'clean' would silently disable
+    checking for that blob. The hot read path alone stays lenient (a
+    genuinely newer algorithm must not brick older readers)."""
+    s = _sess(tmp_path)
+    _insert(s, n=8)
+    part = next(f for f in os.listdir(tmp_path / "store" / "t")
+                if f.endswith(".cbmp"))
+    path = str(tmp_path / "store" / "t" / part)
+    raw = open(path, "rb").read()
+    idx = raw.rindex(b"crc32:")  # last occurrence = inside the footer
+    open(path, "wb").write(raw[:idx] + b"crc99:" + raw[idx + 6:])
+    problems = mp.verify_file(path)
+    assert any("unknown checksum algorithm" in p for p in problems)
+    rep = fsck(str(tmp_path / "store"), deep=True)
+    assert not rep["clean"]
+    # online: still served (lenient), never a wrong answer from it
+    s2 = _sess(tmp_path)
+    assert len(_rows(s2)) == 8
 
 
 def test_verify_off_is_a_config_choice(tmp_path):
@@ -220,6 +270,50 @@ def test_fsck_protects_journal_pending_files(tmp_path):
                for o in rep["orphans"])
 
 
+def test_fsck_gc_refuses_census_when_current_is_torn(tmp_path):
+    """The one state fsck exists to diagnose must never trigger GC data
+    loss: with CURRENT's manifest torn, the referenced-set is unknowable,
+    so NOTHING in the table may be classified (or collected) as an
+    orphan — not even with grace_s=0."""
+    s = _sess(tmp_path)
+    _insert(s)
+    root = str(tmp_path / "store")
+    tdir = os.path.join(root, "t")
+    parts = sorted(f for f in os.listdir(tdir) if f.endswith(".cbmp"))
+    assert parts
+    with open(os.path.join(tdir, "_manifests", "CURRENT")) as f:
+        v = f.read().strip()
+    mpath = os.path.join(tdir, "_manifests", f"v{v}.json")
+    raw = open(mpath, "rb").read()
+    open(mpath, "wb").write(raw[:len(raw) // 2])  # tear it
+    rep = fsck(root, grace_s=0.0, gc=True)
+    assert not rep["clean"]
+    assert any("CURRENT manifest unreadable" in p for p in rep["problems"])
+    assert rep["census_skipped"] == ["t"]
+    assert rep["orphans"] == [] and rep["collected"] == []
+    # every data file and manifest survived
+    assert sorted(f for f in os.listdir(tdir)
+                  if f.endswith(".cbmp")) == parts
+    assert os.path.exists(mpath)
+
+
+def test_fsck_census_skipped_for_table_with_problems(tmp_path):
+    """A table that recorded ANY problem keeps its unreferenced files:
+    'orphan' may mean 'live file we failed to account for'."""
+    s = _sess(tmp_path)
+    _insert(s)
+    root = str(tmp_path / "store")
+    part = next(f for f in os.listdir(os.path.join(root, "t"))
+                if f.endswith(".cbmp"))
+    os.unlink(os.path.join(root, "t", part))  # referenced-but-missing
+    stray = os.path.join(root, "t", "part-deadbeef.cbmp")
+    open(stray, "wb").write(b"unreferenced")
+    rep = fsck(root, grace_s=0.0, gc=True)
+    assert not rep["clean"]
+    assert rep["census_skipped"] == ["t"]
+    assert rep["collected"] == [] and os.path.exists(stray)
+
+
 def test_fsck_flags_delete_vector_out_of_range(tmp_path):
     s = _sess(tmp_path)
     _insert(s)
@@ -233,30 +327,55 @@ def test_fsck_flags_delete_vector_out_of_range(tmp_path):
     assert any("out of range" in p for p in rep["problems"])
 
 
-# ------------------------------------------------------ stale lock break
+# --------------------------------------------------- crash-safe store lock
+# flock(2), not a pid-stamped O_EXCL file: the kernel releases the lock
+# when the holder dies, so a killed writer needs no stale-lock breaking —
+# and breaking-by-unlink had a TOCTOU that could evict a LIVE holder.
 
 
-def test_stale_lock_from_dead_pid_is_broken(tmp_path):
+def test_leftover_lock_file_from_dead_holder_does_not_block(tmp_path):
     store = TableStore(str(tmp_path / "store"))
     lockfile = os.path.join(store.root, "_LOCK")
-    # a pid that cannot be alive: fork-range max is far below this
+    # what a SIGKILLed writer leaves: the file (with its pid), no flock
     with open(lockfile, "w") as f:
         f.write("999999999")
     with store.lock(timeout_s=2.0):
-        assert not os.path.exists(lockfile) or \
-            open(lockfile).read() == str(os.getpid())
-    assert not os.path.exists(lockfile)
+        assert open(lockfile).read() == str(os.getpid())
+    # released: the file persists (unlink-on-release would re-open the
+    # unlinked-inode race), its pid content is cleared
+    assert open(lockfile).read() == ""
 
 
 def test_live_lock_is_respected(tmp_path):
+    import fcntl
+
     store = TableStore(str(tmp_path / "store"))
     lockfile = os.path.join(store.root, "_LOCK")
-    with open(lockfile, "w") as f:
-        f.write("1")  # pid 1 is always alive (and not ours)
-    with pytest.raises(RuntimeError, match="lock timeout"):
-        with store.lock(timeout_s=0.3):
-            pass
-    os.unlink(lockfile)
+    fd = os.open(lockfile, os.O_CREAT | os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_EX)  # a live holder (separate fd = own OFD)
+    try:
+        with pytest.raises(RuntimeError, match="lock timeout"):
+            with store.lock(timeout_s=0.3):
+                pass
+    finally:
+        os.close(fd)
+    with store.lock(timeout_s=2.0):  # released → acquirable again
+        pass
+
+
+def test_lock_releases_when_holder_process_dies(tmp_path):
+    store = TableStore(str(tmp_path / "store"))
+    lockfile = os.path.join(store.root, "_LOCK")
+    code = ("import fcntl, os, sys\n"
+            f"fd = os.open({lockfile!r}, os.O_CREAT | os.O_RDWR)\n"
+            "fcntl.flock(fd, fcntl.LOCK_EX)\n"
+            "sys.stdout.write('locked'); sys.stdout.flush()\n"
+            "os._exit(137)\n")
+    p = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True)
+    assert p.stdout == "locked"
+    with store.lock(timeout_s=2.0):  # no operator, no breaking logic
+        pass
 
 
 # ------------------------------------------------- durable write basics
@@ -271,3 +390,6 @@ def test_durable_write_and_checksum_helpers(tmp_path):
     assert iofault.hash_matches(h, b"hello")
     assert not iofault.hash_matches(h, b"hellp")
     assert iofault.hash_matches("xxh3:feed", b"anything")  # unknown algo
+    assert iofault.hash_verdict(h, b"hello") == "ok"
+    assert iofault.hash_verdict(h, b"hellp") == "mismatch"
+    assert iofault.hash_verdict("xxh3:feed", b"anything") == "unknown"
