@@ -295,6 +295,16 @@ def scan_ladder_context() -> dict:
                     rec["ab"] = {"rows": ab, **scan_bench.summarize(ab)}
                 except Exception as e:  # noqa: BLE001
                     rec["ab"] = {"error": f"{type(e).__name__}: {e}"}
+                # windowed tile-dispatch A/B (exec/tilepipe.py) on the
+                # same store root: inflight_tiles 1 vs 4 — wall-clock
+                # honest on CPU (~1×), the overlap evidence is the
+                # drain-stall-vs-step-wall split the record carries
+                try:
+                    rec["window_ab"] = scan_bench.window_ab(
+                        sf, root=root, reps=1)
+                except Exception as e:  # noqa: BLE001
+                    rec["window_ab"] = {
+                        "error": f"{type(e).__name__}: {e}"}
             finally:
                 shutil.rmtree(root, ignore_errors=True)
     except Exception as e:  # the bench must never die on its metadata
@@ -574,7 +584,8 @@ def adaptive_context(session=None) -> dict:
         rec["counters"] = {k: lg.counter(k) for k in (
             "feedback_folds", "feedback_seeded", "feedback_gen_bumps",
             "rung_downgrades", "rung_upgrades", "adaptive_replans",
-            "tile_replans")}
+            "tile_replans", "tile_deferred_overflows",
+            "tile_window_replays", "tile_stat_syncs")}
     try:
         s = cb.Session(get_config().with_overrides(**{
             "n_segments": 8, "planner.broadcast_threshold": 0,
@@ -598,7 +609,9 @@ def adaptive_context(session=None) -> dict:
              "join adim on afact.d = adim.d group by g order by g")
         lg = s.stmt_log
         keys = ("compiles", "tile_replans", "adaptive_replans",
-                "feedback_seeded", "rung_downgrades", "rung_upgrades")
+                "feedback_seeded", "rung_downgrades", "rung_upgrades",
+                "tile_deferred_overflows", "tile_window_replays",
+                "tile_stat_syncs")
 
         def snap():
             return {k: lg.counter(k) for k in keys}

@@ -172,6 +172,28 @@ class ScanPipelineConfig:
 
 
 @dataclass(frozen=True)
+class TilePipelineConfig:
+    """Windowed in-flight tile dispatch (exec/tilepipe.py) — the
+    device-side twin of the scan pipeline above: the tiled loops keep
+    up to ``inflight_tiles`` step launches in flight and fetch each
+    tile's overflow-check/skew-stat scalars via async copy, draining
+    them up to W tiles late instead of synchronizing the accelerator
+    after every step. A deferred failure (overflow, skew alarm, device
+    loss) replays ≤ W+K tiles through the recovery checkpoint store —
+    results are bit-identical window on/off by construction (tests pin
+    it); the knob only moves when the host LEARNS of a failure. The
+    extra in-flight tiles are charged into the statement's capacity
+    estimate (tilepipe.window_charge_bytes → est_pipeline_bytes)."""
+
+    enabled: bool = True
+    # In-flight tile steps. 1 reproduces the legacy synchronous loop
+    # EXACTLY (checks forced per tile). <= 0 means auto: 1 on the CPU
+    # backend (nothing to overlap on a single-threaded host), 4 on
+    # accelerators (TPU/GPU async dispatch).
+    inflight_tiles: int = 0
+
+
+@dataclass(frozen=True)
 class BufferPoolConfig:
     """HBM-resident micro-partition buffer pool (exec/bufferpool.py) —
     the shared-buffer-pool analog with device residency: decoded, packed
@@ -634,6 +656,8 @@ class Config:
     resource: ResourceConfig = field(default_factory=ResourceConfig)
     scan_pipeline: ScanPipelineConfig = field(
         default_factory=ScanPipelineConfig)
+    tile_pipeline: TilePipelineConfig = field(
+        default_factory=TilePipelineConfig)
     bufferpool: BufferPoolConfig = field(default_factory=BufferPoolConfig)
     sched: SchedConfig = field(default_factory=SchedConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)

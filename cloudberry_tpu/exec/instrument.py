@@ -460,6 +460,15 @@ def _tiled_lines(report: dict) -> list[str]:
         lines.append(
             f"  tile step: mean {th['mean'] * 1000:.2f} ms  "
             f"p95 {th['p95'] * 1000:.2f} ms  over {th['count']} tiles")
+    # windowed dispatch line (exec/tilepipe.py) only when a window was
+    # actually open — window=1 is the legacy loop and its trailer is
+    # pinned by existing tests
+    if report.get("tile_window", 1) > 1:
+        lines.append(
+            f"  tile dispatch: window {report['tile_window']}  "
+            f"in-flight peak {report.get('inflight_depth', 0)}  "
+            f"drain stall "
+            f"{report.get('drain_stall_s', 0) * 1000:.1f} ms")
     pl = report.get("pipeline")
     if pl:
         if pl.get("enabled"):
@@ -728,6 +737,7 @@ def _pipeline_once(plan, session, query):
                 session.config.resource.query_mem_bytes):
             batch = texe.run()
         wall_s = time.monotonic() - t0
+        OC.record_tile_dispatch(session.stmt_log, texe.report)
         metrics = _metrics(plan, {}, query, wall_s, 0.0,
                            batch.num_rows())
         return batch, metrics, motion_annotations(plan, {}, packed)

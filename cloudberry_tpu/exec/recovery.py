@@ -634,6 +634,21 @@ class RecoveryCtx:
 
     # ------------------------------------------------------ tick/snapshot
 
+    def snapshot_due(self, tiles_local: int) -> bool:
+        """True when ``tick`` at this tile ordinal would snapshot. The
+        windowed dispatcher (exec/tilepipe.py) asks at SUBMIT time so it
+        can stage the accumulator's device copy + async D2H before the
+        next step donates the buffer; the save itself still happens at
+        drain time, once the tile has verified clean. Drains run in
+        stream order and ``_last_snapshot`` only advances at drains, so
+        submit-time "due" is a superset of drain-time "due" — a stale
+        capture is wasted staging, never a missed snapshot."""
+        if (self.sid is None or not self.cfg.enabled
+                or self.cfg.checkpoint_every <= 0 or self._ckpt_broken):
+            return False
+        total = self.tiles_base + tiles_local
+        return total - self._last_snapshot >= self.cfg.checkpoint_every
+
     def tick(self, tiles_local: int, payload_fn) -> None:
         """After every completed tile: note progress; snapshot at the
         K-tile boundary. ``payload_fn`` builds the host payload lazily —

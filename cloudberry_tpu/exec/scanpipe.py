@@ -315,13 +315,18 @@ class PlainFeed:
 
 
 def maybe_pipeline(gen, config, device_stage: bool = False,
-                   stats: Optional[ScanStats] = None):
+                   stats: Optional[ScanStats] = None,
+                   min_depth: int = 1):
     """Wrap a tile generator in the prefetch pipeline when
     ``config.scan_pipeline`` enables it; a PlainFeed otherwise (the
-    synchronous path, unchanged semantics)."""
+    synchronous path, unchanged semantics). ``min_depth`` lets the
+    windowed tile dispatcher (exec/tilepipe.py) deepen the prefetch
+    queue to its in-flight window so the feed never becomes the
+    bottleneck behind a W-deep device queue; it never turns the
+    pipeline ON when the config disabled it."""
     sp = getattr(config, "scan_pipeline", None)
     if sp is not None and sp.enabled and sp.prefetch_tiles >= 1:
-        return ScanPipeline(gen, depth=sp.prefetch_tiles,
+        return ScanPipeline(gen, depth=max(sp.prefetch_tiles, min_depth),
                             device_stage=device_stage and sp.device_buffer,
                             stats=stats)
     return PlainFeed(gen, stats=stats)

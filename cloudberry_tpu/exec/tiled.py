@@ -41,6 +41,7 @@ from cloudberry_tpu.exec import bufferpool as BUF
 from cloudberry_tpu.exec import executor as X
 from cloudberry_tpu.exec import kernels as K
 from cloudberry_tpu.exec import scanpipe as SP
+from cloudberry_tpu.exec import tilepipe as TP
 from cloudberry_tpu.exec.resource import estimate_plan_memory
 from cloudberry_tpu.plan import expr as ex
 from cloudberry_tpu.plan import nodes as N
@@ -691,6 +692,12 @@ class SkewSentinel:
         ``self.motions``."""
         if not self.collect:
             return
+        # counter-pinned host fetch: when feedback is off (or the plan
+        # has no stat motions) the loop never even passes stats in, so
+        # this stays 0 — the no-host-sync contract tests rely on
+        log = getattr(self.session, "stmt_log", None)
+        if log is not None:
+            log.bump("tile_stat_syncs")
         for i, (bucket, rows) in enumerate(stats):
             self.demand[i] = max(self.demand[i], int(np.asarray(bucket)))
             self.cum[i] += np.asarray(rows, dtype=np.int64)
@@ -733,9 +740,21 @@ class SkewSentinel:
                 worst = (m, ratio)
         return worst
 
-    def maybe_replan(self, tiles_local: int, payload_fn) -> None:
+    def maybe_replan(self, tiles_local: int, payload_fn,
+                     settle=None) -> None:
         """Raise TileReplan when the cumulative distribution alarms and
-        the adaptation can resume safely; no-op otherwise."""
+        the adaptation can resume safely; no-op otherwise.
+
+        ``settle`` is the windowed-dispatch hook (exec/tilepipe.py): a
+        zero-arg callable that drains every in-flight tile (folding
+        their observations) and returns the new drained-tile count. The
+        alarm fires on DRAINED telemetry, but the snapshot must capture
+        the carried accumulator, which on accelerators is only valid
+        for the newest dispatched step — settling first makes every
+        dispatched tile verified-clean, so ``payload_fn`` (the live
+        accumulator) and ``tiles_local`` agree again. At window=1 the
+        queue is already empty and settle is a no-op, preserving the
+        legacy sequence exactly."""
         from cloudberry_tpu.exec import recovery as R
         from cloudberry_tpu.lifecycle import current_handle
         from cloudberry_tpu.obs import trace as OT
@@ -757,6 +776,14 @@ class SkewSentinel:
         if fault_point("tile_replan"):
             self.armed = False      # seam: suppress the adaptation
             return
+        if settle is not None:
+            # drain the in-flight window: a check that fires here aborts
+            # the replan and rides the normal adaptive-retry path; the
+            # drained tiles' observations fold into the cumulative view
+            tiles_local = settle()
+            worst = self._worst()
+            if worst is None:       # the tail un-alarmed the ratio
+                return
         # Publish what we actually saw BEFORE deciding to restart: pin
         # the cumulative counts on the partial plan's motions and fold a
         # partial sketch — the re-planned statement prices against it.
@@ -853,6 +880,15 @@ class AdaptiveTiledMixin:
                         raise
                 else:
                     raise
+                if getattr(self, "_deferred_fail", False):
+                    # the failed check had already been outrun by newer
+                    # in-flight launches (exec/tilepipe.py): this retry
+                    # IS the deferred-failure window replay — it resumes
+                    # from the last drained-clean checkpoint
+                    self._deferred_fail = False
+                    log = getattr(self.session, "stmt_log", None)
+                    if log is not None:
+                        log.bump("tile_window_replays")
                 self._compiled = None
                 self._refresh_report()
                 # a grown accumulator may blow the step budget: smaller
@@ -925,12 +961,15 @@ class TiledExecutable(AdaptiveTiledMixin):
             "tile_rows": self.tile_rows,
             "acc_capacity": shape.g_cap,
             "est_step_bytes": est + merge_bytes,
-            # scan-pipeline staging charge (exec/scanpipe.py): the
-            # bounded prefetch queue pins prefetch_tiles × one tile's
-            # host working set — obs/capacity.record_tiled adds it to
-            # the statement's observed peak
+            # scan-pipeline staging charge (exec/scanpipe.py) plus the
+            # dispatch window's extra in-flight tiles (exec/tilepipe.py)
+            # — obs/capacity.record_tiled adds both to the statement's
+            # observed peak
             "est_pipeline_bytes": SP.queue_charge_bytes(
-                shape.stream, self.tile_rows, self.session.config),
+                shape.stream, self.tile_rows, self.session.config)
+            + TP.window_charge_bytes(
+                shape.stream, self.tile_rows, self.session.config,
+                self._platform),
             # HBM buffer-pool residency attributable to the streamed
             # table (exec/bufferpool.py) — charged into the capacity
             # plane next to the pipeline's staging bytes
@@ -1013,11 +1052,9 @@ class TiledExecutable(AdaptiveTiledMixin):
             out = {f.name: cols[f.name] for f in shape.root.fields}
             return out, sel, low.checks
 
-        # donate the accumulator so the step updates in place on device;
-        # CPU XLA can't always honor donation — skip the warning noise
-        donate = () if self._platform == "cpu" else (4,)
         self._compiled = (jax.jit(prelude_fn),
-                          jax.jit(step_fn, donate_argnums=donate),
+                          jax.jit(step_fn, donate_argnums=TP.step_donation(
+                              self._platform)),
                           jax.jit(finalize_fn))
         return self._compiled
 
@@ -1075,32 +1112,61 @@ class TiledExecutable(AdaptiveTiledMixin):
         skip = ctx.skip_rows if ctx is not None else 0
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
+        n_sub = 0
         timer = _TileTimer(self.session)
         tracker = _progress_tracker(self, n_base, skip)
+        pipe = TP.TilePipe(self.session, TP.effective_window(
+            self.session.config, self._platform))
         feed = _tile_feed(self.shape.stream, self.session,
-                          self.tile_rows, skip_rows=skip)
+                          self.tile_rows, skip_rows=skip,
+                          min_depth=pipe.window)
+
+        def _verified(d):
+            # host effects for ONE drained-clean tile, in stream order
+            # and in the legacy sequence: progress, then the K-tile
+            # checkpoint tick (a staged payload when the submit saw the
+            # boundary coming; the live accumulator at window=1, where
+            # drain is synchronous and acc IS this tile's state)
+            nonlocal n_local
+            tile_k, staged = d.payload
+            n_local = tile_k
+            tracker.step(tile_k)
+            if ctx is not None:
+                ctx.tick(tile_k, staged if staged is not None
+                         else (lambda: R.acc_payload(acc)))
+
         try:
             for tile, tile_n in feed:
                 fault_point("tile_step")
                 fault_point("tile_device_lost")
-                with timer.step(n_base + n_local):
+                n_sub += 1
+                stage = (ctx is not None and pipe.window > 1
+                         and ctx.snapshot_due(n_sub))
+                with timer.step(n_base + n_sub - 1):
                     acc, checks = step_fn(resident, prelude, tile,
                                           jnp.asarray(tile_n,
                                                       dtype=jnp.int32),
                                           acc)
-                    _raise_tile_checks(checks, n_base + n_local)
-                n_local += 1
-                tracker.step(n_local)
-                if ctx is not None:
-                    ctx.tick(n_local, lambda: R.acc_payload(acc))
+                    staged = TP.stage_checkpoint(acc) if stage else None
+                    drained = pipe.submit(n_base + n_sub - 1, checks,
+                                          (n_sub, staged))
+                for d in drained:
+                    _verified(d)
+            for d in pipe.drain_all():
+                _verified(d)
         finally:
             # deterministic teardown on EVERY exit (cancel, overflow
             # retry, device loss): the reader joins and staged tiles
-            # release — no orphan thread, no pinned prefetch buffers
+            # release — no orphan thread, no pinned prefetch buffers;
+            # abandoned in-flight launches just complete into garbage-
+            # collected buffers (nothing to join on the device side)
+            if pipe.deferred_fail:
+                self._deferred_fail = True
             SP.close_feed(feed)
         SP.stamp_report(self.report, feed)
         n_tiles = n_base + n_local
         timer.stamp(self.report)
+        pipe.stamp(self.report)
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
             empty = _empty_tile(self.shape.stream, self.tile_rows)
             acc, checks = step_fn(resident, prelude, empty,
@@ -1186,9 +1252,9 @@ class TopNTiledExecutable(TiledExecutable):
             out = {f.name: cols[f.name] for f in shape.root.fields}
             return out, sel, low.checks
 
-        donate = () if self._platform == "cpu" else (4,)
         self._compiled = (jax.jit(prelude_fn),
-                          jax.jit(step_fn, donate_argnums=donate),
+                          jax.jit(step_fn, donate_argnums=TP.step_donation(
+                              self._platform)),
                           jax.jit(finalize_fn))
         return self._compiled
 
@@ -1223,7 +1289,10 @@ class SortTiledExecutable(TiledExecutable):
             "acc_capacity": 0,
             "est_step_bytes": est + _merge_bytes(shape),
             "est_pipeline_bytes": SP.queue_charge_bytes(
-                shape.stream, self.tile_rows, self.session.config),
+                shape.stream, self.tile_rows, self.session.config)
+            + TP.window_charge_bytes(
+                shape.stream, self.tile_rows, self.session.config,
+                self._platform),
             "est_bufpool_bytes": _bufpool_charge(
                 self.session, shape.stream.table_name),
             "budget_bytes": self.budget,
@@ -1283,33 +1352,56 @@ class SortTiledExecutable(TiledExecutable):
         skip = ctx.skip_rows if ctx is not None else 0
         n_base = ctx.tiles_base if ctx is not None else 0
         n_local = 0
+        n_sub = 0
         timer = _TileTimer(self.session)
         tracker = _progress_tracker(self, n_base, skip)
+        pipe = TP.TilePipe(self.session, TP.effective_window(
+            self.session.config, self._platform))
         feed = _tile_feed(shape.stream, self.session,
-                          self.tile_rows, skip_rows=skip)
+                          self.tile_rows, skip_rows=skip,
+                          min_depth=pipe.window)
+
+        def _verified(d):
+            # one drained-clean tile: the run-store appends happen HERE
+            # (materializing the async copies started at submit), so the
+            # host collects tile k's rows while tiles k+1..k+W-1 compute;
+            # the checkpoint payload is the runs themselves — host state,
+            # exactly as of this tile, no staging needed
+            nonlocal n_local
+            tile_k, pcols, psel, keys = d.payload
+            n_local = tile_k
+            tracker.step(tile_k)
+            mask = np.asarray(psel)
+            for nm in names:
+                runs[nm].append(np.asarray(pcols[nm])[mask])
+            for i, k in enumerate(keys):
+                key_runs[i].append(np.asarray(k)[mask])
+            if ctx is not None:
+                ctx.tick(tile_k,
+                         lambda: R.runs_payload(runs, key_runs))
+
         try:
             for tile, tile_n in feed:
                 fault_point("tile_step")
                 fault_point("tile_device_lost")
-                with timer.step(n_base + n_local):
+                n_sub += 1
+                with timer.step(n_base + n_sub - 1):
                     (pcols, psel, keys), checks = step_fn(
                         resident, prelude, tile,
                         jnp.asarray(tile_n, dtype=jnp.int32))
-                    _raise_tile_checks(checks, n_base + n_local)
-                n_local += 1
-                tracker.step(n_local)
-                mask = np.asarray(psel)
-                for nm in names:
-                    runs[nm].append(np.asarray(pcols[nm])[mask])
-                for i, k in enumerate(keys):
-                    key_runs[i].append(np.asarray(k)[mask])
-                if ctx is not None:
-                    ctx.tick(n_local,
-                             lambda: R.runs_payload(runs, key_runs))
+                    drained = pipe.submit(n_base + n_sub - 1, checks,
+                                          (n_sub, pcols, psel, keys))
+                for d in drained:
+                    _verified(d)
+            for d in pipe.drain_all():
+                _verified(d)
         finally:
+            if pipe.deferred_fail:
+                self._deferred_fail = True
             SP.close_feed(feed)
         SP.stamp_report(self.report, feed)
         timer.stamp(self.report)
+        pipe.stamp(self.report)
 
         fault_point("tiled_finalize")
         from cloudberry_tpu.lifecycle import check_cancel
@@ -1494,7 +1586,7 @@ def _empty_tile(scan: N.PScan, tile_rows: int) -> dict:
 
 
 def _tile_feed(scan: N.PScan, session, tile_rows: int,
-               skip_rows: int = 0):
+               skip_rows: int = 0, min_depth: int = 1):
     """The single-node tile feed: (tile dict of padded arrays, n_valid)
     items, wrapped in the asynchronous scan pipeline when
     ``config.scan_pipeline`` enables it (exec/scanpipe.py — prefetch +
@@ -1511,8 +1603,11 @@ def _tile_feed(scan: N.PScan, session, tile_rows: int,
         gen = _store_tiles(scan, session, tile_rows, skip_rows, stats)
     else:
         gen = _ram_tiles(scan, session, tile_rows, skip_rows)
+    # min_depth: the dispatch window (exec/tilepipe.py) keeps up to W
+    # tiles in flight — a prefetch queue shallower than W would starve
+    # the window it exists to feed
     return SP.maybe_pipeline(gen, session.config, device_stage=True,
-                             stats=stats)
+                             stats=stats, min_depth=min_depth)
 
 
 def _ram_tiles(scan: N.PScan, session, tile_rows: int,

@@ -46,6 +46,7 @@ from cloudberry_tpu.exec import bufferpool as BUF
 from cloudberry_tpu.exec import executor as X
 from cloudberry_tpu.exec import kernels as K
 from cloudberry_tpu.exec import scanpipe as SP
+from cloudberry_tpu.exec import tilepipe as TP
 from cloudberry_tpu.exec.dist_executor import (DistLowerer, _local_row,
                                                _shard_map,
                                                prepare_dist_inputs)
@@ -583,13 +584,16 @@ class DistTiledExecutable(AdaptiveTiledMixin):
             "acc_capacity": shape.g_cap,
             "est_step_bytes": est + _merge_bytes(shape),
             "est_finalize_bytes": _finalize_bytes(shape, self.nseg),
-            # scan-pipeline staging charge (exec/scanpipe.py): the
-            # bounded prefetch queue pins prefetch_tiles × one
-            # (nseg, tile_rows) host tile — obs/capacity.record_tiled
-            # adds it to the statement's observed peak
+            # scan-pipeline staging charge (exec/scanpipe.py) plus the
+            # dispatch window's extra in-flight (nseg, tile_rows) tiles
+            # (exec/tilepipe.py) — obs/capacity.record_tiled adds both
+            # to the statement's observed peak
             "est_pipeline_bytes": SP.queue_charge_bytes(
                 shape.stream, self.tile_rows, self.session.config,
-                nseg=self.nseg),
+                nseg=self.nseg)
+            + TP.window_charge_bytes(
+                shape.stream, self.tile_rows, self.session.config,
+                jax.default_backend(), nseg=self.nseg),
             # buffer-pool residency for the streamed table's packed
             # feed tiles (exec/bufferpool.py; host-side here —
             # shard_map owns device placement on the distributed path)
@@ -725,9 +729,7 @@ class DistTiledExecutable(AdaptiveTiledMixin):
     def _jit_step(self, step_seg, mesh, res_specs):
         step_in = (res_specs, P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS),
                    P(SEG_AXIS))
-        # donate the accumulator so the step updates in place on device;
-        # CPU XLA can't always honor donation — skip the warning noise
-        donate = () if jax.default_backend() == "cpu" else (4,)
+        donate = TP.step_donation(jax.default_backend())
         # third output: per-motion (required-bucket, per-destination
         # rows) telemetry pairs — psum/pmax replicated, so P() like the
         # checks; the skew sentinel consumes them host-side
@@ -812,32 +814,70 @@ class DistTiledExecutable(AdaptiveTiledMixin):
         timer = _TileTimer(self.session)
         tracker = _dist_progress_tracker(self, feed, n_base)
         sentinel = SkewSentinel(self, self._stat_motions(), ctx)
+        pipe = TP.TilePipe(self.session, TP.effective_window(
+            self.session.config, jax.default_backend()))
         # prefetch pipeline over the per-segment feed (exec/scanpipe.py:
         # host staging only — shard_map owns device placement); the
         # tracker/checkpoint math reads the UNWRAPPED feed above, and
         # progress counts consumed tiles, never staged ones
-        stream = SP.maybe_pipeline(iter(feed), self.session.config)
+        stream = SP.maybe_pipeline(iter(feed), self.session.config,
+                                   min_depth=pipe.window)
+        n_sub = 0
+
+        def _verified(d):
+            # host effects for one drained-clean tile, in stream order
+            nonlocal n_local
+            tile_k, staged, srows = d.payload
+            n_local = tile_k
+            if srows is not None:
+                sentinel.observe(srows)
+            tracker.step(tile_k)
+            if ctx is not None:
+                ctx.tick(tile_k, staged if staged is not None
+                         else (lambda: R.acc_payload(acc)))
+
+        def _settle():
+            # drain every dispatched tile so the replan snapshot's acc
+            # (the newest) matches the settled tile count
+            for d in pipe.drain_all():
+                _verified(d)
+            return n_sub
+
         try:
             for tile, tile_ns in stream:
                 fault_point("tile_step_dist")
                 fault_point("tile_device_lost")
-                with timer.step(n_base + n_local):
+                n_sub += 1
+                stage = (ctx is not None and pipe.window > 1
+                         and ctx.snapshot_due(n_sub))
+                with timer.step(n_base + n_sub - 1):
                     acc, checks, srows = step_fn(resident, prelude, tile,
                                                  tile_ns, acc)
-                    _raise_tile_checks(checks, n_base + n_local)
-                n_local += 1
-                sentinel.observe(srows)
-                tracker.step(n_local)
-                if ctx is not None:
-                    ctx.tick(n_local, lambda: R.acc_payload(acc))
+                    staged = TP.stage_checkpoint(acc) if stage else None
+                    drained = pipe.submit(
+                        n_base + n_sub - 1, checks,
+                        (n_sub, staged,
+                         srows if sentinel.collect else None))
+                for d in drained:
+                    _verified(d)
                 # AFTER the cadence tick: an alarm at a tick tile reuses
                 # that snapshot instead of saving twice
                 sentinel.maybe_replan(n_local,
+                                      lambda: R.acc_payload(acc),
+                                      settle=_settle)
+            for d in pipe.drain_all():
+                _verified(d)
+            if pipe.window > 1:
+                # the tail's observes may alarm after the feed ended
+                sentinel.maybe_replan(n_local,
                                       lambda: R.acc_payload(acc))
         finally:
+            if pipe.deferred_fail:
+                self._deferred_fail = True
             SP.close_feed(stream)
         SP.stamp_report(self.report, stream)
         timer.stamp(self.report)
+        pipe.stamp(self.report)
         sentinel.fold_final()
         n_tiles = n_base + n_local
         if n_tiles == 0:  # empty stream: one all-masked tile seeds the acc
@@ -1046,33 +1086,54 @@ class DistSortTiledExecutable(DistTiledExecutable):
 
         timer = _TileTimer(self.session)
         tracker = _dist_progress_tracker(self, feed, n_base)
+        pipe = TP.TilePipe(self.session, TP.effective_window(
+            self.session.config, jax.default_backend()))
         # same pipeline wrap as the agg-mode loop: staging off the
         # critical path, consumed-tile accounting unchanged
-        stream = SP.maybe_pipeline(iter(feed), self.session.config)
+        stream = SP.maybe_pipeline(iter(feed), self.session.config,
+                                   min_depth=pipe.window)
+        n_sub = 0
+
+        def _verified(d):
+            # materialize one drained-clean tile's run slices, in
+            # stream order (the async D2H started at submit); host runs
+            # are exactly as-of the drained tile, so no staging needed
+            nonlocal n_local
+            tile_k, pcols, psel, keys = d.payload
+            n_local = tile_k
+            tracker.step(tile_k)
+            selnp = np.asarray(psel)
+            for s in range(self.nseg):
+                m = selnp[s]
+                for nm in names:
+                    runs[nm].append(np.asarray(pcols[nm][s])[m])
+                for i, k in enumerate(keys):
+                    key_runs[i].append(np.asarray(k[s])[m])
+            if ctx is not None:
+                ctx.tick(tile_k,
+                         lambda: R.runs_payload(runs, key_runs))
+
         try:
             for tile, tile_ns in stream:
                 fault_point("tile_step_dist")
                 fault_point("tile_device_lost")
-                with timer.step(n_base + n_local):
+                n_sub += 1
+                with timer.step(n_base + n_sub - 1):
                     (pcols, psel, keys), checks = step_fn(
                         resident, prelude, tile, tile_ns)
-                    _raise_tile_checks(checks, n_base + n_local)
-                n_local += 1
-                tracker.step(n_local)
-                selnp = np.asarray(psel)
-                for s in range(self.nseg):
-                    m = selnp[s]
-                    for nm in names:
-                        runs[nm].append(np.asarray(pcols[nm][s])[m])
-                    for i, k in enumerate(keys):
-                        key_runs[i].append(np.asarray(k[s])[m])
-                if ctx is not None:
-                    ctx.tick(n_local,
-                             lambda: R.runs_payload(runs, key_runs))
+                    drained = pipe.submit(n_base + n_sub - 1, checks,
+                                          (n_sub, pcols, psel, keys))
+                for d in drained:
+                    _verified(d)
+            for d in pipe.drain_all():
+                _verified(d)
         finally:
+            if pipe.deferred_fail:
+                self._deferred_fail = True
             SP.close_feed(stream)
         SP.stamp_report(self.report, stream)
         timer.stamp(self.report)
+        pipe.stamp(self.report)
         from cloudberry_tpu.exec.tiled import merge_sorted_runs
 
         cols, karr = merge_sorted_runs(runs, key_runs,

@@ -111,13 +111,18 @@ SEAM_LOOP_MODULES = (
     "exec/tiled_dist.py",
     "exec/recovery.py",
     "exec/scanpipe.py",
+    "exec/tilepipe.py",
     "storage/ingest.py",
     "storage/compact.py",
 )
 
-# calls that count as a cancellation seam inside a loop body
+# calls that count as a cancellation seam inside a loop body;
+# drain_one/drain_all route every drained tile through
+# _raise_tile_checks, so the windowed dispatcher's drain loops poll
+# cancellation once per verified tile
 CANCEL_SEAM_CALLS = frozenset({
     "check_cancel", "raise_if_cancelled", "_raise_tile_checks", "check",
+    "drain_one", "drain_all",
 })
 
 # modules whose wire-response dict literals the taxonomy pass audits

@@ -171,6 +171,20 @@ def record_tiled(log, report: dict) -> None:
     observe_stmt_bytes(log, max(peak, fin) + pipe + bufp)
 
 
+def record_tile_dispatch(log, report: dict) -> None:
+    """POST-run gauge for the windowed tile dispatcher
+    (exec/tilepipe.py): the statement's in-flight high-water mark,
+    read off the freshly stamped report — record_tiled above runs at
+    DISPATCH time when the report still carries the previous run's
+    numbers. window=1 (the legacy loop) writes nothing, so the gauge
+    only exists where a window was actually open."""
+    if log is None or not getattr(log, "obs_enabled", False):
+        return
+    if int(report.get("tile_window", 1)) > 1:
+        log.registry.gauge_max("tile_inflight",
+                               float(report.get("inflight_depth", 0)))
+
+
 # --------------------------------------------------------- memory gauges
 
 

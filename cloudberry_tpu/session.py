@@ -828,7 +828,11 @@ class Session:
                 obs_bytes=max(int(report.get("est_step_bytes", 0)),
                               int(report.get("est_finalize_bytes", 0))),
                 cfg=cfg_plan)
-        return self._obs_launch(texe.run)
+        out = self._obs_launch(texe.run)
+        from cloudberry_tpu.obs import capacity as OC
+
+        OC.record_tile_dispatch(self.stmt_log, texe.report)
+        return out
 
     def _any_external(self, names) -> bool:
         # foreign (FDW) and directory tables count: their rows change

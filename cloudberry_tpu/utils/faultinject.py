@@ -80,6 +80,11 @@ INVENTORY = frozenset({
     # tiled execution + recovery
     "tile_step", "tile_step_dist", "tiled_finalize",
     "ckpt_save", "ckpt_resume", "tile_device_lost",
+    # windowed tile dispatch (exec/tilepipe.py): enqueue fires as a
+    # tile's step enters the in-flight window, drain fires as its
+    # control scalars are forced — 'error'/'sleep' here torture the
+    # deferred-failure replay and the drain stall accounting
+    "tile_enqueue", "tile_drain",
     # asynchronous scan pipeline (exec/scanpipe.py): the prefetch
     # reader's per-tile seam and the per-partition decode seam
     "scan_prefetch", "scan_decode",

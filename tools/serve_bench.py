@@ -127,7 +127,11 @@ CSV_HEADER = ("mode,mix,clients,duration_s,requests,qps,p50_ms,p99_ms,"
               # recovery_ms carries restart-to-first-answer wall clock
               # and acked_lost MUST be 0 (acked writes survive the
               # kill). Normal bench rows report acked_lost=0.
-              "acked_lost")
+              "acked_lost,"
+              # ISSUE 20 (windowed tile dispatch, exec/tilepipe.py):
+              # checks that fired after newer tiles were already in
+              # flight, and the window replays those deferrals cost
+              "tile_deferred_overflows,tile_window_replays")
 
 
 def parse_tenantspec(spec: str, clients: int):
@@ -556,6 +560,8 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     rd_before = session.stmt_log.counter("rung_downgrades")
     ia_before = session.stmt_log.counter("ingest_appends")
     cc_before = session.stmt_log.counter("compact_chunks")
+    do_before = session.stmt_log.counter("tile_deferred_overflows")
+    wr_before = session.stmt_log.counter("tile_window_replays")
 
     _MISS_ETYPES = ("StatementTimeout", "StatementCancelled",
                     "SchedDeadline")
@@ -784,6 +790,11 @@ def run_mode(mode: str, mix: str, clients: int, duration_s: float,
     fh = reg.hist("ingest_flush_seconds") or {}
     out["flush_ms_p95"] = round(fh.get("p95", 0.0) * 1000, 3)
     out["compact_chunks"] = disp.counter("compact_chunks") - cc_before
+    # windowed tile dispatch columns (ISSUE 20)
+    out["tile_deferred_overflows"] = (
+        disp.counter("tile_deferred_overflows") - do_before)
+    out["tile_window_replays"] = (
+        disp.counter("tile_window_replays") - wr_before)
     dmax = 0
     if session.store is not None and mix == "readwrite":
         from cloudberry_tpu.storage.compact import delta_parts
